@@ -1,0 +1,30 @@
+"""TPU-native framework with the capabilities of
+``jonathanhchoi/llm-interpretation-replication`` (the replication package for
+"Off-the-Shelf Large Language Models Are Unreliable Judges").
+
+The reference (see /root/reference, SURVEY.md) runs three empirical studies via a
+serial HuggingFace/PyTorch/CUDA logprob loop plus vendor API pipelines.  This
+package re-designs that stack TPU-first:
+
+- ``models``        Flax causal-LM zoo (Falcon, GPT-NeoX family, BLOOM, Mistral,
+                    OPT, T5 enc-dec) + HF checkpoint converters.
+- ``ops``           XLA/Pallas compute ops: fused attention, yes/no logprob
+                    extraction, weighted-confidence digit reconstruction.
+- ``parallel``      device meshes, GSPMD sharding rules (dp/tp/sp), ring
+                    attention, multi-host init, collective helpers.
+- ``runtime``       HBM-resident parameter loading, bucketed batching, jit'd
+                    score/train steps, sweep executor.
+- ``scoring``       the behavioral core replacing ``get_yes_no_logprobs``
+                    (reference: analysis/run_base_vs_instruct_100q.py:279-392).
+- ``sweeps``        perturbation / 100q / base-vs-instruct / 8-model sweeps with
+                    manifest checkpoint-resume and schema-exact CSV/XLSX writers.
+- ``stats``         normality, truncated-normal, bootstrap, kappa, correlation,
+                    compliance, similarity, power engines (reference L4).
+- ``survey``        human-survey pipeline (reference survey_analysis/).
+- ``api_backends``  OpenAI/Anthropic/Gemini sync + batch clients (stdlib HTTP).
+- ``gen``           perturbation generators (rephrasings, irrelevant insertions).
+- ``utils``         xlsx IO (no openpyxl), retry, logging, caching.
+- ``native``        C components (Levenshtein kernel et al.) built via cc.
+"""
+
+__version__ = "0.1.0"

@@ -1,0 +1,21 @@
+from .checkpoint import CheckpointFile, ProcessedSet
+from .logging import Progress, SessionLogger
+from .retry import RateLimiter, RetryPolicy, retry_with_exponential_backoff
+from .telemetry import clear_host_memory, device_memory_summary, get_memory_usage
+from .xlsx import append_xlsx, read_xlsx, write_xlsx
+
+__all__ = [
+    "CheckpointFile",
+    "ProcessedSet",
+    "Progress",
+    "SessionLogger",
+    "RateLimiter",
+    "RetryPolicy",
+    "retry_with_exponential_backoff",
+    "clear_host_memory",
+    "device_memory_summary",
+    "get_memory_usage",
+    "append_xlsx",
+    "read_xlsx",
+    "write_xlsx",
+]

@@ -1,0 +1,91 @@
+"""Sweep checkpoint / resume primitives.
+
+The reference persists resume state three ways (SURVEY.md §4):
+  - JSON ``{completed_models, results}`` after each model
+    (run_base_vs_instruct_100q.py:265-276),
+  - pickled sets of processed ``(model, scenario, perturbation_id)`` triples
+    (evaluate_irrelevant_perturbations.py:89-162),
+  - skip-sets re-derived from the output workbook (perturb_prompts.py:161-188).
+
+Here: one atomic-JSON ``CheckpointFile`` plus a ``ProcessedSet`` of idempotency
+keys usable for all three patterns (keys are JSON-encoded tuples, so no pickle).
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Any, Iterable, Optional
+
+
+def _atomic_write_json(path: str, obj: Any) -> None:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    tmp = path + ".tmp"
+    with open(tmp, "w") as f:
+        json.dump(obj, f, indent=2, default=str)
+    os.replace(tmp, path)
+
+
+class CheckpointFile:
+    """Atomic JSON checkpoint with a default payload on first load."""
+
+    def __init__(self, path: str, default: Optional[dict] = None):
+        self.path = path
+        self.default = default or {}
+
+    def load(self) -> dict:
+        if os.path.exists(self.path):
+            with open(self.path) as f:
+                return json.load(f)
+        return json.loads(json.dumps(self.default))
+
+    def save(self, state: dict) -> None:
+        _atomic_write_json(self.path, state)
+
+    def clear(self) -> None:
+        if os.path.exists(self.path):
+            os.remove(self.path)
+
+
+class ProcessedSet:
+    """Persistent set of idempotency keys (tuples of str/int)."""
+
+    def __init__(self, path: Optional[str] = None):
+        self.path = path
+        self._keys = set()
+        if path and os.path.exists(path):
+            with open(path) as f:
+                self._keys = {tuple(k) for k in json.load(f)}
+
+    @staticmethod
+    def _norm(key) -> tuple:
+        # Scalar keys (e.g. a bare model name, the reference's
+        # ``completed_models`` pattern) become 1-tuples; only real sequences
+        # are treated as composite keys.
+        if isinstance(key, (str, bytes, int, float)):
+            return (key,)
+        return tuple(key)
+
+    def __contains__(self, key) -> bool:
+        return self._norm(key) in self._keys
+
+    def __len__(self) -> int:
+        return len(self._keys)
+
+    def add(self, key, flush: bool = True) -> None:
+        self._keys.add(self._norm(key))
+        if flush and self.path:
+            self.flush()
+
+    def update(self, keys, flush: bool = True) -> None:
+        for k in keys:
+            self._keys.add(self._norm(k))
+        if flush and self.path:
+            self.flush()
+
+    def flush(self) -> None:
+        if self.path:
+            # Sort by JSON repr: stable output even when keys mix types at the
+            # same tuple position (plain sorted() would raise TypeError).
+            keys = sorted((list(k) for k in self._keys), key=lambda k: json.dumps(k, default=str))
+            _atomic_write_json(self.path, keys)

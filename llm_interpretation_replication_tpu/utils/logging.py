@@ -1,0 +1,79 @@
+"""Session logging + progress telemetry.
+
+The reference logs with bare ``print`` plus a ``log_print`` that tees to a
+session file (/root/reference/analysis/compare_instruct_models.py:20-40) and
+writes ad-hoc progress JSON (evaluate_irrelevant_perturbations.py:111-128).
+Here: one ``SessionLogger`` (stdout + optional file tee) and a ``Progress``
+tracker that persists a JSON heartbeat for external monitoring.
+"""
+
+from __future__ import annotations
+
+import datetime as _dt
+import json
+import os
+import sys
+import threading
+import time
+from typing import Optional
+
+
+class SessionLogger:
+    def __init__(self, log_file: Optional[str] = None, stream=None):
+        self._stream = stream or sys.stdout
+        self._file = None
+        self._lock = threading.Lock()
+        if log_file:
+            os.makedirs(os.path.dirname(os.path.abspath(log_file)), exist_ok=True)
+            self._file = open(log_file, "a", encoding="utf-8")
+
+    def log(self, *parts, timestamp: bool = False) -> None:
+        msg = " ".join(str(p) for p in parts)
+        if timestamp:
+            msg = f"[{_dt.datetime.now().isoformat(timespec='seconds')}] {msg}"
+        with self._lock:
+            print(msg, file=self._stream, flush=True)
+            if self._file:
+                self._file.write(msg + "\n")
+                self._file.flush()
+
+    __call__ = log
+
+    def close(self) -> None:
+        if self._file:
+            self._file.close()
+            self._file = None
+
+
+class Progress:
+    """Persistent progress heartbeat: counts, rate, ETA, arbitrary extras."""
+
+    def __init__(self, total: int, path: Optional[str] = None, clock=time.monotonic):
+        self.total = total
+        self.done = 0
+        self.path = path
+        self._clock = clock
+        self._start = clock()
+        self._lock = threading.Lock()
+
+    def update(self, n: int = 1, **extras) -> dict:
+        with self._lock:
+            self.done += n
+            elapsed = max(self._clock() - self._start, 1e-9)
+            rate = self.done / elapsed
+            snapshot = {
+                "done": self.done,
+                "total": self.total,
+                "elapsed_sec": round(elapsed, 3),
+                "rate_per_sec": round(rate, 6),
+                "eta_sec": round((self.total - self.done) / rate, 3) if rate else None,
+                **extras,
+            }
+            if self.path:
+                parent = os.path.dirname(os.path.abspath(self.path))
+                os.makedirs(parent, exist_ok=True)
+                tmp = self.path + ".tmp"
+                with open(tmp, "w") as f:
+                    json.dump(snapshot, f, indent=2)
+                os.replace(tmp, self.path)
+            return snapshot

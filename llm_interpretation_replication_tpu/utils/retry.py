@@ -1,0 +1,92 @@
+"""Retry / backoff primitives.
+
+Behavioral spec from the reference's ``retry_with_exponential_backoff``
+(/root/reference/analysis/perturb_prompts.py:72-106): up to 10 retries, initial
+delay 60 s doubling to a 300 s cap, multiplicative jitter in [0.8, 1.2], retry
+on rate-limit/transient errors, re-raise after exhaustion.  Here it is a
+decorator factory with injectable sleep/rng so tests run instantly.
+"""
+
+from __future__ import annotations
+
+import functools
+import random
+import time
+from dataclasses import dataclass, field
+from typing import Callable, Tuple, Type
+
+
+@dataclass
+class RetryPolicy:
+    max_retries: int = 10
+    initial_delay: float = 60.0
+    max_delay: float = 300.0
+    exponential_base: float = 2.0
+    jitter: Tuple[float, float] = (0.8, 1.2)
+    retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+    sleep: Callable[[float], None] = field(default=time.sleep)
+    rng: random.Random = field(default_factory=random.Random)
+
+    def delay_for_attempt(self, attempt: int) -> float:
+        base = min(self.initial_delay * self.exponential_base**attempt, self.max_delay)
+        lo, hi = self.jitter
+        return base * self.rng.uniform(lo, hi)
+
+
+def retry_with_exponential_backoff(policy: RetryPolicy | None = None, **overrides):
+    """Decorator: retry the wrapped callable per ``policy``.
+
+    ``retry_with_exponential_backoff()`` with no args reproduces the reference
+    defaults.  Keyword overrides build a fresh policy.
+    """
+    if callable(policy) and not isinstance(policy, RetryPolicy):
+        # Bare-decorator form: @retry_with_exponential_backoff with no call.
+        fn, policy = policy, RetryPolicy()
+        return retry_with_exponential_backoff(policy)(fn)
+    if policy is None:
+        policy = RetryPolicy(**overrides)
+    elif overrides:
+        raise ValueError("pass either a policy or overrides, not both")
+
+    def decorator(fn):
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            last_err = None
+            for attempt in range(policy.max_retries + 1):
+                try:
+                    return fn(*args, **kwargs)
+                except policy.retry_on as err:  # noqa: PERF203
+                    last_err = err
+                    if attempt == policy.max_retries:
+                        break
+                    policy.sleep(policy.delay_for_attempt(attempt))
+            raise last_err
+
+        return wrapper
+
+    return decorator
+
+
+class RateLimiter:
+    """Token-bucket rate limiter (reference: ``RateLimitTracker``
+    perturb_prompts_gemini.py:43-78 and ``rate_limit_wait``
+    perturb_prompts_gemini_parallel.py:30-64).  Thread-safe."""
+
+    def __init__(self, requests_per_second: float, clock=time.monotonic, sleep=time.sleep):
+        import threading
+
+        self._interval = 1.0 / requests_per_second
+        self._clock = clock
+        self._sleep = sleep
+        self._lock = threading.Lock()
+        self._next_slot = clock()
+
+    def acquire(self) -> float:
+        """Block until a request slot is available; return the wait incurred."""
+        with self._lock:
+            now = self._clock()
+            wait = max(0.0, self._next_slot - now)
+            self._next_slot = max(now, self._next_slot) + self._interval
+        if wait:
+            self._sleep(wait)
+        return wait

@@ -1,0 +1,57 @@
+"""Host/device telemetry.
+
+TPU-native replacement for the reference's GPU memory manager
+(``clear_memory``/``get_memory_usage`` — compare_instruct_models.py:66-101,
+run_base_vs_instruct_100q.py:245-262): JAX arrays are freed by dropping
+references (no ``empty_cache`` dance), so the useful pieces are RAM/disk
+telemetry, per-device HBM stats from ``device.memory_stats()``, and explicit
+buffer donation in the jitted steps (handled in runtime/).
+"""
+
+from __future__ import annotations
+
+import gc
+import shutil
+from typing import Optional
+
+
+def get_memory_usage() -> str:
+    """Human-readable host RAM / disk / device HBM summary string."""
+    parts = []
+    try:
+        import psutil
+
+        vm = psutil.virtual_memory()
+        parts.append(f"RAM: {vm.used / 1e9:.1f}/{vm.total / 1e9:.1f} GB ({vm.percent}%)")
+    except Exception:
+        pass
+    try:
+        du = shutil.disk_usage("/")
+        parts.append(f"Disk: {du.used / 1e9:.1f}/{du.total / 1e9:.1f} GB")
+    except Exception:
+        pass
+    parts.append(device_memory_summary() or "HBM: n/a")
+    return " | ".join(parts)
+
+
+def device_memory_summary() -> Optional[str]:
+    try:
+        import jax
+
+        stats = []
+        for d in jax.local_devices():
+            ms = d.memory_stats() or {}
+            used = ms.get("bytes_in_use")
+            limit = ms.get("bytes_limit")
+            if used is not None:
+                lim = f"/{limit / 1e9:.1f}" if limit else ""
+                stats.append(f"{d.platform}:{d.id} {used / 1e9:.2f}{lim} GB")
+        return "HBM: " + ", ".join(stats) if stats else None
+    except Exception:
+        return None
+
+
+def clear_host_memory() -> None:
+    """Release python garbage; JAX device buffers free with their references."""
+    for _ in range(3):
+        gc.collect()

@@ -1,0 +1,204 @@
+"""Minimal, dependency-free .xlsx reader/writer.
+
+The reference writes its sweep results to Excel workbooks (e.g.
+``results_30_multi_model.xlsx`` — /root/reference/analysis/perturb_prompts.py:964-1066)
+and every analysis script reads them back with pandas.  This image has no
+``openpyxl``, so we implement the OOXML subset we need directly: a workbook is a
+zip of XML parts; we emit inline strings (no sharedStrings table) and parse both
+inline and shared strings on read.
+
+Public API:
+    write_xlsx(df, path, sheet_name="Sheet1")
+    read_xlsx(path, sheet=0) -> pandas.DataFrame
+    append_xlsx(df, path)    -> read existing + concat + rewrite (the reference's
+                                incremental-append pattern, perturb_prompts_claude.py:250-253)
+"""
+
+from __future__ import annotations
+
+import math
+import re
+import zipfile
+from xml.etree import ElementTree as ET
+from xml.sax.saxutils import escape
+
+import numpy as np
+import pandas as pd
+
+_NS = "{http://schemas.openxmlformats.org/spreadsheetml/2006/main}"
+
+_CONTENT_TYPES = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Types xmlns="http://schemas.openxmlformats.org/package/2006/content-types">
+<Default Extension="rels" ContentType="application/vnd.openxmlformats-package.relationships+xml"/>
+<Default Extension="xml" ContentType="application/xml"/>
+<Override PartName="/xl/workbook.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.sheet.main+xml"/>
+<Override PartName="/xl/worksheets/sheet1.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.worksheet+xml"/>
+</Types>
+"""
+
+_RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
+<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/officeDocument/2006/relationships/officeDocument" Target="xl/workbook.xml"/>
+</Relationships>
+"""
+
+_WORKBOOK = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<workbook xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main" xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/relationships">
+<sheets><sheet name="{name}" sheetId="1" r:id="rId1"/></sheets>
+</workbook>
+"""
+
+_WORKBOOK_RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
+<Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
+<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/officeDocument/2006/relationships/worksheet" Target="worksheets/sheet1.xml"/>
+</Relationships>
+"""
+
+# Characters illegal in XML 1.0 (except tab/newline/CR) — strip on write.
+_ILLEGAL_XML = re.compile("[\x00-\x08\x0b\x0c\x0e-\x1f]")
+
+
+def _col_letter(idx: int) -> str:
+    """0-based column index -> A1-style letters."""
+    out = ""
+    idx += 1
+    while idx:
+        idx, rem = divmod(idx - 1, 26)
+        out = chr(ord("A") + rem) + out
+    return out
+
+
+def _cell_xml(ref: str, value) -> str:
+    if value is None:
+        return ""
+    if isinstance(value, float) and math.isnan(value):
+        return ""
+    if isinstance(value, (bool, np.bool_)):
+        return f'<c r="{ref}" t="b"><v>{int(value)}</v></c>'
+    if isinstance(value, (int, np.integer)):
+        return f'<c r="{ref}"><v>{int(value)}</v></c>'
+    if isinstance(value, (float, np.floating)):
+        if math.isinf(value):
+            # Excel has no inf literal; store as string like pandas/openpyxl repr
+            text = "inf" if value > 0 else "-inf"
+            return f'<c r="{ref}" t="inlineStr"><is><t>{text}</t></is></c>'
+        return f'<c r="{ref}"><v>{float(value)!r}</v></c>'
+    text = escape(_ILLEGAL_XML.sub("", str(value)))
+    return f'<c r="{ref}" t="inlineStr"><is><t xml:space="preserve">{text}</t></is></c>'
+
+
+def write_xlsx(df: pd.DataFrame, path, sheet_name: str = "Sheet1") -> None:
+    rows_xml = []
+    header_cells = "".join(
+        _cell_xml(f"{_col_letter(c)}1", col) for c, col in enumerate(df.columns)
+    )
+    rows_xml.append(f'<row r="1">{header_cells}</row>')
+    for r, (_, row) in enumerate(df.iterrows(), start=2):
+        cells = "".join(
+            _cell_xml(f"{_col_letter(c)}{r}", v) for c, v in enumerate(row.tolist())
+        )
+        rows_xml.append(f'<row r="{r}">{cells}</row>')
+    sheet = (
+        '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
+        '<worksheet xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main">'
+        f'<sheetData>{"".join(rows_xml)}</sheetData></worksheet>'
+    )
+    with zipfile.ZipFile(path, "w", zipfile.ZIP_DEFLATED) as zf:
+        zf.writestr("[Content_Types].xml", _CONTENT_TYPES)
+        zf.writestr("_rels/.rels", _RELS)
+        zf.writestr("xl/workbook.xml", _WORKBOOK.format(name=escape(sheet_name[:31])))
+        zf.writestr("xl/_rels/workbook.xml.rels", _WORKBOOK_RELS)
+        zf.writestr("xl/worksheets/sheet1.xml", sheet)
+
+
+def _parse_shared_strings(zf: zipfile.ZipFile):
+    try:
+        data = zf.read("xl/sharedStrings.xml")
+    except KeyError:
+        return []
+    root = ET.fromstring(data)
+    strings = []
+    for si in root.findall(f"{_NS}si"):
+        strings.append("".join(t.text or "" for t in si.iter(f"{_NS}t")))
+    return strings
+
+
+def _cell_ref_to_col(ref: str) -> int:
+    col = 0
+    for ch in ref:
+        if ch.isalpha():
+            col = col * 26 + (ord(ch.upper()) - ord("A") + 1)
+        else:
+            break
+    return col - 1
+
+
+def _coerce_number(text: str):
+    try:
+        f = float(text)
+    except ValueError:
+        return text
+    if f.is_integer() and "." not in text and "e" not in text.lower():
+        return int(f)
+    return f
+
+
+def read_xlsx(path, sheet: int = 0) -> pd.DataFrame:
+    with zipfile.ZipFile(path) as zf:
+        shared = _parse_shared_strings(zf)
+        sheet_names = sorted(
+            (n for n in zf.namelist() if re.match(r"xl/worksheets/sheet\d+\.xml$", n)),
+            key=lambda n: int(re.search(r"(\d+)\.xml$", n).group(1)),
+        )
+        if not sheet_names:
+            raise ValueError(f"no worksheets in {path}")
+        root = ET.fromstring(zf.read(sheet_names[sheet]))
+    raw_rows = []
+    max_col = 0
+    for row in root.iter(f"{_NS}row"):
+        cells = {}
+        for c in row.findall(f"{_NS}c"):
+            ref = c.get("r", "")
+            col = _cell_ref_to_col(ref) if ref else len(cells)
+            ctype = c.get("t", "n")
+            value = None
+            if ctype == "inlineStr":
+                is_el = c.find(f"{_NS}is")
+                if is_el is not None:
+                    value = "".join(t.text or "" for t in is_el.iter(f"{_NS}t"))
+            else:
+                v = c.find(f"{_NS}v")
+                if v is not None and v.text is not None:
+                    if ctype == "s":
+                        value = shared[int(v.text)]
+                    elif ctype == "b":
+                        value = bool(int(v.text))
+                    elif ctype == "str":
+                        value = v.text
+                    else:
+                        value = _coerce_number(v.text)
+            cells[col] = value
+            max_col = max(max_col, col + 1)
+        raw_rows.append(cells)
+    if not raw_rows:
+        return pd.DataFrame()
+    header = [raw_rows[0].get(i) for i in range(max_col)]
+    header = [h if h is not None else f"Unnamed: {i}" for i, h in enumerate(header)]
+    data = [[r.get(i) for i in range(max_col)] for r in raw_rows[1:]]
+    df = pd.DataFrame(data, columns=header)
+    # Mirror pandas.read_excel dtype behavior: numeric columns become float when
+    # they contain missing values.
+    return df.infer_objects()
+
+
+def append_xlsx(df: pd.DataFrame, path) -> pd.DataFrame:
+    """Concatenate ``df`` onto an existing workbook (if any) and rewrite it."""
+    import os
+
+    if os.path.exists(path):
+        existing = read_xlsx(path)
+        combined = pd.concat([existing, df], ignore_index=True) if len(existing) else df
+    else:
+        combined = df
+    write_xlsx(combined, path)
+    return combined

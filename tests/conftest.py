@@ -1,0 +1,33 @@
+"""Test harness configuration.
+
+All tests run on a virtual 8-device CPU mesh (the standard JAX substitute for
+multi-chip hardware — SURVEY.md §4): JAX_PLATFORMS=cpu with
+``--xla_force_host_platform_device_count=8``.  These env vars must be set
+before jax initializes, hence the module-level assignments here.
+"""
+
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+_flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in _flags:
+    os.environ["XLA_FLAGS"] = (
+        _flags + " --xla_force_host_platform_device_count=8"
+    ).strip()
+# Keep HF offline: zero-egress image, tests build tiny local models only.
+os.environ.setdefault("HF_HUB_OFFLINE", "1")
+os.environ.setdefault("TRANSFORMERS_OFFLINE", "1")
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import pytest  # noqa: E402
+
+
+@pytest.fixture(scope="session")
+def eight_cpu_devices():
+    import jax
+
+    devices = jax.devices()
+    assert len(devices) == 8, f"expected 8 virtual cpu devices, got {devices}"
+    return devices

@@ -1,0 +1,147 @@
+import math
+import random
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from llm_interpretation_replication_tpu.utils import (
+    CheckpointFile,
+    ProcessedSet,
+    RateLimiter,
+    RetryPolicy,
+    append_xlsx,
+    read_xlsx,
+    retry_with_exponential_backoff,
+    write_xlsx,
+)
+
+
+class TestXlsx:
+    def test_roundtrip_mixed_types(self, tmp_path):
+        df = pd.DataFrame(
+            {
+                "Model": ["gpt-4.1", "claude", "gémini ü"],
+                "Token_1_Prob": [0.123456789, 0.0, 1.0],
+                "Confidence Value": [85, 0, 100],
+                "Odds_Ratio": [1.5, float("inf"), float("nan")],
+                "Model Response": ["Yes", "No <tag> & 'quote'", ""],
+            }
+        )
+        path = tmp_path / "out.xlsx"
+        write_xlsx(df, path)
+        back = read_xlsx(path)
+        assert list(back.columns) == list(df.columns)
+        assert back["Model"].tolist() == df["Model"].tolist()
+        np.testing.assert_allclose(
+            back["Token_1_Prob"].astype(float), df["Token_1_Prob"], rtol=1e-12
+        )
+        assert back["Confidence Value"].tolist() == [85, 0, 100]
+        assert back.loc[1, "Odds_Ratio"] == "inf"
+        assert back.loc[2, "Odds_Ratio"] is None or (
+            isinstance(back.loc[2, "Odds_Ratio"], float)
+            and math.isnan(back.loc[2, "Odds_Ratio"])
+        )
+        assert back.loc[1, "Model Response"] == "No <tag> & 'quote'"
+
+    def test_append(self, tmp_path):
+        path = tmp_path / "acc.xlsx"
+        append_xlsx(pd.DataFrame({"a": [1, 2]}), path)
+        combined = append_xlsx(pd.DataFrame({"a": [3]}), path)
+        assert combined["a"].tolist() == [1, 2, 3]
+        assert read_xlsx(path)["a"].tolist() == [1, 2, 3]
+
+    def test_readable_by_pandas_schema_columns(self, tmp_path):
+        # The reference's perturbation workbook schema (SURVEY.md §2.8 /
+        # perturb_prompts.py:966-969) must survive a write/read cycle verbatim.
+        cols = [
+            "Model", "Original Main Part", "Response Format", "Confidence Format",
+            "Rephrased Main Part", "Full Rephrased Prompt", "Full Confidence Prompt",
+            "Model Response", "Model Confidence Response", "Log Probabilities",
+            "Token_1_Prob", "Token_2_Prob", "Odds_Ratio", "Confidence Value",
+            "Weighted Confidence",
+        ]
+        df = pd.DataFrame([{c: f"v_{i}" for i, c in enumerate(cols)}])
+        path = tmp_path / "schema.xlsx"
+        write_xlsx(df, path)
+        assert list(read_xlsx(path).columns) == cols
+
+
+class TestRetry:
+    def test_retries_then_succeeds(self):
+        sleeps = []
+        policy = RetryPolicy(
+            max_retries=5,
+            initial_delay=60.0,
+            sleep=sleeps.append,
+            rng=random.Random(0),
+        )
+        calls = {"n": 0}
+
+        @retry_with_exponential_backoff(policy)
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 4:
+                raise RuntimeError("rate limit")
+            return "ok"
+
+        assert flaky() == "ok"
+        assert calls["n"] == 4
+        assert len(sleeps) == 3
+        # Reference behavior: 60 s doubling, capped at 300 s, jitter 0.8-1.2.
+        assert 48 <= sleeps[0] <= 72
+        assert 96 <= sleeps[1] <= 144
+        assert 192 <= sleeps[2] <= 288
+
+    def test_exhaustion_reraises(self):
+        policy = RetryPolicy(max_retries=2, sleep=lambda s: None)
+
+        @retry_with_exponential_backoff(policy)
+        def always_fails():
+            raise ValueError("boom")
+
+        with pytest.raises(ValueError):
+            always_fails()
+
+    def test_delay_cap(self):
+        policy = RetryPolicy(rng=random.Random(1), sleep=lambda s: None)
+        assert policy.delay_for_attempt(10) <= 300 * 1.2
+
+    def test_rate_limiter_spacing(self):
+        t = {"now": 0.0}
+        waits = []
+
+        def clock():
+            return t["now"]
+
+        def sleep(s):
+            waits.append(s)
+            t["now"] += s
+
+        rl = RateLimiter(2.0, clock=clock, sleep=sleep)  # 0.5 s interval
+        for _ in range(3):
+            rl.acquire()
+        assert waits == pytest.approx([0.5, 0.5], abs=1e-9) or sum(waits) == pytest.approx(1.0)
+
+
+class TestCheckpoint:
+    def test_checkpoint_file_roundtrip(self, tmp_path):
+        ck = CheckpointFile(str(tmp_path / "ck.json"), default={"completed_models": [], "results": []})
+        state = ck.load()
+        assert state == {"completed_models": [], "results": []}
+        state["completed_models"].append("falcon-7b")
+        ck.save(state)
+        assert ck.load()["completed_models"] == ["falcon-7b"]
+        ck.clear()
+        assert ck.load() == {"completed_models": [], "results": []}
+
+    def test_processed_set_persistence(self, tmp_path):
+        path = str(tmp_path / "keys.json")
+        ps = ProcessedSet(path)
+        ps.add(("gpt-4.1", "scenario_1", 17))
+        ps.update([("claude", "scenario_2", 3), ("claude", "scenario_2", 4)])
+        reloaded = ProcessedSet(path)
+        assert ("gpt-4.1", "scenario_1", 17) in reloaded
+        assert ("claude", "scenario_2", 4) in reloaded
+        assert ("claude", "scenario_2", 5) not in reloaded
+        assert len(reloaded) == 3
