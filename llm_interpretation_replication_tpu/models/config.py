@@ -1,0 +1,266 @@
+"""Model architecture configs.
+
+One ``DecoderConfig`` parameterizes every decoder-only family the reference
+sweeps (SURVEY.md §2.2 model rosters): GPT-NeoX (StableLM-alpha, RedPajama-
+INCITE, Pythia, Dolly-v2, h2ogpt), Falcon, BLOOM(Z), Mistral, LLaMA-2 (also
+covers Baichuan2-7B and Qwen-7B modulo flags), and OPT (opt-iml).  T5-style
+encoder-decoders (T0, tk-instruct, Flan-T5) use ``T5Config``.
+
+The reference loads these via HF ``AutoModelForCausalLM`` with
+``device_map="auto"`` + bitsandbytes int8 (run_base_vs_instruct_100q.py:414-451);
+here a config is a static, hashable pytree-free dataclass so jit caches one
+executable per architecture.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Tuple
+
+
+@dataclasses.dataclass(frozen=True)
+class DecoderConfig:
+    vocab_size: int
+    hidden_size: int
+    num_layers: int
+    num_heads: int
+    # Grouped/multi-query attention: Falcon-7B uses 1 kv head (MQA), Mistral 8
+    # (GQA), everyone else num_heads.
+    num_kv_heads: Optional[int] = None
+    head_dim: Optional[int] = None
+    intermediate_size: Optional[int] = None
+
+    # Position encoding: "rotary" | "alibi" | "learned"
+    position_embedding: str = "rotary"
+    rotary_pct: float = 1.0          # GPT-NeoX applies RoPE to a fraction of head_dim
+    rope_theta: float = 10000.0
+    max_position_embeddings: int = 2048
+    learned_pos_offset: int = 0      # OPT stores positions with a +2 offset
+
+    # Block structure
+    parallel_residual: bool = False  # GPT-NeoX/Falcon: attn and mlp both read x
+    shared_layernorm: bool = False   # Falcon-7B: one LN feeds both attn and mlp
+    norm_type: str = "layernorm"     # "layernorm" | "rmsnorm"
+    norm_eps: float = 1e-5
+    embedding_layernorm: bool = False  # BLOOM: LN right after the embedding
+
+    # Projections
+    qkv_bias: bool = True
+    out_bias: bool = True
+    mlp_bias: bool = True
+    fused_qkv: bool = False           # informational: conversion handles layouts
+    # MLP: "mlp" (fc->act->proj) | "gated" (SwiGLU-style gate*up->proj)
+    mlp_type: str = "mlp"
+    activation: str = "gelu"          # "gelu" | "gelu_new" | "silu" | "relu"
+
+    sliding_window: Optional[int] = None  # Mistral local attention window
+    tie_word_embeddings: bool = False
+    final_norm: bool = True
+    logit_scale: float = 1.0
+
+    def __post_init__(self):
+        if self.num_kv_heads is None:
+            object.__setattr__(self, "num_kv_heads", self.num_heads)
+        if self.head_dim is None:
+            object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
+        if self.intermediate_size is None:
+            object.__setattr__(self, "intermediate_size", 4 * self.hidden_size)
+
+
+@dataclasses.dataclass(frozen=True)
+class T5Config:
+    """Encoder-decoder config for the T0/tk-instruct/Flan-T5 scoring leg
+    (reference scores the *first decoder token* — compare_instruct_models.py:178-225)."""
+
+    vocab_size: int
+    d_model: int
+    num_layers: int          # encoder layers
+    num_decoder_layers: int
+    num_heads: int
+    d_kv: int
+    d_ff: int
+    relative_attention_num_buckets: int = 32
+    relative_attention_max_distance: int = 128
+    norm_eps: float = 1e-6
+    # T5 v1.1 / T0 use gated-gelu; original T5 uses relu
+    feed_forward_proj: str = "gated-gelu"
+    tie_word_embeddings: bool = False
+    decoder_start_token_id: int = 0
+
+
+# ---------------------------------------------------------------------------
+# Family presets → HF config translation
+# ---------------------------------------------------------------------------
+
+def neox_config(hf) -> DecoderConfig:
+    """GPT-NeoX: Pythia/Dolly, StableLM-alpha, RedPajama-INCITE, h2ogpt."""
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        intermediate_size=hf.intermediate_size,
+        position_embedding="rotary",
+        rotary_pct=getattr(hf, "rotary_pct", 0.25),
+        rope_theta=getattr(hf, "rotary_emb_base", 10000.0),
+        max_position_embeddings=hf.max_position_embeddings,
+        parallel_residual=getattr(hf, "use_parallel_residual", True),
+        norm_eps=hf.layer_norm_eps,
+        qkv_bias=True,
+        out_bias=True,
+        mlp_bias=True,
+        fused_qkv=True,
+        activation=_act(getattr(hf, "hidden_act", "gelu")),
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+    )
+
+
+def falcon_config(hf) -> DecoderConfig:
+    """Falcon-7B(-Instruct): MQA, parallel attention, shared LN, no biases."""
+    new_arch = getattr(hf, "new_decoder_architecture", False)
+    if new_arch:
+        # new arch (falcon-40b/180b): num_kv_heads is authoritative
+        num_kv = getattr(hf, "num_kv_heads", None) or hf.num_attention_heads
+    else:
+        # old arch (falcon-7b): multi_query governs; HF's num_kv_heads attr
+        # defaults to num_attention_heads and is NOT used by the torch model
+        num_kv = 1 if getattr(hf, "multi_query", True) else hf.num_attention_heads
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        num_kv_heads=num_kv,
+        intermediate_size=getattr(hf, "ffn_hidden_size", 4 * hf.hidden_size),
+        position_embedding="alibi" if getattr(hf, "alibi", False) else "rotary",
+        rope_theta=getattr(hf, "rope_theta", 10000.0),
+        max_position_embeddings=getattr(hf, "max_position_embeddings", 2048),
+        parallel_residual=getattr(hf, "parallel_attn", True),
+        shared_layernorm=getattr(hf, "parallel_attn", True) and not new_arch,
+        norm_eps=hf.layer_norm_epsilon,
+        qkv_bias=getattr(hf, "bias", False),
+        out_bias=getattr(hf, "bias", False),
+        mlp_bias=getattr(hf, "bias", False),
+        fused_qkv=True,
+        activation="gelu",
+        tie_word_embeddings=True,
+    )
+
+
+def bloom_config(hf) -> DecoderConfig:
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.n_layer,
+        num_heads=hf.n_head,
+        intermediate_size=4 * hf.hidden_size,
+        position_embedding="alibi",
+        max_position_embeddings=getattr(hf, "seq_length", 2048),
+        embedding_layernorm=True,
+        norm_eps=hf.layer_norm_epsilon,
+        qkv_bias=True,
+        out_bias=True,
+        mlp_bias=True,
+        fused_qkv=True,
+        activation="gelu_new",
+        tie_word_embeddings=True,
+    )
+
+
+def llama_config(hf) -> DecoderConfig:
+    """LLaMA-2 / Mistral / Baichuan2-7B / Qwen-style: RMSNorm + SwiGLU + RoPE."""
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        num_kv_heads=getattr(hf, "num_key_value_heads", hf.num_attention_heads),
+        head_dim=getattr(hf, "head_dim", None) or hf.hidden_size // hf.num_attention_heads,
+        intermediate_size=hf.intermediate_size,
+        position_embedding="rotary",
+        rope_theta=getattr(hf, "rope_theta", 10000.0),
+        max_position_embeddings=hf.max_position_embeddings,
+        norm_type="rmsnorm",
+        norm_eps=hf.rms_norm_eps,
+        qkv_bias=getattr(hf, "attention_bias", False),
+        out_bias=False,
+        mlp_bias=getattr(hf, "mlp_bias", False),
+        mlp_type="gated",
+        activation=_act(hf.hidden_act),
+        sliding_window=getattr(hf, "sliding_window", None),
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+    )
+
+
+def opt_config(hf) -> DecoderConfig:
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        intermediate_size=hf.ffn_dim,
+        position_embedding="learned",
+        learned_pos_offset=2,
+        max_position_embeddings=hf.max_position_embeddings,
+        norm_eps=1e-5,
+        qkv_bias=True,
+        out_bias=True,
+        mlp_bias=True,
+        activation=_act(hf.activation_function),
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", True),
+    )
+
+
+def t5_config(hf) -> T5Config:
+    return T5Config(
+        vocab_size=hf.vocab_size,
+        d_model=hf.d_model,
+        num_layers=hf.num_layers,
+        num_decoder_layers=getattr(hf, "num_decoder_layers", hf.num_layers),
+        num_heads=hf.num_heads,
+        d_kv=hf.d_kv,
+        d_ff=hf.d_ff,
+        relative_attention_num_buckets=hf.relative_attention_num_buckets,
+        relative_attention_max_distance=getattr(hf, "relative_attention_max_distance", 128),
+        norm_eps=hf.layer_norm_epsilon,
+        feed_forward_proj="gated-gelu" if getattr(hf, "is_gated_act", False) else _act(hf.dense_act_fn),
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", True),
+        decoder_start_token_id=hf.decoder_start_token_id or 0,
+    )
+
+
+def _act(name: str) -> str:
+    return {
+        "gelu": "gelu",
+        "gelu_new": "gelu_new",
+        "gelu_fast": "gelu_new",
+        "gelu_pytorch_tanh": "gelu_new",
+        "silu": "silu",
+        "swish": "silu",
+        "relu": "relu",
+    }[name]
+
+
+#: HF ``model_type`` → (family name, config translator)
+FAMILY_BY_MODEL_TYPE = {
+    "gpt_neox": ("neox", neox_config),
+    "falcon": ("falcon", falcon_config),
+    "RefinedWeb": ("falcon", falcon_config),
+    "RefinedWebModel": ("falcon", falcon_config),
+    "bloom": ("bloom", bloom_config),
+    "llama": ("llama", llama_config),
+    "mistral": ("llama", llama_config),
+    "qwen2": ("llama", llama_config),
+    "baichuan": ("llama", llama_config),
+    "opt": ("opt", opt_config),
+    "t5": ("t5", t5_config),
+}
+
+
+def from_hf_config(hf) -> Tuple[str, object]:
+    """Map a HF ``PretrainedConfig`` to (family, our config)."""
+    mt = getattr(hf, "model_type", None)
+    if mt not in FAMILY_BY_MODEL_TYPE:
+        raise ValueError(f"unsupported model_type {mt!r}")
+    family, translate = FAMILY_BY_MODEL_TYPE[mt]
+    return family, translate(hf)

@@ -1,0 +1,322 @@
+"""HF checkpoint → param-pytree converters.
+
+The reference loads checkpoints via ``AutoModelForCausalLM.from_pretrained``
+with accelerate/bitsandbytes (run_base_vs_instruct_100q.py:414-451).  Here a
+checkpoint is converted into the stacked-layer pytree documented in
+models/decoder.py: per-family weight-name maps, fused-QKV de-interleaving, and
+[out,in] → [in,out] transposes (torch Linear stores W as [out,in]; our matmuls
+are ``x @ W``).
+
+Converters read from any ``get(name) -> np.ndarray`` source so the same code
+serves torch state dicts (tests) and streamed safetensors shards (runtime/loader).
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict
+
+import numpy as np
+
+from .config import DecoderConfig
+
+Getter = Callable[[str], np.ndarray]
+
+
+def _stack(arrays):
+    return np.stack(arrays, axis=0)
+
+
+def _linear(get: Getter, name: str) -> np.ndarray:
+    return np.ascontiguousarray(get(f"{name}.weight").T)
+
+
+def _maybe(get: Getter, name: str):
+    try:
+        return get(name)
+    except KeyError:
+        return None
+
+
+def _ln(get: Getter, name: str, layers=None, bias=True):
+    if layers is None:
+        out = {"scale": get(f"{name}.weight")}
+        if bias:
+            out["bias"] = get(f"{name}.bias")
+        return out
+    out = {"scale": _stack([get(f"{name.format(i=i)}.weight") for i in layers])}
+    if bias:
+        out["bias"] = _stack([get(f"{name.format(i=i)}.bias") for i in layers])
+    return out
+
+
+def _split_neox_qkv(w: np.ndarray, b, n_heads: int, head_dim: int):
+    """GPT-NeoX / BLOOM fused QKV: rows are head-major, [q(D); k(D); v(D)] per
+    head.  w: [3*N*D, H] -> (wq, wk, wv) each [H, N*D]."""
+    h = w.shape[1]
+    w4 = w.reshape(n_heads, 3, head_dim, h)
+    outs = []
+    for j in range(3):
+        outs.append(np.ascontiguousarray(w4[:, j].reshape(n_heads * head_dim, h).T))
+    if b is None:
+        return outs, (None, None, None)
+    b4 = b.reshape(n_heads, 3, head_dim)
+    bs = [np.ascontiguousarray(b4[:, j].reshape(n_heads * head_dim)) for j in range(3)]
+    return outs, bs
+
+
+def _split_falcon_qkv(w: np.ndarray, b, n_heads: int, n_kv: int, head_dim: int):
+    """Falcon fused QKV.
+    - old arch / MQA (falcon-7b): rows = [q(N*D); k(D); v(D)].
+    - new arch / GQA: rows grouped per kv group: [q(g*D); k(D); v(D)] × n_kv.
+    """
+    h = w.shape[1]
+    if n_kv == n_heads:
+        # fully multi-head fused like neox? Falcon new arch with multi_query
+        # false and kv==heads groups each q with its own kv.
+        g = 1
+    else:
+        g = n_heads // n_kv
+    wg = w.reshape(n_kv, g + 2, head_dim, h)
+    wq = np.ascontiguousarray(wg[:, :g].reshape(n_heads * head_dim, h).T)
+    wk = np.ascontiguousarray(wg[:, g].reshape(n_kv * head_dim, h).T)
+    wv = np.ascontiguousarray(wg[:, g + 1].reshape(n_kv * head_dim, h).T)
+    if b is None:
+        return (wq, wk, wv), (None, None, None)
+    bg = b.reshape(n_kv, g + 2, head_dim)
+    return (wq, wk, wv), (
+        bg[:, :g].reshape(-1),
+        bg[:, g].reshape(-1),
+        bg[:, g + 1].reshape(-1),
+    )
+
+
+def _attn_params(wq, wk, wv, wo, bq=None, bk=None, bv=None, bo=None):
+    out = {"wq": wq, "wk": wk, "wv": wv, "wo": wo}
+    if bq is not None:
+        out.update(bq=bq, bk=bk, bv=bv)
+    if bo is not None:
+        out["bo"] = bo
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Per-family converters
+# ---------------------------------------------------------------------------
+
+def convert_neox(get: Getter, cfg: DecoderConfig) -> Dict:
+    L = range(cfg.num_layers)
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in L:
+        (wq, wk, wv), (bq, bk, bv) = _split_neox_qkv(
+            get(f"gpt_neox.layers.{i}.attention.query_key_value.weight"),
+            get(f"gpt_neox.layers.{i}.attention.query_key_value.bias"),
+            cfg.num_heads,
+            cfg.head_dim,
+        )
+        qs.append(wq); ks.append(wk); vs.append(wv)
+        bqs.append(bq); bks.append(bk); bvs.append(bv)
+    params = {
+        "embed": {"tokens": get("gpt_neox.embed_in.weight")},
+        "layers": {
+            "ln1": _ln(get, "gpt_neox.layers.{i}.input_layernorm", L),
+            "ln2": _ln(get, "gpt_neox.layers.{i}.post_attention_layernorm", L),
+            "attn": {
+                "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+                "wo": _stack([_linear(get, f"gpt_neox.layers.{i}.attention.dense") for i in L]),
+                "bo": _stack([get(f"gpt_neox.layers.{i}.attention.dense.bias") for i in L]),
+            },
+            "mlp": {
+                "wi": _stack([_linear(get, f"gpt_neox.layers.{i}.mlp.dense_h_to_4h") for i in L]),
+                "bi": _stack([get(f"gpt_neox.layers.{i}.mlp.dense_h_to_4h.bias") for i in L]),
+                "wo": _stack([_linear(get, f"gpt_neox.layers.{i}.mlp.dense_4h_to_h") for i in L]),
+                "bo": _stack([get(f"gpt_neox.layers.{i}.mlp.dense_4h_to_h.bias") for i in L]),
+            },
+        },
+        "final_ln": _ln(get, "gpt_neox.final_layer_norm"),
+        "lm_head": np.ascontiguousarray(get("embed_out.weight").T),
+    }
+    return params
+
+
+def convert_falcon(get: Getter, cfg: DecoderConfig) -> Dict:
+    L = range(cfg.num_layers)
+    qs, ks, vs = [], [], []
+    for i in L:
+        (wq, wk, wv), _ = _split_falcon_qkv(
+            get(f"transformer.h.{i}.self_attention.query_key_value.weight"),
+            None,
+            cfg.num_heads,
+            cfg.num_kv_heads,
+            cfg.head_dim,
+        )
+        qs.append(wq); ks.append(wk); vs.append(wv)
+    ln1_name = (
+        "transformer.h.{i}.input_layernorm"
+        if _maybe(get, "transformer.h.0.input_layernorm.weight") is not None
+        else "transformer.h.{i}.ln_attn"
+    )
+    layers = {
+        "ln1": _ln(get, ln1_name, L),
+        "attn": {
+            "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+            "wo": _stack([_linear(get, f"transformer.h.{i}.self_attention.dense") for i in L]),
+        },
+        "mlp": {
+            "wi": _stack([_linear(get, f"transformer.h.{i}.mlp.dense_h_to_4h") for i in L]),
+            "wo": _stack([_linear(get, f"transformer.h.{i}.mlp.dense_4h_to_h") for i in L]),
+        },
+    }
+    if not cfg.shared_layernorm:
+        layers["ln2"] = _ln(get, "transformer.h.{i}.ln_mlp", L)
+    params = {
+        "embed": {"tokens": get("transformer.word_embeddings.weight")},
+        "layers": layers,
+        "final_ln": _ln(get, "transformer.ln_f"),
+    }
+    head = _maybe(get, "lm_head.weight")
+    if head is not None and not cfg.tie_word_embeddings:
+        params["lm_head"] = np.ascontiguousarray(head.T)
+    return params
+
+
+def convert_bloom(get: Getter, cfg: DecoderConfig) -> Dict:
+    L = range(cfg.num_layers)
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in L:
+        (wq, wk, wv), (bq, bk, bv) = _split_neox_qkv(
+            get(f"transformer.h.{i}.self_attention.query_key_value.weight"),
+            get(f"transformer.h.{i}.self_attention.query_key_value.bias"),
+            cfg.num_heads,
+            cfg.head_dim,
+        )
+        qs.append(wq); ks.append(wk); vs.append(wv)
+        bqs.append(bq); bks.append(bk); bvs.append(bv)
+    params = {
+        "embed": {
+            "tokens": get("transformer.word_embeddings.weight"),
+            "ln": _ln(get, "transformer.word_embeddings_layernorm"),
+        },
+        "layers": {
+            "ln1": _ln(get, "transformer.h.{i}.input_layernorm", L),
+            "ln2": _ln(get, "transformer.h.{i}.post_attention_layernorm", L),
+            "attn": {
+                "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+                "wo": _stack([_linear(get, f"transformer.h.{i}.self_attention.dense") for i in L]),
+                "bo": _stack([get(f"transformer.h.{i}.self_attention.dense.bias") for i in L]),
+            },
+            "mlp": {
+                "wi": _stack([_linear(get, f"transformer.h.{i}.mlp.dense_h_to_4h") for i in L]),
+                "bi": _stack([get(f"transformer.h.{i}.mlp.dense_h_to_4h.bias") for i in L]),
+                "wo": _stack([_linear(get, f"transformer.h.{i}.mlp.dense_4h_to_h") for i in L]),
+                "bo": _stack([get(f"transformer.h.{i}.mlp.dense_4h_to_h.bias") for i in L]),
+            },
+        },
+        "final_ln": _ln(get, "transformer.ln_f"),
+    }
+    return params
+
+
+def convert_llama(get: Getter, cfg: DecoderConfig) -> Dict:
+    L = range(cfg.num_layers)
+    attn = {
+        "wq": _stack([_linear(get, f"model.layers.{i}.self_attn.q_proj") for i in L]),
+        "wk": _stack([_linear(get, f"model.layers.{i}.self_attn.k_proj") for i in L]),
+        "wv": _stack([_linear(get, f"model.layers.{i}.self_attn.v_proj") for i in L]),
+        "wo": _stack([_linear(get, f"model.layers.{i}.self_attn.o_proj") for i in L]),
+    }
+    if cfg.qkv_bias:  # Qwen-style
+        attn["bq"] = _stack([get(f"model.layers.{i}.self_attn.q_proj.bias") for i in L])
+        attn["bk"] = _stack([get(f"model.layers.{i}.self_attn.k_proj.bias") for i in L])
+        attn["bv"] = _stack([get(f"model.layers.{i}.self_attn.v_proj.bias") for i in L])
+    params = {
+        "embed": {"tokens": get("model.embed_tokens.weight")},
+        "layers": {
+            "ln1": _ln(get, "model.layers.{i}.input_layernorm", L, bias=False),
+            "ln2": _ln(get, "model.layers.{i}.post_attention_layernorm", L, bias=False),
+            "attn": attn,
+            "mlp": {
+                "wg": _stack([_linear(get, f"model.layers.{i}.mlp.gate_proj") for i in L]),
+                "wi": _stack([_linear(get, f"model.layers.{i}.mlp.up_proj") for i in L]),
+                "wo": _stack([_linear(get, f"model.layers.{i}.mlp.down_proj") for i in L]),
+            },
+        },
+        "final_ln": _ln(get, "model.norm", bias=False),
+    }
+    head = _maybe(get, "lm_head.weight")
+    if head is not None and not cfg.tie_word_embeddings:
+        params["lm_head"] = np.ascontiguousarray(head.T)
+    return params
+
+
+def convert_opt(get: Getter, cfg: DecoderConfig) -> Dict:
+    L = range(cfg.num_layers)
+    pre = "model.decoder"
+    params = {
+        "embed": {
+            "tokens": get(f"{pre}.embed_tokens.weight"),
+            # HF stores the +2 offset inside the table; decoder.forward adds
+            # cfg.learned_pos_offset back to positions.
+            "pos": get(f"{pre}.embed_positions.weight"),
+        },
+        "layers": {
+            "ln1": _ln(get, pre + ".layers.{i}.self_attn_layer_norm", L),
+            "ln2": _ln(get, pre + ".layers.{i}.final_layer_norm", L),
+            "attn": {
+                "wq": _stack([_linear(get, f"{pre}.layers.{i}.self_attn.q_proj") for i in L]),
+                "wk": _stack([_linear(get, f"{pre}.layers.{i}.self_attn.k_proj") for i in L]),
+                "wv": _stack([_linear(get, f"{pre}.layers.{i}.self_attn.v_proj") for i in L]),
+                "bq": _stack([get(f"{pre}.layers.{i}.self_attn.q_proj.bias") for i in L]),
+                "bk": _stack([get(f"{pre}.layers.{i}.self_attn.k_proj.bias") for i in L]),
+                "bv": _stack([get(f"{pre}.layers.{i}.self_attn.v_proj.bias") for i in L]),
+                "wo": _stack([_linear(get, f"{pre}.layers.{i}.self_attn.out_proj") for i in L]),
+                "bo": _stack([get(f"{pre}.layers.{i}.self_attn.out_proj.bias") for i in L]),
+            },
+            "mlp": {
+                "wi": _stack([_linear(get, f"{pre}.layers.{i}.fc1") for i in L]),
+                "bi": _stack([get(f"{pre}.layers.{i}.fc1.bias") for i in L]),
+                "wo": _stack([_linear(get, f"{pre}.layers.{i}.fc2") for i in L]),
+                "bo": _stack([get(f"{pre}.layers.{i}.fc2.bias") for i in L]),
+            },
+        },
+        "final_ln": _ln(get, f"{pre}.final_layer_norm"),
+    }
+    return params
+
+
+CONVERTERS = {
+    "neox": convert_neox,
+    "falcon": convert_falcon,
+    "bloom": convert_bloom,
+    "llama": convert_llama,
+    "opt": convert_opt,
+}
+
+
+def convert(family: str, get: Getter, cfg: DecoderConfig, dtype=None) -> Dict:
+    """Convert a checkpoint to our pytree; optionally cast to ``dtype``."""
+    params = CONVERTERS[family](get, cfg)
+    if dtype is not None:
+        import jax.numpy as jnp
+
+        params = _cast_tree(params, dtype, jnp)
+    return params
+
+
+def _cast_tree(tree, dtype, jnp):
+    if isinstance(tree, dict):
+        return {k: _cast_tree(v, dtype, jnp) for k, v in tree.items()}
+    return jnp.asarray(tree, dtype=dtype)
+
+
+def getter_from_torch_state_dict(state_dict) -> Getter:
+    """Adapt a torch ``state_dict`` (tests use tiny HF models)."""
+
+    def get(name: str) -> np.ndarray:
+        if name not in state_dict:
+            raise KeyError(name)
+        t = state_dict[name]
+        return t.detach().to("cpu").float().numpy()
+
+    return get

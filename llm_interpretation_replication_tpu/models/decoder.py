@@ -1,0 +1,435 @@
+"""Decoder-only transformer forward pass — pure-functional JAX.
+
+TPU-first re-design of the reference's HF/CUDA inference path
+(run_base_vs_instruct_100q.py:279-392, compare_instruct_models.py:171-293):
+instead of per-prompt ``model.generate`` crossing the Python↔device boundary
+every token, the whole model is one jit-compiled function over a padded batch.
+
+Design notes (see SURVEY.md §7):
+- Layer parameters are **stacked along a leading L axis** and the block loop is
+  a ``lax.scan`` — one compiled block body regardless of depth, fast XLA
+  compiles, and clean GSPMD sharding (the L axis is never sharded).
+- Multi/grouped-query attention is native (Falcon MQA num_kv=1, Mistral GQA 8).
+- Rotary (NeoX partial-dim and LLaMA full-dim, rotate-half convention), ALiBi
+  (BLOOM), and learned positions (OPT, +2 offset) are all supported.
+- Attention softmax and the final logits run in fp32 regardless of the compute
+  dtype; matmuls run in the params' dtype (bf16 on TPU) to stay on the MXU.
+- Greedy decode keeps a static-shaped KV cache and runs under ``lax.scan`` so
+  the 50-token generation of the reference is one device program.
+
+Param pytree layout (converters in models/convert.py produce exactly this):
+    embed/tokens            [V, H]
+    embed/pos               [P, H]            (learned positions only)
+    embed/ln/{scale,bias}   [H]               (BLOOM embedding layernorm)
+    layers/ln1/{scale,bias} [L, H]
+    layers/ln2/{scale,bias} [L, H]            (absent when shared_layernorm)
+    layers/attn/{wq,wk,wv}  [L, H, N*D]/[L, H, Nkv*D]  (+ bq,bk,bv)
+    layers/attn/wo          [L, N*D, H]       (+ bo)
+    layers/mlp/wi           [L, H, F]  (+bi)  ("mlp") | wg/wi/wo ("gated")
+    layers/mlp/wo           [L, F, H]  (+bo)
+    final_ln/{scale,bias}   [H]
+    lm_head                 [H, V]            (absent when tie_word_embeddings)
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+from .config import DecoderConfig
+
+NEG_INF = -1e9  # mask value; large but finite so fp32 softmax stays NaN-free
+
+
+# ---------------------------------------------------------------------------
+# Primitives
+# ---------------------------------------------------------------------------
+
+def layer_norm(x, scale, bias, eps):
+    x32 = x.astype(jnp.float32)
+    mu = jnp.mean(x32, axis=-1, keepdims=True)
+    var = jnp.mean(jnp.square(x32 - mu), axis=-1, keepdims=True)
+    out = (x32 - mu) * lax.rsqrt(var + eps) * scale.astype(jnp.float32)
+    if bias is not None:
+        out = out + bias.astype(jnp.float32)
+    return out.astype(x.dtype)
+
+
+def rms_norm(x, scale, eps):
+    x32 = x.astype(jnp.float32)
+    var = jnp.mean(jnp.square(x32), axis=-1, keepdims=True)
+    return (x32 * lax.rsqrt(var + eps) * scale.astype(jnp.float32)).astype(x.dtype)
+
+
+def _norm(cfg: DecoderConfig, x, p):
+    if cfg.norm_type == "rmsnorm":
+        return rms_norm(x, p["scale"], cfg.norm_eps)
+    return layer_norm(x, p["scale"], p.get("bias"), cfg.norm_eps)
+
+
+def activation(name: str, x):
+    if name == "gelu":
+        return jax.nn.gelu(x, approximate=False)
+    if name == "gelu_new":
+        return jax.nn.gelu(x, approximate=True)
+    if name == "silu":
+        return jax.nn.silu(x)
+    if name == "relu":
+        return jax.nn.relu(x)
+    raise ValueError(name)
+
+
+def rotary_embedding(positions, dim: int, theta: float, dtype=jnp.float32):
+    """Return (sin, cos) of shape [..., dim/2] for the given positions."""
+    inv_freq = 1.0 / (theta ** (jnp.arange(0, dim, 2, dtype=jnp.float32) / dim))
+    angles = positions[..., None].astype(jnp.float32) * inv_freq  # [..., dim/2]
+    return jnp.sin(angles).astype(dtype), jnp.cos(angles).astype(dtype)
+
+
+def apply_rotary(x, sin, cos, rotary_dim: int):
+    """Rotate-half RoPE on the first ``rotary_dim`` dims of the head axis.
+
+    x: [B, S, N, D]; sin/cos: [B, S, rotary_dim/2] (broadcast over heads).
+    """
+    rot, pass_ = x[..., :rotary_dim], x[..., rotary_dim:]
+    half = rotary_dim // 2
+    x1, x2 = rot[..., :half], rot[..., half:]
+    sin = sin[:, :, None, :]
+    cos = cos[:, :, None, :]
+    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    return jnp.concatenate([rotated.astype(x.dtype), pass_], axis=-1)
+
+
+def alibi_slopes(num_heads: int) -> jnp.ndarray:
+    """ALiBi per-head slopes (Press et al.; matches HF BLOOM/Falcon)."""
+    import math
+
+    def pow2_slopes(n):
+        start = 2.0 ** (-(2.0 ** -(math.log2(n) - 3)))
+        return [start * (start**i) for i in range(n)]
+
+    if math.log2(num_heads).is_integer():
+        slopes = pow2_slopes(num_heads)
+    else:
+        closest = 2 ** math.floor(math.log2(num_heads))
+        slopes = pow2_slopes(closest)
+        extra = pow2_slopes(2 * closest)[0::2][: num_heads - closest]
+        slopes += extra
+    return jnp.asarray(slopes, dtype=jnp.float32)
+
+
+# ---------------------------------------------------------------------------
+# Attention
+# ---------------------------------------------------------------------------
+
+def _repeat_kv(x, groups: int):
+    """[B, T, Nkv, D] -> [B, T, Nkv*groups, D] for GQA/MQA."""
+    if groups == 1:
+        return x
+    b, t, nkv, d = x.shape
+    return jnp.broadcast_to(x[:, :, :, None, :], (b, t, nkv, groups, d)).reshape(
+        b, t, nkv * groups, d
+    )
+
+
+def dot_product_attention(q, k, v, bias):
+    """q: [B,S,N,D], k/v: [B,T,N,D], bias: broadcastable to [B,N,S,T].
+
+    fp32 softmax; matmuls in input dtype (MXU-friendly bf16 on TPU).
+    """
+    d = q.shape[-1]
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k) / jnp.sqrt(d).astype(q.dtype)
+    scores = scores.astype(jnp.float32) + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
+def make_attention_bias(
+    cfg: DecoderConfig,
+    q_positions,      # [B, S] absolute position of each query token
+    kv_positions,     # [B, T] absolute position of each key slot
+    kv_valid,         # [B, T] bool: key slot holds a real token
+):
+    """Additive fp32 bias [B, N_or_1, S, T]: causal + padding + sliding window
+    (+ ALiBi when configured)."""
+    causal = q_positions[:, :, None] >= kv_positions[:, None, :]          # [B,S,T]
+    mask = causal & kv_valid[:, None, :]
+    if cfg.sliding_window is not None:
+        mask &= q_positions[:, :, None] - kv_positions[:, None, :] < cfg.sliding_window
+    bias = jnp.where(mask[:, None, :, :], 0.0, NEG_INF).astype(jnp.float32)
+    if cfg.position_embedding == "alibi":
+        slopes = alibi_slopes(cfg.num_heads)  # [N]
+        # HF BLOOM computes the ALiBi distance from the *key* position relative
+        # to the final query so rows differ only via the causal mask; the
+        # equivalent per-(i,j) form is slope * -(i - j) for j <= i.
+        dist = (q_positions[:, :, None] - kv_positions[:, None, :]).astype(jnp.float32)
+        bias = bias - slopes[None, :, None, None] * dist[:, None, :, :]
+    return bias
+
+
+class KVCache(NamedTuple):
+    k: jnp.ndarray  # [L, B, T, Nkv, D]
+    v: jnp.ndarray  # [L, B, T, Nkv, D]
+    length: jnp.ndarray  # [] int32 — slots filled so far
+
+
+def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
+    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
+    return KVCache(
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype), length=jnp.zeros((), jnp.int32)
+    )
+
+
+# ---------------------------------------------------------------------------
+# Block + full forward
+# ---------------------------------------------------------------------------
+
+def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None):
+    """One attention sub-block.  When ``cache_kv`` is given, new K/V are written
+    at ``cache_index`` and attention runs over the whole cache."""
+    b, s, h = x.shape
+    n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    ap = lp["attn"]
+    q = x @ ap["wq"]
+    k = x @ ap["wk"]
+    v = x @ ap["wv"]
+    if "bq" in ap:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    q = q.reshape(b, s, n, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
+    if sin_cos is not None:
+        sin, cos = sin_cos
+        rd = int(cfg.rotary_pct * d) // 2 * 2
+        q = apply_rotary(q, sin, cos, rd)
+        k = apply_rotary(k, sin, cos, rd)
+    if cache_kv is not None:
+        ck, cv = cache_kv
+        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
+        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
+        k, v = ck.astype(x.dtype), cv.astype(x.dtype)
+        new_cache = (ck, cv)
+    else:
+        new_cache = None
+    k = _repeat_kv(k, n // nkv)
+    v = _repeat_kv(v, n // nkv)
+    out = dot_product_attention(q, k, v, bias)
+    out = out.reshape(b, s, n * d) @ ap["wo"]
+    if "bo" in ap:
+        out = out + ap["bo"]
+    return out, new_cache
+
+
+def _mlp(cfg: DecoderConfig, lp, x):
+    mp = lp["mlp"]
+    if cfg.mlp_type == "gated":
+        gate = x @ mp["wg"]
+        up = x @ mp["wi"]
+        if "bg" in mp:
+            gate, up = gate + mp["bg"], up + mp["bi"]
+        hidden = activation(cfg.activation, gate) * up
+    else:
+        hidden = x @ mp["wi"]
+        if "bi" in mp:
+            hidden = hidden + mp["bi"]
+        hidden = activation(cfg.activation, hidden)
+    out = hidden @ mp["wo"]
+    if "bo" in mp:
+        out = out + mp["bo"]
+    return out
+
+
+def _block(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None):
+    ln1_out = _norm(cfg, x, lp["ln1"])
+    attn_out, new_cache = _attn(cfg, lp, ln1_out, sin_cos, bias, cache_kv, cache_index)
+    if cfg.parallel_residual:
+        # NeoX/Falcon: mlp reads the same (or its own) LN of the block input.
+        mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
+        x = x + attn_out + _mlp(cfg, lp, mlp_in)
+    else:
+        x = x + attn_out
+        x = x + _mlp(cfg, lp, _norm(cfg, x, lp["ln2"]))
+    return x, new_cache
+
+
+def _embed(cfg: DecoderConfig, params, token_ids, positions):
+    x = jnp.take(params["embed"]["tokens"], token_ids, axis=0)
+    if cfg.position_embedding == "learned":
+        x = x + jnp.take(
+            params["embed"]["pos"], positions + cfg.learned_pos_offset, axis=0
+        )
+    if cfg.embedding_layernorm:
+        ln = params["embed"]["ln"]
+        x = layer_norm(x, ln["scale"], ln["bias"], cfg.norm_eps)
+    return x
+
+
+def _unembed(cfg: DecoderConfig, params, x):
+    if cfg.final_norm:
+        x = _norm(cfg, x, params["final_ln"])
+    table = params.get("lm_head")
+    if table is None:
+        table = params["embed"]["tokens"].T
+    return (x.astype(jnp.float32) @ table.astype(jnp.float32)) * cfg.logit_scale
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "return_cache", "cache_len"))
+def forward(
+    params,
+    cfg: DecoderConfig,
+    token_ids,                 # [B, S] int32, right-padded
+    attention_mask,            # [B, S] 1 for real tokens
+    return_cache: bool = False,
+    cache_len: Optional[int] = None,
+):
+    """Full-sequence forward.  Returns fp32 logits [B, S, V]; optionally also a
+    KV cache (padded to ``cache_len``) for subsequent greedy decode."""
+    b, s = token_ids.shape
+    mask = attention_mask.astype(bool)
+    positions = jnp.cumsum(attention_mask, axis=-1) - 1  # right-padded prompts
+    positions = jnp.maximum(positions, 0)
+    sin_cos = None
+    if cfg.position_embedding == "rotary":
+        rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+        sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, params["embed"]["tokens"].dtype)
+    bias = make_attention_bias(cfg, positions, positions, mask)
+    x = _embed(cfg, params, token_ids, positions)
+
+    if not return_cache:
+        def body(h, lp):
+            h, _ = _block(cfg, lp, h, sin_cos, bias, None, None)
+            return h, None
+
+        x, _ = lax.scan(body, x, params["layers"])
+        return _unembed(cfg, params, x)
+
+    t = cache_len or s
+    cache_dtype = params["embed"]["tokens"].dtype
+    # Attention runs over the whole (zero-padded) cache: extend the key-side
+    # mask/positions from S to T.  Slot index == position for right-padded rows.
+    kv_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    kv_valid = jnp.pad(mask, ((0, 0), (0, t - s)))
+    bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
+
+    def body(h, lp):
+        zeros = jnp.zeros((b, t, cfg.num_kv_heads, cfg.head_dim), cache_dtype)
+        h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, (zeros, zeros), 0)
+        return h, (ck, cv)
+
+    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    lengths = jnp.sum(attention_mask, axis=-1)  # [B] per-row prompt length
+    cache = KVCache(k=ks, v=vs, length=jnp.max(lengths).astype(jnp.int32))
+    return _unembed(cfg, params, x), cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_steps"))
+def greedy_decode(
+    params,
+    cfg: DecoderConfig,
+    token_ids,          # [B, S] right-padded prompts
+    attention_mask,     # [B, S]
+    num_steps: int,
+    eos_token_id: Optional[int] = None,
+):
+    """Batched greedy decode, the reference's ``model.generate(max_new_tokens=N,
+    output_scores=True)`` (run_base_vs_instruct_100q.py:337-346) as ONE device
+    program: prompt forward + ``num_steps`` scanned single-token steps.
+
+    Returns:
+        tokens  [B, num_steps] int32 greedy continuations
+        logits  [B, num_steps, V] fp32 scores at each generated position
+    """
+    b, s = token_ids.shape
+    total = s + num_steps
+    logits, cache = forward(
+        params, cfg, token_ids, attention_mask, return_cache=True, cache_len=total
+    )
+    lengths = jnp.sum(attention_mask, axis=-1)  # [B]
+    # Logit at the last real prompt token predicts the first generated token.
+    last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+
+    kv_positions = jnp.broadcast_to(jnp.arange(total)[None, :], (b, total))
+
+    def step(carry, i):
+        cache, prev_logits, done = carry
+        next_tok = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)  # [B]
+        if eos_token_id is not None:
+            next_tok = jnp.where(done, eos_token_id, next_tok)
+        q_pos = (lengths + i)[:, None]                                  # [B,1]
+        kv_valid = kv_positions < (lengths + i + 1)[:, None]
+        bias = make_attention_bias(cfg, q_pos, kv_positions, kv_valid)
+        sin_cos = None
+        if cfg.position_embedding == "rotary":
+            rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+            sin_cos = rotary_embedding(q_pos, rd, cfg.rope_theta, cache.k.dtype)
+        x = _embed(cfg, params, next_tok[:, None], q_pos)
+
+        def body(carry_h, xs):
+            h = carry_h
+            lp, ck, cv = xs
+            # Rows have ragged lengths; each row writes its K/V at its own
+            # position via per-row dynamic updates expressed as a masked
+            # scatter over the time axis.
+            h, (ck, cv) = _block_ragged(cfg, lp, h, sin_cos, bias, (ck, cv), lengths + i)
+            return h, (ck, cv)
+
+        x, (ks, vs) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+        cache = KVCache(k=ks, v=vs, length=cache.length + 1)
+        step_logits = _unembed(cfg, params, x)[:, 0, :]                 # [B,V]
+        if eos_token_id is not None:
+            done = done | (next_tok == eos_token_id)
+        return (cache, step_logits, done), (next_tok, prev_logits)
+
+    done0 = jnp.zeros((b,), bool)
+    (_, _, _), (tokens, step_scores) = lax.scan(
+        step, (cache, last, done0), jnp.arange(num_steps)
+    )
+    return jnp.swapaxes(tokens, 0, 1), jnp.swapaxes(step_scores, 0, 1)
+
+
+def _block_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
+    """_block variant for decode: write each row's K/V at its own position."""
+    ln1_out = _norm(cfg, x, lp["ln1"])
+    attn_out, new_cache = _attn_ragged(cfg, lp, ln1_out, sin_cos, bias, cache_kv, write_pos)
+    if cfg.parallel_residual:
+        mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
+        x = x + attn_out + _mlp(cfg, lp, mlp_in)
+    else:
+        x = x + attn_out
+        x = x + _mlp(cfg, lp, _norm(cfg, x, lp["ln2"]))
+    return x, new_cache
+
+
+def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
+    b, s, h = x.shape  # s == 1 during decode
+    n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    ap = lp["attn"]
+    q = x @ ap["wq"]
+    k = x @ ap["wk"]
+    v = x @ ap["wv"]
+    if "bq" in ap:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    q = q.reshape(b, s, n, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
+    if sin_cos is not None:
+        sin, cos = sin_cos
+        rd = int(cfg.rotary_pct * d) // 2 * 2
+        q = apply_rotary(q, sin, cos, rd)
+        k = apply_rotary(k, sin, cos, rd)
+    ck, cv = cache_kv
+    t = ck.shape[1]
+    onehot = (jnp.arange(t)[None, :] == write_pos[:, None]).astype(ck.dtype)  # [B,T]
+    ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k.astype(ck.dtype)
+    cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v.astype(cv.dtype)
+    kf = _repeat_kv(ck.astype(x.dtype), n // nkv)
+    vf = _repeat_kv(cv.astype(x.dtype), n // nkv)
+    out = dot_product_attention(q, kf, vf, bias)
+    out = out.reshape(b, s, n * d) @ ap["wo"]
+    if "bo" in ap:
+        out = out + ap["bo"]
+    return out, (ck, cv)
