@@ -320,3 +320,63 @@ def getter_from_torch_state_dict(state_dict) -> Getter:
         return t.detach().to("cpu").float().numpy()
 
     return get
+
+
+# ---------------------------------------------------------------------------
+# T5 encoder-decoder
+# ---------------------------------------------------------------------------
+
+def convert_t5(get: Getter, cfg) -> Dict:
+    """T5/T0/tk-instruct/Flan-T5 (HF ``T5ForConditionalGeneration`` names)."""
+
+    def attn(prefix):
+        return {
+            "wq": _linear(get, f"{prefix}.q"),
+            "wk": _linear(get, f"{prefix}.k"),
+            "wv": _linear(get, f"{prefix}.v"),
+            "wo": _linear(get, f"{prefix}.o"),
+        }
+
+    def mlp(prefix):
+        if cfg.feed_forward_proj == "gated-gelu":
+            return {
+                "wi0": _linear(get, f"{prefix}.wi_0"),
+                "wi1": _linear(get, f"{prefix}.wi_1"),
+                "wo": _linear(get, f"{prefix}.wo"),
+            }
+        return {"wi": _linear(get, f"{prefix}.wi"), "wo": _linear(get, f"{prefix}.wo")}
+
+    enc_layers = {
+        "ln1": {"scale": _stack([get(f"encoder.block.{i}.layer.0.layer_norm.weight") for i in range(cfg.num_layers)])},
+        "ln2": {"scale": _stack([get(f"encoder.block.{i}.layer.1.layer_norm.weight") for i in range(cfg.num_layers)])},
+        "attn": {k: _stack([attn(f"encoder.block.{i}.layer.0.SelfAttention")[k] for i in range(cfg.num_layers)]) for k in ("wq", "wk", "wv", "wo")},
+        "mlp": {k: _stack([mlp(f"encoder.block.{i}.layer.1.DenseReluDense")[k] for i in range(cfg.num_layers)]) for k in mlp("encoder.block.0.layer.1.DenseReluDense")},
+    }
+    Ld = cfg.num_decoder_layers
+    dec_layers = {
+        "ln1": {"scale": _stack([get(f"decoder.block.{i}.layer.0.layer_norm.weight") for i in range(Ld)])},
+        "ln2": {"scale": _stack([get(f"decoder.block.{i}.layer.1.layer_norm.weight") for i in range(Ld)])},
+        "ln3": {"scale": _stack([get(f"decoder.block.{i}.layer.2.layer_norm.weight") for i in range(Ld)])},
+        "self_attn": {k: _stack([attn(f"decoder.block.{i}.layer.0.SelfAttention")[k] for i in range(Ld)]) for k in ("wq", "wk", "wv", "wo")},
+        "cross_attn": {k: _stack([attn(f"decoder.block.{i}.layer.1.EncDecAttention")[k] for i in range(Ld)]) for k in ("wq", "wk", "wv", "wo")},
+        "mlp": {k: _stack([mlp(f"decoder.block.{i}.layer.2.DenseReluDense")[k] for i in range(Ld)]) for k in mlp("decoder.block.0.layer.2.DenseReluDense")},
+    }
+    params = {
+        "shared": get("shared.weight"),
+        "encoder": {
+            "rel_bias": get("encoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"),
+            "layers": enc_layers,
+            "final_ln": {"scale": get("encoder.final_layer_norm.weight")},
+        },
+        "decoder": {
+            "rel_bias": get("decoder.block.0.layer.0.SelfAttention.relative_attention_bias.weight"),
+            "layers": dec_layers,
+            "final_ln": {"scale": get("decoder.final_layer_norm.weight")},
+        },
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = np.ascontiguousarray(get("lm_head.weight").T)
+    return params
+
+
+CONVERTERS["t5"] = convert_t5

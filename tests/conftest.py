@@ -23,6 +23,12 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
 import pytest  # noqa: E402
 
+import jax  # noqa: E402
+
+# XLA CPU's default matmul precision is bf16-like (~7e-2 error on unit-scale
+# 64-dim dots); parity tests against torch fp32 need true fp32 matmuls.
+jax.config.update("jax_default_matmul_precision", "highest")
+
 
 @pytest.fixture(scope="session")
 def eight_cpu_devices():
