@@ -1,0 +1,41 @@
+from .confidence import (
+    extract_final_number,
+    extract_first_int,
+    top_candidates_from_scores,
+    weighted_confidence_digits,
+    weighted_confidence_single_tokens,
+)
+from .prompts import (
+    ANSWER_INSTRUCTION,
+    FEW_SHOT_PREFIX,
+    format_base_prompt,
+    format_binary_prompt,
+    format_confidence_prompt,
+    format_instruct_prompt,
+    format_prompt,
+)
+from .yes_no import (
+    YesNoResult,
+    relative_prob_first_token,
+    target_token_ids,
+    yes_no_from_scores,
+)
+
+__all__ = [
+    "extract_final_number",
+    "extract_first_int",
+    "top_candidates_from_scores",
+    "weighted_confidence_digits",
+    "weighted_confidence_single_tokens",
+    "ANSWER_INSTRUCTION",
+    "FEW_SHOT_PREFIX",
+    "format_base_prompt",
+    "format_binary_prompt",
+    "format_confidence_prompt",
+    "format_instruct_prompt",
+    "format_prompt",
+    "YesNoResult",
+    "relative_prob_first_token",
+    "target_token_ids",
+    "yes_no_from_scores",
+]

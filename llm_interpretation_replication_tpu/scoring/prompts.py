@@ -1,0 +1,46 @@
+"""Prompt formatting contracts.
+
+These strings are behavioral data, kept byte-identical to the reference so the
+statistics reproduce (run_base_vs_instruct_100q.py:455-469,
+compare_instruct_models.py:488-492, compare_base_vs_instruct.py:462-463).
+"""
+
+from __future__ import annotations
+
+FEW_SHOT_PREFIX = (
+    "Question: Is \"soup\" a \"beverage\"? Answer either 'Yes' or 'No', "
+    "without any other text.\nAnswer: No.\n\n"
+    "Question: Is a \"tweet\" a \"publication\"? Answer either 'Yes' or 'No', "
+    "without any other text.\nAnswer: Yes.\n\n"
+)
+
+ANSWER_INSTRUCTION = "Answer either 'Yes' or 'No', without any other text."
+
+
+def format_base_prompt(question: str) -> str:
+    """Base checkpoints: 2-shot prefix + Question/Answer scaffold."""
+    return f"{FEW_SHOT_PREFIX}Question: {question} {ANSWER_INSTRUCTION}\nAnswer:"
+
+
+def format_instruct_prompt(question: str, model_name: str = "") -> str:
+    """Instruction-tuned checkpoints: bare question + instruction; Baichuan
+    gets its chat wrapping."""
+    if "baichuan" in model_name.lower():
+        return f"<human>: {question} {ANSWER_INSTRUCTION}\n<bot>:"
+    return f"{question} {ANSWER_INSTRUCTION}"
+
+
+def format_prompt(question: str, is_base_model: bool, model_name: str = "") -> str:
+    if is_base_model:
+        return format_base_prompt(question)
+    return format_instruct_prompt(question, model_name)
+
+
+def format_binary_prompt(main_part: str, response_format: str) -> str:
+    """Perturbation-sweep binary prompt: ``{rephrased_main} {response_format}``
+    (perturb_prompts.py 'Full Rephrased Prompt' column)."""
+    return f"{main_part} {response_format}"
+
+
+def format_confidence_prompt(main_part: str, confidence_format: str) -> str:
+    return f"{main_part} {confidence_format}"

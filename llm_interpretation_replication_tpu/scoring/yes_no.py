@@ -1,0 +1,92 @@
+"""Yes/No relative-probability extraction — the behavioral core.
+
+Replaces the reference's ``get_yes_no_logprobs``
+(run_base_vs_instruct_100q.py:279-392 and 3 near-identical copies): HF
+``generate(max_new_tokens=50, output_scores=True)`` followed by a Python scan
+of the first MAX_LOOK_AHEAD=10 positions for a step whose top-k (k=5, k=2 in
+the older script) contains the Yes/No token, falling back to position 0.
+
+Here the scan is a vectorized jit'd op over the per-step score tensor produced
+by ``models.decoder.greedy_decode`` / ``models.t5.greedy_decode`` — one device
+program for the whole batch instead of a per-prompt Python loop.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import NamedTuple, Sequence
+
+import jax
+import jax.numpy as jnp
+
+
+class YesNoResult(NamedTuple):
+    yes_prob: jnp.ndarray       # [B]
+    no_prob: jnp.ndarray        # [B]
+    relative_prob: jnp.ndarray  # [B]  p_yes / (p_yes + p_no), 0.5 when both 0
+    odds_ratio: jnp.ndarray     # [B]  p_yes / p_no, +inf when p_no == 0
+    found: jnp.ndarray          # [B]  bool: scan hit within max_look_ahead
+    position: jnp.ndarray       # [B]  int: position read (0 on fallback)
+
+
+@functools.partial(jax.jit, static_argnames=("max_look_ahead", "top_k"))
+def yes_no_from_scores(
+    scores: jnp.ndarray,   # [B, P, V] fp32 per-step generation scores
+    yes_id: jnp.ndarray,   # [] or [B] int token id ("Yes" with leading space)
+    no_id: jnp.ndarray,
+    max_look_ahead: int = 10,
+    top_k: int = 5,
+) -> YesNoResult:
+    b, p, v = scores.shape
+    probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
+    yes_id = jnp.broadcast_to(jnp.asarray(yes_id), (b,))
+    no_id = jnp.broadcast_to(jnp.asarray(no_id), (b,))
+    p_yes = jnp.take_along_axis(probs, yes_id[:, None, None], axis=-1)[..., 0]  # [B,P]
+    p_no = jnp.take_along_axis(probs, no_id[:, None, None], axis=-1)[..., 0]
+    # top-k membership == prob >= k-th largest prob (ties over-match, like the
+    # reference's `token_id in topk(probs, k).indices` up to degenerate ties)
+    kth = jax.lax.top_k(probs, top_k)[0][..., -1]                               # [B,P]
+    look = min(max_look_ahead, p)
+    hit = ((p_yes >= kth) | (p_no >= kth))[:, :look]
+    found = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    sel = jnp.where(found, first, 0)
+    yes = jnp.take_along_axis(p_yes, sel[:, None], axis=1)[:, 0]
+    no = jnp.take_along_axis(p_no, sel[:, None], axis=1)[:, 0]
+    total = yes + no
+    relative = jnp.where(total > 0, yes / jnp.where(total > 0, total, 1.0), 0.5)
+    odds = jnp.where(no > 0, yes / jnp.where(no > 0, no, 1.0), jnp.inf)
+    return YesNoResult(yes, no, relative, odds, found, sel)
+
+
+@jax.jit
+def relative_prob_first_token(logits: jnp.ndarray, yes_id, no_id):
+    """Fast path: single-forward scoring at the final prompt position (the
+    pjit'd sweep's hot op — BASELINE.json north star).  logits: [B, V] fp32."""
+    probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
+    b = logits.shape[0]
+    yes_id = jnp.broadcast_to(jnp.asarray(yes_id), (b,))
+    no_id = jnp.broadcast_to(jnp.asarray(no_id), (b,))
+    yes = jnp.take_along_axis(probs, yes_id[:, None], axis=-1)[:, 0]
+    no = jnp.take_along_axis(probs, no_id[:, None], axis=-1)[:, 0]
+    total = yes + no
+    relative = jnp.where(total > 0, yes / jnp.where(total > 0, total, 1.0), 0.5)
+    return yes, no, relative
+
+
+def target_token_ids(tokenizer, targets: Sequence[str], encoder_decoder: bool = False):
+    """Token ids the scan looks for.
+
+    Decoder-only models match the reference's leading-space convention
+    (``tokenizer(" Yes", add_special_tokens=False).input_ids[0]`` with a
+    no-space fallback — run_base_vs_instruct_100q.py:332-335); encoder-decoder
+    models take the first id of the bare word (ibid.:306-307).
+    """
+    ids = []
+    for t in targets:
+        if encoder_decoder:
+            ids.append(tokenizer(t).input_ids[0])
+            continue
+        with_space = tokenizer(" " + t, add_special_tokens=False).input_ids
+        ids.append(with_space[0] if with_space else tokenizer.encode(t)[0])
+    return ids

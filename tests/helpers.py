@@ -1,0 +1,26 @@
+"""Shared test utilities: offline tokenizers and tiny models."""
+
+from __future__ import annotations
+
+
+def build_test_tokenizer(vocab_size: int = 300):
+    """Byte-level BPE tokenizer trained in-process (zero-egress image: no hub
+    downloads).  Distinguishes " Yes" from "Yes" like real GPT-style vocabs."""
+    from tokenizers import ByteLevelBPETokenizer
+    from transformers import PreTrainedTokenizerFast
+
+    tok = ByteLevelBPETokenizer()
+    corpus = [
+        "Yes No Answer: Yes.",
+        "Answer: No.",
+        "Is a tweet a publication? Yes",
+        "Is soup a beverage? No",
+        "confidence 0 1 2 3 4 5 6 7 8 9 10 42 85 90 100",
+        "The quick brown fox jumps over the lazy dog.",
+    ] * 50
+    tok.train_from_iterator(corpus, vocab_size=vocab_size, min_frequency=1)
+    inner = tok._tokenizer if hasattr(tok, "_tokenizer") else tok
+    fast = PreTrainedTokenizerFast(tokenizer_object=inner)
+    fast.pad_token = fast.decode([0])
+    fast.pad_token_id = 0
+    return fast

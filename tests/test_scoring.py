@@ -1,0 +1,239 @@
+"""Behavioral tests of the scoring core against in-test oracles that follow
+the reference semantics (run_base_vs_instruct_100q.py:279-392,
+evaluate_closed_source_models.py:327-456, perturb_prompts_gpt.py:47-85,
+evaluate_irrelevant_perturbations.py:190-265)."""
+
+import math
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from llm_interpretation_replication_tpu.scoring import (
+    extract_final_number,
+    extract_first_int,
+    format_base_prompt,
+    format_instruct_prompt,
+    target_token_ids,
+    top_candidates_from_scores,
+    weighted_confidence_digits,
+    weighted_confidence_single_tokens,
+    yes_no_from_scores,
+)
+
+
+def oracle_yes_no(scores, yes_id, no_id, max_look_ahead=10, top_k=5):
+    """Reference semantics, straightforward python."""
+    def softmax(x):
+        e = np.exp(x - x.max())
+        return e / e.sum()
+
+    for pos in range(min(max_look_ahead, scores.shape[0])):
+        probs = softmax(scores[pos])
+        top = np.argsort(-probs)[:top_k]
+        if yes_id in top or no_id in top:
+            return probs[yes_id], probs[no_id], pos, True
+    probs = softmax(scores[0])
+    return probs[yes_id], probs[no_id], 0, False
+
+
+class TestYesNoScan:
+    def test_matches_oracle_random(self):
+        rng = np.random.default_rng(0)
+        B, P, V = 16, 12, 50
+        scores = rng.standard_normal((B, P, V)).astype(np.float32) * 3
+        yes_id, no_id = 7, 11
+        res = yes_no_from_scores(jnp.asarray(scores), yes_id, no_id)
+        for b in range(B):
+            ey, en, epos, efound = oracle_yes_no(scores[b], yes_id, no_id)
+            assert res.found[b] == efound, b
+            assert res.position[b] == epos, b
+            np.testing.assert_allclose(res.yes_prob[b], ey, rtol=1e-5)
+            np.testing.assert_allclose(res.no_prob[b], en, rtol=1e-5)
+            expected_rel = ey / (ey + en) if ey + en > 0 else 0.5
+            np.testing.assert_allclose(res.relative_prob[b], expected_rel, rtol=1e-5)
+
+    def test_fallback_position_zero(self):
+        # Yes/No never in top-5 anywhere -> fall back to position 0 probs
+        V = 40
+        scores = np.full((1, 12, V), -10.0, np.float32)
+        scores[:, :, :6] = 5.0  # top-5 always tokens 0..5
+        res = yes_no_from_scores(jnp.asarray(scores), 20, 21)
+        assert not bool(res.found[0])
+        assert int(res.position[0]) == 0
+
+    def test_top_k_2(self):
+        rng = np.random.default_rng(1)
+        scores = rng.standard_normal((8, 10, 30)).astype(np.float32) * 2
+        res = yes_no_from_scores(jnp.asarray(scores), 3, 4, top_k=2)
+        for b in range(8):
+            ey, en, epos, efound = oracle_yes_no(scores[b], 3, 4, top_k=2)
+            assert res.found[b] == efound
+            assert res.position[b] == epos
+
+    def test_odds_ratio_inf_when_no_zero(self):
+        scores = np.full((1, 1, 10), -100.0, np.float32)
+        scores[0, 0, 2] = 50.0  # yes gets everything
+        res = yes_no_from_scores(jnp.asarray(scores), 2, 3, max_look_ahead=1)
+        assert np.isinf(float(res.odds_ratio[0]))
+
+
+class TestEndToEndAgainstTorchReference:
+    """Tiny NeoX model: reference-style HF generate + python scan vs our
+    one-program greedy decode + vectorized scan."""
+
+    def test_pipeline_parity(self):
+        torch = pytest.importorskip("torch")
+        from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        from llm_interpretation_replication_tpu.models import config as mcfg
+        from llm_interpretation_replication_tpu.models import convert as mconvert
+        from llm_interpretation_replication_tpu.models import decoder
+
+        hf_config = GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64, rotary_pct=0.25,
+            max_position_embeddings=128,
+        )
+        torch.manual_seed(21)
+        model = GPTNeoXForCausalLM(hf_config).eval()
+        fam, cfg = mcfg.from_hf_config(hf_config)
+        params = mconvert.convert(
+            fam, mconvert.getter_from_torch_state_dict(model.state_dict()), cfg,
+            dtype=jnp.float32,
+        )
+        rng = np.random.default_rng(2)
+        yes_id, no_id = 5, 9
+        prompts = [rng.integers(3, 128, size=n).astype(np.int32) for n in (9, 6, 12)]
+        seq = max(len(p) for p in prompts)
+        ids = np.zeros((len(prompts), seq), np.int32)
+        mask = np.zeros_like(ids)
+        for r, p in enumerate(prompts):
+            ids[r, : len(p)] = p
+            mask[r, : len(p)] = 1
+
+        # ours: batched decode + vectorized scan
+        _, batch_scores = decoder.greedy_decode(
+            params, cfg, jnp.asarray(ids), jnp.asarray(mask), num_steps=10
+        )
+        ours = yes_no_from_scores(batch_scores, yes_id, no_id)
+
+        # reference style: per-prompt HF generate + oracle scan
+        for r, p in enumerate(prompts):
+            with torch.no_grad():
+                out = model.generate(
+                    torch.tensor(p[None, :].astype(np.int64)), max_new_tokens=10,
+                    do_sample=False, output_scores=True,
+                    return_dict_in_generate=True, pad_token_id=0,
+                )
+            ref_scores = np.stack([s[0].float().numpy() for s in out.scores])
+            ey, en, epos, efound = oracle_yes_no(ref_scores, yes_id, no_id)
+            assert bool(ours.found[r]) == efound
+            assert int(ours.position[r]) == epos
+            np.testing.assert_allclose(float(ours.yes_prob[r]), ey, atol=1e-4)
+            np.testing.assert_allclose(float(ours.no_prob[r]), en, atol=1e-4)
+
+
+class TestWeightedConfidence:
+    def test_single_tokens_simple(self):
+        positions = [[("85", math.log(0.9)), ("90", math.log(0.1))]]
+        got = weighted_confidence_single_tokens(positions)
+        np.testing.assert_allclose(got, 85 * 0.9 + 90 * 0.1, rtol=1e-9)
+
+    def test_single_tokens_filters_out_of_range(self):
+        positions = [[("850", math.log(0.5)), ("42", math.log(0.5))]]
+        got = weighted_confidence_single_tokens(positions)
+        np.testing.assert_allclose(got, 42.0, rtol=1e-9)
+
+    def test_digits_complete_tokens(self):
+        positions = [[("85", math.log(0.6)), ("100", math.log(0.4))]]
+        got = weighted_confidence_digits(positions)
+        np.testing.assert_allclose(got, 85 * 0.6 + 100 * 0.4, rtol=1e-6)
+
+    def test_digits_two_token_reconstruction(self):
+        # first "5" (p=.5), "8" (p=.5); second "0" (p=.4, only digit)
+        positions = [
+            [("5", math.log(0.5)), ("8", math.log(0.5))],
+            [("0", math.log(0.4)), ("x", math.log(0.6))],
+        ]
+        got = weighted_confidence_digits(positions)
+        # 50:.2, 80:.2, 5:.3, 8:.3 -> weighted = 29.9
+        np.testing.assert_allclose(got, 29.9, rtol=1e-6)
+
+    def test_digits_100_chain(self):
+        # "1"(p=.8) -> "0"(p=.9) -> "0"(p=.7): 100 with .504,
+        # 10 with .8*.9*.3=.216, 1 alone with .8*.1=.08
+        positions = [
+            [("1", math.log(0.8)), ("y", math.log(0.2))],
+            [("0", math.log(0.9)), ("z", math.log(0.1))],
+            [("0", math.log(0.7)), ("w", math.log(0.3))],
+        ]
+        got = weighted_confidence_digits(positions)
+        total = 0.504 + 0.216 + 0.08
+        expected = (100 * 0.504 + 10 * 0.216 + 1 * 0.08) / total
+        np.testing.assert_allclose(got, expected, rtol=1e-6)
+
+    def test_digits_none_when_no_numbers(self):
+        assert weighted_confidence_digits([[("a", -1.0)]]) is None
+        assert weighted_confidence_digits([]) is None
+
+    def test_from_model_scores(self):
+        from helpers import build_test_tokenizer
+
+        tok = build_test_tokenizer()
+        v = tok.vocab_size if hasattr(tok, "vocab_size") else 300
+        ids_85 = tok("85", add_special_tokens=False).input_ids
+        scores = np.full((3, max(v, 300)), -20.0, np.float32)
+        scores[0, ids_85[0]] = 5.0
+        positions = top_candidates_from_scores(scores, tok, num_positions=3, top_k=19)
+        got = weighted_confidence_digits(positions)
+        assert got is not None
+
+    def test_extract_first_int(self):
+        assert extract_first_int("Confidence: 85 out of 100") == 85
+        assert extract_first_int("no numbers") is None
+        assert extract_first_int("") is None
+
+
+class TestExtractFinalNumber:
+    def test_marker_sandwich(self):
+        assert extract_final_number("thinking...\n***\n20\n***") == 20.0
+
+    def test_after_marker(self):
+        assert extract_final_number("blah\n###\n42") == 42.0
+
+    def test_standalone_line(self):
+        assert extract_final_number("I reason a lot 123 times.\n77\n") == 77.0
+
+    def test_last_number(self):
+        assert extract_final_number("maybe 10 or rather 65 overall") == 65.0
+
+    def test_digit_concat_fallback(self):
+        assert extract_final_number("9 9") == 9.0  # last number wins over concat
+
+    def test_empty(self):
+        assert extract_final_number("") is None
+        assert extract_final_number("none here") is None
+
+
+class TestPromptsAndTargets:
+    def test_prompt_formats(self):
+        q = 'Is a "screenshot" a "photograph"?'
+        base = format_base_prompt(q)
+        assert base.startswith('Question: Is "soup" a "beverage"?')
+        assert base.endswith(f"Question: {q} Answer either 'Yes' or 'No', without any other text.\nAnswer:")
+        inst = format_instruct_prompt(q)
+        assert inst == f"{q} Answer either 'Yes' or 'No', without any other text."
+        bai = format_instruct_prompt(q, "baichuan-inc/Baichuan2-7B-Chat")
+        assert bai.startswith("<human>: ") and bai.endswith("\n<bot>:")
+
+    def test_target_token_ids_leading_space(self):
+        from helpers import build_test_tokenizer
+
+        tok = build_test_tokenizer()
+        yes_id, no_id = target_token_ids(tok, ["Yes", "No"])
+        # decoder-only convention: the id is for " Yes" (with space)
+        assert yes_id == tok(" Yes", add_special_tokens=False).input_ids[0]
+        assert no_id == tok(" No", add_special_tokens=False).input_ids[0]
+        assert yes_id != tok("Yes", add_special_tokens=False).input_ids[0]
