@@ -1,0 +1,36 @@
+from .mesh import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    data_sharded,
+    initialize_distributed,
+    make_mesh,
+    mesh_shape_for,
+    replicated,
+)
+from .ring_attention import ring_attention, ring_attention_sharded
+from .sharding import (
+    activation_spec,
+    batch_spec,
+    constrain,
+    param_specs,
+    shard_params,
+)
+
+__all__ = [
+    "DATA_AXIS",
+    "MODEL_AXIS",
+    "SEQ_AXIS",
+    "data_sharded",
+    "initialize_distributed",
+    "make_mesh",
+    "mesh_shape_for",
+    "replicated",
+    "ring_attention",
+    "ring_attention_sharded",
+    "activation_spec",
+    "batch_spec",
+    "constrain",
+    "param_specs",
+    "shard_params",
+]

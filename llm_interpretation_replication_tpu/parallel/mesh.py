@@ -1,0 +1,85 @@
+"""Device-mesh construction and multi-host initialization.
+
+The reference has no distributed runtime (SURVEY.md §2.7: concurrency is
+thread-level API fan-out only); scaling here is TPU-native: a
+``jax.sharding.Mesh`` over (data, model, seq) axes, GSPMD shardings from
+parallel/sharding.py, and XLA collectives over ICI/DCN.  Multi-host pods
+bootstrap via ``jax.distributed.initialize``.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Optional, Sequence, Tuple
+
+import jax
+import numpy as np
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+DATA_AXIS = "data"
+MODEL_AXIS = "model"
+SEQ_AXIS = "seq"
+
+
+def make_mesh(
+    data: Optional[int] = None,
+    model: int = 1,
+    seq: int = 1,
+    devices: Optional[Sequence] = None,
+) -> Mesh:
+    """Build a (data, model, seq) mesh.  ``data`` defaults to whatever is left
+    after model×seq divides the device count."""
+    devices = list(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if data is None:
+        if n % (model * seq):
+            raise ValueError(f"{n} devices not divisible by model={model} × seq={seq}")
+        data = n // (model * seq)
+    if data * model * seq != n:
+        raise ValueError(f"mesh {data}×{model}×{seq} != {n} devices")
+    arr = np.asarray(devices).reshape(data, model, seq)
+    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+
+
+def mesh_shape_for(n_devices: int, want_model: int = 1, want_seq: int = 1) -> Tuple[int, int, int]:
+    """Largest data axis given desired model/seq parallelism, shrinking model
+    then seq until they divide the device count."""
+    model, seq = want_model, want_seq
+    while n_devices % (model * seq) and model > 1:
+        model //= 2
+    while n_devices % (model * seq) and seq > 1:
+        seq //= 2
+    return n_devices // (model * seq), model, seq
+
+
+def replicated(mesh: Mesh) -> NamedSharding:
+    return NamedSharding(mesh, P())
+
+
+def data_sharded(mesh: Mesh, rank: int = 2) -> NamedSharding:
+    """Batch-leading arrays sharded over the data axis."""
+    return NamedSharding(mesh, P(DATA_AXIS, *([None] * (rank - 1))))
+
+
+def initialize_distributed(
+    coordinator_address: Optional[str] = None,
+    num_processes: Optional[int] = None,
+    process_id: Optional[int] = None,
+) -> bool:
+    """Multi-host bootstrap.  No-op (returns False) outside a pod/cluster so
+    single-host dev keeps working; honors the standard JAX env vars when args
+    are not given."""
+    coordinator_address = coordinator_address or os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        num_processes = int(os.environ.get("JAX_NUM_PROCESSES", "0")) or None
+    if process_id is None:
+        pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(pid) if pid is not None else None
+    if not coordinator_address and num_processes in (None, 1):
+        return False
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return True

@@ -1,0 +1,107 @@
+"""Ring attention: sequence/context parallelism over the ``seq`` mesh axis.
+
+Long-context first-class support (absent in the reference — SURVEY.md §2.7):
+K/V blocks rotate around the ring via ``ppermute`` while each device keeps its
+resident Q block, combining partial results with an online (flash-style)
+softmax — O(S/n) memory per device, compute overlapped with ICI transfers by
+XLA's latency-hiding scheduler.
+
+``ring_attention`` is the per-shard body (call under ``shard_map``);
+``ring_attention_sharded`` wraps it for a (data, model, seq) mesh.
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+NEG_INF = -1e9
+
+
+def _block_bias(q_pos, kv_pos, kv_valid, causal: bool):
+    """fp32 additive bias [B, 1, Sq, Skv] from absolute positions."""
+    ok = kv_valid[:, None, :]
+    if causal:
+        ok = ok & (q_pos[:, :, None] >= kv_pos[:, None, :])
+    return jnp.where(ok[:, None], 0.0, NEG_INF).astype(jnp.float32)
+
+
+def ring_attention(
+    q,            # [B, Sq, N, D]  local query block
+    k,            # [B, Skv, N, D] local key block (will rotate)
+    v,            # [B, Skv, N, D]
+    q_pos,        # [B, Sq]  absolute positions of local queries
+    kv_pos,       # [B, Skv] absolute positions of local keys
+    kv_valid,     # [B, Skv] bool validity of local keys
+    axis_name: str = SEQ_AXIS,
+    causal: bool = True,
+):
+    """Per-shard ring attention body.  Must run inside shard_map with
+    ``axis_name`` bound to the sequence mesh axis."""
+    n = lax.axis_size(axis_name)
+    b, sq, nh, d = q.shape
+    scale = 1.0 / jnp.sqrt(d).astype(q.dtype)
+
+    m = jnp.full((b, nh, sq), NEG_INF, jnp.float32)      # running max
+    l = jnp.zeros((b, nh, sq), jnp.float32)              # running denominator
+    o = jnp.zeros((b, sq, nh, d), jnp.float32)           # running numerator
+
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    def step(carry, _):
+        m, l, o, k, v, kv_pos, kv_valid = carry
+        scores = jnp.einsum("bsnd,btnd->bnst", q * scale, k).astype(jnp.float32)
+        scores = scores + _block_bias(q_pos, kv_pos, kv_valid, causal)
+        blk_max = jnp.max(scores, axis=-1)                       # [B,N,Sq]
+        m_new = jnp.maximum(m, blk_max)
+        correction = jnp.exp(m - m_new)
+        p = jnp.exp(scores - m_new[..., None])                   # [B,N,Sq,Skv]
+        l_new = l * correction + jnp.sum(p, axis=-1)
+        pv = jnp.einsum("bnst,btnd->bsnd", p.astype(v.dtype), v).astype(jnp.float32)
+        o_new = o * jnp.moveaxis(correction, 1, 2)[..., None] + pv
+        # rotate K/V (and their metadata) one hop around the ring
+        k = lax.ppermute(k, axis_name, perm)
+        v = lax.ppermute(v, axis_name, perm)
+        kv_pos = lax.ppermute(kv_pos, axis_name, perm)
+        kv_valid = lax.ppermute(kv_valid, axis_name, perm)
+        return (m_new, l_new, o_new, k, v, kv_pos, kv_valid), None
+
+    (m, l, o, *_), _ = lax.scan(step, (m, l, o, k, v, kv_pos, kv_valid), None, length=n)
+    # rows with no valid key anywhere (fully masked) produce 0/0 → return 0
+    denom = jnp.moveaxis(l, 1, 2)[..., None]
+    out = jnp.where(denom > 0, o / jnp.maximum(denom, 1e-30), 0.0)
+    return out.astype(q.dtype)
+
+
+def ring_attention_sharded(mesh, q, k, v, attention_mask, causal: bool = True):
+    """Drive ring attention over a (data, model, seq) mesh.
+
+    q/k/v: [B, S, N, D] with S divisible by the seq-axis size; attention_mask
+    [B, S].  Heads shard over ``model``, batch over ``data``, sequence over
+    ``seq``.
+    """
+    b, s, nh, d = q.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    valid = attention_mask.astype(bool)
+
+    qkv_spec = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
+    meta_spec = P(DATA_AXIS, SEQ_AXIS)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, meta_spec, meta_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    def _run(q, k, v, pos, val):
+        return ring_attention(q, k, v, pos, pos, val, SEQ_AXIS, causal)
+
+    return _run(q, k, v, positions, valid)

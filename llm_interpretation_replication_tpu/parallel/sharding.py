@@ -1,0 +1,126 @@
+"""GSPMD sharding rules for model param pytrees and activations.
+
+Tensor parallelism follows the Megatron pattern expressed as GSPMD
+annotations (XLA inserts the collectives — scaling-book recipe):
+
+- attention: Q/K/V projections column-sharded over heads (``model`` axis on
+  the N*D output dim), output projection row-sharded (``model`` on the N*D
+  input dim) → one psum per attention block, emitted by XLA.
+- MLP: up/gate column-sharded, down row-sharded → one psum per MLP.
+- embeddings / lm_head sharded on the vocab dim; layernorms replicated.
+- the stacked layer axis L is never sharded.
+
+This replaces the reference's single-GPU ``device_map="auto"`` layer offload
+(run_base_vs_instruct_100q.py:427) — a 7B bf16 model fits a v5e slice by
+sharding, not by int8 quantization.
+"""
+
+from __future__ import annotations
+
+from typing import Optional
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+
+
+def _decoder_param_specs() -> dict:
+    """PartitionSpec tree matching models/decoder.py's param layout."""
+    attn = {
+        "wq": P(None, None, MODEL_AXIS),
+        "wk": P(None, None, MODEL_AXIS),
+        "wv": P(None, None, MODEL_AXIS),
+        "wo": P(None, MODEL_AXIS, None),
+        "bq": P(None, MODEL_AXIS),
+        "bk": P(None, MODEL_AXIS),
+        "bv": P(None, MODEL_AXIS),
+        "bo": P(None),
+    }
+    mlp = {
+        "wi": P(None, None, MODEL_AXIS),
+        "wg": P(None, None, MODEL_AXIS),
+        "bi": P(None, MODEL_AXIS),
+        "bg": P(None, MODEL_AXIS),
+        "wo": P(None, MODEL_AXIS, None),
+        "bo": P(None),
+    }
+    ln = {"scale": P(None), "bias": P(None)}
+    return {
+        "embed": {"tokens": P(MODEL_AXIS, None), "pos": P(None), "ln": {"scale": P(), "bias": P()}},
+        "layers": {"ln1": ln, "ln2": ln, "attn": attn, "mlp": mlp},
+        "final_ln": {"scale": P(), "bias": P()},
+        "lm_head": P(None, MODEL_AXIS),
+    }
+
+
+def _t5_param_specs() -> dict:
+    attn = {
+        "wq": P(None, None, MODEL_AXIS),
+        "wk": P(None, None, MODEL_AXIS),
+        "wv": P(None, None, MODEL_AXIS),
+        "wo": P(None, MODEL_AXIS, None),
+    }
+    mlp = {
+        "wi": P(None, None, MODEL_AXIS),
+        "wi0": P(None, None, MODEL_AXIS),
+        "wi1": P(None, None, MODEL_AXIS),
+        "wo": P(None, MODEL_AXIS, None),
+    }
+    ln = {"scale": P(None)}
+    return {
+        "shared": P(MODEL_AXIS, None),
+        "encoder": {
+            "rel_bias": P(),
+            "layers": {"ln1": ln, "ln2": ln, "attn": attn, "mlp": mlp},
+            "final_ln": {"scale": P()},
+        },
+        "decoder": {
+            "rel_bias": P(),
+            "layers": {
+                "ln1": ln, "ln2": ln, "ln3": ln,
+                "self_attn": attn, "cross_attn": attn, "mlp": mlp,
+            },
+            "final_ln": {"scale": P()},
+        },
+        "lm_head": P(None, MODEL_AXIS),
+    }
+
+
+def _match_tree(params, spec_tree, path=""):
+    """Walk ``params``; for every leaf take the spec at the same path (falling
+    back to replicated)."""
+    if isinstance(params, dict):
+        out = {}
+        for k, v in params.items():
+            sub = spec_tree.get(k, {}) if isinstance(spec_tree, dict) else {}
+            out[k] = _match_tree(v, sub, f"{path}/{k}")
+        return out
+    return spec_tree if isinstance(spec_tree, P) else P()
+
+
+def param_specs(params, kind: str = "decoder") -> dict:
+    """PartitionSpec pytree for a params pytree (missing entries replicate)."""
+    table = _decoder_param_specs() if kind == "decoder" else _t5_param_specs()
+    return _match_tree(params, table)
+
+
+def shard_params(params, mesh: Mesh, kind: str = "decoder"):
+    """Place a host pytree onto the mesh with TP sharding (HBM-resident)."""
+    specs = param_specs(params, kind)
+    return jax.tree.map(
+        lambda x, s: jax.device_put(x, NamedSharding(mesh, s)), params, specs
+    )
+
+
+def batch_spec() -> P:
+    """Activations: batch over data axis, sequence optionally over seq axis."""
+    return P(DATA_AXIS)
+
+
+def activation_spec(seq_sharded: bool = False) -> P:
+    return P(DATA_AXIS, SEQ_AXIS if seq_sharded else None)
+
+
+def constrain(x, mesh: Mesh, spec: P):
+    return jax.lax.with_sharding_constraint(x, NamedSharding(mesh, spec))

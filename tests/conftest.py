@@ -9,7 +9,9 @@ before jax initializes, hence the module-level assignments here.
 import os
 import sys
 
-os.environ.setdefault("JAX_PLATFORMS", "cpu")
+# Force CPU: the session env pre-sets JAX_PLATFORMS=axon (the real TPU chip);
+# tests always run on the virtual 8-device CPU mesh.
+os.environ["JAX_PLATFORMS"] = "cpu"
 _flags = os.environ.get("XLA_FLAGS", "")
 if "xla_force_host_platform_device_count" not in _flags:
     os.environ["XLA_FLAGS"] = (
@@ -25,6 +27,9 @@ import pytest  # noqa: E402
 
 import jax  # noqa: E402
 
+# The axon TPU plugin (sitecustomize) force-sets jax_platforms="axon,cpu";
+# override at the config level so tests run on the virtual 8-device CPU mesh.
+jax.config.update("jax_platforms", "cpu")
 # XLA CPU's default matmul precision is bf16-like (~7e-2 error on unit-scale
 # 64-dim dots); parity tests against torch fp32 need true fp32 matmuls.
 jax.config.update("jax_default_matmul_precision", "highest")

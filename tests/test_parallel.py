@@ -1,0 +1,131 @@
+"""Parallel-layer tests on the virtual 8-device CPU mesh."""
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from llm_interpretation_replication_tpu.parallel import (
+    DATA_AXIS,
+    MODEL_AXIS,
+    SEQ_AXIS,
+    make_mesh,
+    mesh_shape_for,
+    param_specs,
+    ring_attention_sharded,
+    shard_params,
+)
+
+
+def _dense_attention(q, k, v, mask, causal):
+    d = q.shape[-1]
+    scores = np.einsum("bsnd,btnd->bnst", q, k) / np.sqrt(d)
+    bias = np.where(mask[:, None, None, :], 0.0, -1e9)
+    if causal:
+        s = q.shape[1]
+        causal_m = np.tril(np.ones((s, s), bool))
+        bias = bias + np.where(causal_m[None, None], 0.0, -1e9)
+    scores = scores + bias
+    probs = np.exp(scores - scores.max(-1, keepdims=True))
+    probs /= probs.sum(-1, keepdims=True)
+    return np.einsum("bnst,btnd->bsnd", probs, v)
+
+
+class TestMesh:
+    def test_make_mesh_shapes(self, eight_cpu_devices):
+        mesh = make_mesh(model=2, seq=2)
+        assert mesh.shape == {DATA_AXIS: 2, MODEL_AXIS: 2, SEQ_AXIS: 2}
+        mesh = make_mesh()
+        assert mesh.shape[DATA_AXIS] == 8
+
+    def test_bad_shape_raises(self, eight_cpu_devices):
+        with pytest.raises(ValueError):
+            make_mesh(data=3, model=2, seq=2)
+
+    def test_mesh_shape_for(self):
+        assert mesh_shape_for(8, want_model=4) == (2, 4, 1)
+        assert mesh_shape_for(8, want_model=16) == (1, 8, 1)
+        assert mesh_shape_for(6, want_model=4) == (3, 2, 1)
+
+
+class TestShardParams:
+    def test_tp_sharding_placement(self, eight_cpu_devices):
+        mesh = make_mesh(model=4, seq=1)  # data=2, model=4
+        L, H, ND, F, V = 2, 8, 16, 32, 64
+        params = {
+            "embed": {"tokens": np.zeros((V, H), np.float32)},
+            "layers": {
+                "ln1": {"scale": np.ones((L, H), np.float32), "bias": np.zeros((L, H), np.float32)},
+                "attn": {
+                    "wq": np.zeros((L, H, ND), np.float32),
+                    "wk": np.zeros((L, H, ND), np.float32),
+                    "wv": np.zeros((L, H, ND), np.float32),
+                    "wo": np.zeros((L, ND, H), np.float32),
+                },
+                "mlp": {
+                    "wi": np.zeros((L, H, F), np.float32),
+                    "wo": np.zeros((L, F, H), np.float32),
+                },
+            },
+            "final_ln": {"scale": np.ones(H, np.float32)},
+            "lm_head": np.zeros((H, V), np.float32),
+        }
+        sharded = shard_params(params, mesh)
+        wq = sharded["layers"]["attn"]["wq"]
+        # column-sharded over model axis: local shard holds ND/4 columns
+        assert wq.sharding.spec == P(None, None, MODEL_AXIS)
+        assert wq.addressable_shards[0].data.shape == (L, H, ND // 4)
+        wo = sharded["layers"]["attn"]["wo"]
+        assert wo.addressable_shards[0].data.shape == (L, ND // 4, H)
+        ln = sharded["layers"]["ln1"]["scale"]
+        assert ln.addressable_shards[0].data.shape == (L, H)  # replicated
+
+    def test_specs_cover_all_leaves(self):
+        params = {
+            "embed": {"tokens": 0, "pos": 0},
+            "layers": {"attn": {"wq": 0, "bq": 0}, "mlp": {"wg": 0}},
+            "final_ln": {"scale": 0},
+            "unknown_extra": {"leaf": 0},
+        }
+        specs = param_specs(params)
+        assert specs["layers"]["attn"]["wq"] == P(None, None, MODEL_AXIS)
+        assert specs["unknown_extra"]["leaf"] == P()  # fallback replicated
+
+
+class TestRingAttention:
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, eight_cpu_devices, causal):
+        mesh = make_mesh(data=2, model=1, seq=4)
+        rng = np.random.default_rng(0)
+        B, S, N, D = 2, 16, 4, 8
+        q = rng.standard_normal((B, S, N, D)).astype(np.float32)
+        k = rng.standard_normal((B, S, N, D)).astype(np.float32)
+        v = rng.standard_normal((B, S, N, D)).astype(np.float32)
+        mask = np.ones((B, S), bool)
+        mask[1, 12:] = False
+        with jax.default_matmul_precision("highest"):
+            out = ring_attention_sharded(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(mask), causal=causal,
+            )
+        expected = _dense_attention(q, k, v, mask, causal)
+        real = mask[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(out) * real, expected * real, atol=2e-5, rtol=1e-4
+        )
+
+    def test_model_axis_sharded_heads(self, eight_cpu_devices):
+        mesh = make_mesh(data=2, model=2, seq=2)
+        rng = np.random.default_rng(1)
+        B, S, N, D = 2, 8, 4, 4
+        q, k, v = (rng.standard_normal((B, S, N, D)).astype(np.float32) for _ in range(3))
+        mask = np.ones((B, S), bool)
+        with jax.default_matmul_precision("highest"):
+            out = ring_attention_sharded(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(mask), causal=True,
+            )
+        expected = _dense_attention(q, k, v, mask, True)
+        np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5, rtol=1e-4)
