@@ -1,0 +1,22 @@
+from .batching import Batch, batches_for_prompts, bucket_for, encode_prompts
+from .engine import EngineConfig, ScoringEngine
+from .loader import CheckpointDir, load_hf_config, load_model, load_tokenizer
+from .train import TrainState, causal_lm_loss, init_train_state, make_optimizer, make_train_step
+
+__all__ = [
+    "Batch",
+    "batches_for_prompts",
+    "bucket_for",
+    "encode_prompts",
+    "EngineConfig",
+    "ScoringEngine",
+    "CheckpointDir",
+    "load_hf_config",
+    "load_model",
+    "load_tokenizer",
+    "TrainState",
+    "causal_lm_loss",
+    "init_train_state",
+    "make_optimizer",
+    "make_train_step",
+]

@@ -1,0 +1,74 @@
+"""Length-bucketed batching for the jit'd sweep.
+
+Ragged prompt lengths (few-shot prefix ≈150 tokens + question — SURVEY.md §7
+hard parts) would either recompile per shape or waste FLOPs on one global pad
+length.  Buckets quantize pad lengths to a small fixed set so XLA compiles
+once per (bucket_len, batch_size) and stays on cached executables; batches are
+padded up to a full batch so every program has a static shape.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Iterator, List, Optional, Sequence
+
+import numpy as np
+
+DEFAULT_BUCKETS = (64, 128, 256, 512, 1024, 2048)
+
+
+@dataclasses.dataclass
+class Batch:
+    token_ids: np.ndarray       # [B, S] int32, right-padded
+    attention_mask: np.ndarray  # [B, S] int32
+    indices: np.ndarray         # [B] original prompt index, -1 for pad rows
+    bucket_len: int
+
+
+def bucket_for(length: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
+    for b in buckets:
+        if length <= b:
+            return b
+    raise ValueError(f"prompt length {length} exceeds the largest bucket {buckets[-1]}")
+
+
+def batches_for_prompts(
+    encoded: Sequence[Sequence[int]],
+    batch_size: int,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    pad_id: int = 0,
+    keep_order_within_bucket: bool = True,
+) -> Iterator[Batch]:
+    """Group encoded prompts by bucket, emit fixed-shape padded batches.
+
+    Short final batches are padded with duplicate rows (index -1) so the
+    compiled program shape never varies with sweep size.
+    """
+    by_bucket: dict = {}
+    for idx, ids in enumerate(encoded):
+        b = bucket_for(len(ids), buckets)
+        by_bucket.setdefault(b, []).append((idx, list(ids)))
+    for bucket_len in sorted(by_bucket):
+        items = by_bucket[bucket_len]
+        if not keep_order_within_bucket:
+            items.sort(key=lambda it: len(it[1]))
+        for start in range(0, len(items), batch_size):
+            chunk = items[start : start + batch_size]
+            rows = len(chunk)
+            token_ids = np.full((batch_size, bucket_len), pad_id, np.int32)
+            mask = np.zeros((batch_size, bucket_len), np.int32)
+            indices = np.full((batch_size,), -1, np.int64)
+            for r, (idx, ids) in enumerate(chunk):
+                token_ids[r, : len(ids)] = ids
+                mask[r, : len(ids)] = 1
+                indices[r] = idx
+            # fill pad rows with the first row so the model sees valid tokens
+            for r in range(rows, batch_size):
+                token_ids[r] = token_ids[0]
+                mask[r] = mask[0]
+            yield Batch(token_ids, mask, indices, bucket_len)
+
+
+def encode_prompts(tokenizer, prompts: Sequence[str], add_special_tokens: bool = True) -> List[List[int]]:
+    out = tokenizer(list(prompts), add_special_tokens=add_special_tokens)["input_ids"]
+    return [list(ids) for ids in out]
