@@ -1,0 +1,132 @@
+"""Typed run configuration + experiment-asset registry.
+
+Replaces the reference's constants-at-top-of-file config style (SURVEY.md §5)
+with one typed config carrying the ``device='tpu'|'cpu'`` switch BASELINE.json
+specifies, and gives programmatic access to the experiment materials
+(scenarios, question lists, model rosters) extracted from the reference into
+``data_assets/``.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import json
+import os
+from typing import List, Optional, Sequence
+
+_ASSETS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data_assets")
+
+
+def _load(name: str):
+    with open(os.path.join(_ASSETS, name)) as f:
+        return json.load(f)
+
+
+# ---------------------------------------------------------------------------
+# Experiment assets (data contracts from the reference)
+# ---------------------------------------------------------------------------
+
+def legal_scenarios() -> List[dict]:
+    """5 scenarios of the prompt-sensitivity study: original_main,
+    response_format, target_tokens[2], confidence_format
+    (perturb_prompts.py:728-734)."""
+    return _load("legal_scenarios.json")
+
+
+def irrelevant_scenarios() -> List[dict]:
+    """5 scenarios (simpler target tokens) of the irrelevant-insertion study
+    (perturb_with_irrelevant_statements.py:22-58)."""
+    return _load("irrelevant_scenarios.json")
+
+
+def irrelevant_statements() -> List[str]:
+    """199 factual statements (data/irrelevant_statements.txt)."""
+    path = os.path.join(_ASSETS, "irrelevant_statements.txt")
+    with open(path) as f:
+        return [line.strip() for line in f if line.strip()]
+
+
+def ordinary_meaning_questions() -> List[str]:
+    """The 100 ordinary-meaning questions (survey 1 + survey 2 —
+    run_base_vs_instruct_100q.py:120-231)."""
+    q = _load("ordinary_meaning_questions.json")
+    return q["survey1"] + q["survey2"]
+
+
+def model_pairs_100q() -> List[dict]:
+    """6 base/instruct pairs of the 100q sweep (run_base_vs_instruct_100q.py:88-115)."""
+    return _load("model_pairs_100q.json")
+
+
+def model_pairs_word_meaning() -> List[dict]:
+    """base/instruct pairs of the word-meaning sweep (compare_base_vs_instruct.py:136-180)."""
+    return _load("model_pairs_word_meaning.json")
+
+
+def instruct_sweep_models() -> List[str]:
+    """10-model instruct roster (compare_instruct_models.py:145-166)."""
+    return _load("instruct_sweep_models.json")
+
+
+def api_models() -> dict:
+    """Frontier-API model roster + pricing (perturb_prompts.py:37-65)."""
+    return _load("api_models.json")
+
+
+def irrelevant_eval_models() -> dict:
+    """Models of the irrelevant-perturbation evaluation
+    (evaluate_irrelevant_perturbations.py:41-57)."""
+    return _load("irrelevant_eval_models.json")
+
+
+# ---------------------------------------------------------------------------
+# Run configuration
+# ---------------------------------------------------------------------------
+
+@dataclasses.dataclass
+class RunConfig:
+    """One typed config for local-model sweeps."""
+
+    device: str = "tpu"                  # 'tpu' | 'cpu'
+    dtype: str = "bfloat16"              # params/compute dtype on device
+    mesh_data: Optional[int] = None      # None = all remaining devices
+    mesh_model: int = 1
+    mesh_seq: int = 1
+    batch_size: int = 32
+    max_new_tokens: int = 50
+    max_look_ahead: int = 10
+    top_k: int = 5
+    buckets: Sequence[int] = (64, 128, 256, 512, 1024, 2048)
+    checkpoint_dir: str = "checkpoints"  # local HF snapshots root
+    output_dir: str = "results"
+    seed: int = 42
+
+    def resolve_dtype(self):
+        import jax.numpy as jnp
+
+        return {"bfloat16": jnp.bfloat16, "float32": jnp.float32, "float16": jnp.float16}[self.dtype]
+
+    def make_mesh(self):
+        from ..parallel import make_mesh
+
+        return make_mesh(data=self.mesh_data, model=self.mesh_model, seq=self.mesh_seq)
+
+    def snapshot_path(self, model_name: str) -> str:
+        """Local snapshot dir for a HF model id (zero-egress: must exist)."""
+        flat = model_name.replace("/", "--")
+        candidates = [
+            os.path.join(self.checkpoint_dir, model_name),
+            os.path.join(self.checkpoint_dir, flat),
+            os.path.join(self.checkpoint_dir, f"models--{flat}", "snapshots"),
+        ]
+        for c in candidates:
+            if os.path.isdir(c):
+                if c.endswith("snapshots"):
+                    subs = sorted(os.listdir(c))
+                    if subs:
+                        return os.path.join(c, subs[0])
+                    continue
+                return c
+        raise FileNotFoundError(
+            f"no local snapshot for {model_name!r} under {self.checkpoint_dir}"
+        )

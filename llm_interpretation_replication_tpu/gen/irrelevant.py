@@ -1,0 +1,107 @@
+"""Irrelevant-statement perturbation generator.
+
+Behavioral replica of /root/reference/analysis/perturb_with_irrelevant_statements.py:
+split each scenario into sentences on ``(?<=\\.)\\s+``, insert each of the 199
+facts at every position (beginning + after each sentence), and emit the
+``perturbations_irrelevant.json`` schema (SURVEY.md §2.8): per scenario
+``{scenario_name, original_main, response_format, target_tokens,
+confidence_format, perturbations_with_irrelevant: [{perturbation_id,
+irrelevant_statement, position_index, position_description, perturbed_text}]}``
+— 400/400/600/1000/1000 = 3,400 perturbations for the reference scenarios.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+from typing import List, Optional, Sequence
+
+
+def split_sentences(text: str) -> List[str]:
+    """Period-boundary sentence split; every returned sentence ends with '.'"""
+    parts = re.split(r"(?<=\.)\s+", text)
+    out = []
+    for s in parts:
+        s = s.strip()
+        if not s:
+            continue
+        if not s.endswith("."):
+            s += "."
+        out.append(s)
+    return out
+
+
+def num_insertion_positions(text: str) -> int:
+    """Beginning + after each sentence."""
+    return len([s for s in re.split(r"(?<=\.)\s+", text) if s.strip()]) + 1
+
+
+def insert_statement(text: str, statement: str, position_index: int) -> str:
+    sentences = split_sentences(text)
+    if not statement.endswith("."):
+        statement += "."
+    if position_index <= len(sentences):
+        sentences.insert(position_index, statement)
+    else:
+        sentences.append(statement)
+    return " ".join(sentences)
+
+
+def position_description(position_index: int, num_positions: int) -> str:
+    if position_index == 0:
+        return "beginning"
+    if position_index == num_positions - 1:
+        return "end"
+    return f"after_sentence_{position_index}"
+
+
+def generate_perturbations(
+    scenarios: Sequence[dict],
+    statements: Sequence[str],
+    max_per_scenario: Optional[int] = None,
+) -> List[dict]:
+    """All (position × statement) insertions per scenario, ids starting at 1 —
+    ordering and naming match data/perturbations_irrelevant.json exactly."""
+    out = []
+    for scenario in scenarios:
+        main = scenario.get("main") or scenario["original_main"]
+        n_positions = num_insertion_positions(main)
+        perturbations = []
+        pid = 1
+        for pos in range(n_positions):
+            for statement in statements:
+                perturbations.append(
+                    {
+                        "perturbation_id": pid,
+                        "irrelevant_statement": statement,
+                        "position_index": pos,
+                        "position_description": position_description(pos, n_positions),
+                        "perturbed_text": insert_statement(main, statement, pos),
+                    }
+                )
+                pid += 1
+                if max_per_scenario and pid > max_per_scenario:
+                    break
+            if max_per_scenario and pid > max_per_scenario:
+                break
+        out.append(
+            {
+                "scenario_name": scenario.get("name") or scenario.get("scenario_name", ""),
+                "original_main": main,
+                "response_format": scenario["response_format"],
+                "target_tokens": scenario["target_tokens"],
+                "confidence_format": scenario["confidence_format"],
+                "perturbations_with_irrelevant": perturbations,
+            }
+        )
+    return out
+
+
+def save_perturbations(perturbed: Sequence[dict], path: str) -> None:
+    with open(path, "w") as f:
+        json.dump(list(perturbed), f, indent=2)
+
+
+def load_perturbations(path: str) -> List[dict]:
+    with open(path) as f:
+        return json.load(f)

@@ -139,7 +139,8 @@ class ScoringEngine:
         return [r if r is not None else _error_row("missing") for r in results]
 
     def first_token_relative_prob(
-        self, prompts: Sequence[str], targets: Sequence[str] = ("Yes", "No")
+        self, prompts: Sequence[str], targets: Sequence[str] = ("Yes", "No"),
+        top_filter: int = 0,
     ) -> np.ndarray:
         """Fast path: one forward per bucket, no generation — the pjit'd
         perturbation-sweep hot op.  Returns [N, 3] (yes, no, relative)."""
@@ -161,7 +162,7 @@ class ScoringEngine:
                 logits = jnp.take_along_axis(
                     logits, (lengths - 1)[:, None, None], axis=1
                 )[:, 0, :]
-            yes, no, rel = yn.relative_prob_first_token(logits, yes_id, no_id)
+            yes, no, rel = yn.relative_prob_first_token(logits, yes_id, no_id, top_filter)
             for r, orig in enumerate(batch.indices):
                 if orig >= 0:
                     out[int(orig)] = (float(yes[r]), float(no[r]), float(rel[r]))

@@ -59,16 +59,24 @@ def yes_no_from_scores(
     return YesNoResult(yes, no, relative, odds, found, sel)
 
 
-@jax.jit
-def relative_prob_first_token(logits: jnp.ndarray, yes_id, no_id):
+@functools.partial(jax.jit, static_argnames=("top_filter",))
+def relative_prob_first_token(logits: jnp.ndarray, yes_id, no_id, top_filter: int = 0):
     """Fast path: single-forward scoring at the final prompt position (the
-    pjit'd sweep's hot op — BASELINE.json north star).  logits: [B, V] fp32."""
+    pjit'd sweep's hot op — BASELINE.json north star).  logits: [B, V] fp32.
+
+    ``top_filter`` > 0 zeroes probabilities outside the top-N, matching the
+    API extractor that only sees top-20 logprobs (perturb_prompts.py:480-498).
+    """
     probs = jax.nn.softmax(logits.astype(jnp.float32), axis=-1)
     b = logits.shape[0]
     yes_id = jnp.broadcast_to(jnp.asarray(yes_id), (b,))
     no_id = jnp.broadcast_to(jnp.asarray(no_id), (b,))
     yes = jnp.take_along_axis(probs, yes_id[:, None], axis=-1)[:, 0]
     no = jnp.take_along_axis(probs, no_id[:, None], axis=-1)[:, 0]
+    if top_filter:
+        kth = jax.lax.top_k(probs, top_filter)[0][:, -1]
+        yes = jnp.where(yes >= kth, yes, 0.0)
+        no = jnp.where(no >= kth, no, 0.0)
     total = yes + no
     relative = jnp.where(total > 0, yes / jnp.where(total > 0, total, 1.0), 0.5)
     return yes, no, relative

@@ -1,0 +1,15 @@
+from .base_vs_instruct_100q import run_model_on_prompts, run_sweep
+from .instruct_sweep import run_base_vs_instruct_word_meaning, run_instruct_sweep
+from .perturbation import load_existing_keys, run_model_perturbation_sweep
+from .writers import (
+    BASE_VS_INSTRUCT_100Q_COLUMNS,
+    INSTRUCT_COMPARISON_COLUMNS,
+    MODEL_COMPARISON_COLUMNS,
+    PERTURBATION_COLUMNS,
+    base_vs_instruct_100q_frame,
+    instruct_comparison_frame,
+    model_comparison_frame,
+    model_family_from_name,
+    perturbation_frame,
+    perturbation_row,
+)

@@ -1,0 +1,101 @@
+"""100-question base-vs-instruct sweep (the north-star workload).
+
+TPU-native rebuild of run_base_vs_instruct_100q.py:514-599: per (base,
+instruct, family) pair, format the 100 ordinary-meaning questions (few-shot
+for base, bare for instruct), score the whole batch in one jit'd sweep, and
+checkpoint after every model so a preempted run resumes.  The CSV matches
+``base_vs_instruct_100q_results.csv``; the statistics leg
+(instruct−base MAE bootstrap) lives in stats/bootstrap.py.
+"""
+
+from __future__ import annotations
+
+from typing import Callable, Dict, List, Optional, Sequence
+
+import pandas as pd
+
+from ..config import model_pairs_100q, ordinary_meaning_questions
+from ..scoring.prompts import format_prompt
+from ..utils.checkpoint import CheckpointFile
+from ..utils.logging import SessionLogger
+from .writers import base_vs_instruct_100q_frame
+
+EngineFactory = Callable[[str], object]  # model name -> ScoringEngine
+
+
+def run_model_on_prompts(engine, model_name: str, prompts: Sequence[str], is_base_model: bool) -> List[Dict]:
+    formatted = [format_prompt(q, is_base_model, model_name) for q in prompts]
+    try:
+        rows = engine.score_prompts(formatted)
+    except Exception as err:  # error rows keep the sweep moving (ref :484-496)
+        return [
+            {
+                "prompt": q,
+                "model": model_name,
+                "formatted_prompt": f[:200],
+                "yes_prob": float("nan"),
+                "no_prob": float("nan"),
+                "relative_prob": float("nan"),
+                "completion": f"MODEL_ERROR: {str(err)[:50]}",
+                "success": False,
+            }
+            for q, f in zip(prompts, formatted)
+        ]
+    out = []
+    for q, f, row in zip(prompts, formatted, rows):
+        out.append(
+            {
+                "yes_prob": row["yes_prob"],
+                "no_prob": row["no_prob"],
+                "relative_prob": row["relative_prob"],
+                "completion": row["completion"],
+                "success": row["success"],
+                "prompt": q,
+                "model": model_name,
+                "formatted_prompt": f[:200],
+            }
+        )
+    return out
+
+
+def run_sweep(
+    engine_factory: EngineFactory,
+    model_pairs: Optional[Sequence[Dict]] = None,
+    prompts: Optional[Sequence[str]] = None,
+    checkpoint_path: str = "results/base_vs_instruct_100q_checkpoint.json",
+    results_csv: str = "results/base_vs_instruct_100q_results.csv",
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    log = log or SessionLogger()
+    model_pairs = model_pairs if model_pairs is not None else model_pairs_100q()
+    prompts = prompts if prompts is not None else ordinary_meaning_questions()
+    ck = CheckpointFile(checkpoint_path, default={"completed_models": [], "results": []})
+    state = ck.load()
+    completed = set(state["completed_models"])
+    all_results: List[Dict] = list(state["results"])
+
+    for pair in model_pairs:
+        base, instruct, family = pair["base"], pair["instruct"], pair["family"]
+        for model_name, role, is_base in ((base, "base", True), (instruct, "instruct", False)):
+            if model_name in completed:
+                log(f"Skipping {model_name} (already completed)")
+                continue
+            log(f"Running {role.upper()} model: {model_name}")
+            engine = engine_factory(model_name)
+            results = run_model_on_prompts(engine, model_name, prompts, is_base)
+            for r in results:
+                r["model_family"] = family
+                r["base_or_instruct"] = role
+            all_results.extend(results)
+            completed.add(model_name)
+            state = {"completed_models": sorted(completed), "results": all_results}
+            ck.save(state)
+            log(f"Checkpoint saved after {model_name}")
+
+    df = base_vs_instruct_100q_frame(all_results)
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
+    df.to_csv(results_csv, index=False)
+    log(f"Saved {len(df)} rows to {results_csv}")
+    return df

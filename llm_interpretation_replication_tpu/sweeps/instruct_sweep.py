@@ -1,0 +1,96 @@
+"""Instruct-model and base-vs-instruct word-meaning sweeps.
+
+TPU rebuilds of compare_instruct_models.py (10-model instruct roster →
+``instruct_model_comparison_results.csv``) and compare_base_vs_instruct.py
+(base/instruct pairs → ``model_comparison_results.csv``), with per-model
+checkpointing and the same CSV contracts.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Callable, Dict, Optional, Sequence
+
+import pandas as pd
+
+from ..config import instruct_sweep_models, model_pairs_word_meaning
+from ..scoring.prompts import format_instruct_prompt, format_prompt
+from ..utils.checkpoint import CheckpointFile
+from ..utils.logging import SessionLogger
+from .writers import instruct_comparison_frame, model_comparison_frame
+
+EngineFactory = Callable[[str], object]
+
+
+def _score_model(engine, model_name: str, prompts: Sequence[str], is_base: bool) -> Dict[str, Dict]:
+    formatted = [format_prompt(q, is_base, model_name) for q in prompts]
+    try:
+        rows = engine.score_prompts(formatted)
+    except Exception as err:
+        rows = [
+            {
+                "yes_prob": float("nan"), "no_prob": float("nan"),
+                "relative_prob": float("nan"), "odds_ratio": float("nan"),
+                "completion": f"MODEL_ERROR: {str(err)[:50]}", "success": False,
+            }
+            for _ in prompts
+        ]
+    return {q: row for q, row in zip(prompts, rows)}
+
+
+def run_instruct_sweep(
+    engine_factory: EngineFactory,
+    prompts: Sequence[str],
+    models: Optional[Sequence[str]] = None,
+    checkpoint_path: str = "results/instruct_sweep_checkpoint.json",
+    results_csv: str = "results/instruct_model_comparison_results.csv",
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    log = log or SessionLogger()
+    models = list(models if models is not None else instruct_sweep_models())
+    ck = CheckpointFile(checkpoint_path, default={"outputs": {}})
+    state = ck.load()
+    outputs: Dict[str, Dict] = state["outputs"]
+    for model_name in models:
+        if model_name in outputs:
+            log(f"Skipping {model_name} (checkpointed)")
+            continue
+        log(f"Running instruct model: {model_name}")
+        engine = engine_factory(model_name)
+        outputs[model_name] = _score_model(engine, model_name, prompts, is_base=False)
+        ck.save({"outputs": outputs})
+    df = instruct_comparison_frame(outputs, models)
+    os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
+    df.to_csv(results_csv, index=False)
+    log(f"Saved {len(df)} rows to {results_csv}")
+    return df
+
+
+def run_base_vs_instruct_word_meaning(
+    engine_factory: EngineFactory,
+    prompts: Sequence[str],
+    model_pairs: Optional[Sequence[Dict]] = None,
+    checkpoint_path: str = "results/model_comparison_checkpoint.json",
+    results_csv: str = "results/model_comparison_results.csv",
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    log = log or SessionLogger()
+    model_pairs = list(model_pairs if model_pairs is not None else model_pairs_word_meaning())
+    pair_tuples = [(p["base"], p["instruct"]) for p in model_pairs]
+    ck = CheckpointFile(checkpoint_path, default={"outputs": {}})
+    state = ck.load()
+    outputs: Dict[str, Dict] = state["outputs"]
+    for base, instruct in pair_tuples:
+        for model_name, is_base in ((base, True), (instruct, False)):
+            if model_name in outputs:
+                log(f"Skipping {model_name} (checkpointed)")
+                continue
+            log(f"Running {'base' if is_base else 'instruct'} model: {model_name}")
+            engine = engine_factory(model_name)
+            outputs[model_name] = _score_model(engine, model_name, prompts, is_base)
+            ck.save({"outputs": outputs})
+    df = model_comparison_frame(outputs, pair_tuples)
+    os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
+    df.to_csv(results_csv, index=False)
+    log(f"Saved {len(df)} rows to {results_csv}")
+    return df

@@ -1,0 +1,128 @@
+"""10k-perturbation sweep on local TPU models.
+
+The reference runs this sweep only against vendor APIs (perturb_prompts.py);
+the TPU build makes the same sweep run against local checkpoints: per scenario
+(5 × 2000 rephrasings), a binary leg scoring the two target tokens at the
+first generated position (top-20 membership semantics like the API extractor,
+perturb_prompts.py:480-498) and a confidence leg (greedy continuation parsed
+for the first integer + digit-reconstruction weighted confidence).
+
+Output workbook matches the 15-column schema (SURVEY.md §2.8) so
+``analyze_perturbation_results.py``-equivalent stats consume it unchanged.
+Resume: rows already present in the output workbook are skipped by
+(model, original_main, rephrased_main) key (ibid.:161-188).
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from ..scoring.confidence import (
+    extract_first_int,
+    top_candidates_from_scores,
+    weighted_confidence_digits,
+)
+from ..utils.logging import SessionLogger
+from ..utils.xlsx import read_xlsx, write_xlsx
+from .writers import PERTURBATION_COLUMNS, perturbation_frame, perturbation_row
+
+TOP_LOGPROBS = 20  # API extractor scans top-20 of the first token
+
+
+def load_existing_keys(output_xlsx: str) -> set:
+    if not os.path.exists(output_xlsx):
+        return set()
+    df = read_xlsx(output_xlsx)
+    if df.empty:
+        return set()
+    return {
+        (row["Model"], row["Original Main Part"], row["Rephrased Main Part"])
+        for _, row in df.iterrows()
+    }
+
+
+def run_model_perturbation_sweep(
+    engine,
+    model_name: str,
+    scenarios: Sequence[Dict],
+    output_xlsx: str,
+    checkpoint_every: int = 100,
+    max_rephrasings: Optional[int] = None,
+    confidence: bool = True,
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    log = log or SessionLogger()
+    processed = load_existing_keys(output_xlsx)
+    existing_df = read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else perturbation_frame([])
+    all_rows: List[Dict] = existing_df.to_dict("records") if len(existing_df) else []
+    pending: List[Dict] = []
+
+    def flush():
+        nonlocal pending, all_rows
+        if not pending:
+            return
+        all_rows.extend(pending)
+        pending = []
+        os.makedirs(os.path.dirname(os.path.abspath(output_xlsx)), exist_ok=True)
+        write_xlsx(pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS), output_xlsx)
+
+    for scenario in scenarios:
+        rephrasings = scenario["rephrasings"]
+        if max_rephrasings:
+            rephrasings = rephrasings[:max_rephrasings]
+        todo = [
+            r for r in rephrasings
+            if (model_name, scenario["original_main"], r) not in processed
+        ]
+        if not todo:
+            log(f"Scenario already complete for {model_name}")
+            continue
+        log(f"{model_name}: scoring {len(todo)} rephrasings of scenario "
+            f"{scenario['original_main'][:50]!r}...")
+        targets = list(scenario["target_tokens"])
+        binary_prompts = [f"{r} {scenario['response_format']}" for r in todo]
+        probs = engine.first_token_relative_prob(
+            binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
+        )
+        responses = engine.score_prompts(binary_prompts, targets=targets)
+
+        conf_values: List[Optional[int]] = [None] * len(todo)
+        conf_texts = [""] * len(todo)
+        weighted: List[Optional[float]] = [None] * len(todo)
+        if confidence:
+            conf_prompts = [f"{r} {scenario['confidence_format']}" for r in todo]
+            conf_rows = engine.score_prompts(
+                conf_prompts, targets=targets, with_confidence=True
+            )
+            for i, row in enumerate(conf_rows):
+                conf_texts[i] = row["completion"]
+                conf_values[i] = extract_first_int(row["completion"])
+                weighted[i] = row.get("weighted_confidence")
+
+        for i, reph in enumerate(todo):
+            t1p, t2p = float(probs[i, 0]), float(probs[i, 1])
+            odds = t1p / t2p if t2p > 0 else float("inf")
+            pending.append(
+                perturbation_row(
+                    model_name,
+                    scenario,
+                    reph,
+                    response_text=responses[i]["completion"],
+                    confidence_text=conf_texts[i],
+                    logprobs_repr=f"local:first_token_top{TOP_LOGPROBS}",
+                    token_1_prob=t1p,
+                    token_2_prob=t2p,
+                    odds_ratio=odds,
+                    confidence_value=conf_values[i],
+                    weighted_confidence=weighted[i],
+                )
+            )
+            processed.add((model_name, scenario["original_main"], reph))
+            if len(pending) >= checkpoint_every:
+                flush()
+    flush()
+    return pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS)

@@ -1,0 +1,138 @@
+"""Schema-exact result writers (contracts in SURVEY.md §2.8).
+
+Every downstream statistics script keys on these exact column names; rows are
+built from engine result dicts so the CSV/XLSX outputs are drop-in replacements
+for the reference's.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import pandas as pd
+
+#: perturbation result workbook columns (perturb_prompts.py:966-969)
+PERTURBATION_COLUMNS = [
+    "Model",
+    "Original Main Part",
+    "Response Format",
+    "Confidence Format",
+    "Rephrased Main Part",
+    "Full Rephrased Prompt",
+    "Full Confidence Prompt",
+    "Model Response",
+    "Model Confidence Response",
+    "Log Probabilities",
+    "Token_1_Prob",
+    "Token_2_Prob",
+    "Odds_Ratio",
+    "Confidence Value",
+    "Weighted Confidence",
+]
+
+#: base_vs_instruct_100q_results.csv (run_base_vs_instruct_100q.py:376-382,472-476,547-567)
+BASE_VS_INSTRUCT_100Q_COLUMNS = [
+    "yes_prob", "no_prob", "relative_prob", "completion", "success",
+    "prompt", "model", "formatted_prompt", "model_family", "base_or_instruct",
+]
+
+#: data/model_comparison_results.csv (compare_base_vs_instruct.py:90-111)
+MODEL_COMPARISON_COLUMNS = [
+    "prompt", "model", "model_family", "base_or_instruct", "model_output",
+    "yes_prob", "no_prob", "odds_ratio",
+]
+
+#: data/instruct_model_comparison_results.csv (compare_instruct_models.py:103-121)
+INSTRUCT_COMPARISON_COLUMNS = [
+    "prompt", "model", "model_family", "model_output",
+    "yes_prob", "no_prob", "relative_prob",
+]
+
+
+def model_family_from_name(model_name: str) -> str:
+    """``org/model-name`` → family slug (compare_instruct_models.py:108)."""
+    tail = model_name.split("/")[1] if "/" in model_name else model_name
+    return tail.split("-")[0].lower()
+
+
+def perturbation_row(
+    model: str,
+    scenario: Dict,
+    rephrased_main: str,
+    response_text: str = "",
+    confidence_text: str = "",
+    logprobs_repr: str = "",
+    token_1_prob: float = 0.0,
+    token_2_prob: float = 0.0,
+    odds_ratio: float = 0.0,
+    confidence_value: Optional[int] = None,
+    weighted_confidence: Optional[float] = None,
+) -> Dict:
+    return {
+        "Model": model,
+        "Original Main Part": scenario["original_main"],
+        "Response Format": scenario["response_format"],
+        "Confidence Format": scenario["confidence_format"],
+        "Rephrased Main Part": rephrased_main,
+        "Full Rephrased Prompt": f"{rephrased_main} {scenario['response_format']}",
+        "Full Confidence Prompt": f"{rephrased_main} {scenario['confidence_format']}",
+        "Model Response": response_text,
+        "Model Confidence Response": confidence_text,
+        "Log Probabilities": logprobs_repr,
+        "Token_1_Prob": token_1_prob,
+        "Token_2_Prob": token_2_prob,
+        "Odds_Ratio": odds_ratio,
+        "Confidence Value": confidence_value,
+        "Weighted Confidence": weighted_confidence,
+    }
+
+
+def perturbation_frame(rows: Sequence[Dict]) -> pd.DataFrame:
+    return pd.DataFrame(list(rows), columns=PERTURBATION_COLUMNS)
+
+
+def base_vs_instruct_100q_frame(rows: Sequence[Dict]) -> pd.DataFrame:
+    return pd.DataFrame(list(rows))[BASE_VS_INSTRUCT_100Q_COLUMNS]
+
+
+def model_comparison_frame(outputs: Dict[str, Dict[str, Dict]], model_pairs) -> pd.DataFrame:
+    """outputs[model][prompt] -> result dict; pairs of (base, instruct)."""
+    data = []
+    for pair in model_pairs:
+        base_name, instruct_name = pair[0], pair[1]
+        for model_name in (base_name, instruct_name):
+            family = model_family_from_name(model_name)
+            role = "base" if model_name == base_name else "instruct"
+            for prompt, result in outputs.get(model_name, {}).items():
+                data.append(
+                    {
+                        "prompt": prompt,
+                        "model": model_name,
+                        "model_family": family,
+                        "base_or_instruct": role,
+                        "model_output": result.get("completion", "N/A"),
+                        "yes_prob": result.get("yes_prob", float("nan")),
+                        "no_prob": result.get("no_prob", float("nan")),
+                        "odds_ratio": result.get("odds_ratio", float("nan")),
+                    }
+                )
+    return pd.DataFrame(data, columns=MODEL_COMPARISON_COLUMNS)
+
+
+def instruct_comparison_frame(outputs: Dict[str, Dict[str, Dict]], models: Sequence[str]) -> pd.DataFrame:
+    data = []
+    for model_name in models:
+        family = model_family_from_name(model_name)
+        for prompt, result in outputs.get(model_name, {}).items():
+            data.append(
+                {
+                    "prompt": prompt,
+                    "model": model_name,
+                    "model_family": family,
+                    "model_output": result.get("completion", "N/A"),
+                    "yes_prob": result.get("yes_prob", float("nan")),
+                    "no_prob": result.get("no_prob", float("nan")),
+                    "relative_prob": result.get("relative_prob", float("nan")),
+                }
+            )
+    return pd.DataFrame(data, columns=INSTRUCT_COMPARISON_COLUMNS)

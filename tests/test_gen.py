@@ -1,0 +1,101 @@
+"""Generator tests, incl. the golden diff against the reference's shipped
+perturbations_irrelevant.json (reference data mounted read-only)."""
+
+import json
+import os
+
+import pytest
+
+from llm_interpretation_replication_tpu.config import (
+    irrelevant_scenarios,
+    irrelevant_statements,
+    legal_scenarios,
+)
+from llm_interpretation_replication_tpu.gen import (
+    generate_perturbations,
+    parse_numbered_rephrasings,
+)
+from llm_interpretation_replication_tpu.gen.rephrase import (
+    generate_rephrasings,
+    load_perturbations,
+    save_perturbations,
+)
+
+REF_DATA = "/root/reference/data/perturbations_irrelevant.json"
+
+
+class TestIrrelevantPerturber:
+    @pytest.mark.skipif(not os.path.exists(REF_DATA), reason="reference not mounted")
+    def test_golden_exact_reproduction(self):
+        ref = json.load(open(REF_DATA))
+        ours = generate_perturbations(irrelevant_scenarios(), irrelevant_statements())
+        assert len(ours) == len(ref) == 5
+        total = 0
+        for o, r in zip(ours, ref):
+            assert o["scenario_name"] == r["scenario_name"]
+            assert o["perturbations_with_irrelevant"] == r["perturbations_with_irrelevant"]
+            total += len(o["perturbations_with_irrelevant"])
+        assert total == 3400
+
+    def test_counts_by_scenario(self):
+        ours = generate_perturbations(irrelevant_scenarios(), irrelevant_statements())
+        counts = [len(s["perturbations_with_irrelevant"]) for s in ours]
+        assert counts == [400, 400, 600, 1000, 1000]
+
+
+class TestRephrasings:
+    def test_parse_numbered_list(self):
+        text = (
+            "Here are 20 variations:\n"
+            "1. First rephrasing?\n"
+            "2. Second rephrasing\n"
+            "   that continues on another line?\n"
+            "3 Third without dot?\n"
+            "\n"
+            "4. Fourth?\n"
+        )
+        got = parse_numbered_rephrasings(text)
+        assert got == [
+            "First rephrasing?",
+            "Second rephrasing that continues on another line?",
+            "Third without dot?",
+            "Fourth?",
+        ]
+
+    def test_generate_with_fake_backend(self):
+        scenarios = legal_scenarios()[:1]
+        calls = {"n": 0}
+
+        def fake_complete(prompt):
+            calls["n"] += 1
+            assert scenarios[0]["original_main"][:40] in prompt
+            return "\n".join(f"{i}. Variation {calls['n']}-{i}?" for i in range(1, 21))
+
+        records = generate_rephrasings(
+            scenarios, fake_complete, sessions_per_scenario=3, target_per_scenario=50
+        )
+        assert len(records) == 1
+        assert len(records[0]["rephrasings"]) == 50
+        assert records[0]["target_tokens"] == list(scenarios[0]["target_tokens"])
+
+    def test_save_load_identity_verification(self, tmp_path):
+        scenarios = legal_scenarios()
+        records = [
+            {
+                "original_main": s["original_main"],
+                "response_format": s["response_format"],
+                "target_tokens": list(s["target_tokens"]),
+                "confidence_format": s["confidence_format"],
+                "rephrasings": ["a?", "b?"],
+            }
+            for s in scenarios
+        ]
+        path = str(tmp_path / "perturbations.json")
+        save_perturbations(records, path)
+        back = load_perturbations(path, expected_scenarios=scenarios)
+        assert back[0]["rephrasings"] == ["a?", "b?"]
+        # tampered scenario text must fail verification
+        records[0]["original_main"] = "different"
+        save_perturbations(records, path)
+        with pytest.raises(ValueError):
+            load_perturbations(path, expected_scenarios=scenarios)

@@ -1,0 +1,204 @@
+"""Sweep orchestration tests with a deterministic fake engine: schema-exact
+outputs, checkpoint/resume, error-row behavior."""
+
+import hashlib
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from llm_interpretation_replication_tpu.sweeps import (
+    BASE_VS_INSTRUCT_100Q_COLUMNS,
+    INSTRUCT_COMPARISON_COLUMNS,
+    MODEL_COMPARISON_COLUMNS,
+    PERTURBATION_COLUMNS,
+    run_base_vs_instruct_word_meaning,
+    run_instruct_sweep,
+    run_model_perturbation_sweep,
+    run_sweep,
+)
+from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
+
+
+class FakeEngine:
+    """Deterministic scoring from a hash of (model, prompt)."""
+
+    def __init__(self, model_name, fail=False):
+        self.model_name = model_name
+        self.fail = fail
+        self.calls = 0
+
+    def _p(self, prompt):
+        h = hashlib.sha256(f"{self.model_name}|{prompt}".encode()).digest()
+        return h[0] / 255.0, h[1] / 255.0
+
+    def score_prompts(self, prompts, targets=("Yes", "No"), with_confidence=False):
+        if self.fail:
+            raise RuntimeError("simulated OOM")
+        self.calls += 1
+        rows = []
+        for p in prompts:
+            a, b = self._p(p)
+            total = a + b
+            row = {
+                "yes_prob": a,
+                "no_prob": b,
+                "relative_prob": a / total if total else 0.5,
+                "odds_ratio": a / b if b else float("inf"),
+                "scan_found": True,
+                "completion": "Yes" if a > b else "No",
+                "success": True,
+            }
+            if with_confidence:
+                row["weighted_confidence"] = round(100 * a, 2)
+                row["completion"] = str(int(100 * a))
+            rows.append(row)
+        return rows
+
+    def first_token_relative_prob(self, prompts, targets=("Yes", "No"), top_filter=0):
+        out = np.zeros((len(prompts), 3))
+        for i, p in enumerate(prompts):
+            a, b = self._p(p)
+            out[i] = (a, b, a / (a + b))
+        return out
+
+    def target_ids(self, targets):
+        return [1, 2]
+
+
+PAIRS = [
+    {"base": "fake/alpha-7b", "instruct": "fake/alpha-7b-instruct", "family": "Alpha"},
+    {"base": "fake/beta-7b", "instruct": "fake/beta-7b-instruct", "family": "Beta"},
+]
+QUESTIONS = [f'Is a "thing{i}" a "stuff{i}"?' for i in range(5)]
+
+
+class TestBaseVsInstruct100q:
+    def test_schema_and_rows(self, tmp_path):
+        made = []
+
+        def factory(name):
+            made.append(name)
+            return FakeEngine(name)
+
+        df = run_sweep(
+            factory, model_pairs=PAIRS, prompts=QUESTIONS,
+            checkpoint_path=str(tmp_path / "ck.json"),
+            results_csv=str(tmp_path / "out.csv"),
+        )
+        assert list(df.columns) == BASE_VS_INSTRUCT_100Q_COLUMNS
+        assert len(df) == 4 * len(QUESTIONS)
+        assert set(df["base_or_instruct"]) == {"base", "instruct"}
+        assert set(df["model_family"]) == {"Alpha", "Beta"}
+        assert df["success"].all()
+        saved = pd.read_csv(tmp_path / "out.csv")
+        assert len(saved) == len(df)
+
+    def test_resume_skips_completed(self, tmp_path):
+        factory_calls = []
+
+        def factory(name):
+            factory_calls.append(name)
+            return FakeEngine(name)
+
+        ck = str(tmp_path / "ck.json")
+        csv = str(tmp_path / "out.csv")
+        run_sweep(factory, model_pairs=PAIRS[:1], prompts=QUESTIONS,
+                  checkpoint_path=ck, results_csv=csv)
+        n_first = len(factory_calls)
+        # second run with both pairs: only the new pair's models load
+        run_sweep(factory, model_pairs=PAIRS, prompts=QUESTIONS,
+                  checkpoint_path=ck, results_csv=csv)
+        assert n_first == 2
+        assert factory_calls[n_first:] == ["fake/beta-7b", "fake/beta-7b-instruct"]
+
+    def test_error_rows_keep_sweep_alive(self, tmp_path):
+        def factory(name):
+            return FakeEngine(name, fail="beta" in name)
+
+        df = run_sweep(
+            factory, model_pairs=PAIRS, prompts=QUESTIONS,
+            checkpoint_path=str(tmp_path / "ck.json"),
+            results_csv=str(tmp_path / "out.csv"),
+        )
+        beta = df[df["model"].str.contains("beta")]
+        assert (~beta["success"].astype(bool)).all()
+        assert beta["completion"].str.startswith("MODEL_ERROR").all()
+        alpha = df[df["model"].str.contains("alpha")]
+        assert alpha["success"].all()
+
+
+class TestInstructSweep:
+    def test_schema(self, tmp_path):
+        df = run_instruct_sweep(
+            lambda name: FakeEngine(name),
+            prompts=QUESTIONS,
+            models=["fake/gamma-7b-instruct", "fake/delta-7b-chat"],
+            checkpoint_path=str(tmp_path / "ck.json"),
+            results_csv=str(tmp_path / "out.csv"),
+        )
+        assert list(df.columns) == INSTRUCT_COMPARISON_COLUMNS
+        assert set(df["model_family"]) == {"gamma", "delta"}
+
+    def test_word_meaning_pairs_schema(self, tmp_path):
+        df = run_base_vs_instruct_word_meaning(
+            lambda name: FakeEngine(name),
+            prompts=QUESTIONS,
+            model_pairs=[{"base": "fake/eps-7b", "instruct": "fake/eps-7b-instruct"}],
+            checkpoint_path=str(tmp_path / "ck.json"),
+            results_csv=str(tmp_path / "out.csv"),
+        )
+        assert list(df.columns) == MODEL_COMPARISON_COLUMNS
+        assert set(df["base_or_instruct"]) == {"base", "instruct"}
+
+
+class TestPerturbationSweep:
+    SCENARIOS = [
+        {
+            "original_main": "Scenario one text.",
+            "response_format": "Answer only 'Covered' or 'Not Covered'.",
+            "target_tokens": ["Covered", "Not"],
+            "confidence_format": "How confident are you, 0 to 100?",
+            "rephrasings": [f"Rephrasing {i} of one." for i in range(6)],
+        },
+        {
+            "original_main": "Scenario two text.",
+            "response_format": "Answer only 'First' or 'Ultimate'.",
+            "target_tokens": ["Ultimate", "First"],
+            "confidence_format": "How confident, 0-100?",
+            "rephrasings": [f"Rephrasing {i} of two." for i in range(4)],
+        },
+    ]
+
+    def test_workbook_schema_and_content(self, tmp_path):
+        out = str(tmp_path / "results.xlsx")
+        df = run_model_perturbation_sweep(
+            FakeEngine("fake/model-7b"), "fake/model-7b", self.SCENARIOS, out,
+            checkpoint_every=3,
+        )
+        assert list(df.columns) == PERTURBATION_COLUMNS
+        assert len(df) == 10
+        back = read_xlsx(out)
+        assert list(back.columns) == PERTURBATION_COLUMNS
+        assert len(back) == 10
+        row = back.iloc[0]
+        assert row["Full Rephrased Prompt"] == (
+            f"{row['Rephrased Main Part']} {row['Response Format']}"
+        )
+        assert row["Token_1_Prob"] > 0 or row["Token_2_Prob"] > 0
+
+    def test_resume_skips_done_rows(self, tmp_path):
+        out = str(tmp_path / "results.xlsx")
+        run_model_perturbation_sweep(
+            FakeEngine("fake/model-7b"), "fake/model-7b",
+            [dict(self.SCENARIOS[0], rephrasings=self.SCENARIOS[0]["rephrasings"][:3])],
+            out,
+        )
+        eng = FakeEngine("fake/model-7b")
+        df = run_model_perturbation_sweep(
+            eng, "fake/model-7b", self.SCENARIOS, out
+        )
+        assert len(df) == 10
+        # no duplicated rows after resume
+        keys = df["Rephrased Main Part"].tolist()
+        assert len(keys) == len(set(keys))
