@@ -1,0 +1,99 @@
+"""Native (C) components, built on demand with the system compiler.
+
+``levenshtein`` mirrors the python-Levenshtein C dependency of the reference's
+similarity validator (calculate_prompt_similarity.py).  The shared object is
+compiled once into this directory and loaded via ctypes; a pure-python
+fallback keeps everything working if no compiler is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+from typing import Optional
+
+_DIR = os.path.dirname(os.path.abspath(__file__))
+_SRC = os.path.join(_DIR, "levenshtein.c")
+_SO = os.path.join(_DIR, "_levenshtein.so")
+
+_lib: Optional[ctypes.CDLL] = None
+_build_failed = False
+
+
+def _build() -> Optional[ctypes.CDLL]:
+    global _build_failed
+    if _build_failed:
+        return None
+    try:
+        if not os.path.exists(_SO) or os.path.getmtime(_SO) < os.path.getmtime(_SRC):
+            cc = os.environ.get("CC", "cc")
+            subprocess.run(
+                [cc, "-O3", "-shared", "-fPIC", "-o", _SO, _SRC],
+                check=True,
+                capture_output=True,
+            )
+        lib = ctypes.CDLL(_SO)
+        lib.levenshtein_u32.restype = ctypes.c_size_t
+        lib.levenshtein_u32.argtypes = [
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+            ctypes.POINTER(ctypes.c_uint32), ctypes.c_size_t,
+        ]
+        return lib
+    except Exception:
+        _build_failed = True
+        return None
+
+
+def _get_lib() -> Optional[ctypes.CDLL]:
+    global _lib
+    if _lib is None and not _build_failed:
+        _lib = _build()
+    return _lib
+
+
+def _as_u32(s: str):
+    data = s.encode("utf-32-le")
+    n = len(data) // 4
+    buf = (ctypes.c_uint32 * n).from_buffer_copy(data) if n else (ctypes.c_uint32 * 1)()
+    return buf, n
+
+
+def _levenshtein_py(a: str, b: str) -> int:
+    if not a:
+        return len(b)
+    if not b:
+        return len(a)
+    prev = list(range(len(b) + 1))
+    for i, ca in enumerate(a, 1):
+        curr = [i]
+        for j, cb in enumerate(b, 1):
+            cost = 0 if ca == cb else 1
+            curr.append(min(prev[j] + 1, curr[j - 1] + 1, prev[j - 1] + cost))
+        prev = curr
+    return prev[-1]
+
+
+def levenshtein(a: str, b: str) -> int:
+    """Edit distance (native C when available, python fallback otherwise)."""
+    lib = _get_lib()
+    if lib is None:
+        return _levenshtein_py(a, b)
+    ba, la = _as_u32(a)
+    bb, lb = _as_u32(b)
+    out = lib.levenshtein_u32(ba, la, bb, lb)
+    if out == ctypes.c_size_t(-1).value:  # alloc failure
+        return _levenshtein_py(a, b)
+    return int(out)
+
+
+def normalized_levenshtein_similarity(a: str, b: str) -> float:
+    """1 − d/max_len (the reference's normalized similarity)."""
+    if not a and not b:
+        return 1.0
+    m = max(len(a), len(b))
+    return 1.0 - levenshtein(a, b) / m
+
+
+def using_native() -> bool:
+    return _get_lib() is not None

@@ -1,0 +1,32 @@
+from .bootstrap import (
+    base_vs_instruct_analysis,
+    bootstrap_mae,
+    bootstrap_mae_difference,
+    bootstrap_statistic,
+    paired_mean_diff_bootstrap,
+)
+from .compliance import (
+    EXPECTED_TOKENS,
+    check_confidence_compliance,
+    check_first_and_full,
+    check_output_compliance,
+    classify_confidence_response,
+)
+from .correlations import (
+    cohens_kappa,
+    correlation_summary_bootstrap,
+    fisher_z_pvalue,
+    pairwise_correlations,
+    pairwise_kappa,
+    pivot_model_values,
+)
+from .normality import ad_pvalue_from_bands, normality_tests
+from .power import power_curve, required_sample_size, simulated_power
+from .similarity import (
+    BM25Okapi,
+    bm25_similarity_matrix,
+    calculate_all_similarities,
+    levenshtein_similarity_matrix,
+    tfidf_cosine_matrix,
+)
+from .truncated import fit_clipped_normal, simulate_clipped_normal

@@ -1,0 +1,167 @@
+"""Inter-model correlation and agreement engine.
+
+Behavioral replica of model_comparison_graph.py:207-341/495-709 and
+calculate_cohens_kappa.py: pivot prompts×models, all pairwise Pearson/Spearman
+correlations, prompt-resampling bootstrap of summary statistics, and Cohen's
+kappa on binary judgments thresholded at 0.5.
+"""
+
+from __future__ import annotations
+
+from itertools import combinations
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+from scipy import stats as scipy_stats
+
+
+def pivot_model_values(df: pd.DataFrame, value_col: str = "relative_prob",
+                       prompt_col: str = "prompt", model_col: str = "model") -> pd.DataFrame:
+    """prompts × models matrix of ``value_col``."""
+    return df.pivot_table(index=prompt_col, columns=model_col, values=value_col)
+
+
+def pairwise_correlations(pivot: pd.DataFrame) -> pd.DataFrame:
+    """All model pairs: Pearson r/p and Spearman ρ/p over shared prompts."""
+    rows = []
+    for a, b in combinations(pivot.columns, 2):
+        sub = pivot[[a, b]].dropna()
+        if len(sub) < 3:
+            continue
+        pr, pp = scipy_stats.pearsonr(sub[a], sub[b])
+        sr, sp = scipy_stats.spearmanr(sub[a], sub[b])
+        rows.append(
+            {
+                "model_1": a,
+                "model_2": b,
+                "n": len(sub),
+                "pearson_r": float(pr),
+                "pearson_p": float(pp),
+                "spearman_r": float(sr),
+                "spearman_p": float(sp),
+            }
+        )
+    return pd.DataFrame(rows)
+
+
+def _pairwise_pearson_values(matrix: np.ndarray) -> np.ndarray:
+    """Pearson r for every column pair of a prompts×models matrix (NaN-pair
+    rows dropped per pair)."""
+    n_models = matrix.shape[1]
+    out = []
+    for i, j in combinations(range(n_models), 2):
+        a, b = matrix[:, i], matrix[:, j]
+        ok = np.isfinite(a) & np.isfinite(b)
+        if ok.sum() < 3 or np.std(a[ok]) == 0 or np.std(b[ok]) == 0:
+            continue
+        out.append(np.corrcoef(a[ok], b[ok])[0, 1])
+    return np.asarray(out)
+
+
+def correlation_summary_bootstrap(
+    pivot: pd.DataFrame,
+    n_bootstrap: int = 1000,
+    seed: int = 42,
+) -> Dict:
+    """Mean/median/std of all pairwise correlations with CIs from resampling
+    *prompts* (model_comparison_graph.py:207-341)."""
+    matrix = pivot.to_numpy(dtype=float)
+    observed = _pairwise_pearson_values(matrix)
+    rng = np.random.default_rng(seed)
+    n_prompts = matrix.shape[0]
+    means, medians, stds = [], [], []
+    for _ in range(n_bootstrap):
+        idx = rng.choice(n_prompts, size=n_prompts, replace=True)
+        vals = _pairwise_pearson_values(matrix[idx])
+        if vals.size:
+            means.append(np.mean(vals))
+            medians.append(np.median(vals))
+            stds.append(np.std(vals))
+
+    def ci(arr):
+        return (float(np.percentile(arr, 2.5)), float(np.percentile(arr, 97.5)))
+
+    return {
+        "n_pairs": int(observed.size),
+        "mean": float(np.mean(observed)),
+        "mean_ci": ci(means),
+        "median": float(np.median(observed)),
+        "median_ci": ci(medians),
+        "std": float(np.std(observed)),
+        "std_ci": ci(stds),
+        "values": observed.tolist(),
+    }
+
+
+def cohens_kappa(a: Sequence[int], b: Sequence[int]) -> float:
+    """Cohen's kappa for two binary (or categorical) raters."""
+    a = np.asarray(a)
+    b = np.asarray(b)
+    cats = np.unique(np.concatenate([a, b]))
+    n = len(a)
+    po = float(np.mean(a == b))
+    pe = 0.0
+    for c in cats:
+        pe += float(np.mean(a == c)) * float(np.mean(b == c))
+    if pe >= 1.0:
+        return 1.0 if po >= 1.0 else 0.0
+    return (po - pe) / (1 - pe)
+
+
+def pairwise_kappa(
+    pivot: pd.DataFrame,
+    threshold: float = 0.5,
+    n_bootstrap: int = 1000,
+    seed: int = 42,
+) -> Dict:
+    """Per-pair and aggregate Cohen's kappa of thresholded judgments with a
+    prompt-resampling bootstrap (model_comparison_graph.py:495-709)."""
+    binary = (pivot.to_numpy(dtype=float) >= threshold).astype(int)
+    finite = np.isfinite(pivot.to_numpy(dtype=float))
+    pairs = []
+    for i, j in combinations(range(binary.shape[1]), 2):
+        ok = finite[:, i] & finite[:, j]
+        if ok.sum() < 3:
+            continue
+        pairs.append(
+            {
+                "model_1": pivot.columns[i],
+                "model_2": pivot.columns[j],
+                "kappa": cohens_kappa(binary[ok, i], binary[ok, j]),
+                "n": int(ok.sum()),
+            }
+        )
+    kappas = np.array([p["kappa"] for p in pairs])
+    rng = np.random.default_rng(seed)
+    n_prompts = binary.shape[0]
+    boot_means = []
+    for _ in range(n_bootstrap):
+        idx = rng.choice(n_prompts, size=n_prompts, replace=True)
+        bs = []
+        for i, j in combinations(range(binary.shape[1]), 2):
+            ok = finite[idx, i] & finite[idx, j]
+            if ok.sum() < 3:
+                continue
+            bs.append(cohens_kappa(binary[idx][ok, i], binary[idx][ok, j]))
+        if bs:
+            boot_means.append(np.mean(bs))
+    return {
+        "pairs": pairs,
+        "mean_kappa": float(np.mean(kappas)) if kappas.size else float("nan"),
+        "mean_kappa_ci": (
+            float(np.percentile(boot_means, 2.5)),
+            float(np.percentile(boot_means, 97.5)),
+        )
+        if boot_means
+        else (float("nan"), float("nan")),
+    }
+
+
+def fisher_z_pvalue(r: float, n: int) -> float:
+    """Two-sided p for a Pearson r via the Fisher z transform
+    (calculate_correlation_pvalues.py)."""
+    if n < 4 or abs(r) >= 1:
+        return float("nan")
+    z = 0.5 * np.log((1 + r) / (1 - r)) * np.sqrt(n - 3)
+    return float(2 * (1 - scipy_stats.norm.cdf(abs(z))))

@@ -1,0 +1,68 @@
+"""Normality tests: KS + Anderson-Darling against a fitted normal.
+
+Behavioral replica of analyze_perturbation_results.py:21-110, including the
+reference's banded AD p-value approximation from the critical-value table
+(scipy provides no AD p-value for the normal case).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Optional
+
+import numpy as np
+from scipy import stats as scipy_stats
+
+
+def ad_pvalue_from_bands(ad_statistic: float, critical_values) -> float:
+    """Reference's banded approximation (index 2 = 5% level)."""
+    if ad_statistic > 10:
+        return 0.0001
+    if ad_statistic > critical_values[4]:
+        return 0.005
+    if ad_statistic > critical_values[3]:
+        return 0.015
+    if ad_statistic > critical_values[2]:
+        return 0.035
+    if ad_statistic > critical_values[1]:
+        return 0.075
+    return 0.15
+
+
+def normality_tests(values, label: Optional[str] = None) -> Dict:
+    """KS + AD tests of ``values`` against a normal fitted to them.
+
+    Returns the reference's result fields; non-finite values are dropped, and
+    n<3 yields a degenerate record with NaN statistics.
+    """
+    values = np.asarray(values, dtype=float)
+    values = values[np.isfinite(values)]
+    base = {"label": label, "n": int(len(values))}
+    if len(values) < 3:
+        return {
+            **base,
+            "mean": float(np.mean(values)) if len(values) else float("nan"),
+            "std": float(np.std(values)) if len(values) > 1 else float("nan"),
+            "ks_stat": float("nan"),
+            "ks_p": float("nan"),
+            "ks_normal": False,
+            "ad_stat": float("nan"),
+            "ad_p": float("nan"),
+            "ad_crit_5pct": float("nan"),
+            "ad_normal": False,
+        }
+    mu, sigma = scipy_stats.norm.fit(values)
+    ks_stat, ks_p = scipy_stats.kstest(values, "norm", args=(mu, sigma))
+    ad = scipy_stats.anderson(values, "norm")
+    ad_p = ad_pvalue_from_bands(ad.statistic, ad.critical_values)
+    return {
+        **base,
+        "mean": float(mu),
+        "std": float(sigma),
+        "ks_stat": float(ks_stat),
+        "ks_p": float(ks_p),
+        "ks_normal": bool(ks_p > 0.05),
+        "ad_stat": float(ad.statistic),
+        "ad_p": float(ad_p),
+        "ad_crit_5pct": float(ad.critical_values[2]),
+        "ad_normal": bool(ad.statistic < ad.critical_values[2]),
+    }

@@ -1,0 +1,75 @@
+"""Power analysis: analytic sample-size requirements + simulated power.
+
+Behavioral replica of power_analysis.py:10-95 (one-sample t-test framing over
+MAE differences from a baseline, with the t-correction and safety margin, and
+seeded Monte-Carlo power curves).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, Sequence
+
+import numpy as np
+from scipy import stats as scipy_stats
+
+POWER_LEVELS = (0.70, 0.80, 0.85, 0.90, 0.95)
+
+
+def required_sample_size(
+    observed_mae_diff: float,
+    observed_std: float,
+    alpha: float = 0.05,
+    margin_factor: float = 1.5,
+    power_levels: Sequence[float] = POWER_LEVELS,
+) -> Dict:
+    effect_size = abs(observed_mae_diff) / observed_std if observed_std > 0 else 0.0
+    sample_sizes = {}
+    for target_power in power_levels:
+        key = f"power_{int(target_power * 100)}"
+        if effect_size > 0:
+            z_alpha = scipy_stats.norm.ppf(1 - alpha / 2)
+            z_beta = scipy_stats.norm.ppf(target_power)
+            n = ((z_alpha + z_beta) / effect_size) ** 2
+            if n > 2:
+                n = n * (1 + 1 / (4 * (n - 1)))  # t-distribution correction
+            sample_sizes[key] = {
+                "raw": int(np.ceil(n)),
+                "with_margin": int(np.ceil(n * margin_factor)),
+            }
+        else:
+            sample_sizes[key] = {"raw": np.inf, "with_margin": np.inf}
+    return {
+        "effect_size": effect_size,
+        "sample_sizes": sample_sizes,
+        "observed_mae_diff": observed_mae_diff,
+        "observed_std": observed_std,
+    }
+
+
+def simulated_power(
+    mae_diff: float,
+    std: float,
+    sample_size: int,
+    n_simulations: int = 10_000,
+    alpha: float = 0.05,
+    seed: int = 42,
+) -> float:
+    """Proportion of seeded simulations where a one-sample t-test vs 0 rejects."""
+    rng = np.random.default_rng(seed)
+    samples = rng.normal(mae_diff, std, size=(n_simulations, sample_size))
+    _, p = scipy_stats.ttest_1samp(samples, 0.0, axis=1)
+    return float(np.mean(p < alpha))
+
+
+def power_curve(
+    mae_diff: float,
+    std: float,
+    sample_sizes: Sequence[int],
+    n_simulations: int = 2000,
+    alpha: float = 0.05,
+    seed: int = 42,
+) -> Dict[int, float]:
+    return {
+        int(n): simulated_power(mae_diff, std, int(n), n_simulations, alpha, seed)
+        for n in sample_sizes
+    }

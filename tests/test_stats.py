@@ -1,0 +1,296 @@
+"""Statistics-layer tests: seeded regression values, statistical sanity on
+known distributions, and behavioral checks against reference semantics."""
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from llm_interpretation_replication_tpu.stats import (
+    BM25Okapi,
+    base_vs_instruct_analysis,
+    bootstrap_mae,
+    bootstrap_mae_difference,
+    calculate_all_similarities,
+    check_confidence_compliance,
+    check_first_and_full,
+    check_output_compliance,
+    classify_confidence_response,
+    cohens_kappa,
+    correlation_summary_bootstrap,
+    fisher_z_pvalue,
+    fit_clipped_normal,
+    normality_tests,
+    paired_mean_diff_bootstrap,
+    pairwise_correlations,
+    pairwise_kappa,
+    pivot_model_values,
+    required_sample_size,
+    simulated_power,
+)
+
+
+class TestNormality:
+    def test_normal_data_accepted(self):
+        rng = np.random.default_rng(0)
+        res = normality_tests(rng.normal(0.5, 0.1, 2000))
+        assert res["ks_normal"] and res["ad_normal"]
+        assert res["ad_p"] == 0.15
+
+    def test_bimodal_rejected(self):
+        rng = np.random.default_rng(1)
+        data = np.concatenate([rng.normal(0, 0.05, 1000), rng.normal(1, 0.05, 1000)])
+        res = normality_tests(data)
+        assert not res["ks_normal"] and not res["ad_normal"]
+        assert res["ad_p"] == 0.0001  # large statistic band
+
+    def test_insufficient_data(self):
+        res = normality_tests([0.5, 0.6])
+        assert np.isnan(res["ks_stat"]) and not res["ks_normal"]
+
+    def test_nonfinite_filtered(self):
+        rng = np.random.default_rng(2)
+        data = np.concatenate([rng.normal(0, 1, 500), [np.nan, np.inf, -np.inf]])
+        res = normality_tests(data)
+        assert res["n"] == 500
+
+
+class TestTruncatedNormal:
+    def test_fit_recovers_clipped_normal(self):
+        rng = np.random.default_rng(3)
+        data = np.clip(rng.normal(0.7, 0.3, 3000), 0, 1)
+        res, sim = fit_clipped_normal(data, n_simulations=50_000, seed=42)
+        assert res["fit"] == "ok"
+        assert res["mean_relative_error"] < 0.01
+        assert res["std_relative_error"] < 0.02
+        # a clipped normal should be judged adequate against itself
+        assert res["adequate_ks"]
+        assert abs(res["underlying_mean"] - 0.7) < 0.05
+        assert res["zero_proportion"] > 0.0 and res["one_proportion"] > 0.1
+
+    def test_uniform_data_rejected(self):
+        rng = np.random.default_rng(4)
+        data = rng.uniform(0, 1, 3000)
+        res, _ = fit_clipped_normal(data, n_simulations=50_000, seed=42)
+        assert res["fit"] == "ok"
+        assert not res["adequate"]  # uniform is not a clipped normal
+
+    def test_all_boundary_fails_cleanly(self):
+        res, sim = fit_clipped_normal(np.array([0.0] * 5 + [1.0] * 5))
+        assert res["fit"] == "failed-all-boundary"
+        assert sim.size == 0
+
+    def test_reproducible_with_seed(self):
+        data = np.clip(np.random.default_rng(5).normal(0.4, 0.2, 500), 0, 1)
+        r1, s1 = fit_clipped_normal(data, n_simulations=10_000, seed=7)
+        r2, s2 = fit_clipped_normal(data, n_simulations=10_000, seed=7)
+        assert r1["ks_p"] == r2["ks_p"]
+        np.testing.assert_array_equal(s1, s2)
+
+
+class TestBootstrap:
+    def test_mae_ci_contains_mean_seeded(self):
+        rng = np.random.default_rng(6)
+        errors = np.abs(rng.normal(0.2, 0.05, 100))
+        mean, lo, hi = bootstrap_mae(errors, seed=42)
+        assert lo < mean < hi
+        # seeded regression: repeatable
+        mean2, lo2, hi2 = bootstrap_mae(errors, seed=42)
+        assert (mean, lo, hi) == (mean2, lo2, hi2)
+
+    def test_mae_empty(self):
+        assert bootstrap_mae([]) == (None, None, None)
+
+    def test_mae_difference_detects_real_gap(self):
+        rng = np.random.default_rng(7)
+        model = np.abs(rng.normal(0.30, 0.05, 200))
+        baseline = np.abs(rng.normal(0.20, 0.05, 200))
+        diff, lo, hi, p = bootstrap_mae_difference(model, baseline, seed=42)
+        assert diff > 0.05
+        assert p < 0.01
+        assert lo < diff < hi
+
+    def test_mae_difference_null_not_significant(self):
+        rng = np.random.default_rng(8)
+        a = np.abs(rng.normal(0.2, 0.05, 100))
+        b = np.abs(rng.normal(0.2, 0.05, 100))
+        _, _, _, p = bootstrap_mae_difference(a, b, seed=42)
+        assert p > 0.05
+
+    def test_mae_difference_scalar_baseline(self):
+        rng = np.random.default_rng(9)
+        model = np.abs(rng.normal(0.3, 0.05, 100))
+        diff, lo, hi, p = bootstrap_mae_difference(model, 0.2, seed=42)
+        assert abs(diff - (np.mean(model) - 0.2)) < 1e-12
+
+    def test_paired_diff(self):
+        rng = np.random.default_rng(10)
+        diffs = rng.normal(0.1, 0.2, 100)
+        res = paired_mean_diff_bootstrap(diffs, seed=42)
+        assert res["n"] == 100
+        assert res["ci_lower"] < res["mean_diff"] < res["ci_upper"]
+
+    def test_base_vs_instruct_frame_analysis(self):
+        rng = np.random.default_rng(11)
+        rows = []
+        for i in range(40):
+            rows.append({"model_family": "Fam", "base_or_instruct": "base",
+                         "prompt": f"q{i}", "relative_prob": rng.uniform(0.2, 0.4)})
+            rows.append({"model_family": "Fam", "base_or_instruct": "instruct",
+                         "prompt": f"q{i}", "relative_prob": rng.uniform(0.5, 0.8)})
+        out = base_vs_instruct_analysis(pd.DataFrame(rows), seed=42)
+        assert out["Fam"]["mean_diff"] > 0.2
+        assert out["Fam"]["p_value"] < 0.01
+
+
+class TestCorrelations:
+    def _frame(self):
+        rng = np.random.default_rng(12)
+        base = rng.uniform(0, 1, 50)
+        rows = []
+        for i, v in enumerate(base):
+            rows.append({"prompt": f"q{i}", "model": "a", "relative_prob": v})
+            rows.append({"prompt": f"q{i}", "model": "b",
+                         "relative_prob": np.clip(v + rng.normal(0, 0.05), 0, 1)})
+            rows.append({"prompt": f"q{i}", "model": "c", "relative_prob": rng.uniform(0, 1)})
+        return pd.DataFrame(rows)
+
+    def test_pairwise_correlations(self):
+        pivot = pivot_model_values(self._frame())
+        corr = pairwise_correlations(pivot)
+        assert len(corr) == 3
+        ab = corr[(corr.model_1 == "a") & (corr.model_2 == "b")].iloc[0]
+        assert ab["pearson_r"] > 0.9
+        ac = corr[(corr.model_1 == "a") & (corr.model_2 == "c")].iloc[0]
+        assert abs(ac["pearson_r"]) < 0.5
+
+    def test_summary_bootstrap(self):
+        pivot = pivot_model_values(self._frame())
+        summary = correlation_summary_bootstrap(pivot, n_bootstrap=200, seed=42)
+        assert summary["n_pairs"] == 3
+        assert summary["mean_ci"][0] <= summary["mean"] <= summary["mean_ci"][1]
+
+    def test_cohens_kappa_known_values(self):
+        assert cohens_kappa([1, 1, 0, 0], [1, 1, 0, 0]) == pytest.approx(1.0)
+        assert cohens_kappa([1, 1, 0, 0], [0, 0, 1, 1]) == pytest.approx(-1.0)
+        # independent raters with balanced marginals -> kappa near 0
+        rng = np.random.default_rng(13)
+        a = rng.integers(0, 2, 2000)
+        b = rng.integers(0, 2, 2000)
+        assert abs(cohens_kappa(a, b)) < 0.1
+
+    def test_pairwise_kappa(self):
+        pivot = pivot_model_values(self._frame())
+        res = pairwise_kappa(pivot, n_bootstrap=100, seed=42)
+        assert len(res["pairs"]) == 3
+        ab = [p for p in res["pairs"] if {p["model_1"], p["model_2"]} == {"a", "b"}][0]
+        assert ab["kappa"] > 0.6
+
+    def test_fisher_z(self):
+        p = fisher_z_pvalue(0.5, 100)
+        assert p < 0.001
+        assert fisher_z_pvalue(0.0, 100) == pytest.approx(1.0)
+
+
+class TestCompliance:
+    def test_first_and_full(self):
+        exp = {
+            "first_tokens": ["Covered", "Not"],
+            "full_responses": {"Covered": ["Covered"], "Not": ["Not Covered"]},
+        }
+        assert check_first_and_full("Covered", "Covered", exp) == (True, True)
+        assert check_first_and_full("Not", "Not Covered", exp) == (True, True)
+        assert check_first_and_full("Not", "Not covered at all", exp) == (True, False)
+        assert check_first_and_full("The", "The policy covers", exp) == (False, None)
+
+    def test_confidence_classification(self):
+        assert classify_confidence_response("85") == "compliant"
+        assert classify_confidence_response(" 100 ") == "compliant"
+        assert classify_confidence_response("150") == "out_of_range"
+        assert classify_confidence_response("85.5") == "float"
+        assert classify_confidence_response("I think 85") == "text"
+
+    def test_workbook_compliance_rates(self):
+        df = pd.DataFrame(
+            [
+                {"Original Main Part": "s1", "Model Response": "Covered",
+                 "Model Confidence Response": "85", "Log Probabilities": "", "Relative_Prob": 0.8},
+                {"Original Main Part": "s1", "Model Response": "Not Covered",
+                 "Model Confidence Response": "90.5", "Log Probabilities": "", "Relative_Prob": 0.2},
+                {"Original Main Part": "s1", "Model Response": "It depends on the policy",
+                 "Model Confidence Response": "maybe 50", "Log Probabilities": "", "Relative_Prob": 0.5},
+            ]
+        )
+        out = check_output_compliance(df)
+        row = out.iloc[0]
+        assert row["Total_Samples"] == 3
+        assert row["First_Token_Compliant"] == 2
+        conf = check_confidence_compliance(df)
+        assert conf.iloc[0]["Confidence_Compliant"] == 1
+        assert conf.iloc[0]["Float_Errors"] == 1
+        assert conf.iloc[0]["Text_Errors"] == 1
+
+    def test_api_logprobs_path(self):
+        lp = str({"content": [{"token": "Not"}, {"token": " Covered"}]})
+        df = pd.DataFrame(
+            [{"Original Main Part": "s1", "Model Response": "",
+              "Model Confidence Response": "10", "Log Probabilities": lp,
+              "Relative_Prob": 0.1}]
+        )
+        out = check_output_compliance(df)
+        assert out.iloc[0]["First_Token_Compliant"] == 1
+        assert out.iloc[0]["Conditional_Subsequent_Compliant"] == 1
+
+
+class TestSimilarity:
+    def test_all_metrics_rank_similar_higher(self):
+        original = "Is a screenshot a photograph for copyright purposes?"
+        close = "For copyright purposes, is a screenshot considered a photograph?"
+        far = "Bananas grow in tropical climates around the equator."
+        res = calculate_all_similarities(original, [close, far])
+        ov = res["original_vs_rephrasings"]
+        for metric in ("tfidf_cosine_similarity", "bm25_similarity", "levenshtein_similarity"):
+            assert ov[0][metric] > ov[1][metric], metric
+        assert set(res["summary_stats"]) == {
+            "tfidf_cosine_similarity", "bm25_similarity", "levenshtein_similarity",
+        }
+
+    def test_bm25_scores_self_highest(self):
+        corpus = [["a", "b", "c"], ["a", "b"], ["x", "y", "z"]]
+        bm = BM25Okapi(corpus)
+        scores = bm.get_scores(["x", "y", "z"])
+        assert np.argmax(scores) == 2
+
+
+class TestNativeLevenshtein:
+    def test_native_matches_python(self):
+        from llm_interpretation_replication_tpu.native import (
+            _levenshtein_py,
+            levenshtein,
+            using_native,
+        )
+
+        assert using_native()
+        rng = np.random.default_rng(14)
+        import string
+
+        for _ in range(50):
+            a = "".join(rng.choice(list(string.ascii_lowercase + " é漢")) for _ in range(rng.integers(0, 30)))
+            b = "".join(rng.choice(list(string.ascii_lowercase + " é漢")) for _ in range(rng.integers(0, 30)))
+            assert levenshtein(a, b) == _levenshtein_py(a, b), (a, b)
+
+
+class TestPower:
+    def test_sample_size_formula(self):
+        res = required_sample_size(0.05, 0.1)  # effect size 0.5
+        # classic n ≈ 31.5 for d=0.5, power .80 -> ~32 with t-correction
+        assert 30 <= res["sample_sizes"]["power_80"]["raw"] <= 35
+        assert res["sample_sizes"]["power_80"]["with_margin"] >= res["sample_sizes"]["power_80"]["raw"]
+
+    def test_zero_effect_infinite(self):
+        res = required_sample_size(0.0, 0.1)
+        assert res["sample_sizes"]["power_80"]["raw"] == np.inf
+
+    def test_simulated_power_matches_analytic(self):
+        # d=0.5 at n=32 should give ~80% power
+        p = simulated_power(0.05, 0.1, 32, n_simulations=4000, seed=42)
+        assert 0.74 <= p <= 0.86
