@@ -1,0 +1,53 @@
+"""Prolific demographics → summary table (reference:
+survey_analysis/generate_demographics_table.py)."""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence
+
+import pandas as pd
+
+
+def load_demographics(filepaths) -> pd.DataFrame:
+    if isinstance(filepaths, str):
+        filepaths = [filepaths]
+    return pd.concat([pd.read_csv(p) for p in filepaths], ignore_index=True)
+
+
+def summarize_categorical(df: pd.DataFrame, column: str, top_n: Optional[int] = None) -> pd.DataFrame:
+    counts = df[column].fillna("(missing)").value_counts()
+    if top_n:
+        counts = counts.head(top_n)
+    out = counts.rename("count").to_frame()
+    out["percent"] = 100.0 * out["count"] / len(df)
+    return out.reset_index(names=column)
+
+
+def summarize_age(df: pd.DataFrame, column: str = "Age") -> Dict:
+    ages = pd.to_numeric(df[column], errors="coerce").dropna()
+    return {
+        "n": int(len(ages)),
+        "mean": float(ages.mean()) if len(ages) else float("nan"),
+        "median": float(ages.median()) if len(ages) else float("nan"),
+        "min": float(ages.min()) if len(ages) else float("nan"),
+        "max": float(ages.max()) if len(ages) else float("nan"),
+    }
+
+
+def demographics_latex_table(df: pd.DataFrame, columns: Sequence[str]) -> str:
+    """Counts/percent LaTeX fragment for the appendix."""
+    lines = [
+        "\\begin{tabular}{lrr}",
+        "\\hline",
+        "Category & N & \\% \\\\",
+        "\\hline",
+    ]
+    for column in columns:
+        if column not in df.columns:
+            continue
+        lines.append(f"\\multicolumn{{3}}{{l}}{{\\textbf{{{column}}}}} \\\\")
+        for _, row in summarize_categorical(df, column).iterrows():
+            label = str(row[column]).replace("&", "\\&").replace("%", "\\%")
+            lines.append(f"{label} & {int(row['count'])} & {row['percent']:.1f} \\\\")
+    lines += ["\\hline", "\\end{tabular}"]
+    return "\n".join(lines)

@@ -1,0 +1,434 @@
+"""Human-survey analysis pipeline (reference: survey_analysis/, 4,727 LoC).
+
+Behavioral replicas of survey_analysis_consolidated.py: Qualtrics loading with
+S{n}_ prefixing, the three preregistered exclusions, header question-text
+extraction and exact-string matching to LLM prompts, per-question stats,
+human–LLM correlation with bootstrap, per-item pairwise agreement, and the
+cross-prompt (within-group) correlation machinery with bootstrap-by-question.
+
+Deviations from the reference: bootstrap uses an explicit seeded Generator
+(reference used global numpy state), and the all-pairs rater correlation is
+the vectorized ``DataFrame.corr`` it was already equivalent to.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+import pandas as pd
+from scipy.stats import pearsonr
+
+
+# ---------------------------------------------------------------------------
+# Loading / cleaning
+# ---------------------------------------------------------------------------
+
+def load_and_clean_survey_data(filepaths) -> Tuple[pd.DataFrame, List[str]]:
+    """Load Qualtrics exports (2 meta rows skipped), prefix question columns
+    with S{survey}_ and coerce them (and Duration) to numeric."""
+    if isinstance(filepaths, str):
+        filepaths = [filepaths]
+    dfs = []
+    for survey_idx, filepath in enumerate(filepaths, start=1):
+        raw = pd.read_csv(filepath)
+        data = raw[2:].reset_index(drop=True)
+        rename = {}
+        for group in range(1, 6):
+            for question in range(1, 12):
+                old = f"Q{group}_{question}"
+                if old in data.columns:
+                    rename[old] = f"S{survey_idx}_Q{group}_{question}"
+        dfs.append(data.rename(columns=rename))
+    df = pd.concat(dfs, ignore_index=True)
+    df["Duration (in seconds)"] = pd.to_numeric(df["Duration (in seconds)"], errors="coerce")
+    question_cols = []
+    for survey_idx in range(1, len(filepaths) + 1):
+        for group in range(1, 6):
+            for question in range(1, 12):
+                col = f"S{survey_idx}_Q{group}_{question}"
+                if col in df.columns:
+                    question_cols.append(col)
+                    df[col] = pd.to_numeric(df[col], errors="coerce")
+    return df, question_cols
+
+
+def apply_exclusion_criteria(df: pd.DataFrame, question_cols: Sequence[str]):
+    """Preregistered exclusions: (1) duration < 20% of median, (2) identical
+    substantive slider values, (3) any attention check (\\*_8) ≠ 100."""
+    initial = len(df)
+    stats: Dict = {}
+
+    median_duration = df["Duration (in seconds)"].median()
+    min_duration = 0.2 * median_duration
+    stats["median_duration"] = median_duration
+    stats["min_duration_threshold"] = min_duration
+    stats["duration_excluded"] = int((df["Duration (in seconds)"] < min_duration).sum())
+    df = df[df["Duration (in seconds)"] >= min_duration]
+
+    substantive = [q for q in question_cols if not q.endswith("_8")]
+    identical_idx = []
+    for idx, row in df.iterrows():
+        answered = [q for q in substantive if pd.notna(row[q])]
+        if len(answered) > 1:
+            values = {row[q] for q in answered}
+            if len(values) == 1:
+                identical_idx.append(idx)
+    stats["identical_excluded"] = len(identical_idx)
+    df = df.drop(identical_idx)
+
+    attention_cols = [q for q in question_cols if q.endswith("_8")]
+    failed_idx = []
+    for idx, row in df.iterrows():
+        for col in attention_cols:
+            if pd.notna(row[col]) and row[col] != 100:
+                failed_idx.append(idx)
+                break
+    stats["attention_failed"] = len(failed_idx)
+    df = df.drop(failed_idx)
+
+    stats["final_count"] = len(df)
+    stats["total_excluded"] = initial - len(df)
+    return df, stats
+
+
+def extract_question_text(filepaths) -> Dict[str, str]:
+    """S{n}_Q{g}_{q} -> question text parsed from the Qualtrics header row
+    (last ' - '-separated segment)."""
+    if isinstance(filepaths, str):
+        filepaths = [filepaths]
+    mapping: Dict[str, str] = {}
+    for survey_idx, filepath in enumerate(filepaths, start=1):
+        raw = pd.read_csv(filepath)
+        headers = raw.iloc[0]
+        for col in raw.columns:
+            if col.startswith("Q") and "_" in col:
+                text = headers[col]
+                if pd.notna(text) and isinstance(text, str) and " - " in text:
+                    mapping[f"S{survey_idx}_{col}"] = text.split(" - ")[-1].strip()
+    return mapping
+
+
+def match_survey_to_llm_questions(llm_df: pd.DataFrame, survey_filepaths) -> Tuple[Dict, Dict]:
+    """Exact question-text join of LLM prompts onto survey columns."""
+    mapping = extract_question_text(survey_filepaths)
+    mapping = {k: v for k, v in mapping.items() if not k.endswith("_8")}
+    prompt_to_question = {text: qid for qid, text in mapping.items()}
+    matches = {
+        prompt: prompt_to_question[prompt]
+        for prompt in llm_df["prompt"].unique()
+        if prompt in prompt_to_question
+    }
+    return matches, mapping
+
+
+# ---------------------------------------------------------------------------
+# Per-question stats + correlation
+# ---------------------------------------------------------------------------
+
+def human_responses_by_question(df: pd.DataFrame, question_cols: Sequence[str]) -> Dict:
+    out = {}
+    for q in question_cols:
+        if q.endswith("_8"):
+            continue
+        responses = df[q].dropna()
+        if len(responses):
+            out[q] = {
+                "mean": float(np.mean(responses)),
+                "std": float(np.std(responses)),
+                "n": int(len(responses)),
+                "responses": responses.tolist(),
+            }
+    return out
+
+
+def llm_responses_by_question(llm_df: pd.DataFrame) -> Dict:
+    out = {}
+    for prompt in llm_df["prompt"].unique():
+        vals = llm_df[llm_df["prompt"] == prompt]["relative_prob"]
+        out[prompt] = {
+            "mean": float(np.mean(vals)),
+            "std": float(np.std(vals)),
+            "n": int(len(vals)),
+            "model_responses": vals.tolist(),
+        }
+    return out
+
+
+def pearson_with_bootstrap(x, y, n_bootstrap: int = 1000, confidence_level: float = 0.95,
+                           seed: int = 42) -> Dict:
+    x = np.asarray(x, dtype=float)
+    y = np.asarray(y, dtype=float)
+    corr, p_value = pearsonr(x, y)
+    rng = np.random.default_rng(seed)
+    idx = rng.integers(0, len(x), size=(n_bootstrap, len(x)))
+    boots = np.array([pearsonr(x[row], y[row])[0] for row in idx])
+    alpha = 1 - confidence_level
+    return {
+        "correlation": float(corr),
+        "p_value": float(p_value),
+        "ci_lower": float(np.percentile(boots, 100 * alpha / 2)),
+        "ci_upper": float(np.percentile(boots, 100 * (1 - alpha / 2))),
+        "standard_error": float(np.std(boots)),
+    }
+
+
+def human_llm_correlation(human_stats: Dict, llm_stats: Dict, matches: Dict,
+                          seed: int = 42) -> Optional[Dict]:
+    human_means, llm_means, matched = [], [], []
+    for llm_prompt, survey_q in matches.items():
+        if survey_q in human_stats and llm_prompt in llm_stats:
+            h = human_stats[survey_q]["mean"] / 100.0
+            m = llm_stats[llm_prompt]["mean"]
+            human_means.append(h)
+            llm_means.append(m)
+            matched.append(
+                {"survey_question": survey_q, "llm_prompt": llm_prompt,
+                 "human_mean": h, "llm_mean": m}
+            )
+    if len(human_means) < 2:
+        return None
+    result = pearson_with_bootstrap(human_means, llm_means, seed=seed)
+    result["n_questions"] = len(human_means)
+    result["matched_questions"] = matched
+    return result
+
+
+# ---------------------------------------------------------------------------
+# Per-item agreement (1 − |Δ|)
+# ---------------------------------------------------------------------------
+
+def _pairwise_agreements(values: np.ndarray, scale: float) -> np.ndarray:
+    """mean over pairs of (scale − |vi − vj|)/scale without the O(n²) loop."""
+    diffs = np.abs(values[:, None] - values[None, :])
+    iu = np.triu_indices(len(values), k=1)
+    return (scale - diffs[iu]) / scale
+
+
+def per_item_agreement_humans(df: pd.DataFrame, question_cols: Sequence[str],
+                              n_bootstrap: int = 1000, seed: int = 42) -> Dict:
+    per_item, avgs = {}, []
+    for q in question_cols:
+        if q.endswith("_8"):
+            continue
+        responses = df[q].dropna().to_numpy(dtype=float)
+        if len(responses) >= 2:
+            agreements = _pairwise_agreements(responses, 100.0)
+            per_item[q] = {
+                "mean_agreement": float(np.mean(agreements)),
+                "std_agreement": float(np.std(agreements)),
+                "n_pairs": int(len(agreements)),
+                "response_variance": float(np.var(responses)),
+                "n_responses": int(len(responses)),
+            }
+            avgs.append(float(np.mean(agreements)))
+    return _agreement_summary(per_item, avgs, n_bootstrap, seed)
+
+
+def per_item_agreement_llms(llm_df: pd.DataFrame, n_bootstrap: int = 1000,
+                            seed: int = 42) -> Dict:
+    per_item, avgs = {}, []
+    models = llm_df["model"].unique()
+    for prompt in llm_df["prompt"].unique():
+        sub = llm_df[llm_df["prompt"] == prompt]
+        vals = []
+        for model in models:
+            v = sub[sub["model"] == model]["relative_prob"].values
+            if len(v) and not np.isnan(v[0]):
+                vals.append(float(v[0]))
+        if len(vals) >= 2:
+            agreements = _pairwise_agreements(np.asarray(vals), 1.0)
+            per_item[prompt] = {
+                "mean_agreement": float(np.mean(agreements)),
+                "std_agreement": float(np.std(agreements)),
+                "n_pairs": int(len(agreements)),
+                "response_variance": float(np.var(vals)),
+                "n_models": len(vals),
+            }
+            avgs.append(float(np.mean(agreements)))
+    return _agreement_summary(per_item, avgs, n_bootstrap, seed)
+
+
+def _agreement_summary(per_item, avgs, n_bootstrap, seed):
+    if avgs:
+        rng = np.random.default_rng(seed)
+        idx = rng.integers(0, len(avgs), size=(n_bootstrap, len(avgs)))
+        boots = np.mean(np.asarray(avgs)[idx], axis=1)
+        ci = (float(np.percentile(boots, 2.5)), float(np.percentile(boots, 97.5)))
+    else:
+        ci = (0.0, 0.0)
+    return {
+        "per_item": per_item,
+        "overall_mean": float(np.mean(avgs)) if avgs else 0.0,
+        "overall_std": float(np.std(avgs)) if avgs else 0.0,
+        "n_items": len(avgs),
+        "overall_mean_ci_lower": ci[0],
+        "overall_mean_ci_upper": ci[1],
+    }
+
+
+# ---------------------------------------------------------------------------
+# Cross-prompt (within-group) correlations
+# ---------------------------------------------------------------------------
+
+def _question_groups(question_cols: Sequence[str]) -> Dict[str, List[str]]:
+    groups: Dict[str, List[str]] = {}
+    for col in question_cols:
+        if col.endswith("_8"):
+            continue
+        prefix = col.rsplit("_", 1)[0]  # S1_Q3
+        groups.setdefault(prefix, []).append(col)
+    return dict(sorted(groups.items()))
+
+
+def _rater_matrix(df: pd.DataFrame, group_questions: List[str], min_answered: int = 5):
+    """questions × respondents matrix (0-1 scale) for raters who answered ≥5."""
+    first = group_questions[0]
+    sub = df[df[first].notna()]
+    data = sub[group_questions].to_numpy(dtype=float) / 100.0
+    keep = np.sum(~np.isnan(data), axis=1) >= min_answered
+    return pd.DataFrame(data[keep].T, index=group_questions)
+
+
+def _pairwise_rater_correlations(matrix: pd.DataFrame) -> List[float]:
+    corr = matrix.corr(method="pearson").to_numpy()
+    iu = np.triu_indices(corr.shape[0], k=1)
+    vals = corr[iu]
+    return [float(v) for v in vals if not np.isnan(v)]
+
+
+def human_cross_prompt_correlations(df: pd.DataFrame, question_cols: Sequence[str],
+                                    n_bootstrap: int = 100, seed: int = 42) -> Dict:
+    """All-pairs rater correlations within each 10-question group; CI from
+    resampling questions within groups."""
+    groups = _question_groups(question_cols)
+    all_corrs: List[float] = []
+    group_results: Dict[str, Dict] = {}
+    for group_id, questions in groups.items():
+        if len(questions) < 2:
+            continue
+        matrix = _rater_matrix(df, questions)
+        if matrix.shape[1] < 2:
+            continue
+        corrs = _pairwise_rater_correlations(matrix)
+        all_corrs.extend(corrs)
+        group_results[group_id] = {
+            "n_respondents": matrix.shape[1],
+            "n_pairs": len(corrs),
+            "mean_correlation": float(np.mean(corrs)) if corrs else 0.0,
+            "correlations": corrs,
+        }
+    rng = np.random.default_rng(seed)
+    boot_means = []
+    for _ in range(n_bootstrap):
+        boot_corrs: List[float] = []
+        for group_id, questions in groups.items():
+            if group_id not in group_results or len(questions) < 2:
+                continue
+            sampled = [questions[i] for i in rng.integers(0, len(questions), size=len(questions))]
+            matrix = _rater_matrix(df, questions)
+            if matrix.shape[1] < 2:
+                continue
+            sampled_matrix = matrix.loc[sampled]
+            boot_corrs.extend(_pairwise_rater_correlations(sampled_matrix))
+        if boot_corrs:
+            boot_means.append(np.mean(boot_corrs))
+    base_mean = float(np.mean(all_corrs)) if all_corrs else 0.0
+    ci = (
+        (float(np.percentile(boot_means, 2.5)), float(np.percentile(boot_means, 97.5)))
+        if boot_means
+        else (base_mean, base_mean)
+    )
+    return {
+        "group_results": group_results,
+        "pairwise_correlations": all_corrs,
+        "mean_correlation": base_mean,
+        "std_correlation": float(np.std(all_corrs)) if all_corrs else 0.0,
+        "n_pairs": len(all_corrs),
+        "ci_lower": ci[0],
+        "ci_upper": ci[1],
+    }
+
+
+def llm_cross_prompt_correlations(llm_df: pd.DataFrame, question_mapping: Dict[str, str],
+                                  n_bootstrap: int = 100, seed: int = 42) -> Dict:
+    """Model-pair correlations within the human question groups: each model is
+    a 'rater' over the group's questions."""
+    text_to_qid = {}
+    for qid, text in question_mapping.items():
+        if not qid.endswith("_8"):
+            text_to_qid[text] = qid
+    llm = llm_df.copy()
+    llm["question_id"] = llm["prompt"].map(text_to_qid)
+    llm = llm[llm["question_id"].notna()]
+    llm["group"] = llm["question_id"].map(lambda q: q.rsplit("_", 1)[0])
+
+    all_corrs: List[float] = []
+    group_results: Dict[str, Dict] = {}
+    groups = sorted(llm["group"].unique())
+    pivots = {}
+    for group_id in groups:
+        sub = llm[llm["group"] == group_id]
+        pivot = sub.pivot_table(index="question_id", columns="model", values="relative_prob")
+        pivots[group_id] = pivot
+        if pivot.shape[0] < 2 or pivot.shape[1] < 2:
+            continue
+        corrs = _pairwise_rater_correlations(pivot)
+        all_corrs.extend(corrs)
+        group_results[group_id] = {
+            "n_models": pivot.shape[1],
+            "n_questions": pivot.shape[0],
+            "n_pairs": len(corrs),
+            "mean_correlation": float(np.mean(corrs)) if corrs else 0.0,
+            "correlations": corrs,
+        }
+    rng = np.random.default_rng(seed)
+    boot_means = []
+    for _ in range(n_bootstrap):
+        boot_corrs: List[float] = []
+        for group_id, pivot in pivots.items():
+            n_q = pivot.shape[0]
+            if group_id not in group_results or n_q < 2:
+                continue
+            sampled = pivot.iloc[rng.integers(0, n_q, size=n_q)]
+            boot_corrs.extend(_pairwise_rater_correlations(sampled))
+        if boot_corrs:
+            boot_means.append(np.mean(boot_corrs))
+    base_mean = float(np.mean(all_corrs)) if all_corrs else 0.0
+    ci = (
+        (float(np.percentile(boot_means, 2.5)), float(np.percentile(boot_means, 97.5)))
+        if boot_means
+        else (base_mean, base_mean)
+    )
+    return {
+        "group_results": group_results,
+        "pairwise_correlations": all_corrs,
+        "mean_correlation": base_mean,
+        "std_correlation": float(np.std(all_corrs)) if all_corrs else 0.0,
+        "n_pairs": len(all_corrs),
+        "ci_lower": ci[0],
+        "ci_upper": ci[1],
+    }
+
+
+def cross_prompt_difference_ci(human_result: Dict, llm_result: Dict,
+                               n_bootstrap: int = 1000, seed: int = 42) -> Dict:
+    """CI for (human − LLM) mean cross-prompt correlation by resampling each
+    side's pairwise-correlation pool (survey_analysis_consolidated.py:676-807)."""
+    h = np.asarray(human_result["pairwise_correlations"], dtype=float)
+    l = np.asarray(llm_result["pairwise_correlations"], dtype=float)
+    observed = float(np.mean(h) - np.mean(l))
+    rng = np.random.default_rng(seed)
+    hb = np.mean(h[rng.integers(0, len(h), size=(n_bootstrap, len(h)))], axis=1)
+    lb = np.mean(l[rng.integers(0, len(l), size=(n_bootstrap, len(l)))], axis=1)
+    diffs = hb - lb
+    if observed > 0:
+        p = 2 * float(np.mean(diffs <= 0))
+    else:
+        p = 2 * float(np.mean(diffs >= 0))
+    return {
+        "difference": observed,
+        "ci_lower": float(np.percentile(diffs, 2.5)),
+        "ci_upper": float(np.percentile(diffs, 97.5)),
+        "p_value": min(p, 1.0),
+    }
