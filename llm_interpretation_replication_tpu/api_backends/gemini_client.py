@@ -1,0 +1,117 @@
+"""Gemini client: generateContent with logprobs, safety-off, threaded fan-out.
+
+Behavioral spec from perturb_prompts_gemini.py (response_logprobs=True,
+logprobs=19; client-side rate limiting), perturb_prompts_gemini_parallel.py
+(20 threads, ~2.3 req/s token bucket), evaluate_irrelevant_perturbations.py
+(BLOCK_NONE safety thresholds :72-78; ``max_output_tokens`` deliberately unset
+to dodge the empty-response bug :336-350).
+"""
+
+from __future__ import annotations
+
+import json
+from concurrent.futures import ThreadPoolExecutor
+from typing import Dict, List, Optional, Sequence
+
+from ..utils.retry import RateLimiter, RetryPolicy, retry_with_exponential_backoff
+from .transport import TransportError, UrllibTransport
+
+BASE_URL = "https://generativelanguage.googleapis.com/v1beta"
+
+SAFETY_OFF = [
+    {"category": c, "threshold": "BLOCK_NONE"}
+    for c in (
+        "HARM_CATEGORY_HARASSMENT",
+        "HARM_CATEGORY_HATE_SPEECH",
+        "HARM_CATEGORY_SEXUALLY_EXPLICIT",
+        "HARM_CATEGORY_DANGEROUS_CONTENT",
+    )
+]
+
+
+class GeminiClient:
+    def __init__(self, api_key: str, transport=None, base_url: str = BASE_URL,
+                 retry_policy: Optional[RetryPolicy] = None,
+                 requests_per_second: Optional[float] = None):
+        self.api_key = api_key
+        self.transport = transport or UrllibTransport()
+        self.base_url = base_url
+        self.retry_policy = retry_policy or RetryPolicy(
+            retry_on=(TransportError,), max_retries=10,
+            initial_delay=60.0, max_delay=300.0,
+        )
+        self.rate_limiter = (
+            RateLimiter(requests_per_second) if requests_per_second else None
+        )
+
+    def generate_content(
+        self,
+        model: str,
+        prompt: str,
+        temperature: float = 0.0,
+        max_output_tokens: Optional[int] = None,  # None on purpose (bug dodge)
+        response_logprobs: bool = False,
+        logprobs: int = 19,
+        safety_off: bool = True,
+    ) -> Dict:
+        if self.rate_limiter:
+            self.rate_limiter.acquire()
+        generation_config: Dict = {"temperature": temperature}
+        if max_output_tokens is not None:
+            generation_config["maxOutputTokens"] = max_output_tokens
+        if response_logprobs:
+            generation_config["responseLogprobs"] = True
+            generation_config["logprobs"] = logprobs
+        body = {
+            "contents": [{"parts": [{"text": prompt}]}],
+            "generationConfig": generation_config,
+        }
+        if safety_off:
+            body["safetySettings"] = SAFETY_OFF
+        path = f"/models/{model}:generateContent?key={self.api_key}"
+
+        @retry_with_exponential_backoff(self.retry_policy)
+        def call():
+            try:
+                _, raw = self.transport.request("POST", f"{self.base_url}{path}", {}, body)
+            except TransportError as err:
+                if not err.retryable:
+                    raise RuntimeError(str(err)) from err
+                raise
+            return raw
+
+        return json.loads(call())
+
+    @staticmethod
+    def text_of(response: Dict) -> str:
+        try:
+            parts = response["candidates"][0]["content"]["parts"]
+            return "".join(p.get("text", "") for p in parts).strip()
+        except (KeyError, IndexError):
+            return ""
+
+    @staticmethod
+    def top_candidates_of(response: Dict) -> List[List[tuple]]:
+        """Per-position [(token, logprob)] lists from logprobsResult."""
+        try:
+            lr = response["candidates"][0]["logprobsResult"]
+        except (KeyError, IndexError):
+            return []
+        positions = []
+        for pos in lr.get("topCandidates", []):
+            positions.append(
+                [
+                    (c.get("token", ""), float(c.get("logProbability", 0.0)))
+                    for c in pos.get("candidates", [])
+                ]
+            )
+        return positions
+
+    def generate_many(self, model: str, prompts: Sequence[str], max_workers: int = 20,
+                      **kwargs) -> List[Dict]:
+        """Threaded fan-out (the reference's 'parallel'/'batch auto' mode)."""
+        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+            futures = [
+                pool.submit(self.generate_content, model, p, **kwargs) for p in prompts
+            ]
+            return [f.result() for f in futures]

@@ -1,0 +1,349 @@
+"""API backend tests driven entirely through FakeTransport."""
+
+import json
+import math
+
+import numpy as np
+import pytest
+
+from llm_interpretation_replication_tpu.api_backends import (
+    AnthropicClient,
+    CostTracker,
+    FakeTransport,
+    GeminiClient,
+    OpenAIClient,
+    ResponseCache,
+    build_openai_batch_request,
+    evaluate_claude,
+    evaluate_gemini_binary,
+    evaluate_gemini_confidence,
+    evaluate_gpt_binary,
+    evaluate_gpt_confidence,
+    evaluate_normal_baseline,
+    evaluate_random_baseline,
+    first_token_target_probs,
+    is_reasoning_model,
+)
+from llm_interpretation_replication_tpu.api_backends.transport import TransportError
+from llm_interpretation_replication_tpu.utils.retry import RetryPolicy
+
+
+def fast_retry():
+    return RetryPolicy(retry_on=(TransportError,), max_retries=3,
+                       initial_delay=0.0, sleep=lambda s: None)
+
+
+def chat_response(text, top_logprobs=None, usage=None):
+    content = []
+    if top_logprobs is not None:
+        content = [
+            {"token": text.split()[0] if text else "", "top_logprobs": top_logprobs}
+        ]
+    return {
+        "choices": [
+            {
+                "message": {"content": text},
+                "logprobs": {"content": content} if content else None,
+            }
+        ],
+        "usage": usage or {"prompt_tokens": 100, "completion_tokens": 5},
+    }
+
+
+class TestOpenAIClient:
+    def test_chat_completion_params(self):
+        ft = FakeTransport()
+        seen = {}
+
+        def responder(call):
+            seen.update(call["json"])
+            return 200, chat_response("Yes")
+
+        ft.add("POST", "/chat/completions", responder)
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        client.chat_completion("gpt-4.1-2025-04-14", [{"role": "user", "content": "q"}])
+        assert seen["temperature"] == 0.0
+        assert seen["logprobs"] is True
+        assert seen["top_logprobs"] == 20
+        assert seen["max_tokens"] == 500
+
+    def test_reasoning_model_params(self):
+        ft = FakeTransport()
+        seen = {}
+        ft.add("POST", "/chat/completions", lambda c: (seen.update(c["json"]), (200, chat_response("Yes")))[1])
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        client.chat_completion("gpt-5", [{"role": "user", "content": "q"}])
+        assert seen["max_completion_tokens"] == 2000
+        assert "logprobs" not in seen
+        assert is_reasoning_model("o3-2025-04-16")
+        assert not is_reasoning_model("gpt-4.1-mini-2025-04-14")
+
+    def test_retry_on_429_then_success(self):
+        ft = FakeTransport()
+        attempts = {"n": 0}
+
+        def responder(call):
+            attempts["n"] += 1
+            if attempts["n"] < 3:
+                raise TransportError(429, "rate limited", retryable=True)
+            return 200, chat_response("ok")
+
+        ft.add("POST", "/chat/completions", responder)
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        resp = client.chat_completion("gpt-4o-2024-11-20", [{"role": "user", "content": "q"}])
+        assert attempts["n"] == 3
+        assert resp["choices"][0]["message"]["content"] == "ok"
+
+    def test_non_retryable_raises_immediately(self):
+        ft = FakeTransport()
+        ft.add("POST", "/chat/completions",
+               lambda c: (_ for _ in ()).throw(TransportError(401, "bad key", retryable=False)))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        with pytest.raises(RuntimeError):
+            client.chat_completion("gpt-4o-2024-11-20", [{"role": "user", "content": "q"}])
+        assert len(ft.calls) == 1
+
+    def test_batch_pipeline(self):
+        ft = FakeTransport()
+        polls = {"n": 0}
+        ft.add("POST", "/files", lambda c: (200, {"id": "file-1"}))
+        ft.add("POST", "/batches", lambda c: (200, {"id": "batch-1", "status": "validating"}))
+
+        def poll(call):
+            polls["n"] += 1
+            status = "completed" if polls["n"] >= 2 else "in_progress"
+            return 200, {"id": "batch-1", "status": status, "output_file_id": "file-2"}
+
+        ft.add("GET", "/batches/batch-1", poll)
+        out_lines = [{"custom_id": "a", "response": {"body": chat_response("Yes")}}]
+        ft.add("GET", "/files/file-2/content",
+               lambda c: (200, "\n".join(json.dumps(l) for l in out_lines).encode()))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        reqs = [build_openai_batch_request("a", "gpt-4.1-2025-04-14",
+                                           [{"role": "user", "content": "q"}])]
+        results = client.run_batch(reqs, poll_interval=0, sleep=lambda s: None)
+        assert results[0]["custom_id"] == "a"
+        # the uploaded multipart body contains the request JSONL
+        upload = [c for c in ft.calls if "/files" in c["url"] and c["method"] == "POST"][0]
+        assert b"custom_id" in upload["data"]
+
+    def test_batch_terminal_failure(self):
+        ft = FakeTransport()
+        ft.add("GET", "/batches/batch-x", lambda c: (200, {"id": "batch-x", "status": "failed"}))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        with pytest.raises(RuntimeError, match="terminal state"):
+            client.wait_for_batch("batch-x", poll_interval=0, sleep=lambda s: None)
+
+
+class TestAnthropicClient:
+    def _client(self, handlers):
+        ft = FakeTransport()
+        for h in handlers:
+            ft.add(*h)
+        return AnthropicClient("k", transport=ft, retry_policy=fast_retry()), ft
+
+    def test_message_and_text(self):
+        client, ft = self._client([
+            ("POST", "/messages", lambda c: (200, {
+                "content": [{"type": "text", "text": "Not Covered"}]
+            })),
+        ])
+        msg = client.create_message("claude-opus-4-1-20250805",
+                                    [{"role": "user", "content": "q"}])
+        assert client.text_of(msg) == "Not Covered"
+        sent = ft.calls[0]["headers"]
+        assert sent["x-api-key"] == "k"
+        assert "anthropic-version" in sent
+
+    def test_approximate_logprobs_counts(self):
+        # reference quirk: first matching target in target order wins, so
+        # "Not Covered" counts toward "Covered" with targets (Covered, Not)
+        replies = iter(["Covered", "Covered", "Not Covered", "Not sure thing", "weird"])
+        client, _ = self._client([
+            ("POST", "/messages", lambda c: (200, {
+                "content": [{"type": "text", "text": next(replies)}]
+            })),
+        ])
+        probs, texts = client.approximate_logprobs(
+            "claude-opus-4-1-20250805", [{"role": "user", "content": "q"}],
+            ["Covered", "Not"], n_samples=5,
+        )
+        assert probs["Covered"] == pytest.approx(3 / 5)
+        assert probs["Not"] == pytest.approx(1 / 5)
+
+    def test_approximate_logprobs_uniform_fallback(self):
+        client, _ = self._client([
+            ("POST", "/messages", lambda c: (200, {
+                "content": [{"type": "text", "text": "no target here"}]
+            })),
+        ])
+        probs, _ = client.approximate_logprobs(
+            "claude-opus-4-1-20250805", [{"role": "user", "content": "q"}],
+            ["Covered", "Nope"], n_samples=3,
+        )
+        assert probs == {"Covered": 0.5, "Nope": 0.5}
+
+    def test_batch_size_cap(self):
+        client, _ = self._client([])
+        with pytest.raises(ValueError):
+            client.create_batch([{} for _ in range(10_001)])
+
+    def test_batch_poll_and_results(self):
+        polls = {"n": 0}
+
+        def poll(call):
+            polls["n"] += 1
+            status = "ended" if polls["n"] >= 2 else "in_progress"
+            return 200, {"id": "b1", "processing_status": status}
+
+        lines = [{"custom_id": "x", "result": {"type": "succeeded"}}]
+        client, _ = self._client([
+            ("POST", "/messages/batches", lambda c: (200, {"id": "b1", "processing_status": "in_progress"})),
+            ("GET", "/messages/batches/b1/results",
+             lambda c: (200, "\n".join(json.dumps(l) for l in lines).encode())),
+            ("GET", "/messages/batches/b1", poll),
+        ])
+        results = client.run_batches([{"custom_id": "x", "params": {}}],
+                                     poll_interval=0, sleep=lambda s: None)
+        assert results[0]["custom_id"] == "x"
+
+
+class TestGeminiClient:
+    def _response(self, text, top=None):
+        cand = {"content": {"parts": [{"text": text}]}}
+        if top is not None:
+            cand["logprobsResult"] = {
+                "topCandidates": [
+                    {"candidates": [{"token": t, "logProbability": lp} for t, lp in pos]}
+                    for pos in top
+                ]
+            }
+        return {"candidates": [cand]}
+
+    def test_generate_content_safety_and_logprobs(self):
+        ft = FakeTransport()
+        seen = {}
+        ft.add("POST", ":generateContent",
+               lambda c: (seen.update(c["json"]), (200, self._response("85")))[1])
+        client = GeminiClient("k", transport=ft, retry_policy=fast_retry())
+        resp = client.generate_content("gemini-2.5-pro", "q", response_logprobs=True)
+        assert seen["generationConfig"]["responseLogprobs"] is True
+        assert seen["generationConfig"]["logprobs"] == 19
+        assert "maxOutputTokens" not in seen["generationConfig"]  # bug dodge
+        assert all(s["threshold"] == "BLOCK_NONE" for s in seen["safetySettings"])
+        assert client.text_of(resp) == "85"
+
+    def test_top_candidates_extraction(self):
+        client = GeminiClient("k", transport=FakeTransport(), retry_policy=fast_retry())
+        resp = self._response("85", top=[[("85", math.log(0.9)), ("90", math.log(0.1))]])
+        positions = client.top_candidates_of(resp)
+        assert positions[0][0] == ("85", pytest.approx(math.log(0.9)))
+
+    def test_generate_many_threads(self):
+        ft = FakeTransport()
+        ft.add("POST", ":generateContent", lambda c: (200, self._response("ok")))
+        client = GeminiClient("k", transport=ft, retry_policy=fast_retry())
+        out = client.generate_many("gemini-2.0-flash", [f"p{i}" for i in range(10)],
+                                   max_workers=4)
+        assert len(out) == 10
+
+
+class TestEvaluators:
+    def test_gpt_binary_relative_prob(self):
+        ft = FakeTransport()
+        top = [{"token": "Yes", "logprob": math.log(0.7)},
+               {"token": "No", "logprob": math.log(0.2)}]
+        ft.add("POST", "/chat/completions", lambda c: (200, chat_response("Yes", top)))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        res = evaluate_gpt_binary(client, "gpt-4.1-2025-04-14", "Is a tent a building?")
+        assert res["yes_prob"] == pytest.approx(0.7)
+        assert res["relative_prob"] == pytest.approx(0.7 / 0.9)
+
+    def test_gpt_binary_targets_missing_from_top(self):
+        ft = FakeTransport()
+        top = [{"token": "Maybe", "logprob": math.log(0.9)}]
+        ft.add("POST", "/chat/completions", lambda c: (200, chat_response("Maybe", top)))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        res = evaluate_gpt_binary(client, "gpt-4.1-2025-04-14", "q?")
+        assert res["relative_prob"] == 0.5  # both zero -> 0.5 fallback
+
+    def test_gpt_confidence_weighted(self):
+        ft = FakeTransport()
+        top = [{"token": "85", "logprob": math.log(0.8)},
+               {"token": "90", "logprob": math.log(0.2)}]
+        ft.add("POST", "/chat/completions", lambda c: (200, chat_response("85", top)))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        res = evaluate_gpt_confidence(client, "gpt-4.1-2025-04-14", "q?")
+        assert res["confidence"] == 85
+        assert res["weighted_confidence"] == pytest.approx(85 * 0.8 + 90 * 0.2)
+
+    def test_gemini_evaluators(self):
+        ft = FakeTransport()
+        resp = {
+            "candidates": [{
+                "content": {"parts": [{"text": "Yes"}]},
+                "logprobsResult": {"topCandidates": [
+                    {"candidates": [
+                        {"token": "Yes", "logProbability": math.log(0.6)},
+                        {"token": "No", "logProbability": math.log(0.3)},
+                    ]}
+                ]},
+            }]
+        }
+        ft.add("POST", ":generateContent", lambda c: (200, resp))
+        client = GeminiClient("k", transport=ft, retry_policy=fast_retry())
+        out = evaluate_gemini_binary(client, "gemini-2.5-pro", "q?")
+        assert out["relative_prob"] == pytest.approx(0.6 / 0.9)
+        conf = evaluate_gemini_confidence(client, "gemini-2.5-pro", "q?")
+        assert conf["response"] == "Yes"
+
+    def test_claude_evaluator_no_logprobs(self):
+        ft = FakeTransport()
+        texts = iter(["Yes", "85"])
+        ft.add("POST", "/messages", lambda c: (200, {
+            "content": [{"type": "text", "text": next(texts)}]
+        }))
+        client = AnthropicClient("k", transport=ft, retry_policy=fast_retry())
+        res = evaluate_claude(client, "claude-opus-4-1-20250805", "q?")
+        assert res["response"] == "Yes"
+        assert res["confidence"] == 85
+
+    def test_baselines_seeded(self):
+        rng = np.random.default_rng(42)
+        r1 = evaluate_random_baseline(rng)
+        assert r1["response"] in ("Yes", "No")
+        assert 0 <= r1["confidence"] <= 100
+        n = evaluate_normal_baseline(0.619, 0.167, np.random.default_rng(42))
+        assert 0.0 <= n["relative_prob"] <= 1.0
+
+    def test_first_token_target_probs(self):
+        top = [{"token": "Covered", "logprob": math.log(0.5)},
+               {"token": "Not", "logprob": math.log(0.4)}]
+        p1, p2 = first_token_target_probs(top, ("Covered", "Not"))
+        assert (p1, p2) == (pytest.approx(0.5), pytest.approx(0.4))
+
+
+class TestCacheAndCost:
+    def test_cache_partial_reruns(self, tmp_path):
+        path = str(tmp_path / "api_cache.json")
+        cache = ResponseCache(path)
+        q = "Is a screenshot a photograph?" + "x" * 200
+        cache.put(q, {"gpt_response": "Yes", "gpt_yes_prob": 0.7, "gpt_no_prob": 0.2,
+                      "gpt_relative_prob": 0.78, "gpt_confidence": 80,
+                      "gpt_weighted_confidence": 79.5})
+        missing = cache.missing_evaluators(q)
+        assert "gpt" not in missing
+        assert set(missing) == {"gemini", "claude", "random"}
+        # reload from disk; key is first-100-chars so long questions collide correctly
+        cache2 = ResponseCache(path)
+        assert cache2.get(q[:100] + "DIFFERENT TAIL") is not None
+        assert not cache2.is_complete(q)
+
+    def test_cost_tracking_and_extrapolation(self):
+        tracker = CostTracker(pricing={"m": {"input": 2.0, "output": 8.0}})
+        tracker.record("m", 1_000_000, 500_000)
+        assert tracker.cost("m") == pytest.approx(2.0 + 4.0)
+        assert tracker.extrapolate("m", processed=100, total=1000) == pytest.approx(60.0)
+        tracker.record_response("m", {"usage": {"prompt_tokens": 10, "completion_tokens": 2}})
+        assert tracker.usage["m"]["requests"] == 2
+        assert tracker.total_cost() > 6.0
