@@ -277,17 +277,9 @@ def _unembed(cfg: DecoderConfig, params, x):
     return (x.astype(jnp.float32) @ table.astype(jnp.float32)) * cfg.logit_scale
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "return_cache", "cache_len"))
-def forward(
-    params,
-    cfg: DecoderConfig,
-    token_ids,                 # [B, S] int32, right-padded
-    attention_mask,            # [B, S] 1 for real tokens
-    return_cache: bool = False,
-    cache_len: Optional[int] = None,
-):
-    """Full-sequence forward.  Returns fp32 logits [B, S, V]; optionally also a
-    KV cache (padded to ``cache_len``) for subsequent greedy decode."""
+def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
+           cache_len: Optional[int] = None):
+    """Embed + blocks.  Returns (hidden [B,S,H], cache | None)."""
     b, s = token_ids.shape
     mask = attention_mask.astype(bool)
     positions = jnp.cumsum(attention_mask, axis=-1) - 1  # right-padded prompts
@@ -296,18 +288,19 @@ def forward(
     if cfg.position_embedding == "rotary":
         rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
         sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, params["embed"]["tokens"].dtype)
-    bias = make_attention_bias(cfg, positions, positions, mask)
     x = _embed(cfg, params, token_ids, positions)
 
-    if not return_cache:
+    if cache_len is None:
+        bias = make_attention_bias(cfg, positions, positions, mask)
+
         def body(h, lp):
             h, _ = _block(cfg, lp, h, sin_cos, bias, None, None)
             return h, None
 
         x, _ = lax.scan(body, x, params["layers"])
-        return _unembed(cfg, params, x)
+        return x, None
 
-    t = cache_len or s
+    t = cache_len
     cache_dtype = params["embed"]["tokens"].dtype
     # Attention runs over the whole (zero-padded) cache: extend the key-side
     # mask/positions from S to T.  Slot index == position for right-padded rows.
@@ -323,7 +316,40 @@ def forward(
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     lengths = jnp.sum(attention_mask, axis=-1)  # [B] per-row prompt length
     cache = KVCache(k=ks, v=vs, length=jnp.max(lengths).astype(jnp.int32))
-    return _unembed(cfg, params, x), cache
+    return x, cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "return_cache", "cache_len"))
+def forward(
+    params,
+    cfg: DecoderConfig,
+    token_ids,                 # [B, S] int32, right-padded
+    attention_mask,            # [B, S] 1 for real tokens
+    return_cache: bool = False,
+    cache_len: Optional[int] = None,
+):
+    """Full-sequence forward.  Returns fp32 logits [B, S, V]; optionally also a
+    KV cache (padded to ``cache_len``) for subsequent greedy decode."""
+    s = token_ids.shape[1]
+    x, cache = _trunk(params, cfg, token_ids, attention_mask,
+                      (cache_len or s) if return_cache else None)
+    logits = _unembed(cfg, params, x)
+    if return_cache:
+        return logits, cache
+    return logits
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def forward_last_logits(params, cfg: DecoderConfig, token_ids, attention_mask):
+    """fp32 logits at each row's LAST real position only — [B, V].
+
+    The sweep's hot op: avoids materializing the [B, S, V] fp32 logit tensor
+    (1 GB at B=16, S=256, V=65k) that full-sequence unembedding would cost.
+    """
+    x, _ = _trunk(params, cfg, token_ids, attention_mask, None)
+    lengths = jnp.sum(attention_mask, axis=-1)
+    last = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)  # [B,1,H]
+    return _unembed(cfg, params, last)[:, 0, :]
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_steps"))
@@ -345,12 +371,13 @@ def greedy_decode(
     """
     b, s = token_ids.shape
     total = s + num_steps
-    logits, cache = forward(
-        params, cfg, token_ids, attention_mask, return_cache=True, cache_len=total
-    )
+    x, cache = _trunk(params, cfg, token_ids, attention_mask, cache_len=total)
     lengths = jnp.sum(attention_mask, axis=-1)  # [B]
-    # Logit at the last real prompt token predicts the first generated token.
-    last = jnp.take_along_axis(logits, (lengths - 1)[:, None, None], axis=1)[:, 0, :]
+    # Hidden state at the last real prompt token predicts the first generated
+    # token; unembed only there (full [B,S,V] fp32 logits would be ~1 GB for
+    # 7B-vocab models at sweep batch sizes).
+    last_h = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    last = _unembed(cfg, params, last_h)[:, 0, :]
 
     kv_positions = jnp.broadcast_to(jnp.arange(total)[None, :], (b, total))
 

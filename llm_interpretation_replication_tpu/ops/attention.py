@@ -1,0 +1,140 @@
+"""Fused attention ops: Pallas TPU flash attention + XLA fallback.
+
+The prompt forward pass is the sweep's FLOP hot spot (SURVEY.md §3.1); this
+kernel keeps the S×S score matrix out of HBM by computing attention blockwise
+in VMEM with an online softmax (flash-attention recurrence):
+
+    grid = (batch, heads, Sq/BLOCK_Q); per program the query block lives in
+    VMEM while K/V stream through ``pl.ds`` slices; m/l/acc carry the
+    softmax state in fp32; matmuls run on the MXU via
+    ``preferred_element_type=float32``.
+
+``attention(...)`` dispatches: Pallas on TPU backends, a dense XLA
+implementation elsewhere (tests run the kernel in interpret mode to pin the
+two paths together).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Optional
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+
+NEG_INF = -1e9
+DEFAULT_BLOCK_Q = 128
+DEFAULT_BLOCK_K = 128
+
+
+def _dense_attention(q, k, v, lengths, causal: bool):
+    """Reference XLA path: [B, N, S, D] inputs."""
+    b, n, s, d = q.shape
+    scores = jnp.einsum("bnsd,bntd->bnst", q, k).astype(jnp.float32)
+    scores = scores / jnp.sqrt(jnp.asarray(d, jnp.float32))
+    cols = jnp.arange(s)
+    valid = cols[None, :] < lengths[:, None]                   # [B, S]
+    mask = valid[:, None, None, :]
+    if causal:
+        mask = mask & (cols[None, None, :, None] >= cols[None, None, None, :])
+    scores = jnp.where(mask, scores, NEG_INF)
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,bntd->bnsd", probs, v)
+
+
+def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
+                  seq_len, causal):
+    bi = pl.program_id(0)
+    qi = pl.program_id(2)
+    q = q_ref[0, 0].astype(jnp.float32)                        # [BQ, D]
+    d = q.shape[-1]
+    scale = jax.lax.rsqrt(jnp.asarray(d, jnp.float32))
+    q = q * scale
+    length = len_ref[bi]
+
+    rows = qi * block_q + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 0)
+    num_kv = seq_len // block_k
+
+    def body(ki, carry):
+        m, l, acc = carry
+        k_blk = k_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        v_blk = v_ref[0, 0, pl.ds(ki * block_k, block_k), :].astype(jnp.float32)
+        s = jnp.dot(q, k_blk.T, preferred_element_type=jnp.float32)  # [BQ, BK]
+        cols = ki * block_k + jax.lax.broadcasted_iota(jnp.int32, (block_q, block_k), 1)
+        mask = cols < length
+        if causal:
+            mask = mask & (cols <= rows)
+        s = jnp.where(mask, s, NEG_INF)
+        m_new = jnp.maximum(m, jnp.max(s, axis=-1, keepdims=True))
+        p = jnp.exp(s - m_new)
+        correction = jnp.exp(m - m_new)
+        l_new = l * correction + jnp.sum(p, axis=-1, keepdims=True)
+        acc_new = acc * correction + jnp.dot(p, v_blk, preferred_element_type=jnp.float32)
+        return m_new, l_new, acc_new
+
+    m0 = jnp.full((block_q, 1), NEG_INF, jnp.float32)
+    l0 = jnp.zeros((block_q, 1), jnp.float32)
+    acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
+    m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
+    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    o_ref[0, 0] = out.astype(o_ref.dtype)
+
+
+try:  # pallas imports fail gracefully on unsupported backends
+    from jax.experimental import pallas as pl
+    from jax.experimental.pallas import tpu as pltpu
+
+    _PALLAS_OK = True
+except Exception:  # pragma: no cover
+    _PALLAS_OK = False
+
+
+def flash_attention(
+    q, k, v,                       # [B, N, S, D]
+    lengths,                       # [B] int32 valid key counts
+    causal: bool = True,
+    block_q: int = DEFAULT_BLOCK_Q,
+    block_k: int = DEFAULT_BLOCK_K,
+    interpret: bool = False,
+):
+    """Pallas flash attention over [B, N, S, D]; S must divide by the blocks
+    (callers pad — bucketed batching guarantees it)."""
+    b, n, s, d = q.shape
+    block_q = min(block_q, s)
+    block_k = min(block_k, s)
+    if s % block_q or s % block_k:
+        raise ValueError(f"seq {s} not divisible by blocks ({block_q}, {block_k})")
+    grid = (b, n, s // block_q)
+    kernel = functools.partial(
+        _flash_kernel, block_q=block_q, block_k=block_k, seq_len=s, causal=causal
+    )
+    grid_spec = pltpu.PrefetchScalarGridSpec(
+        num_scalar_prefetch=1,
+        grid=grid,
+        in_specs=[
+            pl.BlockSpec((1, 1, block_q, d), lambda bi, ni, qi, lens: (bi, ni, qi, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, ni, qi, lens: (bi, ni, 0, 0)),
+            pl.BlockSpec((1, 1, s, d), lambda bi, ni, qi, lens: (bi, ni, 0, 0)),
+        ],
+        out_specs=pl.BlockSpec((1, 1, block_q, d), lambda bi, ni, qi, lens: (bi, ni, qi, 0)),
+    )
+    fn = pl.pallas_call(
+        kernel,
+        grid_spec=grid_spec,
+        out_shape=jax.ShapeDtypeStruct((b, n, s, d), q.dtype),
+        interpret=interpret,
+    )
+    return fn(jnp.asarray(lengths, jnp.int32), q, k, v)
+
+
+def attention(q, k, v, lengths, causal: bool = True, force: Optional[str] = None,
+              interpret: bool = False):
+    """Dispatch: 'pallas' on TPU, dense XLA elsewhere.  ``force`` overrides."""
+    backend = force
+    if backend is None:
+        platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
+        backend = "pallas" if (_PALLAS_OK and platform == "tpu") else "dense"
+    if backend == "pallas":
+        return flash_attention(q, k, v, lengths, causal, interpret=interpret)
+    return _dense_attention(q, k, v, lengths, causal)

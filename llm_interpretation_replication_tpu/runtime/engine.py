@@ -157,11 +157,7 @@ class ScoringEngine:
                 dec = jnp.full((ids.shape[0], 1), self.cfg.decoder_start_token_id, jnp.int32)
                 logits = t5mod.forward(self.params, self.cfg, ids, mask, dec)[:, 0, :]
             else:
-                logits = dmod.forward(self.params, self.cfg, ids, mask)
-                lengths = jnp.sum(jnp.asarray(batch.attention_mask), axis=-1)
-                logits = jnp.take_along_axis(
-                    logits, (lengths - 1)[:, None, None], axis=1
-                )[:, 0, :]
+                logits = dmod.forward_last_logits(self.params, self.cfg, ids, mask)
             yes, no, rel = yn.relative_prob_first_token(logits, yes_id, no_id, top_filter)
             for r, orig in enumerate(batch.indices):
                 if orig >= 0:
