@@ -1,0 +1,20 @@
+from .base_vs_instruct_figs import base_vs_instruct_figures, process_model_pair
+from .closed_source_eval import (
+    calculate_correlations,
+    compare_with_human_data,
+    evaluate_all_models,
+    write_report,
+)
+from .combined_confidence import ModelConfidenceAnalyzer, run_combined_analysis
+from .irrelevant_eval import (
+    consistency_statistics,
+    process_scenario_perturbations,
+    write_outputs,
+)
+from .model_comparison import (
+    cross_experiment_kappa,
+    difference_strip_plot,
+    model_comparison_report,
+)
+from .perturbation_report import add_relative_prob, analyze_model, analyze_workbook
+from .similarity_report import similarity_report

@@ -1,0 +1,83 @@
+"""Base-vs-instruct figure builders (paper Figures 7-8).
+
+Rebuild of analyze_results_base_versus_instruct.py: pair base/instruct rows on
+prompt, drop zero-probability rows (:46-52), per-family difference strips and
+a family × prompt difference heatmap.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional
+
+import numpy as np
+import pandas as pd
+
+from ..viz import figures
+
+
+def process_model_pair(df: pd.DataFrame, base_model: str, instruct_model: str,
+                       value_col: str = "relative_prob") -> pd.DataFrame:
+    """Paired frame with instruct−base differences; zero-prob rows dropped."""
+    base = df[df["model"] == base_model]
+    inst = df[df["model"] == instruct_model]
+    merged = pd.merge(
+        base[["prompt", value_col, "yes_prob", "no_prob"]],
+        inst[["prompt", value_col, "yes_prob", "no_prob"]],
+        on="prompt", suffixes=("_base", "_instruct"),
+    )
+    # the reference drops rows where both target probabilities are zero
+    keep = ~(
+        ((merged["yes_prob_base"] == 0) & (merged["no_prob_base"] == 0))
+        | ((merged["yes_prob_instruct"] == 0) & (merged["no_prob_instruct"] == 0))
+    )
+    merged = merged[keep].copy()
+    merged["diff"] = merged[f"{value_col}_instruct"] - merged[f"{value_col}_base"]
+    return merged
+
+
+def base_vs_instruct_figures(
+    df: pd.DataFrame,
+    output_dir: str,
+    value_col: str = "relative_prob",
+) -> Dict[str, str]:
+    """Per-family difference strips + a family×prompt heatmap.
+
+    Expects the model_comparison_results.csv schema (model, model_family,
+    base_or_instruct, prompt, yes_prob, no_prob, <value_col>).
+    """
+    os.makedirs(output_dir, exist_ok=True)
+    paths: Dict[str, str] = {}
+    diffs_by_family: Dict[str, np.ndarray] = {}
+    heat_rows = []
+    heat_families = []
+    prompts: Optional[list] = None
+    for family in df["model_family"].unique():
+        fam = df[df["model_family"] == family]
+        base_models = fam[fam["base_or_instruct"] == "base"]["model"].unique()
+        inst_models = fam[fam["base_or_instruct"] == "instruct"]["model"].unique()
+        if not len(base_models) or not len(inst_models):
+            continue
+        merged = process_model_pair(fam, base_models[0], inst_models[0], value_col)
+        if not len(merged):
+            continue
+        diffs_by_family[family] = merged["diff"].to_numpy()
+        if prompts is None:
+            prompts = merged["prompt"].tolist()
+        aligned = merged.set_index("prompt")["diff"].reindex(prompts)
+        heat_rows.append(aligned.to_numpy(dtype=float))
+        heat_families.append(family)
+    if diffs_by_family:
+        paths["difference_strips"] = figures.jitter_strip_panels(
+            diffs_by_family, "Instruct − base relative-probability differences",
+            os.path.join(output_dir, "base_vs_instruct_diffs.png"),
+            ylabel="Δ relative probability", ylim=(-1, 1),
+        )
+    if heat_rows and prompts:
+        labels = [f"q{i + 1}" for i in range(len(prompts))]
+        paths["heatmap"] = figures.mae_heatmap(
+            np.vstack(heat_rows), heat_families, labels,
+            "Instruct − base differences by prompt",
+            os.path.join(output_dir, "base_vs_instruct_heatmap.png"),
+        )
+    return paths

@@ -1,0 +1,158 @@
+"""Three-model confidence combiner + stacked visualization.
+
+Rebuild of combine_model_confidence_analysis.py's ``ModelConfidenceAnalyzer``
+(:23-610), run_three_model_analysis.py / run_combined_confidence_analysis.py
+wiring, and the stacked Figure-5/6 builders
+(create_three_model_stacked_visualization.py, create_combined_visualization.py).
+"""
+
+from __future__ import annotations
+
+import os
+from itertools import combinations
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+from scipy.stats import pearsonr, spearmanr
+
+from ..viz import figures, latex
+
+
+class ModelConfidenceAnalyzer:
+    """Joins per-model perturbation-sweep confidence frames on
+    (Original Main Part, Rephrased Main Part) and computes cross-model
+    statistics."""
+
+    def __init__(self, frames: Dict[str, pd.DataFrame],
+                 confidence_col: str = "Weighted Confidence"):
+        self.confidence_col = confidence_col
+        self.frames = frames
+        self.combined = self._combine()
+
+    def _combine(self) -> pd.DataFrame:
+        keys = ["Original Main Part", "Rephrased Main Part"]
+        combined: Optional[pd.DataFrame] = None
+        for model, df in self.frames.items():
+            col = self.confidence_col if self.confidence_col in df.columns else "Confidence Value"
+            sub = df[keys + [col]].copy()
+            sub[f"confidence_{model}"] = pd.to_numeric(sub[col], errors="coerce")
+            sub = sub.drop(columns=[col])
+            sub = sub.drop_duplicates(subset=keys)
+            combined = sub if combined is None else combined.merge(sub, on=keys, how="outer")
+        return combined if combined is not None else pd.DataFrame()
+
+    @property
+    def models(self) -> List[str]:
+        return list(self.frames)
+
+    def summary_stats(self) -> pd.DataFrame:
+        records = []
+        for scenario, sub in self.combined.groupby("Original Main Part"):
+            for model in self.models:
+                vals = sub[f"confidence_{model}"].dropna().to_numpy(dtype=float)
+                if not vals.size:
+                    continue
+                p = np.percentile(vals, [2.5, 97.5])
+                records.append(
+                    {
+                        "scenario": scenario[:60],
+                        "model": model,
+                        "n": int(vals.size),
+                        "mean": float(vals.mean()),
+                        "std": float(vals.std()),
+                        "p2_5": float(p[0]),
+                        "p97_5": float(p[1]),
+                        "ci_width": float(p[1] - p[0]),
+                    }
+                )
+        return pd.DataFrame(records)
+
+    def cross_model_correlations(self) -> pd.DataFrame:
+        rows = []
+        for a, b in combinations(self.models, 2):
+            sub = self.combined[[f"confidence_{a}", f"confidence_{b}"]].dropna()
+            if len(sub) < 3:
+                continue
+            pr, pp = pearsonr(sub.iloc[:, 0], sub.iloc[:, 1])
+            sr, sp = spearmanr(sub.iloc[:, 0], sub.iloc[:, 1])
+            rows.append(
+                {
+                    "model_1": a, "model_2": b, "n": len(sub),
+                    "pearson_r": float(pr), "pearson_p": float(pp),
+                    "spearman_r": float(sr), "spearman_p": float(sp),
+                }
+            )
+        return pd.DataFrame(rows)
+
+    def latex_summary(self) -> str:
+        stats = self.summary_stats()
+        lines = [
+            "\\begin{tabular}{llrrrr}",
+            "\\hline",
+            "Scenario & Model & N & Mean & Std & CI width \\\\",
+            "\\hline",
+        ]
+        for _, row in stats.iterrows():
+            lines.append(
+                f"{row['scenario'][:30]}... & {row['model']} & {row['n']} & "
+                f"{row['mean']:.1f} & {row['std']:.1f} & {row['ci_width']:.1f} \\\\"
+            )
+        lines += ["\\hline", "\\end{tabular}"]
+        return "\n".join(lines)
+
+    def stacked_visualization(self, output_path: str, scenarios: Optional[Sequence[str]] = None):
+        """One jitter-strip panel per model, stacked (Fig. 5/6 style)."""
+        import matplotlib.pyplot as plt
+
+        scenario_keys = scenarios or list(self.combined["Original Main Part"].unique())
+        fig, axes = plt.subplots(
+            len(self.models), 1,
+            figsize=(max(8, 2.0 * len(scenario_keys)), 4 * len(self.models)),
+            squeeze=False,
+        )
+        rng = np.random.default_rng(42)
+        for ax_row, model in zip(axes, self.models):
+            ax = ax_row[0]
+            for i, scenario in enumerate(scenario_keys):
+                vals = self.combined[self.combined["Original Main Part"] == scenario][
+                    f"confidence_{model}"
+                ].dropna().to_numpy(dtype=float)
+                if not vals.size:
+                    continue
+                x = i + rng.uniform(-0.18, 0.18, vals.size)
+                ax.scatter(x, vals, s=6, alpha=0.25)
+                mean = vals.mean()
+                lo, hi = np.percentile(vals, [2.5, 97.5])
+                ax.errorbar([i], [mean], yerr=[[mean - lo], [hi - mean]], fmt="o",
+                            color="black", capsize=5, zorder=5)
+            ax.set_title(model)
+            ax.set_ylim(0, 100)
+            ax.set_xticks(range(len(scenario_keys)))
+            ax.set_xticklabels([f"S{i + 1}" for i in range(len(scenario_keys))])
+            ax.set_ylabel("Confidence")
+        fig.tight_layout()
+        os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+        fig.savefig(output_path, dpi=150, bbox_inches="tight")
+        plt.close(fig)
+        return output_path
+
+
+def run_combined_analysis(frames: Dict[str, pd.DataFrame], output_dir: str) -> Dict:
+    os.makedirs(output_dir, exist_ok=True)
+    analyzer = ModelConfidenceAnalyzer(frames)
+    stats = analyzer.summary_stats()
+    corr = analyzer.cross_model_correlations()
+    stats.to_csv(os.path.join(output_dir, "combined_confidence_stats.csv"), index=False)
+    corr.to_csv(os.path.join(output_dir, "cross_model_correlations.csv"), index=False)
+    with open(os.path.join(output_dir, "combined_tables.tex"), "w") as f:
+        f.write(analyzer.latex_summary())
+    fig_path = analyzer.stacked_visualization(
+        os.path.join(output_dir, "stacked_confidence.png")
+    )
+    return {
+        "stats": stats,
+        "correlations": corr,
+        "figure": fig_path,
+        "combined": analyzer.combined,
+    }

@@ -1,0 +1,169 @@
+"""Irrelevant-perturbation evaluation (3,400 insertions × 3 frontier models).
+
+Rebuild of evaluate_irrelevant_perturbations.py:372-1297: evaluate the
+original + every perturbed scenario at temperature 0.7 with
+``extract_final_number`` parsing for thinking-model outputs, resume via a
+processed-triple checkpoint + JSON progress heartbeat, per-scenario/model
+consistency statistics (mean/std/95% interval width), violin plots, and
+Excel/CSV/JSON outputs.  Vendor clients are injected (evaluator callables
+``(scenario_text) -> response_text``) so local models and tests plug in the
+same way.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Callable, Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from ..scoring.confidence import extract_final_number
+from ..utils.checkpoint import ProcessedSet
+from ..utils.logging import Progress, SessionLogger
+from ..utils.xlsx import write_xlsx
+from ..viz import figures
+
+Evaluator = Callable[[str], str]  # perturbed scenario text -> model reply text
+
+RESULT_COLUMNS = [
+    "model", "scenario_name", "perturbation_id", "irrelevant_statement",
+    "position_index", "position_description", "response_text", "confidence",
+]
+
+
+def confidence_prompt(scenario: Dict, text: str) -> str:
+    return f"{text} {scenario['confidence_format']}"
+
+
+def process_scenario_perturbations(
+    evaluators: Dict[str, Evaluator],
+    scenarios: Sequence[Dict],
+    output_dir: str,
+    include_original: bool = True,
+    max_per_scenario: Optional[int] = None,
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    """Evaluate every (model, scenario, perturbation) triple with resume."""
+    log = log or SessionLogger()
+    os.makedirs(output_dir, exist_ok=True)
+    processed = ProcessedSet(os.path.join(output_dir, "processed_triples.json"))
+    rows_path = os.path.join(output_dir, "raw_results.csv")
+    rows: List[Dict] = (
+        pd.read_csv(rows_path).to_dict("records") if os.path.exists(rows_path) else []
+    )
+    total = sum(
+        (len(s["perturbations_with_irrelevant"][:max_per_scenario])
+         if max_per_scenario else len(s["perturbations_with_irrelevant"]))
+        + (1 if include_original else 0)
+        for s in scenarios
+    ) * len(evaluators)
+    progress = Progress(total, path=os.path.join(output_dir, "progress.json"))
+
+    def run_one(model: str, evaluate: Evaluator, scenario: Dict, pid, text: str, extra: Dict):
+        key = (model, scenario["scenario_name"], pid)
+        if key in processed:
+            return
+        try:
+            reply = evaluate(confidence_prompt(scenario, text))
+            confidence = extract_final_number(reply)
+        except Exception as err:  # keep the sweep alive past broken calls
+            reply, confidence = f"ERROR: {str(err)[:100]}", None
+        rows.append(
+            {
+                "model": model,
+                "scenario_name": scenario["scenario_name"],
+                "perturbation_id": pid,
+                "response_text": str(reply)[:500],
+                "confidence": confidence,
+                **extra,
+            }
+        )
+        processed.add(key, flush=False)
+        progress.update(1, model=model, scenario=scenario["scenario_name"])
+
+    for model, evaluate in evaluators.items():
+        for scenario in scenarios:
+            perturbations = scenario["perturbations_with_irrelevant"]
+            if max_per_scenario:
+                perturbations = perturbations[:max_per_scenario]
+            if include_original:
+                run_one(model, evaluate, scenario, "original", scenario["original_main"],
+                        {"irrelevant_statement": "", "position_index": -1,
+                         "position_description": "original"})
+            for p in perturbations:
+                run_one(
+                    model, evaluate, scenario, p["perturbation_id"], p["perturbed_text"],
+                    {
+                        "irrelevant_statement": p["irrelevant_statement"],
+                        "position_index": p["position_index"],
+                        "position_description": p["position_description"],
+                    },
+                )
+            processed.flush()
+            pd.DataFrame(rows).to_csv(rows_path, index=False)
+            log(f"{model} / {scenario['scenario_name']}: checkpointed ({len(rows)} rows)")
+    df = pd.DataFrame(rows, columns=RESULT_COLUMNS)
+    df.to_csv(rows_path, index=False)
+    return df
+
+
+def consistency_statistics(df: pd.DataFrame) -> pd.DataFrame:
+    """Per (model, scenario): mean/std/95% interval width of confidence over
+    the perturbations; the original-scenario value for reference."""
+    records = []
+    for (model, scenario), sub in df.groupby(["model", "scenario_name"]):
+        pert = sub[sub["perturbation_id"] != "original"]
+        vals = pd.to_numeric(pert["confidence"], errors="coerce").dropna().to_numpy()
+        orig = sub[sub["perturbation_id"] == "original"]
+        orig_conf = (
+            pd.to_numeric(orig["confidence"], errors="coerce").iloc[0]
+            if len(orig)
+            else np.nan
+        )
+        rec = {
+            "model": model,
+            "scenario_name": scenario,
+            "n": int(vals.size),
+            "original_confidence": float(orig_conf) if pd.notna(orig_conf) else np.nan,
+        }
+        if vals.size:
+            p = np.percentile(vals, [2.5, 97.5])
+            rec.update(
+                mean=float(vals.mean()), std=float(vals.std()),
+                p2_5=float(p[0]), p97_5=float(p[1]),
+                ci_width=float(p[1] - p[0]),
+            )
+        records.append(rec)
+    return pd.DataFrame(records)
+
+
+def write_outputs(df: pd.DataFrame, stats: pd.DataFrame, output_dir: str,
+                  make_figures: bool = True) -> Dict[str, str]:
+    os.makedirs(output_dir, exist_ok=True)
+    paths = {
+        "csv": os.path.join(output_dir, "raw_results.csv"),
+        "xlsx": os.path.join(output_dir, "results.xlsx"),
+        "stats_csv": os.path.join(output_dir, "consistency_stats.csv"),
+        "stats_json": os.path.join(output_dir, "consistency_stats.json"),
+    }
+    df.to_csv(paths["csv"], index=False)
+    write_xlsx(df, paths["xlsx"])
+    stats.to_csv(paths["stats_csv"], index=False)
+    with open(paths["stats_json"], "w") as f:
+        json.dump(stats.to_dict("records"), f, indent=2, default=float)
+    if make_figures:
+        for model in df["model"].unique():
+            sub = df[(df["model"] == model) & (df["perturbation_id"] != "original")]
+            groups = {
+                scenario: pd.to_numeric(g["confidence"], errors="coerce").dropna().tolist()
+                for scenario, g in sub.groupby("scenario_name")
+            }
+            path = figures.violin_by_group(
+                groups, f"{model} — confidence across irrelevant insertions",
+                os.path.join(output_dir, f"violin_{str(model).replace('/', '--')}.png"),
+            )
+            if path:
+                paths[f"violin_{model}"] = path
+    return paths

@@ -1,0 +1,111 @@
+"""Inter-model comparison reports + figures.
+
+Rebuild of model_comparison_graph.py (pairwise correlation engine + heatmap +
+distribution + reference-model difference strip) and
+calculate_cohens_kappa.py (cross-experiment kappa merge), consuming the
+instruct-sweep CSV schema.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from ..stats.correlations import (
+    correlation_summary_bootstrap,
+    pairwise_correlations,
+    pairwise_kappa,
+    pivot_model_values,
+)
+from ..viz import figures
+
+
+def difference_strip_plot(df: pd.DataFrame, reference_model: str, output_path: str,
+                          value_col: str = "relative_prob") -> Optional[str]:
+    """Per-model distribution of (model − reference) differences per prompt
+    (model_comparison_graph.py:33-205, Baichuan-referenced in the paper)."""
+    pivot = pivot_model_values(df, value_col=value_col)
+    if reference_model not in pivot.columns:
+        return None
+    import matplotlib.pyplot as plt
+
+    others = [m for m in pivot.columns if m != reference_model]
+    rng = np.random.default_rng(42)
+    fig, ax = plt.subplots(figsize=(max(8, 1.6 * len(others)), 6))
+    for i, model in enumerate(others):
+        diffs = (pivot[model] - pivot[reference_model]).dropna().to_numpy()
+        x = i + rng.uniform(-0.18, 0.18, diffs.size)
+        ax.scatter(x, diffs, s=10, alpha=0.4)
+        ax.plot([i - 0.3, i + 0.3], [np.mean(diffs)] * 2, color="black", lw=2)
+    ax.axhline(0.0, color="grey", linestyle=":")
+    ax.set_xticks(range(len(others)))
+    ax.set_xticklabels([m.split("/")[-1] for m in others], rotation=30, ha="right")
+    ax.set_ylabel(f"{value_col} − {reference_model.split('/')[-1]}")
+    ax.set_title("Per-prompt differences vs reference model")
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    fig.savefig(output_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return output_path
+
+
+def model_comparison_report(
+    df: pd.DataFrame,
+    output_dir: str,
+    value_col: str = "relative_prob",
+    n_bootstrap: int = 1000,
+    seed: int = 42,
+    reference_model: Optional[str] = None,
+    make_figures: bool = True,
+) -> Dict:
+    """All pairwise correlations + bootstrap summary + kappa + figures."""
+    os.makedirs(output_dir, exist_ok=True)
+    pivot = pivot_model_values(df, value_col=value_col)
+    corr_df = pairwise_correlations(pivot)
+    summary = correlation_summary_bootstrap(pivot, n_bootstrap=n_bootstrap, seed=seed)
+    kappa = pairwise_kappa(pivot, n_bootstrap=n_bootstrap, seed=seed)
+    corr_df.to_csv(os.path.join(output_dir, "pairwise_correlations.csv"), index=False)
+    report = {"pairwise": corr_df, "summary": summary, "kappa": kappa}
+    if make_figures and len(pivot.columns) >= 2:
+        labels = [m.split("/")[-1] for m in pivot.columns]
+        mat = pivot.corr(method="pearson").to_numpy()
+        report["heatmap"] = figures.correlation_heatmap(
+            mat, labels, "Inter-model Pearson correlations",
+            os.path.join(output_dir, "correlation_heatmap.png"),
+        )
+        if summary["values"]:
+            report["distribution"] = figures.correlation_distribution(
+                summary["values"], "Pairwise correlation distribution",
+                os.path.join(output_dir, "correlation_distribution.png"),
+            )
+        if reference_model:
+            report["difference_strip"] = difference_strip_plot(
+                df, reference_model,
+                os.path.join(output_dir, "difference_strip.png"), value_col,
+            )
+    import json
+
+    with open(os.path.join(output_dir, "correlation_summary.json"), "w") as f:
+        json.dump(
+            {"summary": {k: v for k, v in summary.items() if k != "values"},
+             "mean_kappa": kappa["mean_kappa"],
+             "mean_kappa_ci": kappa["mean_kappa_ci"]},
+            f, indent=2, default=float,
+        )
+    return report
+
+
+def cross_experiment_kappa(
+    frames: Sequence[pd.DataFrame],
+    value_col: str = "relative_prob",
+    threshold: float = 0.5,
+    n_bootstrap: int = 1000,
+    seed: int = 42,
+) -> Dict:
+    """Merge multiple experiment frames (same schema) into one prompts×models
+    pivot and compute aggregate kappa (calculate_cohens_kappa.py)."""
+    merged = pd.concat(list(frames), ignore_index=True)
+    pivot = pivot_model_values(merged, value_col=value_col)
+    return pairwise_kappa(pivot, threshold=threshold, n_bootstrap=n_bootstrap, seed=seed)

@@ -1,0 +1,53 @@
+"""Prompt-similarity report: validate rephrasings against originals.
+
+Rebuild of calculate_prompt_similarity.py:209-343: run the similarity engine
+over every scenario of perturbations.json and write the
+``original_vs_rephrasings_similarity.xlsx`` summary workbook.
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, List, Optional, Sequence
+
+import pandas as pd
+
+from ..stats.similarity import calculate_all_similarities
+from ..utils.xlsx import write_xlsx
+
+
+def similarity_report(
+    perturbation_records: Sequence[Dict],
+    output_dir: str,
+    max_rephrasings: Optional[int] = None,
+    embedding_model=None,
+) -> pd.DataFrame:
+    """Per-scenario similarity summary -> Excel + per-pair CSVs."""
+    os.makedirs(output_dir, exist_ok=True)
+    summary_rows: List[Dict] = []
+    for idx, record in enumerate(perturbation_records):
+        rephrasings = record["rephrasings"]
+        if max_rephrasings:
+            rephrasings = rephrasings[:max_rephrasings]
+        if not rephrasings:
+            continue
+        result = calculate_all_similarities(
+            record["original_main"], rephrasings, embedding_model=embedding_model
+        )
+        pd.DataFrame(result["original_vs_rephrasings"]).to_csv(
+            os.path.join(output_dir, f"scenario_{idx + 1}_original_vs_rephrasings.csv"),
+            index=False,
+        )
+        for metric, stats in result["summary_stats"].items():
+            summary_rows.append(
+                {
+                    "scenario": idx + 1,
+                    "metric": metric,
+                    "n_rephrasings": len(rephrasings),
+                    **{f"orig_{k}": v for k, v in stats["original_vs_rephrasings"].items()},
+                    **{f"pair_{k}": v for k, v in stats["pairwise_rephrasings"].items()},
+                }
+            )
+    summary = pd.DataFrame(summary_rows)
+    write_xlsx(summary, os.path.join(output_dir, "original_vs_rephrasings_similarity.xlsx"))
+    return summary

@@ -64,7 +64,9 @@ class ResponseCache:
         missing = []
         for name in evaluators:
             fields = REQUIRED_FIELDS.get(name, [])
-            if any(f not in record or record[f] is None for f in fields):
+            # key presence marks the evaluator as run: None is a legitimate
+            # value (e.g. Gemini weighted confidence with no digit tokens)
+            if any(f not in record for f in fields):
                 missing.append(name)
         return missing
 

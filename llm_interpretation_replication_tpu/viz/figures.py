@@ -1,0 +1,220 @@
+"""Figure writers (reference L5 presentation layer).
+
+Covers the reference's figure families (all Agg/matplotlib, saved as PNG):
+- probability / confidence histograms (analyze_perturbation_results.py:623-722)
+- QQ plots vs a fitted normal with 95% point bands (:499-622)
+- clipped-normal model overlay (:340-498)
+- combined per-scenario jitter-strip panels (:912-1094, the paper's Fig. 5/6)
+- MAE heatmap and per-question error strips (evaluate_closed_source_models.py:
+  1376-1586)
+- violin plots for irrelevant-perturbation consistency
+  (evaluate_irrelevant_perturbations.py:503-941)
+- correlation heatmap + distribution histogram (model_comparison_graph.py:389-494)
+"""
+
+from __future__ import annotations
+
+import os
+from typing import Dict, Optional, Sequence
+
+import matplotlib
+
+matplotlib.use("Agg")
+import matplotlib.pyplot as plt  # noqa: E402
+import numpy as np  # noqa: E402
+from scipy import stats as scipy_stats  # noqa: E402
+
+
+def _save(fig, output_path: str) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    fig.savefig(output_path, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+    return output_path
+
+
+def probability_histogram(values, title: str, output_path: str, bins: int = 50,
+                          xlabel: str = "Relative probability") -> Optional[str]:
+    values = np.asarray(values, float)
+    values = values[np.isfinite(values)]
+    if values.size == 0:
+        return None
+    fig, ax = plt.subplots(figsize=(10, 6))
+    ax.hist(values, bins=bins, range=(0, 1), edgecolor="black", alpha=0.75)
+    ax.set_xlabel(xlabel)
+    ax.set_ylabel("Count")
+    ax.set_title(title)
+    ax.set_xlim(0, 1)
+    return _save(fig, output_path)
+
+
+def qq_plot(values, title: str, output_path: str) -> Optional[str]:
+    """QQ plot vs fitted normal + histogram-with-fit side panel."""
+    values = np.asarray(values, float)
+    values = values[np.isfinite(values)]
+    if values.size < 2:
+        return None
+    mu, sigma = scipy_stats.norm.fit(values)
+    n = values.size
+    ordered = np.sort(values)
+    positions = (np.arange(1, n + 1) - 0.5) / n
+    theoretical = scipy_stats.norm.ppf(positions)
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(16, 8))
+    ax1.scatter(theoretical, ordered, s=12, alpha=0.6)
+    if np.var(ordered) > 0:
+        slope, intercept = np.polyfit(theoretical, ordered, 1)
+    else:
+        slope, intercept = 0.0, ordered[0]
+    xs = np.array([theoretical.min(), theoretical.max()])
+    ax1.plot(xs, slope * xs + intercept, "r--", label="best fit")
+    # pointwise 95% band via order-statistic std approximation
+    band = 1.96 * sigma * np.sqrt(positions * (1 - positions) / n) / np.maximum(
+        scipy_stats.norm.pdf(theoretical), 1e-6
+    )
+    ax1.fill_between(theoretical, slope * theoretical + intercept - band,
+                     slope * theoretical + intercept + band, alpha=0.15)
+    ax1.set_xlabel("Theoretical quantiles")
+    ax1.set_ylabel("Ordered values")
+    ax1.set_title(f"QQ plot — {title}")
+    ax1.legend()
+    ax2.hist(values, bins=40, density=True, alpha=0.6, edgecolor="black")
+    grid = np.linspace(values.min() - 0.05, values.max() + 0.05, 200)
+    ax2.plot(grid, scipy_stats.norm.pdf(grid, mu, sigma), "r-",
+             label=f"N({mu:.3f}, {sigma:.3f})")
+    ax2.set_title("Histogram with fitted normal")
+    ax2.legend()
+    return _save(fig, output_path)
+
+
+def truncated_model_plot(values, simulated, title: str, output_path: str,
+                         ks_statistic: Optional[float] = None) -> Optional[str]:
+    values = np.asarray(values, float)
+    values = values[np.isfinite(values)]
+    simulated = np.asarray(simulated, float)
+    if values.size == 0 or simulated.size == 0:
+        return None
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(16, 6))
+    bins = np.linspace(0, 1, 41)
+    ax1.hist(values, bins=bins, density=True, alpha=0.55, label="observed",
+             edgecolor="black")
+    ax1.hist(simulated, bins=bins, density=True, alpha=0.4, label="clipped-normal model")
+    ax1.set_title(title + (f" (KS={ks_statistic:.3f})" if ks_statistic is not None else ""))
+    ax1.legend()
+    # empirical CDFs
+    for arr, label in ((values, "observed"), (simulated, "model")):
+        xs = np.sort(arr)
+        ax2.plot(xs, np.arange(1, xs.size + 1) / xs.size, label=label)
+    ax2.set_title("Empirical CDFs")
+    ax2.legend()
+    return _save(fig, output_path)
+
+
+def jitter_strip_panels(
+    per_scenario_values: Dict[str, Sequence[float]],
+    title: str,
+    output_path: str,
+    ylabel: str = "Relative probability",
+    ylim=(0, 1),
+    seed: int = 42,
+) -> str:
+    """One jittered strip per scenario with mean ± 95% CI markers (the
+    Figure 5/6 style)."""
+    rng = np.random.default_rng(seed)
+    names = list(per_scenario_values)
+    fig, ax = plt.subplots(figsize=(max(8, 2.2 * len(names)), 6))
+    for i, name in enumerate(names):
+        vals = np.asarray(per_scenario_values[name], float)
+        vals = vals[np.isfinite(vals)]
+        if vals.size == 0:
+            continue
+        x = i + rng.uniform(-0.18, 0.18, vals.size)
+        ax.scatter(x, vals, s=6, alpha=0.25)
+        mean = vals.mean()
+        lo, hi = np.percentile(vals, [2.5, 97.5])
+        ax.errorbar([i], [mean], yerr=[[mean - lo], [hi - mean]], fmt="o",
+                    color="black", capsize=5, markersize=7, zorder=5)
+    ax.set_xticks(range(len(names)))
+    ax.set_xticklabels(names, rotation=20, ha="right")
+    ax.set_ylabel(ylabel)
+    if ylim:
+        ax.set_ylim(*ylim)
+    ax.set_title(title)
+    return _save(fig, output_path)
+
+
+def mae_heatmap(mae_matrix, row_labels: Sequence[str], col_labels: Sequence[str],
+                title: str, output_path: str) -> str:
+    mat = np.asarray(mae_matrix, float)
+    fig, ax = plt.subplots(figsize=(max(8, 0.3 * len(col_labels)), max(4, 0.5 * len(row_labels))))
+    im = ax.imshow(mat, aspect="auto", cmap="RdYlGn_r")
+    ax.set_xticks(range(len(col_labels)))
+    ax.set_xticklabels(col_labels, rotation=90, fontsize=6)
+    ax.set_yticks(range(len(row_labels)))
+    ax.set_yticklabels(row_labels)
+    fig.colorbar(im, ax=ax, label="Absolute error")
+    ax.set_title(title)
+    return _save(fig, output_path)
+
+
+def per_question_error_strip(errors_by_model: Dict[str, Sequence[float]],
+                             title: str, output_path: str) -> str:
+    names = list(errors_by_model)
+    fig, ax = plt.subplots(figsize=(10, 6))
+    rng = np.random.default_rng(42)
+    for i, name in enumerate(names):
+        vals = np.asarray(errors_by_model[name], float)
+        vals = vals[np.isfinite(vals)]
+        x = i + rng.uniform(-0.15, 0.15, vals.size)
+        ax.scatter(x, vals, s=10, alpha=0.5)
+        ax.plot([i - 0.25, i + 0.25], [vals.mean()] * 2, color="black", lw=2)
+    ax.set_xticks(range(len(names)))
+    ax.set_xticklabels(names, rotation=20, ha="right")
+    ax.set_ylabel("Absolute error vs human mean")
+    ax.set_title(title)
+    return _save(fig, output_path)
+
+
+def violin_by_group(values_by_group: Dict[str, Sequence[float]], title: str,
+                    output_path: str, ylabel: str = "Confidence") -> Optional[str]:
+    names = [k for k, v in values_by_group.items() if len(v)]
+    data = [np.asarray(values_by_group[k], float) for k in names]
+    data = [d[np.isfinite(d)] for d in data]
+    if not data:
+        return None
+    fig, ax = plt.subplots(figsize=(max(8, 1.6 * len(names)), 6))
+    ax.violinplot(data, showmeans=True, showextrema=True)
+    ax.set_xticks(range(1, len(names) + 1))
+    ax.set_xticklabels(names, rotation=20, ha="right")
+    ax.set_ylabel(ylabel)
+    ax.set_title(title)
+    return _save(fig, output_path)
+
+
+def correlation_heatmap(corr_matrix, labels: Sequence[str], title: str,
+                        output_path: str) -> str:
+    mat = np.asarray(corr_matrix, float)
+    fig, ax = plt.subplots(figsize=(1 + 0.7 * len(labels), 1 + 0.6 * len(labels)))
+    im = ax.imshow(mat, vmin=-1, vmax=1, cmap="coolwarm")
+    ax.set_xticks(range(len(labels)))
+    ax.set_xticklabels(labels, rotation=90, fontsize=7)
+    ax.set_yticks(range(len(labels)))
+    ax.set_yticklabels(labels, fontsize=7)
+    for i in range(len(labels)):
+        for j in range(len(labels)):
+            if np.isfinite(mat[i, j]):
+                ax.text(j, i, f"{mat[i, j]:.2f}", ha="center", va="center", fontsize=6)
+    fig.colorbar(im, ax=ax)
+    ax.set_title(title)
+    return _save(fig, output_path)
+
+
+def correlation_distribution(correlations, title: str, output_path: str) -> str:
+    vals = np.asarray(correlations, float)
+    fig, ax = plt.subplots(figsize=(8, 5))
+    ax.hist(vals, bins=20, edgecolor="black", alpha=0.75)
+    ax.axvline(vals.mean(), color="red", linestyle="--",
+               label=f"mean = {vals.mean():.3f}")
+    ax.set_xlabel("Pairwise Pearson correlation")
+    ax.set_ylabel("Count")
+    ax.set_title(title)
+    ax.legend()
+    return _save(fig, output_path)

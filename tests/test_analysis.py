@@ -1,0 +1,289 @@
+"""Orchestrator + presentation-layer tests with synthetic sweep data."""
+
+import math
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from llm_interpretation_replication_tpu.analysis import (
+    ModelConfidenceAnalyzer,
+    analyze_model,
+    analyze_workbook,
+    base_vs_instruct_figures,
+    compare_with_human_data,
+    consistency_statistics,
+    cross_experiment_kappa,
+    evaluate_all_models,
+    model_comparison_report,
+    process_scenario_perturbations,
+    run_combined_analysis,
+    similarity_report,
+    write_outputs,
+    write_report,
+    calculate_correlations,
+)
+from llm_interpretation_replication_tpu.api_backends import (
+    AnthropicClient,
+    FakeTransport,
+    GeminiClient,
+    OpenAIClient,
+    ResponseCache,
+)
+from llm_interpretation_replication_tpu.api_backends.transport import TransportError
+from llm_interpretation_replication_tpu.utils.retry import RetryPolicy
+
+
+def _scenarios(n=2):
+    return [
+        {
+            "original_main": f"Scenario {i} main text. Second sentence here.",
+            "scenario_name": f"Scenario {i}",
+            "response_format": "Answer only 'Covered' or 'Not Covered'.",
+            "target_tokens": ["Covered", "Not"],
+            "confidence_format": "How confident are you, 0-100?",
+        }
+        for i in range(1, n + 1)
+    ]
+
+
+def _workbook(rng, scenarios, model="gpt-test", rows_per_scenario=80):
+    records = []
+    for s in scenarios:
+        center = rng.uniform(0.2, 0.8)
+        for j in range(rows_per_scenario):
+            t1 = float(np.clip(rng.normal(center, 0.15), 0.001, 0.999))
+            records.append(
+                {
+                    "Model": model,
+                    "Original Main Part": s["original_main"],
+                    "Response Format": s["response_format"],
+                    "Confidence Format": s["confidence_format"],
+                    "Rephrased Main Part": f"{s['original_main']} v{j}",
+                    "Full Rephrased Prompt": "x",
+                    "Full Confidence Prompt": "y",
+                    "Model Response": "Covered" if t1 > 0.5 else "Not Covered",
+                    "Model Confidence Response": str(int(100 * t1)),
+                    "Log Probabilities": "",
+                    "Token_1_Prob": t1,
+                    "Token_2_Prob": 1 - t1,
+                    "Odds_Ratio": t1 / (1 - t1),
+                    "Confidence Value": int(100 * t1),
+                    "Weighted Confidence": 100 * t1,
+                }
+            )
+    return pd.DataFrame(records)
+
+
+class TestPerturbationReport:
+    def test_analyze_model_full_report(self, tmp_path):
+        rng = np.random.default_rng(0)
+        scenarios = _scenarios(2)
+        df = _workbook(rng, scenarios)
+        report = analyze_model(
+            df, "gpt-test", scenarios, str(tmp_path), n_simulations=20_000
+        )
+        assert len(report["scenarios"]) == 2
+        rec = report["scenarios"][0]
+        assert rec["n"] == 80
+        assert "summary" in rec and 0 <= rec["summary"]["mean"] <= 1
+        assert "ks_stat" in rec["normality"]
+        assert rec["truncated_normal"]["fit"] == "ok"
+        assert report["scenario_pair_kappa"]
+        assert len(report["compliance"]) == 2
+        assert os.path.exists(tmp_path / "tables.tex")
+        assert os.path.exists(tmp_path / "scenario_1_prob_hist.png")
+        assert os.path.exists(tmp_path / "combined_probability.png")
+
+    def test_analyze_workbook_splits_models(self, tmp_path):
+        rng = np.random.default_rng(1)
+        scenarios = _scenarios(1)
+        df = pd.concat(
+            [_workbook(rng, scenarios, model=m, rows_per_scenario=30) for m in ("a", "b")],
+            ignore_index=True,
+        )
+        out = analyze_workbook(df, scenarios, str(tmp_path),
+                               n_simulations=5_000, make_figures=False)
+        assert set(out) == {"a", "b"}
+
+
+def fast_retry():
+    return RetryPolicy(retry_on=(TransportError,), max_retries=2,
+                       initial_delay=0.0, sleep=lambda s: None)
+
+
+class TestClosedSourceEval:
+    def _clients(self):
+        ft = FakeTransport()
+        top = [{"token": "Yes", "logprob": math.log(0.8)},
+               {"token": "No", "logprob": math.log(0.1)}]
+        ft.add("POST", "/chat/completions", lambda c: (200, {
+            "choices": [{"message": {"content": "Yes"},
+                         "logprobs": {"content": [{"token": "Yes", "top_logprobs": top}]}}],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 1},
+        }))
+        gt = FakeTransport()
+        gt.add("POST", ":generateContent", lambda c: (200, {
+            "candidates": [{
+                "content": {"parts": [{"text": "80"}]},
+                "logprobsResult": {"topCandidates": [
+                    {"candidates": [{"token": "Yes", "logProbability": math.log(0.7)},
+                                    {"token": "No", "logProbability": math.log(0.2)}]},
+                ]},
+            }]
+        }))
+        at = FakeTransport()
+        at.add("POST", "/messages", lambda c: (200, {
+            "content": [{"type": "text", "text": "75"}]
+        }))
+        return (
+            OpenAIClient("k", transport=ft, retry_policy=fast_retry()),
+            GeminiClient("k", transport=gt, retry_policy=fast_retry()),
+            AnthropicClient("k", transport=at, retry_policy=fast_retry()),
+        )
+
+    def test_full_loop_with_cache_and_report(self, tmp_path):
+        gpt, gem, claude = self._clients()
+        cache = ResponseCache(str(tmp_path / "cache.json"))
+        questions = [f'Is a "thing{i}" a "stuff{i}"?' for i in range(6)]
+        df = evaluate_all_models(
+            questions, gpt_client=gpt, gemini_client=gem, claude_client=claude,
+            cache=cache, rng=np.random.default_rng(42),
+        )
+        assert len(df) == 6
+        assert df["gpt_relative_prob"].iloc[0] == pytest.approx(0.8 / 0.9)
+        assert cache.is_complete(questions[0])
+        # second run hits the cache only: no new transport calls for evaluators
+        gpt2, gem2, claude2 = self._clients()
+        df2 = evaluate_all_models(
+            questions, gpt_client=gpt2, gemini_client=gem2, claude_client=claude2,
+            cache=cache, rng=np.random.default_rng(42),
+        )
+        assert len(gpt2.transport.calls) == 0
+
+        human_means = {q: 0.6 for q in questions}
+        comparisons = compare_with_human_data(df, human_means, human_std=0.167,
+                                              n_bootstrap=500, seed=42)
+        assert set(comparisons["mae"]) >= {"GPT", "Claude", "Gemini", "Equanimity", "Random", "Normal"}
+        corr = calculate_correlations(df)
+        paths = write_report(df, comparisons, corr, str(tmp_path / "out"))
+        assert os.path.exists(paths["csv"])
+        assert os.path.exists(paths["latex"])
+        assert os.path.exists(paths["error_strip"])
+
+
+class TestIrrelevantEval:
+    def test_process_and_stats(self, tmp_path):
+        from llm_interpretation_replication_tpu.gen.irrelevant import generate_perturbations
+
+        scenarios = generate_perturbations(
+            [dict(s, main=s["original_main"], name=s["scenario_name"]) for s in _scenarios(2)],
+            [f"Fact {i}." for i in range(3)],
+        )
+        calls = {"n": 0}
+
+        def evaluator(prompt):
+            calls["n"] += 1
+            return f"Thinking...\n***\n{40 + calls['n'] % 20}\n***"
+
+        df = process_scenario_perturbations(
+            {"model-x": evaluator}, scenarios, str(tmp_path),
+        )
+        n_pert = sum(len(s["perturbations_with_irrelevant"]) for s in scenarios)
+        assert len(df) == n_pert + len(scenarios)  # + originals
+        assert df["confidence"].notna().all()
+        stats = consistency_statistics(df)
+        assert set(stats["model"]) == {"model-x"}
+        assert (stats["ci_width"] >= 0).all()
+        paths = write_outputs(df, stats, str(tmp_path), make_figures=True)
+        assert os.path.exists(paths["xlsx"])
+        # resume: nothing re-evaluated
+        before = calls["n"]
+        process_scenario_perturbations({"model-x": evaluator}, scenarios, str(tmp_path))
+        assert calls["n"] == before
+
+
+class TestCombinedConfidence:
+    def test_combiner_and_figure(self, tmp_path):
+        rng = np.random.default_rng(2)
+        scenarios = _scenarios(2)
+        frames = {
+            m: _workbook(rng, scenarios, model=m, rows_per_scenario=40)
+            for m in ("GPT-4.1", "Claude", "Gemini")
+        }
+        out = run_combined_analysis(frames, str(tmp_path))
+        assert len(out["stats"]) == 6  # 2 scenarios x 3 models
+        assert len(out["correlations"]) == 3
+        assert os.path.exists(out["figure"])
+        analyzer = ModelConfidenceAnalyzer(frames)
+        assert set(analyzer.models) == set(frames)
+
+
+class TestModelComparison:
+    def _frame(self, rng):
+        rows = []
+        for i in range(40):
+            base = rng.uniform(0, 1)
+            for model, noise in (("org/a-7b", 0.02), ("org/b-7b", 0.02), ("org/c-7b", 1.0)):
+                v = rng.uniform(0, 1) if noise > 0.5 else np.clip(base + rng.normal(0, noise), 0, 1)
+                rows.append({"prompt": f"q{i}", "model": model, "relative_prob": float(v)})
+        return pd.DataFrame(rows)
+
+    def test_report(self, tmp_path):
+        rng = np.random.default_rng(3)
+        report = model_comparison_report(
+            self._frame(rng), str(tmp_path), n_bootstrap=100,
+            reference_model="org/c-7b",
+        )
+        assert len(report["pairwise"]) == 3
+        ab = report["pairwise"][
+            (report["pairwise"].model_1 == "org/a-7b")
+            & (report["pairwise"].model_2 == "org/b-7b")
+        ].iloc[0]
+        assert ab["pearson_r"] > 0.9
+        assert os.path.exists(report["heatmap"])
+        assert os.path.exists(report["difference_strip"])
+
+    def test_cross_experiment_kappa(self):
+        rng = np.random.default_rng(4)
+        k = cross_experiment_kappa([self._frame(rng), self._frame(rng)], n_bootstrap=50)
+        assert len(k["pairs"]) == 3
+
+
+class TestBaseVsInstructFigs:
+    def test_figures_written(self, tmp_path):
+        rng = np.random.default_rng(5)
+        rows = []
+        for fam, (b, i) in {"falcon": ("org/falcon-7b", "org/falcon-7b-instruct"),
+                            "bloom": ("org/bloom-7b", "org/bloomz-7b")}.items():
+            for q in range(20):
+                for model, role in ((b, "base"), (i, "instruct")):
+                    rows.append({
+                        "prompt": f"q{q}", "model": model, "model_family": fam,
+                        "base_or_instruct": role,
+                        "yes_prob": rng.uniform(0.1, 0.9),
+                        "no_prob": rng.uniform(0.1, 0.9),
+                        "relative_prob": rng.uniform(0, 1),
+                    })
+        paths = base_vs_instruct_figures(pd.DataFrame(rows), str(tmp_path))
+        assert os.path.exists(paths["difference_strips"])
+        assert os.path.exists(paths["heatmap"])
+
+
+class TestSimilarityReport:
+    def test_report_workbook(self, tmp_path):
+        records = [{
+            "original_main": "Is a screenshot a photograph?",
+            "rephrasings": [
+                "Would a screenshot count as a photograph?",
+                "Can a screenshot be considered a photograph?",
+                "Do bananas grow on trees in cold climates?",
+            ],
+        }]
+        summary = similarity_report(records, str(tmp_path))
+        assert set(summary["metric"]) == {
+            "tfidf_cosine_similarity", "bm25_similarity", "levenshtein_similarity",
+        }
+        assert os.path.exists(tmp_path / "original_vs_rephrasings_similarity.xlsx")
+        assert os.path.exists(tmp_path / "scenario_1_original_vs_rephrasings.csv")
