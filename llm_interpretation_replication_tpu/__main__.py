@@ -1,0 +1,216 @@
+"""Command-line entry points.
+
+One typed CLI replaces the reference's 43 standalone scripts::
+
+    python -m llm_interpretation_replication_tpu run-100q --checkpoint-dir ...
+    python -m llm_interpretation_replication_tpu run-instruct-sweep ...
+    python -m llm_interpretation_replication_tpu run-perturbation --model ... --perturbations data/perturbations.json
+    python -m llm_interpretation_replication_tpu generate-irrelevant --output data/perturbations_irrelevant.json
+    python -m llm_interpretation_replication_tpu analyze-perturbations --workbook results.xlsx --output-dir out/
+    python -m llm_interpretation_replication_tpu similarity --perturbations data/perturbations.json --output-dir out/
+    python -m llm_interpretation_replication_tpu analyze-100q --results results/base_vs_instruct_100q_results.csv
+
+Local-model commands build a mesh from RunConfig (device/mesh flags) and load
+HF snapshots from a local checkpoint dir (zero-egress: no hub downloads).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def _add_run_config_args(p: argparse.ArgumentParser):
+    p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
+    p.add_argument("--dtype", default="bfloat16")
+    p.add_argument("--mesh-model", type=int, default=1)
+    p.add_argument("--mesh-seq", type=int, default=1)
+    p.add_argument("--batch-size", type=int, default=16)
+    p.add_argument("--checkpoint-dir", default="checkpoints")
+    p.add_argument("--output-dir", default="results")
+
+
+def _run_config(args):
+    from .config import RunConfig
+
+    return RunConfig(
+        device=args.device, dtype=args.dtype, mesh_model=args.mesh_model,
+        mesh_seq=args.mesh_seq, batch_size=args.batch_size,
+        checkpoint_dir=args.checkpoint_dir, output_dir=args.output_dir,
+    )
+
+
+def _engine_factory(run_config):
+    """model name -> ScoringEngine over local snapshots."""
+    import jax
+
+    from .runtime import EngineConfig, ScoringEngine, load_model, load_tokenizer
+
+    if run_config.device == "cpu":
+        jax.config.update("jax_platforms", "cpu")
+    mesh = run_config.make_mesh() if (run_config.mesh_model > 1 or run_config.mesh_seq > 1) else None
+
+    def factory(model_name: str) -> ScoringEngine:
+        path = run_config.snapshot_path(model_name)
+        family, cfg, params = load_model(path, dtype=run_config.resolve_dtype(), mesh=mesh)
+        tokenizer = load_tokenizer(path)
+        return ScoringEngine(
+            family, cfg, params, tokenizer, mesh=mesh,
+            engine_config=EngineConfig(batch_size=run_config.batch_size),
+        )
+
+    return factory
+
+
+def cmd_run_100q(args):
+    import os
+
+    from .sweeps import run_sweep
+
+    rc = _run_config(args)
+    df = run_sweep(
+        _engine_factory(rc),
+        checkpoint_path=os.path.join(rc.output_dir, "base_vs_instruct_100q_checkpoint.json"),
+        results_csv=os.path.join(rc.output_dir, "base_vs_instruct_100q_results.csv"),
+    )
+    print(f"{len(df)} rows")
+
+
+def cmd_run_instruct_sweep(args):
+    import os
+
+    from .config import ordinary_meaning_questions
+    from .sweeps import run_instruct_sweep
+
+    rc = _run_config(args)
+    df = run_instruct_sweep(
+        _engine_factory(rc),
+        prompts=ordinary_meaning_questions(),
+        checkpoint_path=os.path.join(rc.output_dir, "instruct_sweep_checkpoint.json"),
+        results_csv=os.path.join(rc.output_dir, "instruct_model_comparison_results.csv"),
+    )
+    print(f"{len(df)} rows")
+
+
+def cmd_run_perturbation(args):
+    import os
+
+    from .config import legal_scenarios
+    from .gen.rephrase import load_perturbations
+    from .sweeps import run_model_perturbation_sweep
+
+    rc = _run_config(args)
+    scenarios = load_perturbations(args.perturbations, expected_scenarios=legal_scenarios())
+    engine = _engine_factory(rc)(args.model)
+    df = run_model_perturbation_sweep(
+        engine, args.model, scenarios,
+        output_xlsx=os.path.join(rc.output_dir, "perturbation_results.xlsx"),
+        max_rephrasings=args.max_rephrasings,
+    )
+    print(f"{len(df)} rows")
+
+
+def cmd_generate_irrelevant(args):
+    from .config import irrelevant_scenarios, irrelevant_statements
+    from .gen.irrelevant import generate_perturbations, save_perturbations
+
+    perturbed = generate_perturbations(irrelevant_scenarios(), irrelevant_statements())
+    save_perturbations(perturbed, args.output)
+    total = sum(len(s["perturbations_with_irrelevant"]) for s in perturbed)
+    print(f"{total} perturbations -> {args.output}")
+
+
+def cmd_analyze_perturbations(args):
+    from .analysis import analyze_workbook
+    from .config import legal_scenarios
+    from .utils.xlsx import read_xlsx
+
+    df = read_xlsx(args.workbook)
+    out = analyze_workbook(df, legal_scenarios(), args.output_dir,
+                           n_simulations=args.simulations)
+    print(json.dumps({m: len(r["scenarios"]) for m, r in out.items()}, indent=2))
+
+
+def cmd_similarity(args):
+    from .config import legal_scenarios
+    from .gen.rephrase import load_perturbations
+    from .analysis import similarity_report
+
+    records = load_perturbations(args.perturbations, expected_scenarios=legal_scenarios())
+    summary = similarity_report(records, args.output_dir,
+                                max_rephrasings=args.max_rephrasings)
+    print(summary.to_string(index=False))
+
+
+def cmd_analyze_100q(args):
+    import pandas as pd
+
+    from .stats.bootstrap import base_vs_instruct_analysis
+    from .viz.latex import base_vs_instruct_table
+
+    df = pd.read_csv(args.results)
+    out = base_vs_instruct_analysis(df)
+    print(json.dumps(out, indent=2, default=float))
+    if args.latex:
+        families = {
+            fam: {
+                "base_model": "", "instruct_model": "", "excluded": rec.get("skipped", False),
+                "base_mae": rec.get("mae", float("nan")),
+                "instruct_mae": rec.get("mae", float("nan")),
+                "observed_diff": rec.get("mean_diff", float("nan")),
+                "ci_lower": rec.get("ci_lower", float("nan")),
+                "ci_upper": rec.get("ci_upper", float("nan")),
+                "p_value": rec.get("p_value", float("nan")),
+            }
+            for fam, rec in out.items()
+        }
+        print(base_vs_instruct_table(families))
+
+
+def main(argv=None):
+    parser = argparse.ArgumentParser(prog="llm_interpretation_replication_tpu")
+    sub = parser.add_subparsers(dest="command", required=True)
+
+    p = sub.add_parser("run-100q", help="base-vs-instruct 100-question sweep")
+    _add_run_config_args(p)
+    p.set_defaults(fn=cmd_run_100q)
+
+    p = sub.add_parser("run-instruct-sweep", help="instruct-model roster sweep")
+    _add_run_config_args(p)
+    p.set_defaults(fn=cmd_run_instruct_sweep)
+
+    p = sub.add_parser("run-perturbation", help="10k-perturbation local-model sweep")
+    _add_run_config_args(p)
+    p.add_argument("--model", required=True)
+    p.add_argument("--perturbations", required=True)
+    p.add_argument("--max-rephrasings", type=int, default=None)
+    p.set_defaults(fn=cmd_run_perturbation)
+
+    p = sub.add_parser("generate-irrelevant", help="build perturbations_irrelevant.json")
+    p.add_argument("--output", default="data/perturbations_irrelevant.json")
+    p.set_defaults(fn=cmd_generate_irrelevant)
+
+    p = sub.add_parser("analyze-perturbations", help="statistics over a sweep workbook")
+    p.add_argument("--workbook", required=True)
+    p.add_argument("--output-dir", default="results/perturbation_analysis")
+    p.add_argument("--simulations", type=int, default=100_000)
+    p.set_defaults(fn=cmd_analyze_perturbations)
+
+    p = sub.add_parser("similarity", help="rephrasing similarity validation")
+    p.add_argument("--perturbations", required=True)
+    p.add_argument("--output-dir", default="results/prompt_similarity")
+    p.add_argument("--max-rephrasings", type=int, default=None)
+    p.set_defaults(fn=cmd_similarity)
+
+    p = sub.add_parser("analyze-100q", help="instruct-base bootstrap over 100q results")
+    p.add_argument("--results", required=True)
+    p.add_argument("--latex", action="store_true")
+    p.set_defaults(fn=cmd_analyze_100q)
+
+    args = parser.parse_args(argv)
+    args.fn(args)
+
+
+if __name__ == "__main__":
+    main()

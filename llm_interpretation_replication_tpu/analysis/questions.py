@@ -1,0 +1,60 @@
+"""Question-list loaders for the ordinary-meaning evaluation.
+
+Rebuilds evaluate_closed_source_models.py:51-81 (first 50 prompts of the
+instruct CSV + 50 parsed out of the survey-2 Qualtrics headers) and
+extract_survey2_questions.py (header extraction incl. attention-check skip).
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Tuple
+
+import pandas as pd
+
+
+def extract_survey2_questions(survey_csv: str) -> Tuple[List[str], Dict[str, str]]:
+    """Unique questions (and their columns) from a Qualtrics header row,
+    skipping the *_8 attention checks."""
+    df = pd.read_csv(survey_csv)
+    headers = df.iloc[0]
+    questions: List[str] = []
+    question_to_col: Dict[str, str] = {}
+    for col in df.columns:
+        if col.startswith("Q") and "_" in col and not col.endswith("_8"):
+            text = headers[col]
+            if pd.notna(text) and isinstance(text, str) and " - " in text:
+                question = text.split(" - ")[-1].strip()
+                if question not in questions:
+                    questions.append(question)
+                    question_to_col[question] = col
+    return questions, question_to_col
+
+
+def load_ordinary_meaning_questions(
+    instruct_csv: str,
+    survey2_csv: str,
+    n_part1: int = 50,
+    n_part2: int = 50,
+) -> List[str]:
+    """First ``n_part1`` unique prompts of the instruct comparison CSV + the
+    first ``n_part2`` questions parsed from the survey-2 headers (the
+    reference's marker filter: columns containing 'Left = No, Right = Yes')."""
+    df1 = pd.read_csv(instruct_csv)
+    questions: List[str] = list(df1["prompt"].unique()[:n_part1])
+    survey2 = pd.read_csv(survey2_csv, skiprows=1)
+    part2: List[str] = []
+    for col in survey2.columns:
+        if "Left = No, Right = Yes" in col:
+            parts = col.split(" - ")
+            if len(parts) >= 2:
+                q = parts[-1].strip()
+                if q.endswith("?") and q not in part2:
+                    part2.append(q)
+    questions.extend(part2[:n_part2])
+    return questions
+
+
+def write_question_list(questions: List[str], path: str) -> None:
+    with open(path, "w") as f:
+        for q in questions:
+            f.write(q + "\n")

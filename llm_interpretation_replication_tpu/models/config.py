@@ -57,6 +57,9 @@ class DecoderConfig:
     tie_word_embeddings: bool = False
     final_norm: bool = True
     logit_scale: float = 1.0
+    # "xla" (fused by the compiler) | "flash" (Pallas TPU kernel; causal +
+    # right-padding only — rejected for ALiBi / sliding-window configs)
+    attention_impl: str = "xla"
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -65,6 +68,13 @@ class DecoderConfig:
             object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
         if self.intermediate_size is None:
             object.__setattr__(self, "intermediate_size", 4 * self.hidden_size)
+        if self.attention_impl == "flash" and (
+            self.position_embedding == "alibi" or self.sliding_window is not None
+        ):
+            raise ValueError(
+                "flash attention kernel supports causal+padding only "
+                "(no ALiBi / sliding window)"
+            )
 
 
 @dataclasses.dataclass(frozen=True)

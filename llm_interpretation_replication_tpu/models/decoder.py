@@ -188,9 +188,12 @@ def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=jnp.bfloat16)
 # Block + full forward
 # ---------------------------------------------------------------------------
 
-def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None):
+def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None,
+          flash_lengths=None):
     """One attention sub-block.  When ``cache_kv`` is given, new K/V are written
-    at ``cache_index`` and attention runs over the whole cache."""
+    at ``cache_index`` and attention runs over the whole cache.  When
+    ``flash_lengths`` is given (no-cache path only), the Pallas flash kernel
+    replaces the dense bias-based attention."""
     b, s, h = x.shape
     n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     ap = lp["attn"]
@@ -217,7 +220,17 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
         new_cache = None
     k = _repeat_kv(k, n // nkv)
     v = _repeat_kv(v, n // nkv)
-    out = dot_product_attention(q, k, v, bias)
+    if flash_lengths is not None and cache_kv is None:
+        from ..ops.attention import attention as fused_attention
+
+        # dispatcher: Pallas kernel on TPU, equivalent dense path elsewhere
+        out = fused_attention(
+            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
+            flash_lengths, causal=True,
+        )
+        out = jnp.swapaxes(out, 1, 2)
+    else:
+        out = dot_product_attention(q, k, v, bias)
     out = out.reshape(b, s, n * d) @ ap["wo"]
     if "bo" in ap:
         out = out + ap["bo"]
@@ -243,9 +256,11 @@ def _mlp(cfg: DecoderConfig, lp, x):
     return out
 
 
-def _block(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None):
+def _block(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None,
+           flash_lengths=None):
     ln1_out = _norm(cfg, x, lp["ln1"])
-    attn_out, new_cache = _attn(cfg, lp, ln1_out, sin_cos, bias, cache_kv, cache_index)
+    attn_out, new_cache = _attn(cfg, lp, ln1_out, sin_cos, bias, cache_kv,
+                                cache_index, flash_lengths)
     if cfg.parallel_residual:
         # NeoX/Falcon: mlp reads the same (or its own) LN of the block input.
         mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
@@ -291,10 +306,12 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
     x = _embed(cfg, params, token_ids, positions)
 
     if cache_len is None:
-        bias = make_attention_bias(cfg, positions, positions, mask)
+        use_flash = cfg.attention_impl == "flash"
+        bias = None if use_flash else make_attention_bias(cfg, positions, positions, mask)
+        flash_lengths = jnp.sum(attention_mask, axis=-1).astype(jnp.int32) if use_flash else None
 
         def body(h, lp):
-            h, _ = _block(cfg, lp, h, sin_cos, bias, None, None)
+            h, _ = _block(cfg, lp, h, sin_cos, bias, None, None, flash_lengths)
             return h, None
 
         x, _ = lax.scan(body, x, params["layers"])

@@ -133,7 +133,9 @@ def attention(q, k, v, lengths, causal: bool = True, force: Optional[str] = None
     """Dispatch: 'pallas' on TPU, dense XLA elsewhere.  ``force`` overrides."""
     backend = force
     if backend is None:
-        platform = q.devices().pop().platform if hasattr(q, "devices") else jax.default_backend()
+        # works under tracing too (committed device platform is unavailable
+        # on tracers; the default backend is what jit will compile for)
+        platform = jax.default_backend()
         backend = "pallas" if (_PALLAS_OK and platform == "tpu") else "dense"
     if backend == "pallas":
         return flash_attention(q, k, v, lengths, causal, interpret=interpret)

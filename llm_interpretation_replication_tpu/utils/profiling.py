@@ -1,0 +1,63 @@
+"""Tracing / profiling (aux subsystem the reference lacks — SURVEY.md §5).
+
+``trace`` wraps ``jax.profiler`` for TensorBoard-viewable device traces;
+``ThroughputMeter`` tracks prompts/sec and tokens/sec/chip for sweeps with
+optional heartbeat persistence.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from typing import Optional
+
+
+@contextlib.contextmanager
+def trace(log_dir: str, enabled: bool = True):
+    """Capture a jax.profiler trace into ``log_dir`` (view with TensorBoard)."""
+    if not enabled:
+        yield
+        return
+    import jax
+
+    jax.profiler.start_trace(log_dir)
+    try:
+        yield
+    finally:
+        jax.profiler.stop_trace()
+
+
+@contextlib.contextmanager
+def annotate(name: str):
+    """Named region inside a trace (shows up on the TraceViewer timeline)."""
+    import jax
+
+    with jax.profiler.TraceAnnotation(name):
+        yield
+
+
+class ThroughputMeter:
+    def __init__(self, n_chips: int = 1, clock=time.perf_counter):
+        self.n_chips = max(n_chips, 1)
+        self._clock = clock
+        self.reset()
+
+    def reset(self):
+        self._start = self._clock()
+        self.prompts = 0
+        self.tokens = 0
+
+    def add(self, prompts: int, tokens: int = 0):
+        self.prompts += prompts
+        self.tokens += tokens
+
+    def snapshot(self) -> dict:
+        elapsed = max(self._clock() - self._start, 1e-9)
+        return {
+            "elapsed_sec": round(elapsed, 3),
+            "prompts": self.prompts,
+            "prompts_per_sec": round(self.prompts / elapsed, 4),
+            "prompts_per_sec_per_chip": round(self.prompts / elapsed / self.n_chips, 4),
+            "tokens_per_sec": round(self.tokens / elapsed, 2),
+            "tokens_per_sec_per_chip": round(self.tokens / elapsed / self.n_chips, 2),
+        }

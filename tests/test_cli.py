@@ -1,0 +1,85 @@
+"""CLI smoke tests (no-model commands run end-to-end; model commands are
+covered via the engine-factory path in test_sweeps)."""
+
+import json
+import os
+
+import numpy as np
+import pandas as pd
+import pytest
+
+from llm_interpretation_replication_tpu.__main__ import main
+from llm_interpretation_replication_tpu.analysis.questions import (
+    extract_survey2_questions,
+    load_ordinary_meaning_questions,
+)
+from llm_interpretation_replication_tpu.utils.profiling import ThroughputMeter
+
+
+def test_generate_irrelevant_cli(tmp_path, capsys):
+    out = str(tmp_path / "perturbations_irrelevant.json")
+    main(["generate-irrelevant", "--output", out])
+    data = json.load(open(out))
+    assert sum(len(s["perturbations_with_irrelevant"]) for s in data) == 3400
+    assert "3400 perturbations" in capsys.readouterr().out
+
+
+def test_analyze_100q_cli(tmp_path, capsys):
+    rng = np.random.default_rng(0)
+    rows = []
+    for i in range(30):
+        rows.append({"model_family": "Fam", "base_or_instruct": "base",
+                     "prompt": f"q{i}", "relative_prob": rng.uniform(0.2, 0.4)})
+        rows.append({"model_family": "Fam", "base_or_instruct": "instruct",
+                     "prompt": f"q{i}", "relative_prob": rng.uniform(0.6, 0.8)})
+    csv = str(tmp_path / "r.csv")
+    pd.DataFrame(rows).to_csv(csv, index=False)
+    main(["analyze-100q", "--results", csv, "--latex"])
+    out = capsys.readouterr().out
+    assert "mean_diff" in out
+    assert "\\begin{tabular}" in out
+
+
+def test_similarity_cli(tmp_path, capsys):
+    from llm_interpretation_replication_tpu.config import legal_scenarios
+
+    records = [
+        {
+            "original_main": s["original_main"],
+            "response_format": s["response_format"],
+            "target_tokens": list(s["target_tokens"]),
+            "confidence_format": s["confidence_format"],
+            "rephrasings": [s["original_main"][:60] + " rephrased?"] * 3,
+        }
+        for s in legal_scenarios()
+    ]
+    path = str(tmp_path / "perturbations.json")
+    json.dump(records, open(path, "w"))
+    main(["similarity", "--perturbations", path,
+          "--output-dir", str(tmp_path / "sim"), "--max-rephrasings", "3"])
+    assert os.path.exists(tmp_path / "sim" / "original_vs_rephrasings_similarity.xlsx")
+
+
+REF2 = "/root/reference/data/word_meaning_survey_results_part_2.csv"
+REF_INSTRUCT = "/root/reference/data/instruct_model_comparison_results.csv"
+
+
+@pytest.mark.skipif(not os.path.exists(REF2), reason="reference not mounted")
+def test_question_loaders_on_real_data():
+    questions, mapping = extract_survey2_questions(REF2)
+    assert len(questions) >= 50
+    assert all(not c.endswith("_8") for c in mapping.values())
+    all_questions = load_ordinary_meaning_questions(REF_INSTRUCT, REF2)
+    assert len(all_questions) == 100
+    assert len(set(all_questions)) == 100
+
+
+def test_throughput_meter():
+    t = {"now": 0.0}
+    meter = ThroughputMeter(n_chips=4, clock=lambda: t["now"])
+    t["now"] = 2.0
+    meter.add(100, tokens=50_000)
+    snap = meter.snapshot()
+    assert snap["prompts_per_sec"] == 50.0
+    assert snap["prompts_per_sec_per_chip"] == 12.5
+    assert snap["tokens_per_sec_per_chip"] == 6250.0

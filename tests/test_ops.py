@@ -52,3 +52,50 @@ def test_indivisible_seq_raises():
     q = jnp.zeros((1, 1, 100, 32))
     with pytest.raises(ValueError):
         flash_attention(q, q, q, np.array([100]), block_q=64, block_k=64)
+
+
+def test_decoder_flash_config_matches_xla():
+    """attention_impl='flash' must not change decoder outputs (dense dispatch
+    on CPU; the Pallas kernel itself is parity-tested above)."""
+    import dataclasses
+
+    import torch
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    from llm_interpretation_replication_tpu.models import config as mcfg
+    from llm_interpretation_replication_tpu.models import convert as mconvert
+    from llm_interpretation_replication_tpu.models import decoder
+
+    hf_config = GPTNeoXConfig(
+        vocab_size=128, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, rotary_pct=0.25,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(9)
+    model = GPTNeoXForCausalLM(hf_config).eval()
+    fam, cfg = mcfg.from_hf_config(hf_config)
+    params = mconvert.convert(
+        fam, mconvert.getter_from_torch_state_dict(model.state_dict()), cfg,
+        dtype=jnp.float32,
+    )
+    rng = np.random.default_rng(7)
+    ids = rng.integers(3, 128, size=(2, 12)).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[1, 9:] = 0
+    base = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    flash_cfg = dataclasses.replace(cfg, attention_impl="flash")
+    flashed = decoder.forward(params, flash_cfg, jnp.asarray(ids), jnp.asarray(mask))
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(flashed)[valid], np.asarray(base)[valid], atol=2e-4, rtol=1e-4
+    )
+
+
+def test_flash_config_rejects_alibi():
+    from llm_interpretation_replication_tpu.models.config import DecoderConfig
+
+    with pytest.raises(ValueError):
+        DecoderConfig(
+            vocab_size=10, hidden_size=8, num_layers=1, num_heads=2,
+            position_embedding="alibi", attention_impl="flash",
+        )
