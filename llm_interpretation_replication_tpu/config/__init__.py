@@ -89,6 +89,9 @@ class RunConfig:
 
     device: str = "tpu"                  # 'tpu' | 'cpu'
     dtype: str = "bfloat16"              # params/compute dtype on device
+    quant: str = "none"                  # 'none' | 'int8' (w8a8, decoder-only;
+                                         # the TPU answer to the reference's
+                                         # bitsandbytes load_in_8bit)
     mesh_data: Optional[int] = None      # None = all remaining devices
     mesh_model: int = 1
     mesh_seq: int = 1

@@ -41,6 +41,7 @@ import jax.numpy as jnp
 from jax import lax
 
 from .config import DecoderConfig
+from ..ops import quant
 
 NEG_INF = -1e9  # mask value; large but finite so fp32 softmax stays NaN-free
 
@@ -197,9 +198,9 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
     b, s, h = x.shape
     n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     ap = lp["attn"]
-    q = x @ ap["wq"]
-    k = x @ ap["wk"]
-    v = x @ ap["wv"]
+    q = quant.linear(ap, "wq", x)
+    k = quant.linear(ap, "wk", x)
+    v = quant.linear(ap, "wv", x)
     if "bq" in ap:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
     q = q.reshape(b, s, n, d)
@@ -231,7 +232,7 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
         out = jnp.swapaxes(out, 1, 2)
     else:
         out = dot_product_attention(q, k, v, bias)
-    out = out.reshape(b, s, n * d) @ ap["wo"]
+    out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
     if "bo" in ap:
         out = out + ap["bo"]
     return out, new_cache
@@ -240,17 +241,17 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
 def _mlp(cfg: DecoderConfig, lp, x):
     mp = lp["mlp"]
     if cfg.mlp_type == "gated":
-        gate = x @ mp["wg"]
-        up = x @ mp["wi"]
+        gate = quant.linear(mp, "wg", x)
+        up = quant.linear(mp, "wi", x)
         if "bg" in mp:
             gate, up = gate + mp["bg"], up + mp["bi"]
         hidden = activation(cfg.activation, gate) * up
     else:
-        hidden = x @ mp["wi"]
+        hidden = quant.linear(mp, "wi", x)
         if "bi" in mp:
             hidden = hidden + mp["bi"]
         hidden = activation(cfg.activation, hidden)
-    out = hidden @ mp["wo"]
+    out = quant.linear(mp, "wo", hidden)
     if "bo" in mp:
         out = out + mp["bo"]
     return out
@@ -452,9 +453,9 @@ def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
     b, s, h = x.shape  # s == 1 during decode
     n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     ap = lp["attn"]
-    q = x @ ap["wq"]
-    k = x @ ap["wk"]
-    v = x @ ap["wv"]
+    q = quant.linear(ap, "wq", x)
+    k = quant.linear(ap, "wk", x)
+    v = quant.linear(ap, "wv", x)
     if "bq" in ap:
         q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
     q = q.reshape(b, s, n, d)
@@ -473,7 +474,7 @@ def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
     kf = _repeat_kv(ck.astype(x.dtype), n // nkv)
     vf = _repeat_kv(cv.astype(x.dtype), n // nkv)
     out = dot_product_attention(q, kf, vf, bias)
-    out = out.reshape(b, s, n * d) @ ap["wo"]
+    out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
     if "bo" in ap:
         out = out + ap["bo"]
     return out, (ck, cv)

@@ -1,0 +1,125 @@
+"""W8A8 int8 quantization for the scoring forward pass.
+
+The reference runs its 7B checkpoints through bitsandbytes ``load_in_8bit``
+(run_base_vs_instruct_100q.py:414-451, compare_instruct_models.py:436-443) —
+an int8 *memory* trick on CUDA.  On TPU the int8 story is different: the v5e
+MXU executes int8×int8→int32 at ~1.5× the bf16 rate, so quantizing both
+weights AND activations turns the compute-bound scoring sweep itself faster,
+not just smaller.  This module implements that path:
+
+- weights: symmetric per-output-channel int8 (scale = max|w| / 127 over the
+  input dim), computed once at load time;
+- activations: symmetric per-token dynamic int8 (scale from the running
+  max|x| of each token's feature vector), computed inside the jit'd forward;
+- matmul: ``lax.dot_general`` int8×int8 with ``preferred_element_type=int32``
+  so XLA lowers onto the MXU's int8 path, then one fused rescale
+  ``y * (s_x ⊗ s_w)`` back to the activation dtype.
+
+Attention scores/softmax and norms stay in bf16/fp32 — only the six large
+projection matmuls per block (QKV, out, MLP in/out ≈98% of FLOPs) quantize.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+from jax import lax
+
+# Keys eligible for quantization inside a stacked decoder layer pytree.
+_ATTN_KEYS = ("wq", "wk", "wv", "wo")
+_MLP_KEYS = ("wi", "wg", "wo")
+_QSCALE_SUFFIX = "_qscale"
+
+
+def quantize_weight(w: jnp.ndarray, *, contract_axis: int = -2):
+    """Symmetric per-output-channel int8 quantization.
+
+    ``w`` has shape ``[..., K, N]`` (possibly with a leading stacked-layer
+    axis); the contraction (input) axis is ``contract_axis`` and every other
+    trailing axis indexes output channels.  Returns ``(w_int8, scale_f32)``
+    with ``scale`` shaped like ``w`` minus the contraction axis, such that
+    ``w ≈ w_int8 * scale``.
+    """
+    absmax = jnp.max(jnp.abs(w.astype(jnp.float32)), axis=contract_axis, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(w.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=contract_axis)
+
+
+def quantize_activations(x: jnp.ndarray):
+    """Symmetric per-token dynamic int8: scale over the last (feature) axis."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, scale
+
+
+def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray):
+    """``x @ dequant(w_q)`` computed on the int8 MXU path.
+
+    x: ``[..., K]`` float; w_q: ``[K, N]`` int8; w_scale: ``[N]`` fp32.
+    Returns ``[..., N]`` in ``x.dtype``.
+    """
+    x_q, x_scale = quantize_activations(x)
+    y = lax.dot_general(
+        x_q, w_q,
+        (((x_q.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.int32,
+    )
+    return (y.astype(jnp.float32) * x_scale * w_scale).astype(x.dtype)
+
+
+def linear(p: dict, key: str, x: jnp.ndarray) -> jnp.ndarray:
+    """Dispatch a projection: int8 path when ``{key}_qscale`` is present."""
+    qs = p.get(key + _QSCALE_SUFFIX)
+    if qs is not None:
+        return int8_matmul(x, p[key], qs)
+    return x @ p[key]
+
+
+def quantize_weight_np(w, *, contract_axis: int = -2):
+    """Host-side (numpy) twin of :func:`quantize_weight` for the load path —
+    quantizes while weights are still host arrays, so the full bf16 copy never
+    touches device HBM."""
+    import numpy as np
+
+    w = np.asarray(w, np.float32)
+    absmax = np.abs(w).max(axis=contract_axis, keepdims=True)
+    scale = np.maximum(absmax, 1e-8) / 127.0
+    q = np.clip(np.round(w / scale), -127, 127).astype(np.int8)
+    return q, np.squeeze(scale, axis=contract_axis).astype(np.float32)
+
+
+def _quantize_layers(params: dict, quantize_fn) -> dict:
+    """Shared walker: replace each eligible projection weight with
+    ``(int8, {name}_qscale)`` produced by ``quantize_fn``."""
+    out = dict(params)
+    layers = dict(out["layers"])
+    for group, keys in (("attn", _ATTN_KEYS), ("mlp", _MLP_KEYS)):
+        if group not in layers:
+            continue
+        g = dict(layers[group])
+        for k in keys:
+            w = g.get(k)
+            if w is not None and getattr(w, "ndim", 0) >= 2:
+                q, s = quantize_fn(w)
+                g[k] = q
+                g[k + _QSCALE_SUFFIX] = s
+        layers[group] = g
+    out["layers"] = layers
+    return out
+
+
+def quantize_decoder_params_np(params: dict) -> dict:
+    """Host-side twin of :func:`quantize_decoder_params` (numpy in/out)."""
+    return _quantize_layers(params, quantize_weight_np)
+
+
+def quantize_decoder_params(params: dict) -> dict:
+    """Quantize a decoder param pytree's projection weights in place-of.
+
+    Stacked layer weights ``[L, K, N]`` become int8 with ``[L, N]`` scales
+    stored under ``{name}_qscale``.  Embedding, norms, biases, and the
+    (tied) unembedding stay in their original dtype — they are a rounding
+    error of the FLOPs and the logit head is accuracy-critical.
+    """
+    return _quantize_layers(params, quantize_weight)

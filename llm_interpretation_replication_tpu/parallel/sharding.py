@@ -36,6 +36,12 @@ def _decoder_param_specs() -> dict:
         "bk": P(None, MODEL_AXIS),
         "bv": P(None, MODEL_AXIS),
         "bo": P(None),
+        # w8a8 int8 scales (ops/quant.py): per-output-channel, so they shard
+        # with the weight's output dim (column-sharded) or replicate (row-).
+        "wq_qscale": P(None, MODEL_AXIS),
+        "wk_qscale": P(None, MODEL_AXIS),
+        "wv_qscale": P(None, MODEL_AXIS),
+        "wo_qscale": P(None),
     }
     mlp = {
         "wi": P(None, None, MODEL_AXIS),
@@ -44,6 +50,9 @@ def _decoder_param_specs() -> dict:
         "bg": P(None, MODEL_AXIS),
         "wo": P(None, MODEL_AXIS, None),
         "bo": P(None),
+        "wi_qscale": P(None, MODEL_AXIS),
+        "wg_qscale": P(None, MODEL_AXIS),
+        "wo_qscale": P(None),
     }
     ln = {"scale": P(None), "bias": P(None)}
     return {

@@ -3,6 +3,39 @@
 from __future__ import annotations
 
 
+def random_decoder_params(cfg, seed: int = 0):
+    """Random fp32 param pytree matching ``models.decoder``'s stacked layout
+    for a bias-free rotary decoder config (ln1/ln2, attn, mlp)."""
+    import numpy as np
+    import jax.numpy as jnp
+
+    rng = np.random.default_rng(seed)
+    h, nd = cfg.hidden_size, cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    L, F, V = cfg.num_layers, cfg.intermediate_size, cfg.vocab_size
+
+    def init(*shape):
+        return jnp.asarray(rng.standard_normal(shape) * 0.02, jnp.float32)
+
+    layers = {
+        "ln1": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+        "ln2": {"scale": jnp.ones((L, h)), "bias": jnp.zeros((L, h))},
+        "attn": {
+            "wq": init(L, h, nd), "wk": init(L, h, kvd),
+            "wv": init(L, h, kvd), "wo": init(L, nd, h),
+        },
+        "mlp": {"wi": init(L, h, F), "wo": init(L, F, h)},
+    }
+    params = {
+        "embed": {"tokens": init(V, h)},
+        "layers": layers,
+        "final_ln": {"scale": jnp.ones(h), "bias": jnp.zeros(h)},
+    }
+    if not cfg.tie_word_embeddings:
+        params["lm_head"] = init(h, V)
+    return params
+
+
 def build_test_tokenizer(vocab_size: int = 300):
     """Byte-level BPE tokenizer trained in-process (zero-egress image: no hub
     downloads).  Distinguishes " Yes" from "Yes" like real GPT-style vocabs."""

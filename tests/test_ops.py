@@ -99,3 +99,115 @@ def test_flash_config_rejects_alibi():
             vocab_size=10, hidden_size=8, num_layers=1, num_heads=2,
             position_embedding="alibi", attention_impl="flash",
         )
+
+
+# ---------------------------------------------------------------------------
+# W8A8 int8 quantization (ops/quant.py) — the TPU answer to the reference's
+# bitsandbytes load_in_8bit path (run_base_vs_instruct_100q.py:414-451).
+# ---------------------------------------------------------------------------
+
+class TestQuant:
+    def test_int8_matmul_close_to_fp(self):
+        from llm_interpretation_replication_tpu.ops import quant
+
+        rng = np.random.default_rng(0)
+        x = jnp.asarray(rng.standard_normal((4, 8, 64)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((64, 32)) * 0.05, jnp.float32)
+        q, s = quant.quantize_weight(w)
+        assert q.dtype == jnp.int8 and s.shape == (32,)
+        ref = np.asarray(x @ w)
+        got = np.asarray(quant.int8_matmul(x, q, s))
+        rel = np.abs(got - ref).max() / np.abs(ref).max()
+        assert rel < 0.02, rel
+
+    def test_quantize_weight_stacked_layers(self):
+        from llm_interpretation_replication_tpu.ops import quant
+
+        rng = np.random.default_rng(1)
+        w = jnp.asarray(rng.standard_normal((3, 16, 8)), jnp.float32)  # [L, K, N]
+        q, s = quant.quantize_weight(w)
+        assert q.shape == (3, 16, 8) and s.shape == (3, 8)
+        deq = np.asarray(q, np.float32) * np.asarray(s)[:, None, :]
+        np.testing.assert_allclose(deq, np.asarray(w), atol=np.abs(w).max() / 127)
+
+    def test_linear_dispatch(self):
+        from llm_interpretation_replication_tpu.ops import quant
+
+        rng = np.random.default_rng(2)
+        x = jnp.asarray(rng.standard_normal((2, 16)), jnp.float32)
+        w = jnp.asarray(rng.standard_normal((16, 4)) * 0.1, jnp.float32)
+        plain = quant.linear({"w": w}, "w", x)
+        np.testing.assert_allclose(np.asarray(plain), np.asarray(x @ w), rtol=1e-6)
+        qw, s = quant.quantize_weight(w)
+        quantized = quant.linear({"w": qw, "w_qscale": s}, "w", x)
+        assert np.abs(np.asarray(quantized) - np.asarray(x @ w)).max() < 0.05
+
+    def test_quantized_decoder_matches_fp32(self):
+        """End-to-end: quantized tiny decoder logits track fp32 closely."""
+        from llm_interpretation_replication_tpu.models.config import DecoderConfig
+        from llm_interpretation_replication_tpu.models.decoder import forward_last_logits
+        from llm_interpretation_replication_tpu.ops import quant
+
+        from helpers import random_decoder_params
+
+        cfg = DecoderConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, position_embedding="rotary",
+            tie_word_embeddings=True, max_position_embeddings=64,
+        )
+        rng = np.random.default_rng(3)
+        params = random_decoder_params(cfg, seed=3)
+        ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 24)), jnp.int32)
+        mask = jnp.ones((2, 24), jnp.int32)
+        ref = np.asarray(forward_last_logits(params, cfg, ids, mask))
+        qp = quant.quantize_decoder_params(params)
+        got = np.asarray(forward_last_logits(qp, cfg, ids, mask))
+        corr = np.corrcoef(ref.ravel(), got.ravel())[0, 1]
+        assert corr > 0.999, corr
+
+    def test_quantized_greedy_decode_matches_fp32(self):
+        """The decode path (_attn_ragged / _block_ragged) must also apply the
+        dequant scales — greedy tokens should match fp32 on a tiny model."""
+        from llm_interpretation_replication_tpu.models.config import DecoderConfig
+        from llm_interpretation_replication_tpu.models.decoder import greedy_decode
+        from llm_interpretation_replication_tpu.ops import quant
+
+        from helpers import random_decoder_params
+
+        cfg = DecoderConfig(
+            vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+            intermediate_size=64, position_embedding="rotary",
+            tie_word_embeddings=True, max_position_embeddings=64,
+        )
+        rng = np.random.default_rng(5)
+        params = random_decoder_params(cfg, seed=5)
+        ids = jnp.asarray(rng.integers(1, cfg.vocab_size, (2, 12)), jnp.int32)
+        mask = jnp.ones((2, 12), jnp.int32)
+        toks_fp, scores_fp = greedy_decode(params, cfg, ids, mask, num_steps=5)
+        qp = quant.quantize_decoder_params(params)
+        toks_q, scores_q = greedy_decode(qp, cfg, ids, mask, num_steps=5)
+        np.testing.assert_array_equal(np.asarray(toks_q), np.asarray(toks_fp))
+        corr = np.corrcoef(
+            np.asarray(scores_fp, np.float64).ravel(),
+            np.asarray(scores_q, np.float64).ravel(),
+        )[0, 1]
+        assert corr > 0.999, corr
+
+    def test_quantize_decoder_params_gated_mlp(self):
+        from llm_interpretation_replication_tpu.ops import quant
+
+        rng = np.random.default_rng(4)
+        layers = {
+            "attn": {"wq": jnp.asarray(rng.standard_normal((2, 8, 8)), jnp.float32)},
+            "mlp": {
+                "wg": jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32),
+                "wi": jnp.asarray(rng.standard_normal((2, 8, 16)), jnp.float32),
+                "wo": jnp.asarray(rng.standard_normal((2, 16, 8)), jnp.float32),
+                "bo": jnp.zeros((2, 8), jnp.float32),
+            },
+        }
+        out = quant.quantize_decoder_params({"layers": layers})
+        for grp, key in (("attn", "wq"), ("mlp", "wg"), ("mlp", "wi"), ("mlp", "wo")):
+            assert out["layers"][grp][key].dtype == jnp.int8
+            assert key + "_qscale" in out["layers"][grp]
+        assert out["layers"]["mlp"]["bo"].dtype == jnp.float32  # biases untouched
