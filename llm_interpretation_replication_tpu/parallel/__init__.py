@@ -1,12 +1,18 @@
 from .mesh import (
     DATA_AXIS,
     MODEL_AXIS,
+    PIPE_AXIS,
     SEQ_AXIS,
     data_sharded,
     initialize_distributed,
     make_mesh,
     mesh_shape_for,
     replicated,
+)
+from .pipeline import (
+    pipeline_apply,
+    pipeline_decoder_forward,
+    split_stage_params,
 )
 from .ring_attention import ring_attention, ring_attention_sharded
 from .sharding import (
@@ -20,7 +26,11 @@ from .sharding import (
 __all__ = [
     "DATA_AXIS",
     "MODEL_AXIS",
+    "PIPE_AXIS",
     "SEQ_AXIS",
+    "pipeline_apply",
+    "pipeline_decoder_forward",
+    "split_stage_params",
     "data_sharded",
     "initialize_distributed",
     "make_mesh",

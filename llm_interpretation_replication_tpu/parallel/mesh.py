@@ -19,26 +19,32 @@ from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 DATA_AXIS = "data"
 MODEL_AXIS = "model"
 SEQ_AXIS = "seq"
+PIPE_AXIS = "pipe"
 
 
 def make_mesh(
     data: Optional[int] = None,
     model: int = 1,
     seq: int = 1,
+    pipe: int = 1,
     devices: Optional[Sequence] = None,
 ) -> Mesh:
-    """Build a (data, model, seq) mesh.  ``data`` defaults to whatever is left
-    after model×seq divides the device count."""
+    """Build a (data, pipe, model, seq) mesh.  ``data`` defaults to whatever
+    is left after pipe×model×seq divides the device count.  The pipe axis sits
+    between data and model so pipeline-neighbor ``ppermute`` hops stay within
+    a contiguous device block while TP collectives ride the innermost ring."""
     devices = list(devices if devices is not None else jax.devices())
     n = len(devices)
     if data is None:
-        if n % (model * seq):
-            raise ValueError(f"{n} devices not divisible by model={model} × seq={seq}")
-        data = n // (model * seq)
-    if data * model * seq != n:
-        raise ValueError(f"mesh {data}×{model}×{seq} != {n} devices")
-    arr = np.asarray(devices).reshape(data, model, seq)
-    return Mesh(arr, (DATA_AXIS, MODEL_AXIS, SEQ_AXIS))
+        if n % (pipe * model * seq):
+            raise ValueError(
+                f"{n} devices not divisible by pipe={pipe} × model={model} × seq={seq}"
+            )
+        data = n // (pipe * model * seq)
+    if data * pipe * model * seq != n:
+        raise ValueError(f"mesh {data}×{pipe}×{model}×{seq} != {n} devices")
+    arr = np.asarray(devices).reshape(data, pipe, model, seq)
+    return Mesh(arr, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
 def mesh_shape_for(n_devices: int, want_model: int = 1, want_seq: int = 1) -> Tuple[int, int, int]:

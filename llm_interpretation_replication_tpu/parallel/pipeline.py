@@ -1,0 +1,199 @@
+"""Pipeline parallelism: GPipe-style microbatched stages over a ``pipe`` axis.
+
+Beyond-reference capability (SURVEY.md §2.7 marks PP absent upstream): layers
+split into contiguous stages, one stage per device along the ``pipe`` mesh
+axis; microbatches stream through the ring with ``ppermute`` handing each
+stage's activations to the next, M + P - 1 ticks total (the usual GPipe
+bubble).  TPU-first mechanics:
+
+- ``shard_map(axis_names={'pipe'})`` makes only the pipe axis manual — data
+  and tensor parallelism inside a stage stay GSPMD-automatic, so dp×pp×tp
+  composes on one mesh without hand-written model collectives;
+- the tick loop is a ``lax.scan`` (static trip count, one compiled program);
+- stage handoff is a single ``ppermute`` per tick riding ICI neighbors;
+- autodiff flows through scan+ppermute, so ``jax.grad`` of a pipelined loss
+  just works (activations rematerialized by XLA as needed).
+
+``pipeline_apply`` is the generic engine; ``pipeline_decoder_forward`` wires
+it to models/decoder.py's stacked-layer params (embed and unembed run outside
+the pipeline under plain GSPMD).
+"""
+
+from __future__ import annotations
+
+import functools
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import Mesh, PartitionSpec as P
+
+from .mesh import PIPE_AXIS
+
+
+def split_stage_params(params, n_stages: int):
+    """Reshape stacked-layer leaves ``[L, ...]`` → ``[P, L/P, ...]`` so the
+    leading axis can shard over the pipe axis (stage s holds layers
+    ``[s·L/P, (s+1)·L/P)``)."""
+
+    def reshape(x):
+        L = x.shape[0]
+        if L % n_stages:
+            raise ValueError(f"{L} layers not divisible into {n_stages} stages")
+        return x.reshape(n_stages, L // n_stages, *x.shape[1:])
+
+    return jax.tree.map(reshape, params)
+
+
+def pipeline_apply(
+    stage_fn: Callable,
+    stage_params,
+    xs,
+    mesh: Mesh,
+):
+    """Run ``xs`` microbatches through ``stage_fn`` pipelined over ``pipe``.
+
+    stage_fn: ``(params_for_one_stage, x) -> x`` — same pytree structure and
+       shapes in and out, so activations can flow stage to stage.
+    stage_params: pytree with leading stage axis ``P`` on every leaf
+       (see :func:`split_stage_params`).
+    xs: pytree of microbatched arrays ``[M, ...]`` (microbatch-major).
+    Returns the same pytree, ``[M, ...]``, fully processed by all stages.
+    """
+    n_stages = mesh.shape[PIPE_AXIS]
+    xs_leaves = jax.tree.leaves(xs)
+    if not xs_leaves:
+        return xs
+    n_micro = xs_leaves[0].shape[0]
+    if n_micro < 1:
+        raise ValueError("need at least one microbatch")
+
+    param_specs = jax.tree.map(lambda _: P(PIPE_AXIS), stage_params)
+    x_specs = jax.tree.map(lambda _: P(), xs)
+    ring = [(i, (i + 1) % n_stages) for i in range(n_stages)]
+
+    def body(sp, xs):
+        pid = lax.axis_index(PIPE_AXIS)
+        my_params = jax.tree.map(lambda a: a[0], sp)  # local [1, ...] block
+        take = lambda tree, t: jax.tree.map(lambda a: a[t], tree)
+
+        buf = take(xs, 0)  # stage-resident activation (garbage until fed)
+        outs = jax.tree.map(jnp.zeros_like, xs)
+
+        def tick(carry, t):
+            buf, outs = carry
+            inject = take(xs, jnp.minimum(t, n_micro - 1))
+            cur = jax.tree.map(
+                lambda i, b: jnp.where(pid == 0, i, b), inject, buf
+            )
+            y = stage_fn(my_params, cur)
+            nxt = jax.tree.map(
+                lambda a: lax.ppermute(a, PIPE_AXIS, ring), y
+            )
+            out_t = t - (n_stages - 1)
+            write = (out_t >= 0) & (pid == n_stages - 1)
+            outs = jax.tree.map(
+                lambda o, v: jnp.where(
+                    write,
+                    lax.dynamic_update_index_in_dim(
+                        o, v, jnp.maximum(out_t, 0), 0
+                    ),
+                    o,
+                ),
+                outs, y,
+            )
+            return (nxt, outs), None
+
+        (_, outs), _ = lax.scan(
+            tick, (buf, outs), jnp.arange(n_micro + n_stages - 1)
+        )
+        # Only the last stage holds real outputs; replicate over the ring.
+        outs = jax.tree.map(
+            lambda o: lax.psum(jnp.where(pid == n_stages - 1, o, 0), PIPE_AXIS),
+            outs,
+        )
+        return outs
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(param_specs, x_specs),
+        out_specs=x_specs,
+        axis_names=frozenset({PIPE_AXIS}),
+        check_vma=False,
+    )
+    # Partial-manual shard_map (axis_names ⊂ mesh axes) only lowers under a
+    # jit trace — its eager impl path rejects the auto axes — so always wrap
+    # in jit.  Inside a caller's jit (the production path; see
+    # pipeline_decoder_forward's cached jit) this traces inline and caches
+    # with the outer executable; only bare eager calls pay a per-call trace.
+    return jax.jit(mapped)(stage_params, xs)
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "mesh", "n_microbatches")
+)
+def pipeline_decoder_forward(
+    params,
+    cfg,
+    token_ids,      # [B, S] int32
+    attention_mask, # [B, S] int32
+    mesh: Mesh,
+    n_microbatches: int = 2,
+):
+    """models/decoder.py forward with the layer trunk pipelined over ``pipe``.
+
+    Embedding and final-norm/unembed run outside the pipeline under plain
+    GSPMD (they are a rounding error of the FLOPs); each stage recomputes the
+    attention bias from the positions/mask it receives with its microbatch so
+    nothing positional needs to be resident per stage.  Returns full logits
+    ``[B, S, V]`` — numerically identical to ``decoder.forward``.
+    """
+    from ..models import decoder as dmod
+
+    b = token_ids.shape[0]
+    if b % n_microbatches:
+        raise ValueError(f"batch {b} not divisible into {n_microbatches} microbatches")
+    n_stages = mesh.shape[PIPE_AXIS]
+
+    mask = attention_mask.astype(bool)
+    positions = jnp.cumsum(attention_mask, axis=-1) - 1
+    positions = jnp.maximum(positions, 0)
+    x = dmod._embed(cfg, params, token_ids, positions)
+
+    def micro(a):  # [B, ...] -> [M, B/M, ...]
+        return a.reshape(n_microbatches, b // n_microbatches, *a.shape[1:])
+
+    xs = {
+        "h": micro(x),
+        "pos": micro(positions),
+        "mask": micro(attention_mask),
+    }
+    stage_layers = split_stage_params(params["layers"], n_stages)
+
+    def stage_fn(layers, mb):
+        h, pos, amask = mb["h"], mb["pos"], mb["mask"]
+        valid = amask.astype(bool)
+        sin_cos = None
+        if cfg.position_embedding == "rotary":
+            rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+            sin_cos = dmod.rotary_embedding(pos, rd, cfg.rope_theta, h.dtype)
+        # Mirror decoder._trunk's attention dispatch: the Pallas flash kernel
+        # (lengths-based) when configured, dense bias otherwise.
+        use_flash = cfg.attention_impl == "flash"
+        bias = None if use_flash else dmod.make_attention_bias(cfg, pos, pos, valid)
+        flash_lengths = (
+            jnp.sum(amask, axis=-1).astype(jnp.int32) if use_flash else None
+        )
+
+        def body(hh, lp):
+            hh, _ = dmod._block(cfg, lp, hh, sin_cos, bias, None, None, flash_lengths)
+            return hh, None
+
+        h, _ = lax.scan(body, h, layers)
+        return {"h": h, "pos": pos, "mask": amask}
+
+    outs = pipeline_apply(stage_fn, stage_layers, xs, mesh)
+    h = outs["h"].reshape(b, *outs["h"].shape[2:])
+    return dmod._unembed(cfg, params, h)
