@@ -293,6 +293,28 @@ def _unembed(cfg: DecoderConfig, params, x):
     return (x.astype(jnp.float32) @ table.astype(jnp.float32)) * cfg.logit_scale
 
 
+def run_layers(cfg: DecoderConfig, layers, x, positions, attention_mask):
+    """Rotary setup + flash/dense attention dispatch + scan over stacked
+    ``layers``.  The one shared per-layer driver for the full-trunk path and
+    the pipeline-parallel stage (parallel/pipeline.py) — change attention
+    dispatch here and both paths move together."""
+    mask = attention_mask.astype(bool)
+    sin_cos = None
+    if cfg.position_embedding == "rotary":
+        rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+        sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, x.dtype)
+    use_flash = cfg.attention_impl == "flash"
+    bias = None if use_flash else make_attention_bias(cfg, positions, positions, mask)
+    flash_lengths = jnp.sum(attention_mask, axis=-1).astype(jnp.int32) if use_flash else None
+
+    def body(h, lp):
+        h, _ = _block(cfg, lp, h, sin_cos, bias, None, None, flash_lengths)
+        return h, None
+
+    out, _ = lax.scan(body, x, layers)
+    return out
+
+
 def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
            cache_len: Optional[int] = None):
     """Embed + blocks.  Returns (hidden [B,S,H], cache | None)."""
@@ -300,23 +322,15 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
     mask = attention_mask.astype(bool)
     positions = jnp.cumsum(attention_mask, axis=-1) - 1  # right-padded prompts
     positions = jnp.maximum(positions, 0)
+    x = _embed(cfg, params, token_ids, positions)
+
+    if cache_len is None:
+        return run_layers(cfg, params["layers"], x, positions, attention_mask), None
+
     sin_cos = None
     if cfg.position_embedding == "rotary":
         rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
         sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, params["embed"]["tokens"].dtype)
-    x = _embed(cfg, params, token_ids, positions)
-
-    if cache_len is None:
-        use_flash = cfg.attention_impl == "flash"
-        bias = None if use_flash else make_attention_bias(cfg, positions, positions, mask)
-        flash_lengths = jnp.sum(attention_mask, axis=-1).astype(jnp.int32) if use_flash else None
-
-        def body(h, lp):
-            h, _ = _block(cfg, lp, h, sin_cos, bias, None, None, flash_lengths)
-            return h, None
-
-        x, _ = lax.scan(body, x, params["layers"])
-        return x, None
 
     t = cache_len
     cache_dtype = params["embed"]["tokens"].dtype

@@ -1,0 +1,195 @@
+"""Mixture-of-Experts MLP with expert parallelism (GShard-style all_to_all).
+
+Beyond-reference capability (SURVEY.md §2.7 marks EP absent upstream).  Two
+interchangeable compute paths over one parameter layout:
+
+- :func:`moe_mlp_dense` — every expert computed for every token, masked and
+  combined by the router gates.  Exact semantics, O(E·T·F) FLOPs; the
+  correctness oracle and single-device fallback.
+- :func:`moe_mlp_sharded` — the TPU path: experts sharded over a mesh axis
+  (GShard maps experts across the data-parallel axis), tokens routed with
+  capacity-C one-hot dispatch tensors, moved to their expert's device with
+  ``lax.all_to_all`` over ICI, expert FLOPs computed locally, and combined on
+  the way back.  O(T·K·F) FLOPs + two all_to_alls.
+
+Router: softmax over all experts, take top-k, renormalize the selected
+probabilities (Mixtral-style), with the Switch-Transformer auxiliary
+load-balancing loss available for training.
+
+Parameter layout (leading ``E`` axis shards over the expert axis):
+  ``{"router": [H, E], "wi": [E, H, F], "wo": [E, F, H]}``
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+
+def init_moe_params(key, hidden: int, ffn: int, num_experts: int, dtype=jnp.float32):
+    kr, ki, ko = jax.random.split(key, 3)
+    scale = 0.02
+    return {
+        "router": jax.random.normal(kr, (hidden, num_experts), dtype) * scale,
+        "wi": jax.random.normal(ki, (num_experts, hidden, ffn), dtype) * scale,
+        "wo": jax.random.normal(ko, (num_experts, ffn, hidden), dtype) * scale,
+    }
+
+
+def route(params, x, top_k: int, renormalize: bool = True):
+    """Top-k routing.  x: [T, H] → (gates [T, K], indices [T, K] int32,
+    probs [T, E] full softmax for the aux loss)."""
+    logits = x.astype(jnp.float32) @ params["router"].astype(jnp.float32)
+    probs = jax.nn.softmax(logits, axis=-1)
+    gates, indices = lax.top_k(probs, top_k)
+    if renormalize:
+        gates = gates / jnp.maximum(gates.sum(axis=-1, keepdims=True), 1e-9)
+    return gates, indices, probs
+
+
+def load_balancing_loss(probs, indices, num_experts: int):
+    """Switch-Transformer aux loss: E · Σ_e (fraction of tokens routed to e)
+    × (mean router prob of e).  Minimized at uniform routing."""
+    one_hot = jax.nn.one_hot(indices[..., 0], num_experts, dtype=probs.dtype)
+    fraction = one_hot.mean(axis=0)
+    mean_prob = probs.mean(axis=0)
+    return num_experts * jnp.sum(fraction * mean_prob)
+
+
+def _expert_ffn(wi, wo, x, activation):
+    return activation(x @ wi) @ wo
+
+
+def moe_mlp_dense(params, x, top_k: int = 2, activation=jax.nn.gelu,
+                  renormalize: bool = True):
+    """Oracle path: compute every expert for every token, gate-combine.
+
+    x: [T, H] → [T, H].  Also returns the aux loss.
+    """
+    num_experts = params["router"].shape[-1]
+    gates, indices, probs = route(params, x, top_k, renormalize)
+    # [E, T, H]: every expert applied to every token
+    expert_out = jax.vmap(
+        lambda wi, wo: _expert_ffn(wi, wo, x, activation)
+    )(params["wi"], params["wo"])
+    # combine weights [T, E]: gate where selected, 0 elsewhere
+    combine = jnp.zeros((x.shape[0], num_experts), expert_out.dtype)
+    for k in range(top_k):
+        combine = combine + gates[:, k, None] * jax.nn.one_hot(
+            indices[:, k], num_experts, dtype=expert_out.dtype
+        )
+    out = jnp.einsum("te,eth->th", combine, expert_out)
+    return out.astype(x.dtype), load_balancing_loss(probs, indices, num_experts)
+
+
+def _dispatch_tensors(gates, indices, num_experts: int, capacity: int):
+    """Capacity-C one-hot dispatch/combine tensors from top-k routing.
+
+    gates/indices: [T, K].  Returns (dispatch [T, E, C] one-hot,
+    combine [T, E, C] gate-weighted).  Token t's k-th choice lands in expert
+    e's c-th capacity slot where c counts prior assignments to e; choices
+    beyond capacity are dropped (standard GShard overflow behavior).
+    """
+    t = gates.shape[0]
+    k = gates.shape[1]
+    # Flatten (k, t) so primary choices (k=0) claim capacity slots first.
+    flat_idx = indices.T.reshape(-1)          # [K*T], k-major
+    flat_gate = gates.T.reshape(-1)
+    onehot = jax.nn.one_hot(flat_idx, num_experts, dtype=jnp.float32)  # [KT, E]
+    position = jnp.cumsum(onehot, axis=0) * onehot - 1.0               # slot per row
+    keep = (position >= 0) & (position < capacity)
+    slot = jax.nn.one_hot(
+        position.max(axis=-1).astype(jnp.int32), capacity, dtype=jnp.float32
+    )
+    dispatch_flat = onehot[:, :, None] * slot[:, None, :] * keep.max(-1)[:, None, None]
+    combine_flat = dispatch_flat * flat_gate[:, None, None]
+    dispatch = dispatch_flat.reshape(k, t, num_experts, capacity).sum(axis=0)
+    combine = combine_flat.reshape(k, t, num_experts, capacity).sum(axis=0)
+    return dispatch, combine
+
+
+@functools.partial(
+    jax.jit,
+    static_argnames=(
+        "mesh", "axis_name", "top_k", "capacity_factor", "activation",
+        "renormalize",
+    ),
+)
+def moe_mlp_sharded(
+    params,
+    x,
+    mesh,
+    axis_name: str = "data",
+    top_k: int = 2,
+    capacity_factor: float = 2.0,
+    activation=jax.nn.gelu,
+    renormalize: bool = True,
+):
+    """Expert-parallel MoE: experts sharded over ``axis_name``, tokens moved
+    to their experts via all_to_all and back.
+
+    x: [B, H] tokens sharded over ``axis_name`` on the batch dim (the usual
+    data-parallel activation layout).  params leaves shard on their leading
+    expert axis.  Returns ([B, H], aux_loss) matching
+    :func:`moe_mlp_dense` wherever no token overflowed expert capacity.
+    """
+    num_experts = params["router"].shape[-1]
+    n_shards = mesh.shape[axis_name]
+    if num_experts % n_shards:
+        raise ValueError(f"{num_experts} experts not divisible over {n_shards} shards")
+
+    def body(router, wi, wo, xb):
+        # xb: local tokens [t, H]; wi/wo: local experts [E/n, ...]
+        t = xb.shape[0]
+        capacity = max(1, int(capacity_factor * top_k * t / num_experts))
+        gates, indices, probs = route({"router": router}, xb, top_k, renormalize)
+        dispatch, combine = _dispatch_tensors(gates, indices, num_experts, capacity)
+        buf = jnp.einsum("tec,th->ech", dispatch, xb.astype(jnp.float32))
+        # [E, C, H] → [n, E/n·C, H] → all_to_all(tiled) → [E/n, n·C, H]:
+        # shard s ends up holding, for each of its local experts, the C
+        # capacity slots from every source shard.
+        h = buf.shape[-1]
+        buf = buf.reshape(n_shards, (num_experts // n_shards) * capacity, h)
+        buf = lax.all_to_all(buf, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        buf = buf.reshape(n_shards, num_experts // n_shards, capacity, h)
+        buf = jnp.moveaxis(buf, 0, 1).reshape(
+            num_experts // n_shards, n_shards * capacity, h
+        )
+        out = jax.vmap(
+            lambda wi_e, wo_e, xe: _expert_ffn(
+                wi_e.astype(jnp.float32), wo_e.astype(jnp.float32), xe, activation
+            )
+        )(wi, wo, buf)  # [E/n, n·C, H]
+        # Reverse the exchange back to [E, C, H] on the token-owning shard.
+        out = out.reshape(num_experts // n_shards, n_shards, capacity, h)
+        out = jnp.moveaxis(out, 1, 0).reshape(
+            n_shards, (num_experts // n_shards) * capacity, h
+        )
+        out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
+        out = out.reshape(num_experts, capacity, h)
+        y = jnp.einsum("tec,ech->th", combine, out)
+        # Global aux loss: average the routing fraction and mean prob across
+        # shards BEFORE the product so it equals the single-device value.
+        frac = lax.pmean(
+            jax.nn.one_hot(indices[..., 0], num_experts, dtype=probs.dtype).mean(axis=0),
+            axis_name,
+        )
+        mean_prob = lax.pmean(probs.mean(axis=0), axis_name)
+        aux = num_experts * jnp.sum(frac * mean_prob)
+        return y.astype(xb.dtype), aux
+
+    mapped = jax.shard_map(
+        body,
+        mesh=mesh,
+        in_specs=(P(), P(axis_name), P(axis_name), P(axis_name)),
+        out_specs=(P(axis_name), P()),
+        axis_names=frozenset({axis_name}),
+        check_vma=False,
+    )
+    # Partial-manual shard_map only lowers under a jit trace (see
+    # parallel/pipeline.py); inside a caller's jit this traces inline.
+    return jax.jit(mapped)(params["router"], params["wi"], params["wo"], x)

@@ -157,7 +157,6 @@ def pipeline_decoder_forward(
         raise ValueError(f"batch {b} not divisible into {n_microbatches} microbatches")
     n_stages = mesh.shape[PIPE_AXIS]
 
-    mask = attention_mask.astype(bool)
     positions = jnp.cumsum(attention_mask, axis=-1) - 1
     positions = jnp.maximum(positions, 0)
     x = dmod._embed(cfg, params, token_ids, positions)
@@ -173,26 +172,10 @@ def pipeline_decoder_forward(
     stage_layers = split_stage_params(params["layers"], n_stages)
 
     def stage_fn(layers, mb):
-        h, pos, amask = mb["h"], mb["pos"], mb["mask"]
-        valid = amask.astype(bool)
-        sin_cos = None
-        if cfg.position_embedding == "rotary":
-            rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
-            sin_cos = dmod.rotary_embedding(pos, rd, cfg.rope_theta, h.dtype)
-        # Mirror decoder._trunk's attention dispatch: the Pallas flash kernel
-        # (lengths-based) when configured, dense bias otherwise.
-        use_flash = cfg.attention_impl == "flash"
-        bias = None if use_flash else dmod.make_attention_bias(cfg, pos, pos, valid)
-        flash_lengths = (
-            jnp.sum(amask, axis=-1).astype(jnp.int32) if use_flash else None
-        )
-
-        def body(hh, lp):
-            hh, _ = dmod._block(cfg, lp, hh, sin_cos, bias, None, None, flash_lengths)
-            return hh, None
-
-        h, _ = lax.scan(body, h, layers)
-        return {"h": h, "pos": pos, "mask": amask}
+        # decoder.run_layers is the same per-layer driver _trunk uses, so the
+        # pipelined path inherits any attention-dispatch change automatically.
+        h = dmod.run_layers(cfg, layers, mb["h"], mb["pos"], mb["mask"])
+        return {"h": h, "pos": mb["pos"], "mask": mb["mask"]}
 
     outs = pipeline_apply(stage_fn, stage_layers, xs, mesh)
     h = outs["h"].reshape(b, *outs["h"].shape[2:])

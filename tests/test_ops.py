@@ -211,3 +211,113 @@ class TestQuant:
             assert out["layers"][grp][key].dtype == jnp.int8
             assert key + "_qscale" in out["layers"][grp]
         assert out["layers"]["mlp"]["bo"].dtype == jnp.float32  # biases untouched
+
+
+# ---------------------------------------------------------------------------
+# Mixture-of-Experts with expert parallelism (ops/moe.py) — beyond-reference
+# capability (SURVEY.md §2.7: EP absent upstream).
+# ---------------------------------------------------------------------------
+
+class TestMoE:
+    H, F, E, K = 16, 32, 8, 2
+
+    def _params_and_tokens(self, n_tokens=32, seed=0):
+        import jax
+
+        from llm_interpretation_replication_tpu.ops import moe
+
+        params = moe.init_moe_params(jax.random.PRNGKey(0), self.H, self.F, self.E)
+        rng = np.random.default_rng(seed)
+        x = jnp.asarray(rng.standard_normal((n_tokens, self.H)), jnp.float32)
+        return params, x
+
+    def test_dense_matches_per_token_loop(self):
+        import jax
+
+        from llm_interpretation_replication_tpu.ops import moe
+
+        params, x = self._params_and_tokens()
+        out, aux = moe.moe_mlp_dense(params, x, top_k=self.K)
+        gates, idx, _ = moe.route(params, x, self.K)
+        expect = np.zeros(x.shape, np.float32)
+        for t in range(x.shape[0]):
+            for k in range(self.K):
+                e = int(idx[t, k])
+                wi = np.asarray(params["wi"][e])
+                wo = np.asarray(params["wo"][e])
+                y = np.asarray(jax.nn.gelu(np.asarray(x[t]) @ wi)) @ wo
+                expect[t] += float(gates[t, k]) * y
+        np.testing.assert_allclose(np.asarray(out), expect, atol=1e-5)
+        assert np.isfinite(float(aux))
+
+    def test_route_renormalizes_topk(self):
+        from llm_interpretation_replication_tpu.ops import moe
+
+        params, x = self._params_and_tokens()
+        gates, idx, probs = moe.route(params, x, self.K)
+        np.testing.assert_allclose(np.asarray(gates).sum(-1), 1.0, rtol=1e-5)
+        assert np.asarray(probs).shape == (x.shape[0], self.E)
+        # distinct experts per token
+        assert (np.asarray(idx)[:, 0] != np.asarray(idx)[:, 1]).all()
+
+    def test_sharded_matches_dense(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.ops import moe
+        from llm_interpretation_replication_tpu.parallel import make_mesh
+
+        params, x = self._params_and_tokens()
+        out_d, aux_d = moe.moe_mlp_dense(params, x, top_k=self.K)
+        mesh = make_mesh(data=4, model=2)
+        out_s, aux_s = moe.moe_mlp_sharded(
+            params, x, mesh, axis_name="data", top_k=self.K, capacity_factor=8.0
+        )
+        np.testing.assert_allclose(np.asarray(out_s), np.asarray(out_d), atol=1e-5)
+        np.testing.assert_allclose(float(aux_s), float(aux_d), rtol=1e-6)
+
+    def test_capacity_drops_overflow(self, eight_cpu_devices):
+        """capacity_factor→tiny forces token dropping: output stays finite and
+        differs from the uncapped result (documents GShard overflow)."""
+        from llm_interpretation_replication_tpu.ops import moe
+        from llm_interpretation_replication_tpu.parallel import make_mesh
+
+        params, x = self._params_and_tokens()
+        mesh = make_mesh(data=4, model=2)
+        out_tiny, _ = moe.moe_mlp_sharded(
+            params, x, mesh, axis_name="data", top_k=self.K, capacity_factor=0.25
+        )
+        out_full, _ = moe.moe_mlp_sharded(
+            params, x, mesh, axis_name="data", top_k=self.K, capacity_factor=8.0
+        )
+        assert np.isfinite(np.asarray(out_tiny)).all()
+        assert np.abs(np.asarray(out_tiny) - np.asarray(out_full)).max() > 1e-6
+
+    def test_grad_through_sharded(self, eight_cpu_devices):
+        import jax
+
+        from llm_interpretation_replication_tpu.ops import moe
+        from llm_interpretation_replication_tpu.parallel import make_mesh
+
+        params, x = self._params_and_tokens()
+        mesh = make_mesh(data=4, model=2)
+
+        def loss(p):
+            y, aux = moe.moe_mlp_sharded(
+                p, x, mesh, axis_name="data", top_k=self.K, capacity_factor=8.0
+            )
+            return (y ** 2).sum() + 0.01 * aux
+
+        g = jax.grad(loss)(params)
+        for name, v in g.items():
+            arr = np.asarray(v)
+            assert np.isfinite(arr).all() and np.abs(arr).max() > 0, name
+
+    def test_indivisible_experts_raise(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.ops import moe
+        from llm_interpretation_replication_tpu.parallel import make_mesh
+
+        import jax
+
+        params = moe.init_moe_params(jax.random.PRNGKey(0), self.H, self.F, 6)
+        mesh = make_mesh(data=4, model=2)
+        x = jnp.zeros((8, self.H), jnp.float32)
+        with pytest.raises(ValueError, match="divisible"):
+            moe.moe_mlp_sharded(params, x, mesh, axis_name="data")
