@@ -90,6 +90,21 @@ except Exception:  # pragma: no cover
     _PALLAS_OK = False
 
 
+def pick_block(s: int, default: int) -> Optional[int]:
+    """Largest power-of-two block ≤ ``default`` that divides ``s`` (≥8 so the
+    MXU/VPU tiles stay efficient).  None when no such block exists — non-
+    power-of-two length buckets like 448/320/192 are all multiples of 64, so
+    in practice this only fails on pathological sequence lengths."""
+    blk = default                      # defaults are powers of two
+    while blk > s:
+        blk //= 2
+    while blk >= 8:
+        if s % blk == 0:
+            return blk
+        blk //= 2
+    return None
+
+
 def flash_attention(
     q, k, v,                       # [B, N, S, D]
     lengths,                       # [B] int32 valid key counts
@@ -98,13 +113,13 @@ def flash_attention(
     block_k: int = DEFAULT_BLOCK_K,
     interpret: bool = False,
 ):
-    """Pallas flash attention over [B, N, S, D]; S must divide by the blocks
-    (callers pad — bucketed batching guarantees it)."""
+    """Pallas flash attention over [B, N, S, D]; blocks shrink to the largest
+    power-of-two divisor of S (bucketed batching keeps S a multiple of 64)."""
     b, n, s, d = q.shape
-    block_q = min(block_q, s)
-    block_k = min(block_k, s)
-    if s % block_q or s % block_k:
-        raise ValueError(f"seq {s} not divisible by blocks ({block_q}, {block_k})")
+    block_q = pick_block(s, block_q)
+    block_k = pick_block(s, block_k)
+    if block_q is None or block_k is None:
+        raise ValueError(f"seq {s} has no power-of-two block divisor >= 8")
     grid = (b, n, s // block_q)
     kernel = functools.partial(
         _flash_kernel, block_q=block_q, block_k=block_k, seq_len=s, causal=causal
@@ -137,6 +152,10 @@ def attention(q, k, v, lengths, causal: bool = True, force: Optional[str] = None
         # on tracers; the default backend is what jit will compile for)
         platform = jax.default_backend()
         backend = "pallas" if (_PALLAS_OK and platform == "tpu") else "dense"
+        if backend == "pallas" and pick_block(q.shape[2], DEFAULT_BLOCK_Q) is None:
+            backend = "dense"      # no valid block for this length: XLA path
+            # (auto-selected only; an explicit force='pallas' still raises so
+            # parity tests can't silently compare dense against itself)
     if backend == "pallas":
         return flash_attention(q, k, v, lengths, causal, interpret=interpret)
     return _dense_attention(q, k, v, lengths, causal)

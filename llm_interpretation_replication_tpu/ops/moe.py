@@ -51,13 +51,24 @@ def route(params, x, top_k: int, renormalize: bool = True):
     return gates, indices, probs
 
 
-def load_balancing_loss(probs, indices, num_experts: int):
-    """Switch-Transformer aux loss: E · Σ_e (fraction of tokens routed to e)
-    × (mean router prob of e).  Minimized at uniform routing."""
+def routing_stats(probs, indices, num_experts: int):
+    """Per-expert (fraction of tokens routed, mean router prob) — the two
+    reduced statistics the aux loss is built from.  The sharded path pmeans
+    these across shards before the product so the global loss matches the
+    single-device value."""
     one_hot = jax.nn.one_hot(indices[..., 0], num_experts, dtype=probs.dtype)
-    fraction = one_hot.mean(axis=0)
-    mean_prob = probs.mean(axis=0)
+    return one_hot.mean(axis=0), probs.mean(axis=0)
+
+
+def aux_loss_from_stats(fraction, mean_prob, num_experts: int):
+    """Switch-Transformer aux loss: E · Σ_e fraction_e × mean_prob_e.
+    Minimized at uniform routing."""
     return num_experts * jnp.sum(fraction * mean_prob)
+
+
+def load_balancing_loss(probs, indices, num_experts: int):
+    fraction, mean_prob = routing_stats(probs, indices, num_experts)
+    return aux_loss_from_stats(fraction, mean_prob, num_experts)
 
 
 def _expert_ffn(wi, wo, x, activation):
@@ -172,14 +183,12 @@ def moe_mlp_sharded(
         out = lax.all_to_all(out, axis_name, split_axis=0, concat_axis=0, tiled=True)
         out = out.reshape(num_experts, capacity, h)
         y = jnp.einsum("tec,ech->th", combine, out)
-        # Global aux loss: average the routing fraction and mean prob across
-        # shards BEFORE the product so it equals the single-device value.
-        frac = lax.pmean(
-            jax.nn.one_hot(indices[..., 0], num_experts, dtype=probs.dtype).mean(axis=0),
-            axis_name,
+        # Global aux loss: average the routing stats across shards BEFORE the
+        # product so it equals the single-device value.
+        frac, mean_prob = routing_stats(probs, indices, num_experts)
+        aux = aux_loss_from_stats(
+            lax.pmean(frac, axis_name), lax.pmean(mean_prob, axis_name), num_experts
         )
-        mean_prob = lax.pmean(probs.mean(axis=0), axis_name)
-        aux = num_experts * jnp.sum(frac * mean_prob)
         return y.astype(xb.dtype), aux
 
     mapped = jax.shard_map(

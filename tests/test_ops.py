@@ -54,6 +54,33 @@ def test_indivisible_seq_raises():
         flash_attention(q, q, q, np.array([100]), block_q=64, block_k=64)
 
 
+def test_pick_block():
+    from llm_interpretation_replication_tpu.ops.attention import pick_block
+
+    assert pick_block(512, 128) == 128
+    assert pick_block(448, 128) == 64    # 448 = 7·64 — the sweep's hot bucket
+    assert pick_block(320, 128) == 64
+    assert pick_block(192, 128) == 64
+    assert pick_block(64, 128) == 64
+    assert pick_block(100, 128) is None  # no power-of-two divisor ≥ 8
+
+
+def test_flash_non_pow2_bucket_matches_dense():
+    """Regression: buckets like 448 are not 128-multiples; blocks must shrink
+    to a divisor instead of raising (runtime/batching.DEFAULT_BUCKETS)."""
+    rng = np.random.default_rng(2)
+    B, N, S, D = 2, 2, 448, 32
+    q, k, v = (rng.standard_normal((B, N, S, D)).astype(np.float32) for _ in range(3))
+    lengths = np.array([430, 448], np.int32)
+    out = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), lengths,
+                          causal=True, interpret=True)
+    expected = _dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                                jnp.asarray(lengths), True)
+    valid = (np.arange(S)[None, :] < lengths[:, None])[:, None, :, None]
+    np.testing.assert_allclose(np.asarray(out) * valid, np.asarray(expected) * valid,
+                               atol=2e-5, rtol=1e-4)
+
+
 def test_decoder_flash_config_matches_xla():
     """attention_impl='flash' must not change decoder outputs (dense dispatch
     on CPU; the Pallas kernel itself is parity-tested above)."""
