@@ -10,8 +10,9 @@ whose keys match the reference CSV schemas (§2.8).
 
 from __future__ import annotations
 
+import collections
 import dataclasses
-from typing import Dict, List, Optional, Sequence
+from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
 
@@ -35,6 +36,9 @@ class EngineConfig:
     buckets: Sequence[int] = batching.DEFAULT_BUCKETS
     decode_completions: bool = True
     completion_chars: int = 100     # reference truncation (":379")
+    pipeline_depth: int = 2         # in-flight device batches; host post-
+                                    # processing of batch k overlaps device
+                                    # compute of batch k+1 (JAX async dispatch)
 
 
 class ScoringEngine:
@@ -70,6 +74,27 @@ class ScoringEngine:
             jnp.asarray(arr), NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (arr.ndim - 1))))
         )
 
+    def _run_pipelined(self, batches: Iterable, launch: Callable, consume: Callable):
+        """Launch device programs up to ``pipeline_depth`` ahead of host-side
+        result consumption.
+
+        JAX dispatch is asynchronous: ``launch`` returns device arrays
+        immediately while the program runs, and only ``consume``'s host
+        fetches (np.asarray) block.  Keeping a short queue of in-flight
+        batches means the host's tokenizer-decode / row-building work for
+        batch k runs while the chip computes batch k+1 — the double-buffered
+        input feed of SURVEY.md §7 step 6, without threads."""
+        depth = max(1, self.ecfg.pipeline_depth)
+        pending: collections.deque = collections.deque()
+        for batch in batches:
+            pending.append((batch, launch(batch)))
+            if len(pending) >= depth:
+                done, out = pending.popleft()
+                consume(done, out)
+        while pending:
+            done, out = pending.popleft()
+            consume(done, out)
+
     # -- core ------------------------------------------------------------
 
     def score_prompts(
@@ -88,24 +113,22 @@ class ScoringEngine:
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
         steps = max(ecfg.score_steps, ecfg.max_look_ahead)
-        for batch in batching.batches_for_prompts(
-            encoded, ecfg.batch_size, ecfg.buckets,
-            pad_id=self.tokenizer.pad_token_id or 0,
-        ):
+
+        def launch(batch):
             ids = self._put(batch.token_ids)
             mask = self._put(batch.attention_mask)
-            if self.is_encoder_decoder:
-                tokens, scores = t5mod.greedy_decode(
-                    self.params, self.cfg, ids, mask, num_steps=steps
-                )
-            else:
-                tokens, scores = dmod.greedy_decode(
-                    self.params, self.cfg, ids, mask, num_steps=steps
-                )
+            decode = t5mod.greedy_decode if self.is_encoder_decoder else dmod.greedy_decode
+            tokens, scores = decode(self.params, self.cfg, ids, mask, num_steps=steps)
             res = yn.yes_no_from_scores(
                 scores, yes_id, no_id,
                 max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
             )
+            # Only pin the [B, steps, V] scores buffer in the pending queue
+            # when the confidence leg needs it — ~250 MB/batch at sweep sizes.
+            return tokens, scores if with_confidence else None, res
+
+        def consume(batch, out):
+            tokens, scores, res = out
             tokens_np = np.asarray(tokens)
             scores_np = np.asarray(scores) if with_confidence else None
             yes_np = np.asarray(res.yes_prob)
@@ -136,6 +159,14 @@ class ScoringEngine:
                     )
                     row["weighted_confidence"] = weighted_confidence_digits(cands)
                 results[int(orig)] = row
+
+        self._run_pipelined(
+            batching.batches_for_prompts(
+                encoded, ecfg.batch_size, ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+            ),
+            launch, consume,
+        )
         return [r if r is not None else _error_row("missing") for r in results]
 
     def first_token_relative_prob(
@@ -147,10 +178,8 @@ class ScoringEngine:
         yes_id, no_id = self.target_ids(targets)[:2]
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         out = np.zeros((len(prompts), 3), np.float64)
-        for batch in batching.batches_for_prompts(
-            encoded, self.ecfg.batch_size, self.ecfg.buckets,
-            pad_id=self.tokenizer.pad_token_id or 0,
-        ):
+
+        def launch(batch):
             ids = self._put(batch.token_ids)
             mask = self._put(batch.attention_mask)
             if self.is_encoder_decoder:
@@ -158,10 +187,21 @@ class ScoringEngine:
                 logits = t5mod.forward(self.params, self.cfg, ids, mask, dec)[:, 0, :]
             else:
                 logits = dmod.forward_last_logits(self.params, self.cfg, ids, mask)
-            yes, no, rel = yn.relative_prob_first_token(logits, yes_id, no_id, top_filter)
+            return yn.relative_prob_first_token(logits, yes_id, no_id, top_filter)
+
+        def consume(batch, res):
+            yes, no, rel = (np.asarray(a) for a in res)
             for r, orig in enumerate(batch.indices):
                 if orig >= 0:
                     out[int(orig)] = (float(yes[r]), float(no[r]), float(rel[r]))
+
+        self._run_pipelined(
+            batching.batches_for_prompts(
+                encoded, self.ecfg.batch_size, self.ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+            ),
+            launch, consume,
+        )
         return out
 
 

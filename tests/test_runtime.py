@@ -120,6 +120,28 @@ class TestScoringEngine:
         for a, b in zip(rows_single, rows_tp):
             np.testing.assert_allclose(a["relative_prob"], b["relative_prob"], atol=1e-5)
 
+    def test_pipelined_matches_unpipelined(self):
+        """pipeline_depth > 1 overlaps host work with device compute; results
+        must be identical to the serial depth-1 loop (order and values)."""
+        import dataclasses as dc
+
+        eng, _, _ = _tiny_engine(batch_size=2)
+        prompts = [f"prompt {i} about soup and tweets" for i in range(7)]
+        rows_piped = eng.score_prompts(prompts, with_confidence=True)
+        eng.ecfg = dc.replace(eng.ecfg, pipeline_depth=1)
+        rows_serial = eng.score_prompts(prompts, with_confidence=True)
+        assert [r["relative_prob"] for r in rows_piped] == [
+            r["relative_prob"] for r in rows_serial
+        ]
+        assert [r["completion"] for r in rows_piped] == [
+            r["completion"] for r in rows_serial
+        ]
+        eng.ecfg = dc.replace(eng.ecfg, pipeline_depth=4)  # deeper than #batches
+        fast_deep = eng.first_token_relative_prob(prompts)
+        eng.ecfg = dc.replace(eng.ecfg, pipeline_depth=1)
+        fast_serial = eng.first_token_relative_prob(prompts)
+        np.testing.assert_array_equal(fast_deep, fast_serial)
+
     def test_first_token_fast_path_matches_scan_position0(self):
         eng, _, _ = _tiny_engine()
         prompts = ["Is soup a beverage?"]
