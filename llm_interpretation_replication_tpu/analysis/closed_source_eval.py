@@ -138,6 +138,7 @@ def compare_with_human_data(
     """MAE vs human mean per model + Always-50 / N(μ,σ) baselines + paired
     difference tests (reference :917-1135)."""
     errors: Dict[str, List[float]] = {}
+    pairs: Dict[str, List[tuple]] = {}  # name -> [(prediction, human mean)]
     model_cols = {
         "GPT": "gpt_relative_prob",
         "Gemini": "gemini_relative_prob",
@@ -154,10 +155,12 @@ def compare_with_human_data(
             v = pd.to_numeric(pd.Series([row.get(col)]), errors="coerce").iloc[0]
             if pd.notna(v):
                 errors.setdefault(name, []).append(abs(float(v) - h))
+                pairs.setdefault(name, []).append((float(v), h))
         # claude gives confidence only: use confidence/100 as P(yes)
         cv = pd.to_numeric(pd.Series([row.get("claude_confidence")]), errors="coerce").iloc[0]
         if pd.notna(cv):
             errors.setdefault("Claude", []).append(abs(float(cv) / 100.0 - h))
+            pairs.setdefault("Claude", []).append((float(cv) / 100.0, h))
     matched_h = [human_means[q] for q in matched_questions]
     # Equanimity: always 0.5; Normal baseline: N(mean_h, std_h) draws
     errors["Equanimity"] = [abs(0.5 - h) for h in matched_h]
@@ -170,7 +173,21 @@ def compare_with_human_data(
     results: Dict = {"mae": {}, "differences": {}}
     for name, errs in errors.items():
         mean, lo, hi = bootstrap_mae(errs, n_bootstrap=n_bootstrap, seed=seed)
-        results["mae"][name] = {"mae": mean, "ci_lower": lo, "ci_upper": hi, "n": len(errs)}
+        record = {"mae": mean, "ci_lower": lo, "ci_upper": hi, "n": len(errs)}
+        # per-model Pearson correlation vs the human means (reference :985-1135
+        # records correlation/p_value/n_matched alongside each model's MAE)
+        pred_h = pairs.get(name, [])
+        if len(pred_h) >= 3 and np.std([p for p, _ in pred_h]) > 0 and np.std(
+            [hh for _, hh in pred_h]
+        ) > 0:
+            r, p = pearsonr([p for p, _ in pred_h], [hh for _, hh in pred_h])
+            record.update(correlation=float(r), p_value=float(p),
+                          n_matched=len(pred_h))
+        results["mae"][name] = record
+    if "Normal" in results["mae"] and matched_h:
+        results["mae"]["Normal"].update(
+            human_mean=float(np.mean(matched_h)), human_std=float(human_std)
+        )
     for name in ("GPT", "Claude", "Gemini"):
         if name not in errors:
             continue
@@ -218,6 +235,24 @@ def write_report(
                 mat, names, [f"q{i + 1}" for i in range(width)],
                 "Absolute error heatmap", os.path.join(output_dir, "mae_heatmap.png"),
             )
+    mae = comparisons.get("mae", {})
+    if mae:
+        dashboard_input = {
+            "models": {k: v for k, v in mae.items()
+                       if k in ("GPT", "Gemini", "Claude")},
+            "baselines": {
+                key: mae[name]
+                for key, name in (("always_50", "Equanimity"), ("normal_human", "Normal"))
+                if name in mae
+            },
+        }
+        paths["dashboard"] = figures.model_comparison_dashboard(
+            df, correlations, dashboard_input,
+            os.path.join(output_dir, "model_comparison_plots.png"),
+        )
+        paths["mae_comparison"] = figures.mae_comparison_bar(
+            dashboard_input, os.path.join(output_dir, "mae_comparison.png"),
+        )
     import json
 
     with open(os.path.join(output_dir, "correlations.json"), "w") as f:

@@ -12,7 +12,11 @@ from .evaluators import (
     evaluate_random_baseline,
     first_token_target_probs,
 )
-from .gemini_client import GeminiClient
+from .gemini_client import (
+    GeminiClient,
+    extract_text_from_response_string,
+    repair_batch_responses,
+)
 from .openai_client import OpenAIClient
 from .openai_client import build_batch_request as build_openai_batch_request
 from .openai_client import is_reasoning_model

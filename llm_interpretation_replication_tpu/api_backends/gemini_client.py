@@ -115,3 +115,74 @@ class GeminiClient:
                 pool.submit(self.generate_content, model, p, **kwargs) for p in prompts
             ]
             return [f.result() for f in futures]
+
+
+# ---------------------------------------------------------------------------
+# Batch-response repair (fix_batch_responses.py)
+#
+# A buggy batch download can leave each JSONL row's text field holding the
+# *string repr* of a response object instead of the text itself, with the
+# custom_id lost.  The repair pass re-pairs rows with the original request
+# custom_ids (by line position) and regex-recovers the text.
+# ---------------------------------------------------------------------------
+
+def extract_text_from_response_string(response_str: str) -> str:
+    """Recover the reply text from a stringified response object
+    (fix_batch_responses.py:21-28: the ``text='...'`` group, else '').
+
+    Unlike the reference's ``[^']*`` regex, this also handles Python reprs
+    that switch to double quotes (``text="It's likely"``) and backslash-
+    escaped quotes inside the literal, so apostrophed answers survive the
+    repair instead of being silently truncated or blanked.
+    """
+    import re
+
+    s = str(response_str)
+    for pattern, unescape in (
+        (r"text='((?:[^'\\]|\\.)*)'", (("\\'", "'"),)),
+        (r'text="((?:[^"\\]|\\.)*)"', (('\\"', '"'),)),
+    ):
+        match = re.search(pattern, s)
+        if match:
+            text = match.group(1)
+            for src, dst in unescape + (("\\\\", "\\"),):
+                text = text.replace(src, dst)
+            return text
+    return ""
+
+
+def repair_batch_responses(request_jsonl: str, response_jsonl: str,
+                           output_jsonl: str) -> int:
+    """Rewrite a corrupted batch-response JSONL (fix_batch_responses.py:30-77).
+
+    Reads custom_ids from ``request_jsonl`` (positional pairing; rows past the
+    request list get ``result_{i}`` ids), extracts the real text out of each
+    stringified response, and writes rows in the canonical
+    ``{"custom_id", "response": {"candidates": [{"content": {"parts":
+    [{"text": ...}]}, "logprobs_result": None}]}}`` shape.  Returns the number
+    of rows repaired.
+    """
+    with open(request_jsonl) as f:
+        request_ids = [json.loads(line)["custom_id"] for line in f if line.strip()]
+    with open(response_jsonl) as f:
+        responses = [json.loads(line) for line in f if line.strip()]
+
+    fixed = 0
+    with open(output_jsonl, "w") as f:
+        for idx, row in enumerate(responses):
+            custom_id = request_ids[idx] if idx < len(request_ids) else f"result_{idx}"
+            try:
+                raw = row["response"]["candidates"][0]["content"]["parts"][0]["text"]
+            except (KeyError, IndexError, TypeError):
+                raw = ""
+            f.write(json.dumps({
+                "custom_id": custom_id,
+                "response": {
+                    "candidates": [{
+                        "content": {"parts": [{"text": extract_text_from_response_string(raw)}]},
+                        "logprobs_result": None,
+                    }]
+                },
+            }) + "\n")
+            fixed += 1
+    return fixed

@@ -21,7 +21,7 @@ from .correlations import (
     pivot_model_values,
 )
 from .normality import ad_pvalue_from_bands, normality_tests
-from .power import power_curve, required_sample_size, simulated_power
+from .power import power_curve, power_report, required_sample_size, simulated_power
 from .similarity import (
     BM25Okapi,
     bm25_similarity_matrix,

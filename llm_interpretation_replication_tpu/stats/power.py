@@ -73,3 +73,60 @@ def power_curve(
         int(n): simulated_power(mae_diff, std, int(n), n_simulations, alpha, seed)
         for n in sample_sizes
     }
+
+
+def power_report(
+    model_results: Dict[str, Dict],
+    baseline_mae: float,
+    sample_size: int,
+    alpha: float = 0.05,
+    n_simulations: int = 10_000,
+    output_tex: str = None,
+) -> Dict:
+    """Full power-analysis report (power_analysis.py `main`, :96-278).
+
+    ``model_results`` maps model name -> {"mae", "mae_std", "mae_diff",
+    "ci_lower", "ci_upper"}.  Computes per-model effect sizes, required
+    sample sizes at every power level, simulated power at the current
+    ``sample_size``, and the 80%/90%-power recommendation (the max over
+    models, i.e. the smallest effect is the limiting factor).  Optionally
+    writes a LaTeX table (``power_analysis_report.tex``).
+    """
+    report: Dict = {"models": {}, "baseline_mae": baseline_mae,
+                    "sample_size": sample_size}
+    for name, res in model_results.items():
+        analysis = required_sample_size(
+            res["mae_diff"], res["mae_std"], alpha=alpha
+        )
+        analysis["achieved_power"] = simulated_power(
+            res["mae_diff"], res["mae_std"], sample_size,
+            n_simulations=n_simulations, alpha=alpha,
+        )
+        analysis["significant"] = not (
+            res.get("ci_lower", -np.inf) <= 0 <= res.get("ci_upper", np.inf)
+        )
+        report["models"][name] = analysis
+
+    def _max_required(level: str):
+        best_n, best_margin, limiting = 0, 0, None
+        for name, analysis in report["models"].items():
+            sizes = analysis["sample_sizes"][level]
+            if sizes["raw"] > best_n:
+                best_n, best_margin, limiting = sizes["raw"], sizes["with_margin"], name
+        # a zero-effect model keeps raw=inf: no N can power it, and the
+        # recommendation must say so rather than silently dropping the model
+        return {"raw": best_n, "with_margin": best_margin, "limiting_model": limiting}
+
+    report["recommendation"] = {
+        "power_80": _max_required("power_80"),
+        "power_90": _max_required("power_90"),
+    }
+
+    if output_tex:
+        from ..viz.latex import power_analysis_table
+
+        with open(output_tex, "w") as f:
+            f.write(power_analysis_table(report, alpha=alpha,
+                                         sample_size=sample_size))
+        report["tex_path"] = output_tex
+    return report

@@ -189,6 +189,202 @@ def violin_by_group(values_by_group: Dict[str, Sequence[float]], title: str,
     return _save(fig, output_path)
 
 
+def _mae_bars(ax, human_comparisons: Dict, capsize: int = 5) -> None:
+    """Shared MAE-vs-baselines bar panel (evaluate_closed_source_models.py:
+    1690-1780 and the standalone figure :1832-1901): per-model MAE with
+    asymmetric bootstrap-CI error bars, then the Always-50 and N(μ,σ)
+    baselines in grey/cyan."""
+    models = human_comparisons.get("models", human_comparisons)
+    baselines = human_comparisons.get("baselines", {})
+    labels, values, lo_err, hi_err, colors = [], [], [], [], []
+
+    def push(record, label, color):
+        mae = record.get("mae")
+        if mae is None or not np.isfinite(mae):
+            return
+        labels.append(label)
+        values.append(mae)
+        lo = record.get("mae_ci_lower", record.get("ci_lower"))
+        hi = record.get("mae_ci_upper", record.get("ci_upper"))
+        if lo is not None and hi is not None and np.isfinite(lo) and np.isfinite(hi):
+            lo_err.append(max(mae - lo, 0.0))
+            hi_err.append(max(hi - mae, 0.0))
+        else:
+            std = record.get("std", record.get("mae_std", 0.0)) or 0.0
+            lo_err.append(std)
+            hi_err.append(std)
+        colors.append(color)
+
+    palette = {"gpt": "#1f77b4", "gemini": "#2ca02c", "claude": "#d62728"}
+    for name, record in models.items():
+        push(record, str(name), palette.get(str(name).lower(), "#9467bd"))
+    if "always_50" in baselines:
+        push(baselines["always_50"], "Always 50%", "#808080")
+    if "normal_human" in baselines:
+        rec = baselines["normal_human"]
+        mu, sd = rec.get("human_mean"), rec.get("human_std")
+        if mu is None or sd is None:
+            label = "N(human)"
+        else:
+            # confidences are 0-100, relative probabilities 0-1: pick digits
+            fmt = ".0f" if mu > 1 else ".2f"
+            label = f"N({mu:{fmt}},{sd:{fmt}})"
+        push(rec, label, "#17becf")
+    if not values:
+        ax.axis("off")
+        return
+    x = np.arange(len(values))
+    ax.bar(x, values, yerr=np.array([lo_err, hi_err]), capsize=capsize,
+           alpha=0.7, color=colors)
+    for i, mae in enumerate(values):
+        ax.text(i, mae + hi_err[i] + 0.01, f"{mae:.3f}", ha="center")
+    ax.set_xticks(x)
+    ax.set_xticklabels(labels, rotation=45, ha="right")
+    ax.set_ylabel("Mean Absolute Error")
+    ax.set_title("MAE vs human assessments (lower is better)")
+    ax.grid(axis="y", alpha=0.3)
+
+
+def model_comparison_dashboard(
+    df,
+    correlations: Optional[Dict] = None,
+    human_comparisons: Optional[Dict] = None,
+    output_path: str = "model_comparison_plots.png",
+) -> str:
+    """The closed-source evaluation dashboard (evaluate_closed_source_models.py
+    `create_visualizations`, :1587-1830): GPT-vs-Gemini scatter, per-model
+    confidence histograms, binary-agreement heatmap, response-count bars, and
+    — when human comparisons exist — the MAE bar chart, a correlation summary
+    card, and confidence boxplots."""
+    correlations = correlations or {}
+    with_humans = bool(human_comparisons)
+    nrows = 3 if with_humans else 2
+    fig, axes = plt.subplots(nrows, 3, figsize=(18, 4.7 * nrows))
+
+    ax = axes[0, 0]
+    if {"gpt_relative_prob", "gemini_relative_prob"} <= set(df.columns):
+        sub = df[["gpt_relative_prob", "gemini_relative_prob"]].dropna()
+        ax.scatter(sub["gpt_relative_prob"], sub["gemini_relative_prob"], alpha=0.6)
+        ax.plot([0, 1], [0, 1], "r--", alpha=0.5)
+        ax.set_xlabel("GPT relative probability")
+        ax.set_ylabel("Gemini relative probability")
+        rho = correlations.get("gpt_relative_prob__gemini_relative_prob", {})
+        ax.set_title(f"GPT vs Gemini (ρ={rho.get('pearson', float('nan')):.3f})")
+    else:
+        ax.axis("off")
+
+    hist_specs = [
+        (axes[0, 1], "gpt_weighted_confidence", "GPT weighted confidence"),
+        (axes[0, 2], "gemini_weighted_confidence", "Gemini weighted confidence"),
+        (axes[1, 0], "claude_confidence", "Claude confidence"),
+    ]
+    for ax, col, title in hist_specs:
+        vals = df[col].dropna() if col in df.columns else []
+        if len(vals):
+            ax.hist(vals, bins=20, edgecolor="black", alpha=0.7)
+            ax.axvline(np.mean(vals), color="red", linestyle="--",
+                       label=f"mean: {np.mean(vals):.1f}")
+            ax.legend()
+            ax.set_xlabel("Confidence")
+            ax.set_ylabel("Frequency")
+        ax.set_title(title)
+
+    ax = axes[1, 1]
+    names = ["gpt", "gemini", "claude"]
+    cols = [f"{n}_response" for n in names]
+    if all(c in df.columns for c in cols):
+        agree = np.eye(3)
+        for i, a in enumerate(cols):
+            for j, b in enumerate(cols):
+                if i != j:
+                    sub = df[[a, b]].dropna()
+                    agree[i, j] = (sub[a] == sub[b]).mean() if len(sub) else np.nan
+        ax.imshow(agree, cmap="coolwarm", vmin=0, vmax=1)
+        ax.set_xticks(range(3)), ax.set_yticks(range(3))
+        ax.set_xticklabels(names), ax.set_yticklabels(names)
+        for i in range(3):
+            for j in range(3):
+                if np.isfinite(agree[i, j]):
+                    ax.text(j, i, f"{agree[i, j]:.2f}", ha="center", va="center",
+                            color="white" if agree[i, j] < 0.5 else "black")
+        ax.set_title("Binary-response agreement")
+    else:
+        ax.axis("off")
+
+    ax = axes[1, 2]
+    counts = {n: df[f"{n}_response"].value_counts()
+              for n in names if f"{n}_response" in df.columns}
+    if counts:
+        table = np.array([[c.get(v, 0) for v in ("Yes", "No")] for c in counts.values()])
+        x = np.arange(len(counts))
+        ax.bar(x - 0.18, table[:, 0], width=0.36, label="Yes")
+        ax.bar(x + 0.18, table[:, 1], width=0.36, label="No")
+        ax.set_xticks(x)
+        ax.set_xticklabels(list(counts), rotation=45, ha="right")
+        ax.set_ylabel("Count")
+        ax.set_title("Response distribution by model")
+        ax.legend()
+    else:
+        ax.axis("off")
+
+    if with_humans:
+        _mae_bars(axes[2, 0], human_comparisons)
+
+        ax = axes[2, 1]
+        ax.axis("off")
+        models = human_comparisons.get("models", human_comparisons)
+        lines = ["Model-human correlations:", ""]
+        for name, record in models.items():
+            corr = record.get("correlation")
+            if corr is None:
+                continue
+            lines.append(f"{name}:")
+            lines.append(f"  correlation: {corr:.3f}")
+            if record.get("p_value") is not None:
+                lines.append(f"  p-value: {record['p_value']:.4f}")
+            if record.get("n_matched") is not None:
+                lines.append(f"  n matched: {record['n_matched']}")
+            lines.append("")
+        ax.text(0.05, 0.5, "\n".join(lines), fontsize=11, va="center",
+                family="monospace")
+        ax.set_title("Model-human correlations")
+
+        ax = axes[2, 2]
+        box_cols = [("gpt", "gpt_weighted_confidence"),
+                    ("gemini", "gemini_weighted_confidence"),
+                    ("claude", "claude_confidence")]
+        data, labels = [], []
+        for name, col in box_cols:
+            vals = df[col].dropna() if col in df.columns else []
+            if len(vals):
+                data.append(np.asarray(vals, float))
+                labels.append(name)
+        if data:
+            bp = ax.boxplot(data, tick_labels=labels, patch_artist=True)
+            for patch, color in zip(bp["boxes"], ["lightblue", "lightgreen", "lightcoral"]):
+                patch.set_facecolor(color)
+            ax.set_ylabel("Confidence")
+            ax.set_title("Confidence distributions")
+            ax.grid(axis="y", alpha=0.3)
+        else:
+            ax.axis("off")
+
+    fig.tight_layout()
+    return _save(fig, output_path)
+
+
+def mae_comparison_bar(human_comparisons: Dict, output_path: str) -> str:
+    """Standalone high-quality MAE comparison chart
+    (evaluate_closed_source_models.py:1832-1901)."""
+    fig, ax = plt.subplots(figsize=(10, 6))
+    _mae_bars(ax, human_comparisons, capsize=10)
+    baselines = human_comparisons.get("baselines", {})
+    if "always_50" in baselines and baselines["always_50"].get("mae") is not None:
+        ax.axhline(y=baselines["always_50"]["mae"], color="gray", linestyle="--",
+                   alpha=0.3)
+    return _save(fig, output_path)
+
+
 def correlation_heatmap(corr_matrix, labels: Sequence[str], title: str,
                         output_path: str) -> str:
     mat = np.asarray(corr_matrix, float)

@@ -169,3 +169,78 @@ def base_vs_instruct_table(family_records: Dict[str, Dict]) -> str:
         )
     lines += ["\\hline", "\\end{tabular}"]
     return "\n".join(lines)
+
+
+# unicode -> LaTeX replacements for the irrelevant-statement sampler
+# (data/generate_latex_statements.py:28-44)
+_STATEMENT_REPLACEMENTS = (
+    ("&", "\\&"), ("%", "\\%"), ("$", "\\$"), ("#", "\\#"), ("_", "\\_"),
+    ("°", "$^\\circ$"), ("−", "$-$"), ("×", "$\\times$"),
+    ("π", "$\\pi$"),
+    ("⁻¹⁹", "$^{-19}$"), ("⁻³⁴", "$^{-34}$"),
+    ("²³", "$^{23}$"), ("₂", "$_2$"),
+    ("²", "$^2$"), ("³", "$^3$"), ("–", "--"),
+)
+
+
+def escape_statement(statement: str) -> str:
+    for src, dst in _STATEMENT_REPLACEMENTS:
+        statement = statement.replace(src, dst)
+    return statement
+
+
+def irrelevant_statements_sample(statements, k: int = 50, seed: int = 42) -> str:
+    """Seeded random sample of irrelevant statements as a LaTeX enumerate
+    (data/generate_latex_statements.py: random.seed(42) + random.sample(·, 50),
+    same escaping rules) for the paper appendix."""
+    import random
+
+    rng = random.Random(seed)
+    selected = rng.sample(list(statements), k)
+    lines = ["\\begin{enumerate}"]
+    lines += [f"    \\item {escape_statement(s)}" for s in selected]
+    lines.append("\\end{enumerate}")
+    return "\n".join(lines)
+
+
+def power_analysis_table(report, alpha: float = 0.05,
+                         sample_size: int = None) -> str:
+    """LaTeX table for a `stats.power.power_report` result: per-model effect
+    size, required N at 80%/90% power, achieved power at the current N, and
+    the limiting-model recommendation."""
+    import math
+
+    def fmt_n(n):
+        return "$\\infty$" if math.isinf(n) else str(int(n))
+
+    lines = [
+        "\\begin{table}[htbp]", "\\centering",
+        "\\caption{Power analysis: required sample sizes "
+        f"($\\alpha={alpha}$, current $N={sample_size}$)}}",
+        "\\begin{tabular}{lrrrr}", "\\hline",
+        "Model & Cohen's $d$ & $N$ (80\\% power) & $N$ (90\\% power) "
+        "& Power at current $N$ \\\\", "\\hline",
+    ]
+    for name, analysis in report["models"].items():
+        n80 = analysis["sample_sizes"]["power_80"]["raw"]
+        n90 = analysis["sample_sizes"]["power_90"]["raw"]
+        power_pct = f"{100 * analysis['achieved_power']:.1f}\\%"
+        lines.append(
+            f"{_esc(str(name))} & {analysis['effect_size']:.3f} & {fmt_n(n80)} & "
+            f"{fmt_n(n90)} & {power_pct} \\\\"
+        )
+    rec = report["recommendation"]["power_80"]
+    if math.isinf(rec["raw"]):
+        footer = (
+            f"No finite $N$ achieves 80\\% power for every model "
+            f"(zero observed effect for: {_esc(str(rec['limiting_model']))})."
+        )
+    else:
+        footer = (
+            f"Recommended $N$ for 80\\% power across all models: {fmt_n(rec['raw'])} "
+            f"({fmt_n(rec['with_margin'])} with 50\\% margin; "
+            f"limiting model: {_esc(str(rec['limiting_model']))})."
+        )
+    lines += ["\\hline", "\\end{tabular}", "\\par\\smallskip " + footer,
+              "\\end{table}", ""]
+    return "\n".join(lines)

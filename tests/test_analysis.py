@@ -162,15 +162,57 @@ class TestClosedSourceEval:
         )
         assert len(gpt2.transport.calls) == 0
 
-        human_means = {q: 0.6 for q in questions}
+        human_means = {q: 0.4 + 0.05 * i for i, q in enumerate(questions)}
         comparisons = compare_with_human_data(df, human_means, human_std=0.167,
                                               n_bootstrap=500, seed=42)
         assert set(comparisons["mae"]) >= {"GPT", "Claude", "Gemini", "Equanimity", "Random", "Normal"}
+        assert comparisons["mae"]["Normal"]["human_std"] == pytest.approx(0.167)
+        # constant predictions here -> no correlation recorded for GPT; the
+        # random baseline varies, so its correlation fields are present
+        assert {"correlation", "p_value", "n_matched"} <= set(comparisons["mae"]["Random"])
         corr = calculate_correlations(df)
         paths = write_report(df, comparisons, corr, str(tmp_path / "out"))
         assert os.path.exists(paths["csv"])
         assert os.path.exists(paths["latex"])
         assert os.path.exists(paths["error_strip"])
+        assert os.path.exists(paths["dashboard"])
+        assert os.path.exists(paths["mae_comparison"])
+
+
+class TestStatementsSample:
+    def test_escaping_and_structure(self):
+        from llm_interpretation_replication_tpu.viz.latex import (
+            escape_statement,
+            irrelevant_statements_sample,
+        )
+
+        assert escape_statement("5% of $2 & #3_x") == "5\\% of \\$2 \\& \\#3\\_x"
+        assert escape_statement("90° × 10⁻¹⁹ π") == (
+            "90$^\\circ$ $\\times$ 10$^{-19}$ $\\pi$"
+        )
+        statements = [f"Fact number {i}." for i in range(100)]
+        tex = irrelevant_statements_sample(statements, k=10, seed=42)
+        lines = tex.splitlines()
+        assert lines[0] == "\\begin{enumerate}"
+        assert lines[-1] == "\\end{enumerate}"
+        assert sum(1 for l in lines if l.startswith("    \\item ")) == 10
+        # seeded: deterministic across calls
+        assert tex == irrelevant_statements_sample(statements, k=10, seed=42)
+
+    @pytest.mark.skipif(
+        not os.path.exists("/root/reference/data/irrelevant_statements_sample.tex"),
+        reason="reference mount not available",
+    )
+    def test_golden_vs_reference_sample(self):
+        from llm_interpretation_replication_tpu.config import irrelevant_statements
+        from llm_interpretation_replication_tpu.viz.latex import (
+            irrelevant_statements_sample,
+        )
+
+        with open("/root/reference/data/irrelevant_statements_sample.tex") as f:
+            golden = f.read()
+        ours = irrelevant_statements_sample(irrelevant_statements(), k=50, seed=42)
+        assert ours.strip() == golden.strip()
 
 
 class TestIrrelevantEval:

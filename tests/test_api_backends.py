@@ -248,6 +248,54 @@ class TestGeminiClient:
         assert len(out) == 10
 
 
+class TestBatchRepair:
+    def test_extract_text_from_response_string(self):
+        from llm_interpretation_replication_tpu.api_backends import (
+            extract_text_from_response_string,
+        )
+
+        raw = "candidates=[Candidate(content=Content(parts=[Part(text='85')]))]"
+        assert extract_text_from_response_string(raw) == "85"
+        assert extract_text_from_response_string("no text field here") == ""
+        # Python repr switches to double quotes around apostrophes; escaped
+        # quotes inside the literal must survive un-truncated
+        assert extract_text_from_response_string(
+            'Part(text="It\'s likely")') == "It's likely"
+        assert extract_text_from_response_string(
+            "Part(text='a \\'quoted\\' word')") == "a 'quoted' word"
+
+    def test_repair_batch_responses(self, tmp_path):
+        import json
+
+        from llm_interpretation_replication_tpu.api_backends import (
+            repair_batch_responses,
+        )
+
+        req = tmp_path / "requests.jsonl"
+        resp = tmp_path / "responses.jsonl"
+        out = tmp_path / "fixed.jsonl"
+        req.write_text(
+            "\n".join(json.dumps({"custom_id": f"q{i}", "request": {}}) for i in range(2)) + "\n"
+        )
+        # corrupted rows: the text field holds a stringified response object,
+        # custom_ids lost; third row has no matching request
+        def corrupt(text):
+            return {"response": {"candidates": [{"content": {"parts": [{
+                "text": f"Candidate(content=Content(parts=[Part(text='{text}')]))"
+            }]}}]}}
+
+        resp.write_text("\n".join(
+            json.dumps(r) for r in (corrupt("Yes"), corrupt("72"), {"response": {}})
+        ) + "\n")
+        n = repair_batch_responses(str(req), str(resp), str(out))
+        assert n == 3
+        rows = [json.loads(l) for l in out.read_text().splitlines()]
+        assert [r["custom_id"] for r in rows] == ["q0", "q1", "result_2"]
+        texts = [r["response"]["candidates"][0]["content"]["parts"][0]["text"]
+                 for r in rows]
+        assert texts == ["Yes", "72", ""]
+
+
 class TestEvaluators:
     def test_gpt_binary_relative_prob(self):
         ft = FakeTransport()

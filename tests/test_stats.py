@@ -294,3 +294,46 @@ class TestPower:
         # d=0.5 at n=32 should give ~80% power
         p = simulated_power(0.05, 0.1, 32, n_simulations=4000, seed=42)
         assert 0.74 <= p <= 0.86
+
+    def test_power_report(self, tmp_path):
+        from llm_interpretation_replication_tpu.stats import power_report
+
+        # the reference's pilot numbers (power_analysis.py:103-132)
+        models = {
+            "GPT": {"mae": 0.205, "mae_std": 0.126, "mae_diff": 0.032,
+                    "ci_lower": -0.017, "ci_upper": 0.082},
+            "Claude": {"mae": 0.232, "mae_std": 0.129, "mae_diff": 0.059,
+                       "ci_lower": 0.008, "ci_upper": 0.109},
+        }
+        tex = tmp_path / "power_analysis_report.tex"
+        report = power_report(models, baseline_mae=0.180, sample_size=50,
+                              n_simulations=2000, output_tex=str(tex))
+        # GPT has the smaller effect -> it is the limiting model
+        assert report["recommendation"]["power_80"]["limiting_model"] == "GPT"
+        assert (report["models"]["GPT"]["sample_sizes"]["power_80"]["raw"]
+                > report["models"]["Claude"]["sample_sizes"]["power_80"]["raw"])
+        # Claude's CI excludes zero, GPT's doesn't
+        assert report["models"]["Claude"]["significant"]
+        assert not report["models"]["GPT"]["significant"]
+        # achieved power at N=50 is low for GPT (underpowered pilot)
+        assert report["models"]["GPT"]["achieved_power"] < 0.6
+        content = tex.read_text()
+        assert "\\begin{tabular}" in content and "GPT" in content
+
+    def test_power_report_zero_effect_limits(self, tmp_path):
+        from llm_interpretation_replication_tpu.stats import power_report
+
+        models = {
+            "Flat": {"mae": 0.2, "mae_std": 0.1, "mae_diff": 0.0,
+                     "ci_lower": -0.05, "ci_upper": 0.05},
+            "Real": {"mae": 0.25, "mae_std": 0.1, "mae_diff": 0.05,
+                     "ci_lower": 0.01, "ci_upper": 0.09},
+        }
+        tex = tmp_path / "report.tex"
+        report = power_report(models, baseline_mae=0.18, sample_size=50,
+                              n_simulations=500, output_tex=str(tex))
+        # the unpowerable model must surface as the limiting factor, not be
+        # silently dropped
+        rec = report["recommendation"]["power_80"]
+        assert rec["raw"] == np.inf and rec["limiting_model"] == "Flat"
+        assert "No finite $N$" in tex.read_text()
