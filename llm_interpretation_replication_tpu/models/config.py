@@ -2,9 +2,10 @@
 
 One ``DecoderConfig`` parameterizes every decoder-only family the reference
 sweeps (SURVEY.md §2.2 model rosters): GPT-NeoX (StableLM-alpha, RedPajama-
-INCITE, Pythia, Dolly-v2, h2ogpt), Falcon, BLOOM(Z), Mistral, LLaMA-2 (also
-covers Baichuan2-7B and Qwen-7B modulo flags), and OPT (opt-iml).  T5-style
-encoder-decoders (T0, tk-instruct, Flan-T5) use ``T5Config``.
+INCITE, Pythia, Dolly-v2, h2ogpt), Falcon, BLOOM(Z), Mistral, LLaMA-2, Qwen
+(v1 fused-c_attn and v2), Baichuan(2) (fused W_pack, NormHead, 13B ALiBi), and
+OPT (opt-iml).  T5-style encoder-decoders (T0, tk-instruct, Flan-T5) use
+``T5Config``.
 
 The reference loads these via HF ``AutoModelForCausalLM`` with
 ``device_map="auto"`` + bitsandbytes int8 (run_base_vs_instruct_100q.py:414-451);
@@ -54,6 +55,10 @@ class DecoderConfig:
     activation: str = "gelu"          # "gelu" | "gelu_new" | "silu" | "relu"
 
     sliding_window: Optional[int] = None  # Mistral local attention window
+    # Baichuan2 NormHead: lm_head rows are L2-normalized at inference.  Weights
+    # are static at inference, so conversion bakes the normalization into the
+    # checkpoint (convert_baichuan) instead of normalizing per forward.
+    norm_head: bool = False
     tie_word_embeddings: bool = False
     final_norm: bool = True
     logit_scale: float = 1.0
@@ -202,6 +207,78 @@ def llama_config(hf) -> DecoderConfig:
     )
 
 
+def qwen_config(hf) -> DecoderConfig:
+    """Qwen-7B(-Chat) first generation (``model_type: "qwen"``, the
+    trust_remote_code arch the reference loads — compare_instruct_models.py:159,
+    compare_base_vs_instruct.py roster).  LLaMA-style RMSNorm+RoPE+SwiGLU block
+    with three quirks: the HF config's ``intermediate_size`` is TWICE the MLP
+    width (modeling_qwen splits it across the w1/w2 pair), QKV carries a bias
+    while every other projection has none, and the word embeddings are untied.
+    """
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        head_dim=getattr(hf, "kv_channels", None) or hf.hidden_size // hf.num_attention_heads,
+        intermediate_size=hf.intermediate_size // 2,
+        position_embedding="rotary",
+        rope_theta=getattr(hf, "rotary_emb_base", 10000.0),
+        rotary_pct=getattr(hf, "rotary_pct", 1.0),
+        max_position_embeddings=getattr(hf, "seq_length", 8192),
+        norm_type="rmsnorm",
+        norm_eps=hf.layer_norm_epsilon,
+        qkv_bias=True,
+        out_bias=False,
+        mlp_bias=False,
+        fused_qkv=True,
+        mlp_type="gated",
+        activation="silu",
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+    )
+
+
+def qwen2_config(hf) -> DecoderConfig:
+    """Qwen2/Qwen1.5: llama-shaped but QKV bias is hardwired on in the HF
+    model (no ``attention_bias`` config attr).  Checkpoints ship a
+    ``sliding_window`` value alongside ``use_sliding_window: false``; the
+    window only applies when the latter is set."""
+    cfg = dataclasses.replace(llama_config(hf), qkv_bias=True, out_bias=False)
+    if not getattr(hf, "use_sliding_window", False):
+        cfg = dataclasses.replace(cfg, sliding_window=None)
+    return cfg
+
+
+def baichuan_config(hf) -> DecoderConfig:
+    """Baichuan(2)-7B/13B-Chat (``model_type: "baichuan"``,
+    compare_instruct_models.py:146 roster; slow-tokenizer special case
+    ibid.:422-428).  LLaMA block with a fused ``W_pack`` QKV projection.
+    Size variants differ in position encoding — 7B (32 layers) is rotary,
+    13B (40 layers) is ALiBi — and Baichuan2 checkpoints (vocab 125,696 vs
+    Baichuan1's 64,000) add the NormHead output projection."""
+    is_13b = hf.num_hidden_layers >= 40
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        intermediate_size=hf.intermediate_size,
+        position_embedding="alibi" if is_13b else "rotary",
+        max_position_embeddings=getattr(hf, "max_position_embeddings", None)
+        or getattr(hf, "model_max_length", 4096),
+        norm_type="rmsnorm",
+        norm_eps=hf.rms_norm_eps,
+        qkv_bias=False,
+        out_bias=False,
+        mlp_bias=False,
+        fused_qkv=True,
+        mlp_type="gated",
+        activation="silu",
+        norm_head=hf.vocab_size > 100_000,  # Baichuan2
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+    )
+
+
 def opt_config(hf) -> DecoderConfig:
     return DecoderConfig(
         vocab_size=hf.vocab_size,
@@ -260,8 +337,9 @@ FAMILY_BY_MODEL_TYPE = {
     "bloom": ("bloom", bloom_config),
     "llama": ("llama", llama_config),
     "mistral": ("llama", llama_config),
-    "qwen2": ("llama", llama_config),
-    "baichuan": ("llama", llama_config),
+    "qwen": ("qwen", qwen_config),
+    "qwen2": ("llama", qwen2_config),
+    "baichuan": ("baichuan", baichuan_config),
     "opt": ("opt", opt_config),
     "t5": ("t5", t5_config),
 }

@@ -250,6 +250,80 @@ def convert_llama(get: Getter, cfg: DecoderConfig) -> Dict:
     return params
 
 
+def _split_concat_qkv(w: np.ndarray, b=None):
+    """Straight-concat fused QKV (Qwen1 ``c_attn``, Baichuan ``W_pack``):
+    rows are [q(all heads); k; v] with NO per-head interleaving.
+    w: [3*N*D, H] -> (wq, wk, wv) each [H, N*D]."""
+    wq, wk, wv = (np.ascontiguousarray(part.T) for part in np.split(w, 3, axis=0))
+    if b is None:
+        return (wq, wk, wv), (None, None, None)
+    return (wq, wk, wv), tuple(np.split(b, 3))
+
+
+def convert_qwen(get: Getter, cfg: DecoderConfig) -> Dict:
+    """Qwen-7B first generation (modeling_qwen naming: transformer.h.{i} with
+    ln_1/ln_2, fused attn.c_attn, and the w1/w2/c_proj gated MLP where SiLU is
+    applied to the *w2* branch — so w2 is our gate and w1 our up projection)."""
+    L = range(cfg.num_layers)
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in L:
+        (wq, wk, wv), (bq, bk, bv) = _split_concat_qkv(
+            get(f"transformer.h.{i}.attn.c_attn.weight"),
+            get(f"transformer.h.{i}.attn.c_attn.bias"),
+        )
+        qs.append(wq); ks.append(wk); vs.append(wv)
+        bqs.append(bq); bks.append(bk); bvs.append(bv)
+    params = {
+        "embed": {"tokens": get("transformer.wte.weight")},
+        "layers": {
+            "ln1": _ln(get, "transformer.h.{i}.ln_1", L, bias=False),
+            "ln2": _ln(get, "transformer.h.{i}.ln_2", L, bias=False),
+            "attn": {
+                "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+                "bq": _stack(bqs), "bk": _stack(bks), "bv": _stack(bvs),
+                "wo": _stack([_linear(get, f"transformer.h.{i}.attn.c_proj") for i in L]),
+            },
+            "mlp": {
+                "wg": _stack([_linear(get, f"transformer.h.{i}.mlp.w2") for i in L]),
+                "wi": _stack([_linear(get, f"transformer.h.{i}.mlp.w1") for i in L]),
+                "wo": _stack([_linear(get, f"transformer.h.{i}.mlp.c_proj") for i in L]),
+            },
+        },
+        "final_ln": _ln(get, "transformer.ln_f", bias=False),
+    }
+    head = _maybe(get, "lm_head.weight")
+    if head is not None and not cfg.tie_word_embeddings:
+        params["lm_head"] = np.ascontiguousarray(head.T)
+    return params
+
+
+def convert_baichuan(get: Getter, cfg: DecoderConfig) -> Dict:
+    """Baichuan(2): llama naming except the fused ``self_attn.W_pack`` QKV,
+    so delegate to convert_llama through a getter that synthesizes the split
+    q/k/v projections.  For Baichuan2 (cfg.norm_head) the lm_head rows are
+    then L2-normalized — the torch model normalizes on every forward, but
+    inference weights are static so baking it into the checkpoint is exact."""
+    import re
+
+    def get_split(name: str) -> np.ndarray:
+        m = re.fullmatch(
+            r"model\.layers\.(\d+)\.self_attn\.([qkv])_proj\.weight", name
+        )
+        if m is None:
+            return get(name)
+        packed = get(f"model.layers.{m.group(1)}.self_attn.W_pack.weight")
+        return np.split(packed, 3, axis=0)["qkv".index(m.group(2))]
+
+    params = convert_llama(get_split, cfg)
+    if cfg.norm_head and "lm_head" in params:
+        # lm_head is stored transposed [H, V]: normalize each vocab column
+        head = params["lm_head"]
+        params["lm_head"] = head / np.maximum(
+            np.linalg.norm(head, axis=0, keepdims=True), 1e-12
+        )
+    return params
+
+
 def convert_opt(get: Getter, cfg: DecoderConfig) -> Dict:
     L = range(cfg.num_layers)
     pre = "model.decoder"
@@ -290,6 +364,8 @@ CONVERTERS = {
     "falcon": convert_falcon,
     "bloom": convert_bloom,
     "llama": convert_llama,
+    "qwen": convert_qwen,
+    "baichuan": convert_baichuan,
     "opt": convert_opt,
 }
 

@@ -258,3 +258,188 @@ def test_greedy_decode_ragged_batch_matches_unpadded(rng):
             params, cfg, jnp.asarray(row[None, :]), jnp.ones((1, len(row)), jnp.int32), num_steps=5
         )
         np.testing.assert_array_equal(np.asarray(btoks)[r], np.asarray(stoks)[0])
+
+
+def _qwen2_tiny(seed, tie=False):
+    from transformers import Qwen2Config, Qwen2ForCausalLM
+
+    hf_config = Qwen2Config(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=64,
+        max_position_embeddings=64, use_sliding_window=False,
+        sliding_window=None, tie_word_embeddings=tie,
+    )
+    torch.manual_seed(seed)
+    return hf_config, Qwen2ForCausalLM(hf_config).eval()
+
+
+def test_qwen2_sliding_window_ignored_when_disabled():
+    """Qwen2 checkpoints ship sliding_window alongside use_sliding_window:
+    false — the window must not leak into our config."""
+    from transformers import Qwen2Config
+
+    hf_config = Qwen2Config(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=64,
+        use_sliding_window=False, sliding_window=32768,
+    )
+    _, cfg = mcfg.from_hf_config(hf_config)
+    assert cfg.sliding_window is None and cfg.qkv_bias
+
+
+def test_qwen2_parity(rng):
+    """Qwen2/Qwen1.5 (the reference's Qwen-7B-Chat leg on modern checkpoints):
+    llama-shaped with hardwired QKV bias."""
+    hf_config, model = _qwen2_tiny(9)
+    ids, mask = _batch(rng)
+    _assert_close(
+        _ours_logits("llama", hf_config, model.state_dict(), ids, mask),
+        _hf_logits(model, ids, mask),
+        mask,
+    )
+
+
+def test_qwen1_parity(rng):
+    """Qwen-7B-Chat first generation (model_type "qwen", trust_remote_code —
+    compare_instruct_models.py:159).  Its arch is computationally identical to
+    Qwen2 at MHA/full-rotary settings, so a tiny Qwen2 is the torch oracle:
+    we re-key its state dict into the Qwen1 layout (fused c_attn; the w1/w2
+    MLP pair where SiLU acts on w2) and require identical logits through our
+    "qwen" converter."""
+    import types
+
+    hf_config, model = _qwen2_tiny(10)
+    sd = model.state_dict()
+    qwen1_sd = {
+        "transformer.wte.weight": sd["model.embed_tokens.weight"],
+        "transformer.ln_f.weight": sd["model.norm.weight"],
+        "lm_head.weight": sd["lm_head.weight"],
+    }
+    for i in range(hf_config.num_hidden_layers):
+        src = f"model.layers.{i}"
+        dst = f"transformer.h.{i}"
+        qwen1_sd[f"{dst}.ln_1.weight"] = sd[f"{src}.input_layernorm.weight"]
+        qwen1_sd[f"{dst}.ln_2.weight"] = sd[f"{src}.post_attention_layernorm.weight"]
+        qwen1_sd[f"{dst}.attn.c_attn.weight"] = torch.cat(
+            [sd[f"{src}.self_attn.{p}.weight"] for p in ("q_proj", "k_proj", "v_proj")]
+        )
+        qwen1_sd[f"{dst}.attn.c_attn.bias"] = torch.cat(
+            [sd[f"{src}.self_attn.{p}.bias"] for p in ("q_proj", "k_proj", "v_proj")]
+        )
+        qwen1_sd[f"{dst}.attn.c_proj.weight"] = sd[f"{src}.self_attn.o_proj.weight"]
+        qwen1_sd[f"{dst}.mlp.w2.weight"] = sd[f"{src}.mlp.gate_proj.weight"]
+        qwen1_sd[f"{dst}.mlp.w1.weight"] = sd[f"{src}.mlp.up_proj.weight"]
+        qwen1_sd[f"{dst}.mlp.c_proj.weight"] = sd[f"{src}.mlp.down_proj.weight"]
+
+    qwen1_config = types.SimpleNamespace(
+        model_type="qwen", vocab_size=VOCAB, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, kv_channels=8,
+        intermediate_size=2 * 64,  # Qwen1 configs store DOUBLE the MLP width
+        rotary_emb_base=getattr(hf_config, "rope_theta", 10000.0),
+        rotary_pct=1.0, seq_length=64, layer_norm_epsilon=hf_config.rms_norm_eps,
+        tie_word_embeddings=False,
+    )
+    ids, mask = _batch(rng)
+    _assert_close(
+        _ours_logits("qwen", qwen1_config, qwen1_sd, ids, mask),
+        _hf_logits(model, ids, mask),
+        mask,
+    )
+
+
+def _baichuan_from_llama(seed, norm_head):
+    """Tiny llama oracle re-keyed into the Baichuan layout (fused W_pack)."""
+    from transformers import LlamaConfig, LlamaForCausalLM
+
+    hf_config = LlamaConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, num_key_value_heads=4, intermediate_size=64,
+        max_position_embeddings=64, tie_word_embeddings=False,
+        rms_norm_eps=1e-6,
+    )
+    torch.manual_seed(seed)
+    model = LlamaForCausalLM(hf_config).eval()
+    if norm_head:
+        # bake the NormHead into the ORACLE: Baichuan2 normalizes lm_head rows
+        # every forward, so an oracle with pre-normalized rows is the target
+        with torch.no_grad():
+            w = model.lm_head.weight
+            model.lm_head.weight.copy_(torch.nn.functional.normalize(w))
+    sd = model.state_dict()
+    bc_sd = {
+        "model.embed_tokens.weight": sd["model.embed_tokens.weight"],
+        "model.norm.weight": sd["model.norm.weight"],
+    }
+    if norm_head:
+        # our converter receives UN-normalized rows (scaled arbitrarily) and
+        # must normalize them itself
+        torch.manual_seed(seed + 100)
+        scale = 0.5 + torch.rand(VOCAB, 1)
+        bc_sd["lm_head.weight"] = sd["lm_head.weight"] * scale
+    else:
+        bc_sd["lm_head.weight"] = sd["lm_head.weight"]
+    for i in range(hf_config.num_hidden_layers):
+        pre = f"model.layers.{i}"
+        bc_sd[f"{pre}.input_layernorm.weight"] = sd[f"{pre}.input_layernorm.weight"]
+        bc_sd[f"{pre}.post_attention_layernorm.weight"] = sd[f"{pre}.post_attention_layernorm.weight"]
+        bc_sd[f"{pre}.self_attn.W_pack.weight"] = torch.cat(
+            [sd[f"{pre}.self_attn.{p}.weight"] for p in ("q_proj", "k_proj", "v_proj")]
+        )
+        bc_sd[f"{pre}.self_attn.o_proj.weight"] = sd[f"{pre}.self_attn.o_proj.weight"]
+        for p in ("gate_proj", "up_proj", "down_proj"):
+            bc_sd[f"{pre}.mlp.{p}.weight"] = sd[f"{pre}.mlp.{p}.weight"]
+    return hf_config, model, bc_sd
+
+
+def test_baichuan_7b_parity(rng):
+    """Baichuan-7B layout (W_pack fused QKV, rotary, no NormHead)."""
+    import types
+
+    hf_config, model, bc_sd = _baichuan_from_llama(11, norm_head=False)
+    bc_config = types.SimpleNamespace(
+        model_type="baichuan", vocab_size=VOCAB, hidden_size=32,
+        num_hidden_layers=2, num_attention_heads=4, intermediate_size=64,
+        max_position_embeddings=64, rms_norm_eps=1e-6,
+        tie_word_embeddings=False,
+    )
+    fam, cfg = mcfg.from_hf_config(bc_config)
+    assert fam == "baichuan"
+    assert cfg.position_embedding == "rotary" and not cfg.norm_head
+    ids, mask = _batch(rng)
+    _assert_close(
+        _ours_logits("baichuan", bc_config, bc_sd, ids, mask),
+        _hf_logits(model, ids, mask),
+        mask,
+    )
+
+
+def test_baichuan2_norm_head_parity(rng):
+    """Baichuan2 NormHead: the converter L2-normalizes lm_head rows, so
+    arbitrary row scaling of the stored head must not change logits."""
+    import dataclasses
+
+    hf_config, model, bc_sd = _baichuan_from_llama(12, norm_head=True)
+    fam_cfg = mcfg.llama_config(hf_config)
+    cfg = dataclasses.replace(fam_cfg, fused_qkv=True, norm_head=True)
+    get = mconvert.getter_from_torch_state_dict(bc_sd)
+    params = mconvert.convert("baichuan", get, cfg, dtype=jnp.float32)
+    ids, mask = _batch(rng)
+    ours = np.asarray(decoder.forward(
+        params, cfg, jnp.asarray(ids), jnp.asarray(mask)
+    ))
+    _assert_close(ours, _hf_logits(model, ids, mask), mask)
+
+
+def test_baichuan_13b_config_translation():
+    """13B geometry (40 layers) -> ALiBi; Baichuan2 vocab (125,696) -> NormHead."""
+    import types
+
+    b2_13b = types.SimpleNamespace(
+        model_type="baichuan", vocab_size=125_696, hidden_size=5120,
+        num_hidden_layers=40, num_attention_heads=40, intermediate_size=13696,
+        model_max_length=4096, rms_norm_eps=1e-6, tie_word_embeddings=False,
+    )
+    fam, cfg = mcfg.from_hf_config(b2_13b)
+    assert fam == "baichuan"
+    assert cfg.position_embedding == "alibi"
+    assert cfg.norm_head and cfg.max_position_embeddings == 4096
