@@ -98,9 +98,24 @@ def _bf16_to_f32(raw: np.ndarray) -> np.ndarray:
 
 
 def load_hf_config(path: str):
-    from transformers import AutoConfig
+    """Read a snapshot's ``config.json`` WITHOUT executing repo code.
 
-    return AutoConfig.from_pretrained(path, trust_remote_code=False, local_files_only=True)
+    Families that only exist as remote code (Qwen v1, Baichuan) make
+    ``AutoConfig(trust_remote_code=False)`` raise and
+    ``trust_remote_code=True`` would execute arbitrary repo code just to
+    build a config object.  ``models.config.from_hf_config`` reads plain
+    attributes only, so a namespace over the raw JSON serves every family.
+    """
+    import types
+
+    with open(os.path.join(path, "config.json")) as f:
+        raw = json.load(f)
+    # T5 checkpoints store only feed_forward_proj; HF derives these two
+    proj = raw.get("feed_forward_proj")
+    if proj and "dense_act_fn" not in raw:
+        raw["dense_act_fn"] = proj.replace("gated-", "")
+        raw["is_gated_act"] = proj.startswith("gated-")
+    return types.SimpleNamespace(**raw)
 
 
 def load_model(
@@ -188,10 +203,38 @@ def _cast(tree, dtype, key=""):
     return jnp.asarray(tree, dtype=_target_dtype(key, tree, dtype))
 
 
-def load_tokenizer(path: str):
+#: families whose tokenizers only exist as repo code (the reference passes
+#: trust_remote_code=True everywhere — compare_instruct_models.py:404-428)
+_REMOTE_CODE_TOKENIZER_TYPES = {"qwen", "baichuan", "chatglm", "xgen"}
+
+
+def load_tokenizer(path: str, trust_remote_code: bool = False):
+    """Family quirks are keyed off the snapshot's ``model_type`` (never the
+    filesystem path): Baichuan ships a broken fast tokenizer, so it gets the
+    slow one (the reference's special case — compare_instruct_models.py:
+    422-428), and Qwen v1/Baichuan tokenizers only exist as remote code."""
     from transformers import AutoTokenizer
 
-    tok = AutoTokenizer.from_pretrained(path, local_files_only=True, use_fast=True)
+    model_type = ""
+    try:
+        model_type = getattr(load_hf_config(path), "model_type", "") or ""
+    except (OSError, ValueError):
+        pass  # tokenizer-only directory: no family quirks to apply
+    use_fast = model_type != "baichuan"
+    if model_type in _REMOTE_CODE_TOKENIZER_TYPES:
+        trust_remote_code = True
+    tok = AutoTokenizer.from_pretrained(
+        path, local_files_only=True, use_fast=use_fast,
+        trust_remote_code=trust_remote_code,
+    )
     if tok.pad_token_id is None:
-        tok.pad_token = tok.eos_token
+        if tok.eos_token is not None:
+            # pad positions are attention-masked, so any in-vocab id works
+            tok.pad_token = tok.eos_token
+        elif "<|endoftext|>" in tok.get_vocab():  # Qwen v1: no eos attr
+            tok.pad_token = "<|endoftext|>"
+        else:
+            # last resort: a registered special token (stays in-vocab for
+            # embedding lookups, unlike assigning a raw unknown string)
+            tok.add_special_tokens({"pad_token": "<|pad|>"})
     return tok

@@ -156,6 +156,39 @@ class TestScoringEngine:
 
 
 class TestLoader:
+    def test_remote_code_family_config_loads_without_code(self, tmp_path):
+        """Qwen v1 / Baichuan configs must load from raw config.json — their
+        model_types are unknown to transformers, so AutoConfig would either
+        raise or demand trust_remote_code (executing repo code)."""
+        import json
+
+        from llm_interpretation_replication_tpu.models.config import from_hf_config
+        from llm_interpretation_replication_tpu.runtime.loader import load_hf_config
+
+        snap = tmp_path / "qwen"
+        snap.mkdir()
+        (snap / "config.json").write_text(json.dumps({
+            "model_type": "qwen", "vocab_size": 151936, "hidden_size": 4096,
+            "num_hidden_layers": 32, "num_attention_heads": 32,
+            "kv_channels": 128, "intermediate_size": 22016,
+            "seq_length": 8192, "layer_norm_epsilon": 1e-6,
+            "tie_word_embeddings": False,
+        }))
+        fam, cfg = from_hf_config(load_hf_config(str(snap)))
+        assert fam == "qwen" and cfg.intermediate_size == 11008
+
+        # T5 snapshots carry only feed_forward_proj; the derived
+        # dense_act_fn / is_gated_act attrs must be synthesized
+        (snap / "config.json").write_text(json.dumps({
+            "model_type": "t5", "vocab_size": 32128, "d_model": 512,
+            "num_layers": 8, "num_decoder_layers": 8, "num_heads": 6,
+            "d_kv": 64, "d_ff": 1024, "relative_attention_num_buckets": 32,
+            "layer_norm_epsilon": 1e-6, "feed_forward_proj": "gated-gelu",
+            "decoder_start_token_id": 0, "tie_word_embeddings": False,
+        }))
+        fam, cfg = from_hf_config(load_hf_config(str(snap)))
+        assert fam == "t5" and cfg.feed_forward_proj == "gated-gelu"
+
     def test_load_from_saved_snapshot(self, tmp_path):
         torch = pytest.importorskip("torch")
         from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
