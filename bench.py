@@ -18,11 +18,13 @@ a conservative A100 baseline, so vs_baseline = prompts_per_sec / 1.0.
 Default configuration (measured on TPU v5e, 2026-07): w8a8 int8 projections
 (the reference's own path is bitsandbytes int8; ours keeps 0.9997 logit
 correlation vs bf16 — see ops/quant.py and tests/test_ops.py) at batch 192
-with the engine's 448-token length bucket (430-token prompts pad to 448, not
-512 — runtime/batching.DEFAULT_BUCKETS), where the v5e int8 MXU path runs
-~2.3x the bf16 ceiling: 37.7 prompts/sec (31.5 int8 and 16.5 bf16 at the old
-batch-128/512 config — reproduce those with ``--batch 128 --seq 512
-[--quant none]``).
+with the engine's 432-token length bucket (430-token prompts pad to 432 —
+runtime/batching.DEFAULT_BUCKETS), where the v5e int8 MXU path runs ~2.3x
+the bf16 ceiling: 38.2 prompts/sec (37.7 at the previous 448 bucket; 31.5
+int8 and 16.5 bf16 at the old batch-128/512 config — reproduce with
+``--batch 128 --seq 512 [--quant none]``).  Batch 224+ OOMs 16 GB HBM;
+``--attn flash`` (the grouped Pallas kernel) measures 33.3 here — see
+ops/attention.py for why XLA dense attention wins at sweep shapes.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -139,13 +141,17 @@ def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", choices=["falcon-7b", "small-1b"], default="falcon-7b")
     parser.add_argument("--batch", type=int, default=192)
-    parser.add_argument("--seq", type=int, default=448)
+    parser.add_argument("--seq", type=int, default=432)
     parser.add_argument("--iters", type=int, default=16)
     parser.add_argument("--prompt-tokens", type=int, default=430)
     parser.add_argument("--quant", choices=["none", "int8"], default="int8",
                         help="w8a8 int8 projections (the reference path is "
                              "bitsandbytes int8, so int8-vs-int8 is the fair "
                              "comparison; ~0.9997 logit correlation vs bf16)")
+    parser.add_argument("--attn", choices=["xla", "flash"], default="xla",
+                        help="attention impl: XLA dense (the DecoderConfig "
+                             "'xla' value) or the Pallas kernels "
+                             "(ops/attention.py)")
     args = parser.parse_args()
 
     import jax
@@ -156,7 +162,7 @@ def main():
     from llm_interpretation_replication_tpu.scoring.yes_no import relative_prob_first_token
 
     geometry = FALCON_7B if args.model == "falcon-7b" else SMALL_1B
-    cfg = DecoderConfig(**geometry)
+    cfg = DecoderConfig(**geometry, attention_impl=args.attn)
     dtype = jnp.bfloat16
 
     use_quant = args.quant == "int8"

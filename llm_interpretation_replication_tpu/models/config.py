@@ -152,7 +152,7 @@ def falcon_config(hf) -> DecoderConfig:
         max_position_embeddings=getattr(hf, "max_position_embeddings", 2048),
         parallel_residual=getattr(hf, "parallel_attn", True),
         shared_layernorm=getattr(hf, "parallel_attn", True) and not new_arch,
-        norm_eps=hf.layer_norm_epsilon,
+        norm_eps=getattr(hf, "layer_norm_epsilon", 1e-5),
         qkv_bias=getattr(hf, "bias", False),
         out_bias=getattr(hf, "bias", False),
         mlp_bias=getattr(hf, "bias", False),

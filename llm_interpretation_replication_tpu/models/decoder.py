@@ -219,18 +219,20 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
         new_cache = (ck, cv)
     else:
         new_cache = None
-    k = _repeat_kv(k, n // nkv)
-    v = _repeat_kv(v, n // nkv)
     if flash_lengths is not None and cache_kv is None:
         from ..ops.attention import attention as fused_attention
 
-        # dispatcher: Pallas kernel on TPU, equivalent dense path elsewhere
+        # dispatcher: Pallas kernel on TPU, equivalent dense path elsewhere.
+        # K/V go in UNREPEATED ([B, G, S, D]) — the grouped kernel reads each
+        # group's K/V once from VMEM instead of materializing N copies.
         out = fused_attention(
             jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
             flash_lengths, causal=True,
         )
         out = jnp.swapaxes(out, 1, 2)
     else:
+        k = _repeat_kv(k, n // nkv)
+        v = _repeat_kv(v, n // nkv)
         out = dot_product_attention(q, k, v, bias)
     out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
     if "bo" in ap:

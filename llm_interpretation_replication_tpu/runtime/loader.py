@@ -115,6 +115,19 @@ def load_hf_config(path: str):
     if proj and "dense_act_fn" not in raw:
         raw["dense_act_fn"] = proj.replace("gated-", "")
         raw["is_gated_act"] = proj.startswith("gated-")
+    # Legacy-key aliases AutoConfig normally applies via attribute_map —
+    # original Falcon snapshots (model_type 'RefinedWeb'/'RefinedWebModel')
+    # and GPT-2-lineage configs use the short names.
+    for legacy, canonical in (
+        ("n_layer", "num_hidden_layers"),
+        ("n_head", "num_attention_heads"),
+        ("n_head_kv", "num_kv_heads"),
+        ("n_embed", "hidden_size"),
+        ("n_embd", "hidden_size"),
+        ("n_positions", "max_position_embeddings"),
+    ):
+        if legacy in raw and canonical not in raw:
+            raw[canonical] = raw[legacy]
     return types.SimpleNamespace(**raw)
 
 
@@ -233,8 +246,13 @@ def load_tokenizer(path: str, trust_remote_code: bool = False):
             tok.pad_token = tok.eos_token
         elif "<|endoftext|>" in tok.get_vocab():  # Qwen v1: no eos attr
             tok.pad_token = "<|endoftext|>"
+        elif tok.unk_token is not None:
+            tok.pad_token = tok.unk_token
         else:
-            # last resort: a registered special token (stays in-vocab for
-            # embedding lookups, unlike assigning a raw unknown string)
-            tok.add_special_tokens({"pad_token": "<|pad|>"})
+            # last resort: reuse an existing in-vocab token.  Minting a new
+            # special token would get id == len(vocab) — out of range for the
+            # checkpoint's embedding table (pad positions are masked, but
+            # consumers that bounds-check ids against cfg.vocab_size break).
+            vocab = tok.get_vocab()
+            tok.pad_token = min(vocab, key=vocab.get)
     return tok

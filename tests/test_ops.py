@@ -8,7 +8,9 @@ import jax.numpy as jnp
 
 from llm_interpretation_replication_tpu.ops.attention import (
     _dense_attention,
+    attention,
     flash_attention,
+    grouped_attention,
 )
 
 
@@ -109,6 +111,81 @@ def test_decoder_flash_config_matches_xla():
     ids = rng.integers(3, 128, size=(2, 12)).astype(np.int32)
     mask = np.ones_like(ids)
     mask[1, 9:] = 0
+    base = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    flash_cfg = dataclasses.replace(cfg, attention_impl="flash")
+    flashed = decoder.forward(params, flash_cfg, jnp.asarray(ids), jnp.asarray(mask))
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(flashed)[valid], np.asarray(base)[valid], atol=2e-4, rtol=1e-4
+    )
+
+
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("n_heads,n_kv", [(6, 1), (8, 4), (4, 4)])
+def test_grouped_matches_dense(causal, n_heads, n_kv):
+    """Grouped single-pass kernel (heads flattened into the row axis, K/V
+    unrepeated) vs dense attention with repeated K/V.  block_rows=32 with
+    S=48 forces row blocks that straddle head boundaries AND pad the tail."""
+    rng = np.random.default_rng(4)
+    B, S, D = 2, 48, 16
+    q = rng.standard_normal((B, n_heads, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, n_kv, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, n_kv, S, D)).astype(np.float32)
+    lengths = np.array([S, S - 17], np.int32)
+    out = grouped_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), lengths,
+        causal=causal, block_rows=32, interpret=True,
+    )
+    reps = n_heads // n_kv
+    expected = _dense_attention(
+        jnp.asarray(q),
+        jnp.asarray(np.repeat(k, reps, axis=1)),
+        jnp.asarray(np.repeat(v, reps, axis=1)),
+        jnp.asarray(lengths), causal,
+    )
+    valid = (np.arange(S)[None, :] < lengths[:, None])[:, None, :, None]
+    np.testing.assert_allclose(
+        np.asarray(out) * valid, np.asarray(expected) * valid, atol=2e-5, rtol=1e-4
+    )
+
+
+def test_attention_dispatch_accepts_grouped_kv():
+    """The dispatcher takes unrepeated [B, G, S, D] K/V on every backend; on
+    the dense path it must repeat to full heads itself."""
+    rng = np.random.default_rng(5)
+    B, N, G, S, D = 2, 8, 2, 32, 8
+    q = jnp.asarray(rng.standard_normal((B, N, S, D)).astype(np.float32))
+    k = rng.standard_normal((B, G, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, G, S, D)).astype(np.float32)
+    lengths = jnp.asarray([S, S - 5], jnp.int32)
+    got = attention(q, jnp.asarray(k), jnp.asarray(v), lengths, causal=True)
+    expected = _dense_attention(
+        q, jnp.asarray(np.repeat(k, N // G, axis=1)),
+        jnp.asarray(np.repeat(v, N // G, axis=1)), lengths, True,
+    )
+    np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
+
+
+def test_decoder_flash_mqa_matches_xla():
+    """attention_impl='flash' on an MQA decoder (num_kv_heads=1) routes
+    unrepeated K/V through the dispatcher — outputs must match the XLA path."""
+    import dataclasses
+
+    from llm_interpretation_replication_tpu.models.config import DecoderConfig
+    from llm_interpretation_replication_tpu.models import decoder
+
+    from helpers import random_decoder_params
+
+    cfg = DecoderConfig(
+        vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=1, intermediate_size=64, position_embedding="rotary",
+        max_position_embeddings=64,
+    )
+    params = random_decoder_params(cfg, seed=3)
+    rng = np.random.default_rng(11)
+    ids = rng.integers(3, 96, size=(2, 14)).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[0, 10:] = 0
     base = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
     flash_cfg = dataclasses.replace(cfg, attention_impl="flash")
     flashed = decoder.forward(params, flash_cfg, jnp.asarray(ids), jnp.asarray(mask))
