@@ -4,8 +4,9 @@ One ``DecoderConfig`` parameterizes every decoder-only family the reference
 sweeps (SURVEY.md §2.2 model rosters): GPT-NeoX (StableLM-alpha, RedPajama-
 INCITE, Pythia, Dolly-v2, h2ogpt), Falcon, BLOOM(Z), Mistral, LLaMA-2, Qwen
 (v1 fused-c_attn and v2), Baichuan(2) (fused W_pack, NormHead, 13B ALiBi), and
-OPT (opt-iml).  T5-style encoder-decoders (T0, tk-instruct, Flan-T5) use
-``T5Config``.
+OPT (opt-iml) — plus the roster's commented-out alternates: GPT-J(T), MPT,
+GLM/ChatGLM2, and XGen (LLaMA-arch behind remote code).  T5-style encoder-
+decoders (T0, tk-instruct, Flan-T5) use ``T5Config``.
 
 The reference loads these via HF ``AutoModelForCausalLM`` with
 ``device_map="auto"`` + bitsandbytes int8 (run_base_vs_instruct_100q.py:414-451);
@@ -34,6 +35,12 @@ class DecoderConfig:
     # Position encoding: "rotary" | "alibi" | "learned"
     position_embedding: str = "rotary"
     rotary_pct: float = 1.0          # GPT-NeoX applies RoPE to a fraction of head_dim
+    # RoPE pairing convention over the rotated dims:
+    #   "half"        rotate-half, pair (i, i+rd/2) with freq i — LLaMA/NeoX/HF
+    #   "interleaved" pair (2i, 2i+1) with freq i — GPT-J, ChatGLM2
+    #   "glm"         rotate-half pairing with interleaved freq assignment
+    #                 (cos/sin repeat_interleave'd) — HF GLM-4
+    rotary_style: str = "half"
     rope_theta: float = 10000.0
     max_position_embeddings: int = 2048
     learned_pos_offset: int = 0      # OPT stores positions with a +2 offset
@@ -279,6 +286,133 @@ def baichuan_config(hf) -> DecoderConfig:
     )
 
 
+def gptj_config(hf) -> DecoderConfig:
+    """GPT-J-6B / GPT-JT-6B (``model_type: "gptj"`` — togethercomputer/GPT-JT
+    in the reference's commented word-meaning roster,
+    compare_instruct_models.py:162).  Parallel attn+mlp off ONE shared LN
+    (Falcon-style block), interleaved RoPE on ``rotary_dim`` dims, no
+    qkv/out biases but fc biases, untied lm_head WITH bias."""
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.n_embd,
+        num_layers=hf.n_layer,
+        num_heads=hf.n_head,
+        intermediate_size=getattr(hf, "n_inner", None) or 4 * hf.n_embd,
+        position_embedding="rotary",
+        rotary_pct=(hf.rotary_dim or hf.n_embd // hf.n_head)
+        / (hf.n_embd // hf.n_head),
+        rotary_style="interleaved",
+        max_position_embeddings=hf.n_positions,
+        parallel_residual=True,
+        shared_layernorm=True,
+        norm_eps=hf.layer_norm_epsilon,
+        qkv_bias=False,
+        out_bias=False,
+        mlp_bias=True,
+        activation=_act(getattr(hf, "activation_function", "gelu_new")),
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+    )
+
+
+def mpt_config(hf) -> DecoderConfig:
+    """MPT-7B(-Instruct) (``model_type: "mpt"`` — mosaicml/mpt-7b-instruct in
+    the reference's commented word-meaning roster,
+    compare_instruct_models.py:157).  ALiBi, fused Wqkv, and — with the
+    standard ``no_bias: true`` — no biases anywhere including LayerNorm."""
+    attn_cfg = getattr(hf, "attn_config", None)
+    alibi, kv_heads = True, None
+    if attn_cfg is not None:
+        _get = attn_cfg.get if isinstance(attn_cfg, dict) else (
+            lambda k, d=None: getattr(attn_cfg, k, d))
+        alibi = _get("alibi", True)
+        kv_heads = _get("kv_n_heads", None)
+    if not alibi:
+        # HF's MPT port itself has no learned-position path; neither do we.
+        raise ValueError("MPT without ALiBi (attn_config.alibi=false) is unsupported")
+    if kv_heads is not None and kv_heads != hf.n_heads:
+        raise ValueError("GQA MPT (attn_config.kv_n_heads) is unsupported")
+    no_bias = getattr(hf, "no_bias", True)
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.d_model,
+        num_layers=hf.n_layers,
+        num_heads=hf.n_heads,
+        intermediate_size=int(getattr(hf, "expansion_ratio", 4) * hf.d_model),
+        position_embedding="alibi",
+        max_position_embeddings=getattr(hf, "max_seq_len", 2048),
+        norm_eps=getattr(hf, "layer_norm_epsilon", 1e-5),
+        qkv_bias=not no_bias,
+        out_bias=not no_bias,
+        mlp_bias=not no_bias,
+        fused_qkv=True,
+        activation="gelu",
+        tie_word_embeddings=True,   # MPT always ties (no lm_head weight)
+    )
+
+
+def glm_config(hf) -> DecoderConfig:
+    """GLM-4 (``model_type: "glm"``, HF-native GlmForCausalLM) — the current
+    lineage of the ChatGLM family the reference's loader special-cases
+    (compare_instruct_models.py:416-421).  LLaMA-shaped block with GQA, a
+    partial GLM-convention RoPE, and QKV-only biases."""
+    head_dim = getattr(hf, "head_dim", None) or hf.hidden_size // hf.num_attention_heads
+    return DecoderConfig(
+        vocab_size=hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_hidden_layers,
+        num_heads=hf.num_attention_heads,
+        num_kv_heads=getattr(hf, "num_key_value_heads", hf.num_attention_heads),
+        head_dim=head_dim,
+        intermediate_size=hf.intermediate_size,
+        position_embedding="rotary",
+        rotary_pct=getattr(hf, "partial_rotary_factor", 0.5),
+        rotary_style="glm",
+        rope_theta=getattr(hf, "rope_theta", 10000.0),
+        max_position_embeddings=getattr(hf, "max_position_embeddings", 131072),
+        norm_type="rmsnorm",
+        norm_eps=hf.rms_norm_eps,
+        qkv_bias=getattr(hf, "attention_bias", True),
+        out_bias=False,
+        mlp_bias=False,
+        mlp_type="gated",
+        activation=_act(getattr(hf, "hidden_act", "silu")),
+        tie_word_embeddings=getattr(hf, "tie_word_embeddings", False),
+    )
+
+
+def chatglm_config(hf) -> DecoderConfig:
+    """ChatGLM2/3-6B (``model_type: "chatglm"``, the trust_remote_code arch in
+    the reference's roster — compare_instruct_models.py:165 (commented) and
+    its tokenizer special-case ibid.:416-421).  RMSNorm + SwiGLU + GQA
+    (``multi_query_group_num``) with interleaved RoPE on half the head dims.
+    No in-process HF oracle exists offline (remote-code only), so conversion
+    is structurally tested; the GLM-4 leg above is oracle-tested."""
+    return DecoderConfig(
+        vocab_size=getattr(hf, "padded_vocab_size", None) or hf.vocab_size,
+        hidden_size=hf.hidden_size,
+        num_layers=hf.num_layers,
+        num_heads=hf.num_attention_heads,
+        num_kv_heads=(getattr(hf, "multi_query_group_num", None)
+                      if getattr(hf, "multi_query_attention", False) else None),
+        head_dim=getattr(hf, "kv_channels", None),
+        intermediate_size=hf.ffn_hidden_size,
+        position_embedding="rotary",
+        rotary_pct=0.5,
+        rotary_style="interleaved",
+        rope_theta=10000.0 * getattr(hf, "rope_ratio", 1.0),
+        max_position_embeddings=getattr(hf, "seq_length", 32768),
+        norm_type="rmsnorm" if getattr(hf, "rmsnorm", True) else "layernorm",
+        norm_eps=getattr(hf, "layernorm_epsilon", 1e-5),
+        qkv_bias=getattr(hf, "add_qkv_bias", True),
+        out_bias=getattr(hf, "add_bias_linear", False),
+        mlp_bias=getattr(hf, "add_bias_linear", False),
+        fused_qkv=True,
+        mlp_type="gated",
+        activation="silu",
+        tie_word_embeddings=False,
+    )
+
+
 def opt_config(hf) -> DecoderConfig:
     return DecoderConfig(
         vocab_size=hf.vocab_size,
@@ -341,6 +475,13 @@ FAMILY_BY_MODEL_TYPE = {
     "qwen2": ("llama", qwen2_config),
     "baichuan": ("baichuan", baichuan_config),
     "opt": ("opt", opt_config),
+    "gptj": ("gptj", gptj_config),
+    "mpt": ("mpt", mpt_config),
+    "glm": ("glm", glm_config),
+    "chatglm": ("chatglm", chatglm_config),
+    # Salesforce XGen ships LLaMA-architecture weights behind remote code;
+    # only its tokenizer needs special handling (compare_instruct_models.py:409-415)
+    "xgen": ("llama", llama_config),
     "t5": ("t5", t5_config),
 }
 

@@ -359,6 +359,156 @@ def convert_opt(get: Getter, cfg: DecoderConfig) -> Dict:
     return params
 
 
+def convert_gptj(get: Getter, cfg: DecoderConfig) -> Dict:
+    """GPT-J/GPT-JT: separate unbiased q/k/v/out, biased fc_in/fc_out off one
+    shared LN (parallel block), untied lm_head with bias."""
+    L = range(cfg.num_layers)
+    params = {
+        "embed": {"tokens": get("transformer.wte.weight")},
+        "layers": {
+            "ln1": _ln(get, "transformer.h.{i}.ln_1", L),
+            "attn": {
+                "wq": _stack([_linear(get, f"transformer.h.{i}.attn.q_proj") for i in L]),
+                "wk": _stack([_linear(get, f"transformer.h.{i}.attn.k_proj") for i in L]),
+                "wv": _stack([_linear(get, f"transformer.h.{i}.attn.v_proj") for i in L]),
+                "wo": _stack([_linear(get, f"transformer.h.{i}.attn.out_proj") for i in L]),
+            },
+            "mlp": {
+                "wi": _stack([_linear(get, f"transformer.h.{i}.mlp.fc_in") for i in L]),
+                "bi": _stack([get(f"transformer.h.{i}.mlp.fc_in.bias") for i in L]),
+                "wo": _stack([_linear(get, f"transformer.h.{i}.mlp.fc_out") for i in L]),
+                "bo": _stack([get(f"transformer.h.{i}.mlp.fc_out.bias") for i in L]),
+            },
+        },
+        "final_ln": _ln(get, "transformer.ln_f"),
+        "lm_head": np.ascontiguousarray(get("lm_head.weight").T),
+        "lm_head_bias": get("lm_head.bias"),
+    }
+    return params
+
+
+def convert_mpt(get: Getter, cfg: DecoderConfig) -> Dict:
+    """MPT: fused straight-concat Wqkv; with the standard ``no_bias: true``
+    everything (incl. LN) is bias-free; tied embeddings (no lm_head tensor in
+    the checkpoint).  Non-ALiBi / GQA variants are rejected in mpt_config."""
+    L = range(cfg.num_layers)
+    biased = cfg.qkv_bias                    # no_bias=false checkpoints
+    qs, ks, vs, bqs, bks, bvs = [], [], [], [], [], []
+    for i in L:
+        (wq, wk, wv), (bq, bk, bv) = _split_concat_qkv(
+            get(f"transformer.blocks.{i}.attn.Wqkv.weight"),
+            get(f"transformer.blocks.{i}.attn.Wqkv.bias") if biased else None,
+        )
+        qs.append(wq); ks.append(wk); vs.append(wv)
+        if biased:
+            bqs.append(bq); bks.append(bk); bvs.append(bv)
+    attn = {
+        "wq": _stack(qs), "wk": _stack(ks), "wv": _stack(vs),
+        "wo": _stack([_linear(get, f"transformer.blocks.{i}.attn.out_proj") for i in L]),
+    }
+    mlp = {
+        "wi": _stack([_linear(get, f"transformer.blocks.{i}.ffn.up_proj") for i in L]),
+        "wo": _stack([_linear(get, f"transformer.blocks.{i}.ffn.down_proj") for i in L]),
+    }
+    if biased:
+        attn.update(
+            bq=_stack(bqs), bk=_stack(bks), bv=_stack(bvs),
+            bo=_stack([get(f"transformer.blocks.{i}.attn.out_proj.bias") for i in L]),
+        )
+        mlp.update(
+            bi=_stack([get(f"transformer.blocks.{i}.ffn.up_proj.bias") for i in L]),
+            bo=_stack([get(f"transformer.blocks.{i}.ffn.down_proj.bias") for i in L]),
+        )
+    params = {
+        "embed": {"tokens": get("transformer.wte.weight")},
+        "layers": {
+            "ln1": _ln(get, "transformer.blocks.{i}.norm_1", L, bias=biased),
+            "ln2": _ln(get, "transformer.blocks.{i}.norm_2", L, bias=biased),
+            "attn": attn,
+            "mlp": mlp,
+        },
+        "final_ln": _ln(get, "transformer.norm_f", bias=biased),
+    }
+    return params
+
+
+def convert_glm(get: Getter, cfg: DecoderConfig) -> Dict:
+    """HF GLM-4: llama-shaped keys except the fused ``gate_up_proj`` (rows are
+    [gate; up] — modeling_glm.GlmMLP chunks on the output dim)."""
+    L = range(cfg.num_layers)
+    gates, ups = [], []
+    for i in L:
+        w = get(f"model.layers.{i}.mlp.gate_up_proj.weight")   # [2F, H]
+        g, u = np.split(w, 2, axis=0)
+        gates.append(np.ascontiguousarray(g.T))
+        ups.append(np.ascontiguousarray(u.T))
+    attn = {
+        "wq": _stack([_linear(get, f"model.layers.{i}.self_attn.q_proj") for i in L]),
+        "wk": _stack([_linear(get, f"model.layers.{i}.self_attn.k_proj") for i in L]),
+        "wv": _stack([_linear(get, f"model.layers.{i}.self_attn.v_proj") for i in L]),
+        "wo": _stack([_linear(get, f"model.layers.{i}.self_attn.o_proj") for i in L]),
+    }
+    if cfg.qkv_bias:
+        attn["bq"] = _stack([get(f"model.layers.{i}.self_attn.q_proj.bias") for i in L])
+        attn["bk"] = _stack([get(f"model.layers.{i}.self_attn.k_proj.bias") for i in L])
+        attn["bv"] = _stack([get(f"model.layers.{i}.self_attn.v_proj.bias") for i in L])
+    params = {
+        "embed": {"tokens": get("model.embed_tokens.weight")},
+        "layers": {
+            "ln1": _ln(get, "model.layers.{i}.input_layernorm", L, bias=False),
+            "ln2": _ln(get, "model.layers.{i}.post_attention_layernorm", L, bias=False),
+            "attn": attn,
+            "mlp": {"wg": _stack(gates), "wi": _stack(ups),
+                    "wo": _stack([_linear(get, f"model.layers.{i}.mlp.down_proj") for i in L])},
+        },
+        "final_ln": _ln(get, "model.norm", bias=False),
+    }
+    head = _maybe(get, "lm_head.weight")
+    if head is not None and not cfg.tie_word_embeddings:
+        params["lm_head"] = np.ascontiguousarray(head.T)
+    return params
+
+
+def convert_chatglm(get: Getter, cfg: DecoderConfig) -> Dict:
+    """ChatGLM2/3-6B (THUDM remote-code checkpoints): fused
+    ``query_key_value`` is a straight concat [q(N*D); k(Nkv*D); v(Nkv*D)] and
+    ``dense_h_to_4h`` is [gate; up] on the output dim (modeling_chatglm's
+    swiglu chunks in half)."""
+    L = range(cfg.num_layers)
+    pre = "transformer.encoder.layers"
+    nd = cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    attn = {"wq": [], "wk": [], "wv": [], "bq": [], "bk": [], "bv": []}
+    gates, ups = [], []
+    for i in L:
+        w = get(f"{pre}.{i}.self_attention.query_key_value.weight")  # [nd+2kvd, H]
+        attn["wq"].append(np.ascontiguousarray(w[:nd].T))
+        attn["wk"].append(np.ascontiguousarray(w[nd:nd + kvd].T))
+        attn["wv"].append(np.ascontiguousarray(w[nd + kvd:].T))
+        if cfg.qkv_bias:
+            b = get(f"{pre}.{i}.self_attention.query_key_value.bias")
+            attn["bq"].append(b[:nd]); attn["bk"].append(b[nd:nd + kvd])
+            attn["bv"].append(b[nd + kvd:])
+        g, u = np.split(get(f"{pre}.{i}.mlp.dense_h_to_4h.weight"), 2, axis=0)
+        gates.append(np.ascontiguousarray(g.T))
+        ups.append(np.ascontiguousarray(u.T))
+    attn = {k: _stack(v) for k, v in attn.items() if v}
+    attn["wo"] = _stack([_linear(get, f"{pre}.{i}.self_attention.dense") for i in L])
+    params = {
+        "embed": {"tokens": get("transformer.embedding.word_embeddings.weight")},
+        "layers": {
+            "ln1": _ln(get, pre + ".{i}.input_layernorm", L, bias=False),
+            "ln2": _ln(get, pre + ".{i}.post_attention_layernorm", L, bias=False),
+            "attn": attn,
+            "mlp": {"wg": _stack(gates), "wi": _stack(ups),
+                    "wo": _stack([_linear(get, f"{pre}.{i}.mlp.dense_4h_to_h") for i in L])},
+        },
+        "final_ln": _ln(get, "transformer.encoder.final_layernorm", bias=False),
+        "lm_head": np.ascontiguousarray(get("transformer.output_layer.weight").T),
+    }
+    return params
+
+
 CONVERTERS = {
     "neox": convert_neox,
     "falcon": convert_falcon,
@@ -367,6 +517,10 @@ CONVERTERS = {
     "qwen": convert_qwen,
     "baichuan": convert_baichuan,
     "opt": convert_opt,
+    "gptj": convert_gptj,
+    "mpt": convert_mpt,
+    "glm": convert_glm,
+    "chatglm": convert_chatglm,
 }
 
 

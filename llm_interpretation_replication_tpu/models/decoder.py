@@ -10,8 +10,9 @@ Design notes (see SURVEY.md §7):
   a ``lax.scan`` — one compiled block body regardless of depth, fast XLA
   compiles, and clean GSPMD sharding (the L axis is never sharded).
 - Multi/grouped-query attention is native (Falcon MQA num_kv=1, Mistral GQA 8).
-- Rotary (NeoX partial-dim and LLaMA full-dim, rotate-half convention), ALiBi
-  (BLOOM), and learned positions (OPT, +2 offset) are all supported.
+- Rotary (NeoX partial-dim and LLaMA full-dim rotate-half, GPT-J/ChatGLM2
+  interleaved, GLM-4 hybrid — see ``apply_rotary``), ALiBi (BLOOM, MPT,
+  Baichuan-13B), and learned positions (OPT, +2 offset) are all supported.
 - Attention softmax and the final logits run in fp32 regardless of the compute
   dtype; matmuls run in the params' dtype (bf16 on TPU) to stay on the MXU.
 - Greedy decode keeps a static-shaped KV cache and runs under ``lax.scan`` so
@@ -29,6 +30,7 @@ Param pytree layout (converters in models/convert.py produce exactly this):
     layers/mlp/wo           [L, F, H]  (+bo)
     final_ln/{scale,bias}   [H]
     lm_head                 [H, V]            (absent when tie_word_embeddings)
+    lm_head_bias            [V]               (GPT-J only)
 """
 
 from __future__ import annotations
@@ -91,17 +93,35 @@ def rotary_embedding(positions, dim: int, theta: float, dtype=jnp.float32):
     return jnp.sin(angles).astype(dtype), jnp.cos(angles).astype(dtype)
 
 
-def apply_rotary(x, sin, cos, rotary_dim: int):
-    """Rotate-half RoPE on the first ``rotary_dim`` dims of the head axis.
+def apply_rotary(x, sin, cos, rotary_dim: int, style: str = "half"):
+    """RoPE on the first ``rotary_dim`` dims of the head axis.
 
     x: [B, S, N, D]; sin/cos: [B, S, rotary_dim/2] (broadcast over heads).
-    """
+    ``style`` picks the pairing convention (DecoderConfig.rotary_style):
+    "half" pairs (i, i+rd/2) — LLaMA/NeoX; "interleaved" pairs (2i, 2i+1) —
+    GPT-J and ChatGLM2 (HF rotate_every_two); "glm" is HF GLM-4's hybrid:
+    rotate-half pairing but frequencies repeat_interleave'd across dims
+    (modeling_glm.apply_rotary_pos_emb)."""
     rot, pass_ = x[..., :rotary_dim], x[..., rotary_dim:]
     half = rotary_dim // 2
-    x1, x2 = rot[..., :half], rot[..., half:]
     sin = sin[:, :, None, :]
     cos = cos[:, :, None, :]
-    rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    if style == "half":
+        x1, x2 = rot[..., :half], rot[..., half:]
+        rotated = jnp.concatenate([x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1)
+    elif style == "interleaved":
+        x1, x2 = rot[..., 0::2], rot[..., 1::2]
+        rotated = jnp.stack(
+            [x1 * cos - x2 * sin, x2 * cos + x1 * sin], axis=-1
+        ).reshape(rot.shape)
+    elif style == "glm":
+        cs = jnp.repeat(cos, 2, axis=-1)                     # [.., rd]
+        sn = jnp.repeat(sin, 2, axis=-1)
+        x1, x2 = rot[..., :half], rot[..., half:]
+        rotate_half = jnp.concatenate([-x2, x1], axis=-1)
+        rotated = rot * cs + rotate_half * sn
+    else:
+        raise ValueError(f"unknown rotary style {style!r}")
     return jnp.concatenate([rotated.astype(x.dtype), pass_], axis=-1)
 
 
@@ -209,8 +229,8 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
     if sin_cos is not None:
         sin, cos = sin_cos
         rd = int(cfg.rotary_pct * d) // 2 * 2
-        q = apply_rotary(q, sin, cos, rd)
-        k = apply_rotary(k, sin, cos, rd)
+        q = apply_rotary(q, sin, cos, rd, cfg.rotary_style)
+        k = apply_rotary(k, sin, cos, rd, cfg.rotary_style)
     if cache_kv is not None:
         ck, cv = cache_kv
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
@@ -292,7 +312,11 @@ def _unembed(cfg: DecoderConfig, params, x):
     table = params.get("lm_head")
     if table is None:
         table = params["embed"]["tokens"].T
-    return (x.astype(jnp.float32) @ table.astype(jnp.float32)) * cfg.logit_scale
+    logits = (x.astype(jnp.float32) @ table.astype(jnp.float32)) * cfg.logit_scale
+    bias = params.get("lm_head_bias")          # GPT-J ships an lm_head bias
+    if bias is not None:
+        logits = logits + bias.astype(jnp.float32)
+    return logits
 
 
 def run_layers(cfg: DecoderConfig, layers, x, positions, attention_mask):
@@ -480,8 +504,8 @@ def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
     if sin_cos is not None:
         sin, cos = sin_cos
         rd = int(cfg.rotary_pct * d) // 2 * 2
-        q = apply_rotary(q, sin, cos, rd)
-        k = apply_rotary(k, sin, cos, rd)
+        q = apply_rotary(q, sin, cos, rd, cfg.rotary_style)
+        k = apply_rotary(k, sin, cos, rd, cfg.rotary_style)
     ck, cv = cache_kv
     t = ck.shape[1]
     onehot = (jnp.arange(t)[None, :] == write_pos[:, None]).astype(ck.dtype)  # [B,T]

@@ -6,11 +6,11 @@ length.  Buckets quantize pad lengths to a small fixed set so XLA compiles
 once per (bucket_len, batch_size) and stays on cached executables; batches are
 padded up to a full batch so every program has a static shape.
 
-The bucket set is deliberately fine-grained above 128: the sweep's dominant
-prompt shape (few-shot prefix + question ≈ 430 tokens) pads to 448 instead of
-512, which measures 11% faster on a v5e chip (37.7 vs 34.0 prompts/sec at
-batch 192).  Each extra bucket costs one compile, amortized by XLA's
-persistent compilation cache.
+The bucket set is deliberately fine-grained (step 16) around the sweep's
+dominant prompt shape (few-shot prefix + question ≈ 430 tokens): padding to
+432 instead of 512 measures 13% faster on a v5e chip (38.2 vs 34.0
+prompts/sec at batch 192; the coarser 448 bucket measured 37.7).  Each extra
+bucket costs one compile, amortized by XLA's persistent compilation cache.
 """
 
 from __future__ import annotations
