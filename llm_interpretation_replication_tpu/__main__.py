@@ -100,6 +100,38 @@ def cmd_run_instruct_sweep(args):
     print(f"{len(df)} rows")
 
 
+def cmd_run_closed_source(args):
+    import os
+    import time
+
+    from .analysis.closed_source_eval import run_closed_source_evaluation
+    from .analysis.questions import load_ordinary_meaning_questions
+    from .api_backends.anthropic_client import AnthropicClient
+    from .api_backends.gemini_client import GeminiClient
+    from .api_backends.openai_client import OpenAIClient
+
+    questions = load_ordinary_meaning_questions(
+        instruct_csv=args.questions_csv, survey2_csv=args.survey2_csv,
+    )
+
+    def client(env, cls):
+        key = os.environ.get(env)
+        return cls(key) if key else None
+
+    run_closed_source_evaluation(
+        questions,
+        output_dir=args.output_dir,
+        cache_file=os.path.join(args.output_dir, "api_cache.json"),
+        confirm_fn=None if args.yes else (
+            lambda prompt: input(prompt).strip().lower() == "yes"
+        ),
+        gpt_client=client("OPENAI_API_KEY", OpenAIClient),
+        gemini_client=client("GEMINI_API_KEY", GeminiClient),
+        claude_client=client("ANTHROPIC_API_KEY", AnthropicClient),
+        sleep=time.sleep,           # real per-vendor pacing outside tests
+    )
+
+
 def cmd_run_perturbation(args):
     import os
 
@@ -186,6 +218,16 @@ def main(argv=None):
     p = sub.add_parser("run-instruct-sweep", help="instruct-model roster sweep")
     _add_run_config_args(p)
     p.set_defaults(fn=cmd_run_instruct_sweep)
+
+    p = sub.add_parser("run-closed-source",
+                       help="frontier-API 100-question evaluation (keys via env)")
+    p.add_argument("--questions-csv", required=True,
+                   help="instruct_model_comparison_results.csv (first 50 questions)")
+    p.add_argument("--survey2-csv", required=True,
+                   help="survey part-2 export (remaining questions)")
+    p.add_argument("--output-dir", default="results/closed_source_evaluation")
+    p.add_argument("--yes", action="store_true", help="skip the cost confirmation")
+    p.set_defaults(fn=cmd_run_closed_source)
 
     p = sub.add_parser("run-perturbation", help="10k-perturbation local-model sweep")
     _add_run_config_args(p)

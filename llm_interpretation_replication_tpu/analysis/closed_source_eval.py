@@ -63,6 +63,7 @@ def evaluate_all_models(
         missing = cache.missing_evaluators(question)
         if "gpt" in missing and gpt_client is not None:
             b = evaluate_gpt_binary(gpt_client, gpt_model, question)
+            sleep(sleeps["gpt"])
             c = evaluate_gpt_confidence(gpt_client, gpt_model, question)
             record.update(
                 gpt_response=b["response"], gpt_yes_prob=b["yes_prob"],
@@ -73,6 +74,7 @@ def evaluate_all_models(
             sleep(sleeps["gpt"])
         if "gemini" in missing and gemini_client is not None:
             b = evaluate_gemini_binary(gemini_client, gemini_model, question)
+            sleep(sleeps["gemini"])
             c = evaluate_gemini_confidence(gemini_client, gemini_model, question)
             record.update(
                 gemini_response=b["response"], gemini_yes_prob=b["yes_prob"],
@@ -263,3 +265,56 @@ def write_report(
             default=float,
         )
     return paths
+
+
+def run_closed_source_evaluation(
+    questions: Sequence[str],
+    output_dir: str,
+    human_means: Optional[Dict[str, float]] = None,
+    human_std: Optional[float] = None,
+    cache_file: Optional[str] = None,
+    confirm_fn: Optional[Callable[[str], bool]] = None,
+    log: Callable[[str], None] = print,
+    **eval_kwargs,
+) -> Optional[pd.DataFrame]:
+    """The reference main()'s orchestration shell (:1902-2110).
+
+    Short-circuits to ``closed_source_evaluation_results.csv`` when a previous
+    run finished; otherwise reports how many questions the cache already
+    covers, and for the remainder estimates API-call count and wall time from
+    the per-vendor sleeps and gates on ``confirm_fn`` (the reference's
+    interactive "Proceed with evaluation? (yes/no)" prompt, :1938-1942; pass
+    None to skip, e.g. under ``--yes``).  Returns the results DataFrame, or
+    None when the user declines.
+    """
+    saved = os.path.join(output_dir, "closed_source_evaluation_results.csv")
+    if os.path.exists(saved):
+        log(f"Loading existing results from {saved}")
+        df = pd.read_csv(saved)
+    else:
+        cache = ResponseCache(cache_file) if cache_file else ResponseCache()
+        done = sum(1 for q in questions if cache.is_complete(q))
+        fresh = len(questions) - done
+        if done:
+            log(f"Cache mode: ENABLED ({done}/{len(questions)} questions "
+                f"complete in {cache_file})")
+        if fresh:
+            sleeps = eval_kwargs.get("sleeps") or {"gpt": 0.5, "gemini": 6.0, "claude": 1.0}
+            calls = fresh * 6                    # 2 calls per vendor per question
+            # one sleep after EACH vendor call, matching evaluate_all_models
+            minutes = fresh * 2 * sum(sleeps.values()) / 60.0
+            log(f"Estimated processing time: {minutes:.1f} minutes")
+            log(f"Total API calls: {calls}")
+            if confirm_fn is not None and not confirm_fn(
+                "Proceed with evaluation? (yes/no): "
+            ):
+                log("Evaluation cancelled.")
+                return None
+        df = evaluate_all_models(questions, cache=cache, **eval_kwargs)
+    correlations = calculate_correlations(df)
+    comparisons = (
+        compare_with_human_data(df, human_means, human_std)
+        if human_means else {"mae": {}, "differences": {}, "errors": {}}
+    )
+    write_report(df, comparisons, correlations, output_dir)
+    return df

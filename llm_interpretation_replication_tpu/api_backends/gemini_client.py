@@ -2,9 +2,11 @@
 
 Behavioral spec from perturb_prompts_gemini.py (response_logprobs=True,
 logprobs=19; client-side rate limiting), perturb_prompts_gemini_parallel.py
-(20 threads, ~2.3 req/s token bucket), evaluate_irrelevant_perturbations.py
-(BLOCK_NONE safety thresholds :72-78; ``max_output_tokens`` deliberately unset
-to dodge the empty-response bug :336-350).
+(20 threads, ~2.3 req/s token bucket), perturb_prompts_gemini_batch.py (true
+batch jobs: inlined-request submit, 30 s JOB_STATE_* polling, resumable saved
+batch-id :236-470), and evaluate_irrelevant_perturbations.py (BLOCK_NONE
+safety thresholds :72-78; ``max_output_tokens`` deliberately unset to dodge
+the empty-response bug :336-350).
 """
 
 from __future__ import annotations
@@ -115,6 +117,149 @@ class GeminiClient:
                 pool.submit(self.generate_content, model, p, **kwargs) for p in prompts
             ]
             return [f.result() for f in futures]
+
+    # -- true batch-job pipeline (perturb_prompts_gemini_batch.py) ----------
+    #
+    # Submit inlined requests to the Batch API, poll every 30 s against the
+    # JOB_STATE_* machine, and persist the batch name to a resume file so an
+    # interrupted run re-attaches instead of re-submitting
+    # (save/load/clear_batch_id, reference :349-381).
+
+    TERMINAL_STATES = frozenset({
+        "JOB_STATE_SUCCEEDED", "JOB_STATE_FAILED",
+        "JOB_STATE_CANCELLED", "JOB_STATE_EXPIRED",
+    })
+
+    def create_batch(self, model: str, prompts: Sequence[str],
+                     display_name: Optional[str] = None, temperature: float = 0.0,
+                     response_logprobs: bool = False, logprobs: int = 19,
+                     safety_off: bool = True) -> str:
+        """Submit a batch of inlined generateContent requests; returns the
+        batch resource name (``batches/...``) used for polling/retrieval."""
+        generation_config: Dict = {"temperature": temperature}
+        if response_logprobs:
+            generation_config["responseLogprobs"] = True
+            generation_config["logprobs"] = logprobs
+        requests = []
+        for i, prompt in enumerate(prompts):
+            req: Dict = {
+                "contents": [{"parts": [{"text": prompt}]}],
+                "generationConfig": generation_config,
+            }
+            if safety_off:
+                req["safetySettings"] = SAFETY_OFF
+            requests.append({"request": req, "metadata": {"key": str(i)}})
+        body = {
+            "batch": {
+                "displayName": display_name or f"sweep-batch-{len(prompts)}",
+                "inputConfig": {"requests": {"requests": requests}},
+            }
+        }
+        path = f"/models/{model}:batchGenerateContent?key={self.api_key}"
+
+        @retry_with_exponential_backoff(self.retry_policy)
+        def call():
+            try:
+                _, raw = self.transport.request("POST", f"{self.base_url}{path}", {}, body)
+            except TransportError as err:
+                if not err.retryable:   # 400 payload-too-large / 403: surface now
+                    raise RuntimeError(str(err)) from err
+                raise
+            return raw
+
+        return json.loads(call())["name"]
+
+    def get_batch(self, name: str) -> Dict:
+        @retry_with_exponential_backoff(self.retry_policy)
+        def call():
+            try:
+                _, raw = self.transport.request(
+                    "GET", f"{self.base_url}/{name}?key={self.api_key}", {}, None
+                )
+            except TransportError as err:
+                if not err.retryable:
+                    raise RuntimeError(str(err)) from err
+                raise
+            return raw
+
+        return json.loads(call())
+
+    @staticmethod
+    def batch_state(batch: Dict) -> str:
+        return (batch.get("metadata", {}).get("state")
+                or batch.get("state", "JOB_STATE_UNSPECIFIED"))
+
+    def wait_for_batch(self, name: str, poll_interval: float = 30.0,
+                       max_wait: float = 24 * 3600.0, sleep_fn=None) -> Dict:
+        """Poll until a terminal JOB_STATE_*; raises on failed/cancelled/
+        expired (the reference treats them as run-ending, :337-343)."""
+        import time as _time
+
+        sleep_fn = sleep_fn or _time.sleep
+        waited = 0.0
+        while True:
+            batch = self.get_batch(name)
+            state = self.batch_state(batch)
+            if state == "JOB_STATE_SUCCEEDED":
+                return batch
+            if state in self.TERMINAL_STATES:
+                raise RuntimeError(f"gemini batch {name} ended in {state}")
+            if waited >= max_wait:
+                raise TimeoutError(f"gemini batch {name} still {state} after {waited:.0f}s")
+            sleep_fn(poll_interval)
+            waited += poll_interval
+
+    @staticmethod
+    def batch_responses(batch: Dict) -> List[Dict]:
+        """Per-request response dicts (inlined results), in submit order."""
+        inlined = (batch.get("response", {}).get("inlinedResponses", {})
+                   .get("inlinedResponses", []))
+        return [r.get("response", {}) for r in inlined]
+
+    def run_batch(self, model: str, prompts: Sequence[str],
+                  resume_file: Optional[str] = None, poll_interval: float = 30.0,
+                  sleep_fn=None, **kwargs) -> List[Dict]:
+        """Submit-or-resume → wait → collect.  With ``resume_file``, a saved
+        batch name is re-attached to (and cleared on success) so a crashed
+        orchestrator never double-submits 20k requests."""
+        name = load_batch_id(resume_file) if resume_file else None
+        if name is None:
+            name = self.create_batch(model, prompts, **kwargs)
+            if resume_file:
+                save_batch_id(resume_file, name)
+        try:
+            batch = self.wait_for_batch(name, poll_interval, sleep_fn=sleep_fn)
+        except RuntimeError:
+            # terminal FAILED/CANCELLED/EXPIRED: the saved id is dead — clear
+            # it so the next run resubmits instead of re-attaching forever
+            if resume_file:
+                clear_batch_id(resume_file)
+            raise
+        if resume_file:
+            clear_batch_id(resume_file)
+        return self.batch_responses(batch)
+
+
+# Resume-file helpers ride utils/checkpoint.CheckpointFile (atomic tmp +
+# os.replace writes) so a crash mid-save can never leave a truncated batch
+# name for the next run to poll.
+
+def save_batch_id(path: str, name: str) -> None:
+    from ..utils.checkpoint import CheckpointFile
+
+    CheckpointFile(path).save({"batch_name": name})
+
+
+def load_batch_id(path: str) -> Optional[str]:
+    from ..utils.checkpoint import CheckpointFile
+
+    return CheckpointFile(path).load().get("batch_name") or None
+
+
+def clear_batch_id(path: str) -> None:
+    from ..utils.checkpoint import CheckpointFile
+
+    CheckpointFile(path).clear()
 
 
 # ---------------------------------------------------------------------------

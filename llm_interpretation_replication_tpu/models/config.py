@@ -320,17 +320,23 @@ def mpt_config(hf) -> DecoderConfig:
     compare_instruct_models.py:157).  ALiBi, fused Wqkv, and — with the
     standard ``no_bias: true`` — no biases anywhere including LayerNorm."""
     attn_cfg = getattr(hf, "attn_config", None)
-    alibi, kv_heads = True, None
+    alibi, kv_heads, clip_qkv, qk_ln = True, None, None, False
     if attn_cfg is not None:
         _get = attn_cfg.get if isinstance(attn_cfg, dict) else (
             lambda k, d=None: getattr(attn_cfg, k, d))
         alibi = _get("alibi", True)
         kv_heads = _get("kv_n_heads", None)
+        clip_qkv = _get("clip_qkv", None)
+        qk_ln = _get("qk_ln", False)
     if not alibi:
         # HF's MPT port itself has no learned-position path; neither do we.
         raise ValueError("MPT without ALiBi (attn_config.alibi=false) is unsupported")
     if kv_heads is not None and kv_heads != hf.n_heads:
         raise ValueError("GQA MPT (attn_config.kv_n_heads) is unsupported")
+    if clip_qkv:
+        raise ValueError("MPT clip_qkv (e.g. mpt-30b/storywriter) is unsupported")
+    if qk_ln:
+        raise ValueError("MPT qk_ln checkpoints are unsupported")
     no_bias = getattr(hf, "no_bias", True)
     return DecoderConfig(
         vocab_size=hf.vocab_size,

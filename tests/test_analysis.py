@@ -143,6 +143,65 @@ class TestClosedSourceEval:
             AnthropicClient("k", transport=at, retry_policy=fast_retry()),
         )
 
+    def test_run_orchestrator_confirm_and_short_circuit(self, tmp_path):
+        """The main()-shell behaviors (reference :1902-2110): interactive
+        confirm gate on fresh API runs, cache-mode banner skips the gate,
+        saved-results CSV short-circuits evaluation entirely."""
+        from llm_interpretation_replication_tpu.analysis.closed_source_eval import (
+            run_closed_source_evaluation,
+        )
+
+        questions = [f'Is a "x{i}" a "y{i}"?' for i in range(3)]
+        logs = []
+        # 1. declined confirm: no evaluation, no report
+        out = run_closed_source_evaluation(
+            questions, str(tmp_path / "o1"), confirm_fn=lambda _p: False,
+            log=logs.append,
+        )
+        assert out is None
+        assert not os.path.exists(tmp_path / "o1")
+        assert any("Total API calls: 18" in line for line in logs)
+
+        # 2. accepted confirm with live clients: full run + report files
+        gpt, gem, claude = self._clients()
+        human_means = {q: 0.5 for q in questions}
+        df = run_closed_source_evaluation(
+            questions, str(tmp_path / "o2"), human_means=human_means,
+            human_std=0.1, confirm_fn=lambda _p: True, log=logs.append,
+            gpt_client=gpt, gemini_client=gem, claude_client=claude,
+            rng=np.random.default_rng(42),
+        )
+        assert len(df) == 3
+        assert os.path.exists(tmp_path / "o2" / "closed_source_evaluation_results.csv")
+        assert os.path.exists(tmp_path / "o2" / "mae_results_tables.tex")
+
+        # 3. rerun: saved CSV short-circuits — confirm never fires, no clients
+        df2 = run_closed_source_evaluation(
+            questions, str(tmp_path / "o2"),
+            confirm_fn=lambda _p: (_ for _ in ()).throw(AssertionError("asked")),
+            log=logs.append,
+        )
+        assert len(df2) == 3
+        assert any("Loading existing results" in line for line in logs)
+
+        # 4. warm cache file: banner instead of confirm gate
+        cache_path = str(tmp_path / "cache.json")
+        gpt, gem, claude = self._clients()
+        ResponseCache(cache_path)  # empty; fill via a normal run first
+        run_closed_source_evaluation(
+            questions, str(tmp_path / "o3"), cache_file=cache_path,
+            confirm_fn=lambda _p: True, log=logs.append,
+            gpt_client=gpt, gemini_client=gem, claude_client=claude,
+            rng=np.random.default_rng(42),
+        )
+        logs.clear()
+        run_closed_source_evaluation(
+            questions, str(tmp_path / "o4"), cache_file=cache_path,
+            confirm_fn=lambda _p: (_ for _ in ()).throw(AssertionError("asked")),
+            log=logs.append, rng=np.random.default_rng(42),
+        )
+        assert any("Cache mode: ENABLED" in line for line in logs)
+
     def test_full_loop_with_cache_and_report(self, tmp_path):
         gpt, gem, claude = self._clients()
         cache = ResponseCache(str(tmp_path / "cache.json"))

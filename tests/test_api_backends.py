@@ -247,6 +247,69 @@ class TestGeminiClient:
                                    max_workers=4)
         assert len(out) == 10
 
+    def _batch_client(self, states, results=None):
+        """Fake batch endpoints: submit returns batches/b1; each poll pops the
+        next JOB_STATE_*; success carries inlined responses."""
+        ft = FakeTransport()
+        submitted = {}
+        ft.add("POST", ":batchGenerateContent",
+               lambda c: (submitted.update(c["json"]), (200, {"name": "batches/b1"}))[1])
+        it = iter(states)
+
+        def poll(_c):
+            state = next(it)
+            body = {"name": "batches/b1", "metadata": {"state": state}}
+            if state == "JOB_STATE_SUCCEEDED" and results is not None:
+                body["response"] = {"inlinedResponses": {"inlinedResponses": [
+                    {"response": self._response(t)} for t in results
+                ]}}
+            return 200, body
+
+        ft.add("GET", "batches/b1", poll)
+        client = GeminiClient("k", transport=ft, retry_policy=fast_retry())
+        return client, ft, submitted
+
+    def test_batch_lifecycle(self):
+        """Submit -> PENDING -> RUNNING -> SUCCEEDED with inlined results
+        (perturb_prompts_gemini_batch.py:236-347)."""
+        client, ft, submitted = self._batch_client(
+            ["JOB_STATE_PENDING", "JOB_STATE_RUNNING", "JOB_STATE_SUCCEEDED"],
+            results=["yes", "no"],
+        )
+        name = client.create_batch("gemini-2.5-pro", ["p1", "p2"],
+                                   response_logprobs=True)
+        assert name == "batches/b1"
+        reqs = submitted["batch"]["inputConfig"]["requests"]["requests"]
+        assert len(reqs) == 2
+        assert reqs[0]["request"]["generationConfig"]["logprobs"] == 19
+        naps = []
+        batch = client.wait_for_batch(name, poll_interval=30, sleep_fn=naps.append)
+        assert naps == [30, 30]  # slept between the 3 polls, 30 s apart
+        out = client.batch_responses(batch)
+        assert [client.text_of(r) for r in out] == ["yes", "no"]
+
+    def test_batch_failure_state_raises(self):
+        client, _, _ = self._batch_client(["JOB_STATE_FAILED"])
+        with pytest.raises(RuntimeError, match="JOB_STATE_FAILED"):
+            client.wait_for_batch("batches/b1", sleep_fn=lambda _s: None)
+
+    def test_run_batch_resumes_from_saved_id(self, tmp_path):
+        """A saved batch id re-attaches (NO second submit) and is cleared on
+        success (reference save/load/clear_batch_id :349-381)."""
+        from llm_interpretation_replication_tpu.api_backends.gemini_client import (
+            load_batch_id, save_batch_id,
+        )
+
+        resume = str(tmp_path / "ckpt" / "batch_id.txt")
+        save_batch_id(resume, "batches/b1")
+        assert load_batch_id(resume) == "batches/b1"
+        client, ft, _ = self._batch_client(["JOB_STATE_SUCCEEDED"], results=["ok"])
+        out = client.run_batch("gemini-2.5-pro", ["p"], resume_file=resume,
+                               sleep_fn=lambda _s: None)
+        assert [client.text_of(r) for r in out] == ["ok"]
+        assert not any(":batchGenerateContent" in c["url"] for c in ft.calls)
+        assert load_batch_id(resume) is None  # cleared after success
+
 
 class TestBatchRepair:
     def test_extract_text_from_response_string(self):

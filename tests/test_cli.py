@@ -83,3 +83,32 @@ def test_throughput_meter():
     assert snap["prompts_per_sec"] == 50.0
     assert snap["prompts_per_sec_per_chip"] == 12.5
     assert snap["tokens_per_sec_per_chip"] == 6250.0
+
+
+@pytest.mark.skipif(not os.path.exists(REF2), reason="reference not mounted")
+def test_run_closed_source_cli_short_circuit(tmp_path, capsys):
+    """run-closed-source with a finished results CSV short-circuits to report
+    generation — no API keys needed (the reference main()'s saved-results
+    path, evaluate_closed_source_models.py:1919-1926)."""
+    import numpy as np
+    import pandas as pd
+
+    from llm_interpretation_replication_tpu.analysis.closed_source_eval import (
+        RESULT_COLUMNS,
+    )
+
+    out = tmp_path / "cseval"
+    out.mkdir()
+    rng = np.random.default_rng(0)
+    df = pd.DataFrame({c: rng.uniform(size=4) for c in RESULT_COLUMNS})
+    df["question"] = [f"q{i}?" for i in range(4)]
+    df.to_csv(out / "closed_source_evaluation_results.csv", index=False)
+    main([
+        "run-closed-source",
+        "--questions-csv", REF_INSTRUCT,
+        "--survey2-csv", REF2,
+        "--output-dir", str(out),
+        "--yes",
+    ])
+    assert (out / "correlations.json").exists()
+    assert (out / "mae_results_tables.tex").exists()
