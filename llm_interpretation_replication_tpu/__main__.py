@@ -104,8 +104,13 @@ def cmd_run_closed_source(args):
     import os
     import time
 
+    import numpy as np
+
     from .analysis.closed_source_eval import run_closed_source_evaluation
-    from .analysis.questions import load_ordinary_meaning_questions
+    from .analysis.questions import (
+        load_human_survey_means,
+        load_ordinary_meaning_questions,
+    )
     from .api_backends.anthropic_client import AnthropicClient
     from .api_backends.gemini_client import GeminiClient
     from .api_backends.openai_client import OpenAIClient
@@ -113,6 +118,8 @@ def cmd_run_closed_source(args):
     questions = load_ordinary_meaning_questions(
         instruct_csv=args.questions_csv, survey2_csv=args.survey2_csv,
     )
+    human_means = load_human_survey_means(args.survey1_csv, args.survey2_csv)
+    human_std = float(np.std(list(human_means.values()))) if human_means else None
 
     def client(env, cls):
         key = os.environ.get(env)
@@ -121,6 +128,8 @@ def cmd_run_closed_source(args):
     run_closed_source_evaluation(
         questions,
         output_dir=args.output_dir,
+        human_means=human_means,
+        human_std=human_std,
         cache_file=os.path.join(args.output_dir, "api_cache.json"),
         confirm_fn=None if args.yes else (
             lambda prompt: input(prompt).strip().lower() == "yes"
@@ -225,6 +234,8 @@ def main(argv=None):
                    help="instruct_model_comparison_results.csv (first 50 questions)")
     p.add_argument("--survey2-csv", required=True,
                    help="survey part-2 export (remaining questions)")
+    p.add_argument("--survey1-csv", required=True,
+                   help="survey part-1 export (human means for the MAE tables)")
     p.add_argument("--output-dir", default="results/closed_source_evaluation")
     p.add_argument("--yes", action="store_true", help="skip the cost confirmation")
     p.set_defaults(fn=cmd_run_closed_source)

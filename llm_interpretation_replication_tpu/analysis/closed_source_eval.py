@@ -29,6 +29,11 @@ from ..api_backends.evaluators import (
 from ..stats.bootstrap import bootstrap_mae, bootstrap_mae_difference
 from ..viz import figures, latex
 
+#: per-vendor pause after EACH API call (reference GPT_DELAY/GEMINI_DELAY/
+#: CLAUDE_DELAY, evaluate_closed_source_models.py:39-41); single source for
+#: both the evaluation loop and the orchestrator's wall-time estimate.
+DEFAULT_SLEEPS = {"gpt": 0.5, "gemini": 6.0, "claude": 1.0}
+
 RESULT_COLUMNS = [
     "question",
     "gpt_response", "gpt_yes_prob", "gpt_no_prob", "gpt_relative_prob",
@@ -56,7 +61,7 @@ def evaluate_all_models(
     # NOTE: explicit None check — an empty ResponseCache is falsy (__len__==0)
     cache = ResponseCache() if cache is None else cache
     rng = np.random.default_rng(42) if rng is None else rng
-    sleeps = sleeps or {"gpt": 0.5, "gemini": 6.0, "claude": 1.0}
+    sleeps = sleeps or DEFAULT_SLEEPS
     rows: List[Dict] = []
     for qi, question in enumerate(questions):
         record = dict(cache.get(question) or {})
@@ -86,7 +91,8 @@ def evaluate_all_models(
         if "claude" in missing and claude_client is not None:
             c = evaluate_claude(claude_client, claude_model, question)
             record.update(claude_response=c["response"], claude_confidence=c["confidence"])
-            sleep(sleeps["claude"])
+            sleep(sleeps["claude"])      # two messages inside evaluate_claude:
+            sleep(sleeps["claude"])      # one pause per call, like the reference
         if "random" in missing:
             r = evaluate_random_baseline(rng)
             record.update(
@@ -137,47 +143,78 @@ def compare_with_human_data(
     n_bootstrap: int = 10_000,
     seed: int = 42,
 ) -> Dict:
-    """MAE vs human mean per model + Always-50 / N(μ,σ) baselines + paired
-    difference tests (reference :917-1135)."""
+    """MAE vs human mean + baselines + paired difference tests, mirroring
+    evaluate_closed_source_models.py:985-1135 exactly (regression-pinned to
+    the paper's Table 3/4 in tests/test_published_regression.py):
+
+    - model prediction = verbalized WEIGHTED confidence / 100 for GPT/Gemini
+      (fallback to plain confidence when weighted is NaN); plain
+      confidence / 100 for Claude and the random evaluator (:1024-1035);
+    - questions match by SUBSTRING in either direction, first hit in dict
+      order (:1016-1018);
+    - top-level Equanimity (always-0.5) and N(mu,sigma) baselines run over
+      ALL survey questions (:917-983); per-model difference tests re-derive
+      both baselines over that model's matched questions only (:1060-1099);
+    - the Normal baseline replays the reference's legacy global-seed RNG
+      (np.random.seed(43), N(mu*100, sigma*100), clip to [0,100], /100) so
+      its draws are bit-identical;
+    - mu/sigma come from ALL question means (sigma overridable via
+      ``human_std``).
+    """
+    def match(question: str) -> Optional[float]:
+        for hq, hv in human_means.items():
+            if question in hq or hq in question:
+                return hv
+        return None
+
+    def model_value(row, name: str):
+        key = name.lower()
+        if name in ("Claude", "Random"):
+            return pd.to_numeric(pd.Series([row.get(f"{key}_confidence")]),
+                                 errors="coerce").iloc[0]
+        v = pd.to_numeric(pd.Series([row.get(f"{key}_weighted_confidence")]),
+                          errors="coerce").iloc[0]
+        if pd.isna(v):
+            v = pd.to_numeric(pd.Series([row.get(f"{key}_confidence")]),
+                              errors="coerce").iloc[0]
+        return v
+
+    model_names = ("GPT", "Gemini", "Claude", "Random")
     errors: Dict[str, List[float]] = {}
-    pairs: Dict[str, List[tuple]] = {}  # name -> [(prediction, human mean)]
-    model_cols = {
-        "GPT": "gpt_relative_prob",
-        "Gemini": "gemini_relative_prob",
-        "Random": "random_relative_prob",
-    }
-    matched_questions = []
+    pairs: Dict[str, List[tuple]] = {}   # name -> [(prediction, human mean)]
+    paired_h: Dict[str, List[float]] = {}
     for _, row in df.iterrows():
-        q = row["question"]
-        if q not in human_means:
+        h = match(str(row["question"]))
+        if h is None:
             continue
-        h = human_means[q]
-        matched_questions.append(q)
-        for name, col in model_cols.items():
-            v = pd.to_numeric(pd.Series([row.get(col)]), errors="coerce").iloc[0]
+        for name in model_names:
+            v = model_value(row, name)
             if pd.notna(v):
-                errors.setdefault(name, []).append(abs(float(v) - h))
-                pairs.setdefault(name, []).append((float(v), h))
-        # claude gives confidence only: use confidence/100 as P(yes)
-        cv = pd.to_numeric(pd.Series([row.get("claude_confidence")]), errors="coerce").iloc[0]
-        if pd.notna(cv):
-            errors.setdefault("Claude", []).append(abs(float(cv) / 100.0 - h))
-            pairs.setdefault("Claude", []).append((float(cv) / 100.0, h))
-    matched_h = [human_means[q] for q in matched_questions]
-    # Equanimity: always 0.5; Normal baseline: N(mean_h, std_h) draws
-    errors["Equanimity"] = [abs(0.5 - h) for h in matched_h]
-    if human_std is not None and matched_h:
-        rng = np.random.default_rng(seed)
-        mu = float(np.mean(matched_h))
-        draws = np.clip(rng.normal(mu, human_std, len(matched_h)), 0, 1)
-        errors["Normal"] = [abs(d - h) for d, h in zip(draws, matched_h)]
+                pred = float(v) / 100.0
+                errors.setdefault(name, []).append(abs(pred - h))
+                pairs.setdefault(name, []).append((pred, h))
+                paired_h.setdefault(name, []).append(h)
+
+    all_h = list(human_means.values())
+    mu = float(np.mean(all_h)) if all_h else 0.5
+    sigma = float(human_std) if human_std is not None else float(np.std(all_h))
+
+    def normal_draws(count: int) -> List[float]:
+        # legacy global-RNG replay: np.random.seed(43) + sequential normals
+        legacy = np.random.RandomState(43)
+        return [
+            float(np.clip(legacy.normal(mu * 100, sigma * 100), 0, 100) / 100.0)
+            for _ in range(count)
+        ]
+
+    errors["Equanimity"] = [abs(0.5 - h) for h in all_h]
+    if all_h:
+        errors["Normal"] = [abs(d - h) for d, h in zip(normal_draws(len(all_h)), all_h)]
 
     results: Dict = {"mae": {}, "differences": {}}
     for name, errs in errors.items():
         mean, lo, hi = bootstrap_mae(errs, n_bootstrap=n_bootstrap, seed=seed)
         record = {"mae": mean, "ci_lower": lo, "ci_upper": hi, "n": len(errs)}
-        # per-model Pearson correlation vs the human means (reference :985-1135
-        # records correlation/p_value/n_matched alongside each model's MAE)
         pred_h = pairs.get(name, [])
         if len(pred_h) >= 3 and np.std([p for p, _ in pred_h]) > 0 and np.std(
             [hh for _, hh in pred_h]
@@ -186,19 +223,21 @@ def compare_with_human_data(
             record.update(correlation=float(r), p_value=float(p),
                           n_matched=len(pred_h))
         results["mae"][name] = record
-    if "Normal" in results["mae"] and matched_h:
-        results["mae"]["Normal"].update(
-            human_mean=float(np.mean(matched_h)), human_std=float(human_std)
-        )
+    if "Normal" in results["mae"] and all_h:
+        results["mae"]["Normal"].update(human_mean=mu, human_std=sigma)
+
     for name in ("GPT", "Claude", "Gemini"):
         if name not in errors:
             continue
+        hs = paired_h[name]
+        baselines = {"Equanimity": [abs(0.5 - h) for h in hs],
+                     "Normal": [abs(d - h) for d, h in zip(normal_draws(len(hs)), hs)]}
+        if "Random" in errors:
+            baselines["Random"] = errors["Random"]
         diffs = {}
-        for baseline in ("Equanimity", "Normal", "Random"):
-            if baseline not in errors:
-                continue
+        for baseline, base_errs in baselines.items():
             d, lo, hi, p = bootstrap_mae_difference(
-                errors[name], errors[baseline], n_bootstrap=n_bootstrap, seed=seed
+                errors[name], base_errs, n_bootstrap=n_bootstrap, seed=seed
             )
             diffs[baseline] = {"diff": d, "ci_lower": lo, "ci_upper": hi, "p_value": p}
         results["differences"][name] = diffs
@@ -298,11 +337,14 @@ def run_closed_source_evaluation(
         if done:
             log(f"Cache mode: ENABLED ({done}/{len(questions)} questions "
                 f"complete in {cache_file})")
-        if fresh:
-            sleeps = eval_kwargs.get("sleeps") or {"gpt": 0.5, "gemini": 6.0, "claude": 1.0}
-            calls = fresh * 6                    # 2 calls per vendor per question
-            # one sleep after EACH vendor call, matching evaluate_all_models
-            minutes = fresh * 2 * sum(sleeps.values()) / 60.0
+        vendors_configured = [v for v in ("gpt", "gemini", "claude")
+                              if eval_kwargs.get(f"{v}_client") is not None]
+        if fresh and vendors_configured:
+            sleeps = eval_kwargs.get("sleeps") or DEFAULT_SLEEPS
+            # 2 calls (binary + confidence) per CONFIGURED vendor per
+            # question, one sleep after each call — mirrors the loop exactly
+            calls = fresh * 2 * len(vendors_configured)
+            minutes = fresh * 2 * sum(sleeps[v] for v in vendors_configured) / 60.0
             log(f"Estimated processing time: {minutes:.1f} minutes")
             log(f"Total API calls: {calls}")
             if confirm_fn is not None and not confirm_fn(

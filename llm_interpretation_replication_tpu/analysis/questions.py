@@ -58,3 +58,40 @@ def write_question_list(questions: List[str], path: str) -> None:
     with open(path, "w") as f:
         for q in questions:
             f.write(q + "\n")
+
+
+def load_human_survey_means(
+    part1_csv: str,
+    part2_csv: str,
+    return_full: bool = False,
+):
+    """Pooled per-question human means from BOTH survey parts, 0-1 scale
+    (evaluate_closed_source_models.py:83-159).
+
+    Unlike the preregistered survey pipeline (survey/pipeline.py), this loader
+    applies NO exclusions — the closed-source comparison pools every numeric
+    response under each 'Left = No, Right = Yes' column, exactly as the
+    reference does; questions appearing in both parts pool across parts.
+    With ``return_full`` also returns question -> list of responses.
+    """
+    import numpy as np
+
+    responses: Dict[str, List[float]] = {}
+    for path in (part1_csv, part2_csv):
+        df = pd.read_csv(path, skiprows=1)
+        for col in df.columns:
+            if "Left = No, Right = Yes" not in col:
+                continue
+            parts = col.split(" - ")
+            if len(parts) < 2:
+                continue
+            question = parts[-1].strip()
+            if not question.endswith("?"):
+                continue
+            values = pd.to_numeric(df[col], errors="coerce").dropna()
+            if len(values):
+                responses.setdefault(question, []).extend((values / 100.0).tolist())
+    means = {q: float(np.mean(v)) for q, v in responses.items()}
+    if return_full:
+        return means, responses
+    return means

@@ -31,6 +31,10 @@ SAFETY_OFF = [
 ]
 
 
+class BatchTerminalError(RuntimeError):
+    """A batch reached FAILED/CANCELLED/EXPIRED — the saved id is dead."""
+
+
 class GeminiClient:
     def __init__(self, api_key: str, transport=None, base_url: str = BASE_URL,
                  retry_policy: Optional[RetryPolicy] = None,
@@ -203,7 +207,7 @@ class GeminiClient:
             if state == "JOB_STATE_SUCCEEDED":
                 return batch
             if state in self.TERMINAL_STATES:
-                raise RuntimeError(f"gemini batch {name} ended in {state}")
+                raise BatchTerminalError(f"gemini batch {name} ended in {state}")
             if waited >= max_wait:
                 raise TimeoutError(f"gemini batch {name} still {state} after {waited:.0f}s")
             sleep_fn(poll_interval)
@@ -211,9 +215,17 @@ class GeminiClient:
 
     @staticmethod
     def batch_responses(batch: Dict) -> List[Dict]:
-        """Per-request response dicts (inlined results), in submit order."""
+        """Per-request response dicts, re-paired to submit order.
+
+        Each submitted request carries ``metadata.key = str(i)``; when the
+        service echoes it, responses are ordered by that key rather than
+        trusting wire order (mis-pairing would silently attribute every
+        logprob to the wrong prompt).  Keyless responses keep wire order."""
         inlined = (batch.get("response", {}).get("inlinedResponses", {})
                    .get("inlinedResponses", []))
+        keys = [r.get("metadata", {}).get("key") for r in inlined]
+        if all(k is not None for k in keys) and len(set(keys)) == len(keys):
+            inlined = sorted(inlined, key=lambda r: int(r["metadata"]["key"]))
         return [r.get("response", {}) for r in inlined]
 
     def run_batch(self, model: str, prompts: Sequence[str],
@@ -229,9 +241,10 @@ class GeminiClient:
                 save_batch_id(resume_file, name)
         try:
             batch = self.wait_for_batch(name, poll_interval, sleep_fn=sleep_fn)
-        except RuntimeError:
-            # terminal FAILED/CANCELLED/EXPIRED: the saved id is dead — clear
-            # it so the next run resubmits instead of re-attaching forever
+        except BatchTerminalError:
+            # FAILED/CANCELLED/EXPIRED: the saved id is dead — clear it so the
+            # next run resubmits.  Other errors (transient poll failures, auth
+            # hiccups) keep the file: the batch may still be running.
             if resume_file:
                 clear_batch_id(resume_file)
             raise

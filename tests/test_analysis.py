@@ -118,8 +118,9 @@ class TestClosedSourceEval:
         ft = FakeTransport()
         top = [{"token": "Yes", "logprob": math.log(0.8)},
                {"token": "No", "logprob": math.log(0.1)}]
+        # content "85" parses as a confidence; binary probs come from logprobs
         ft.add("POST", "/chat/completions", lambda c: (200, {
-            "choices": [{"message": {"content": "Yes"},
+            "choices": [{"message": {"content": "85"},
                          "logprobs": {"content": [{"token": "Yes", "top_logprobs": top}]}}],
             "usage": {"prompt_tokens": 10, "completion_tokens": 1},
         }))
@@ -153,14 +154,19 @@ class TestClosedSourceEval:
 
         questions = [f'Is a "x{i}" a "y{i}"?' for i in range(3)]
         logs = []
-        # 1. declined confirm: no evaluation, no report
+        # 1. declined confirm: no evaluation, no report.  The gate only fires
+        # when paid vendors are configured (3 q x 2 calls x 3 vendors = 18).
+        gpt, gem, claude = self._clients()
         out = run_closed_source_evaluation(
             questions, str(tmp_path / "o1"), confirm_fn=lambda _p: False,
-            log=logs.append,
+            log=logs.append, gpt_client=gpt, gemini_client=gem,
+            claude_client=claude,
         )
         assert out is None
         assert not os.path.exists(tmp_path / "o1")
+        assert len(gpt.transport.calls) == 0    # declined before any API call
         assert any("Total API calls: 18" in line for line in logs)
+        assert any("Estimated processing time: 0.8 minutes" in line for line in logs)
 
         # 2. accepted confirm with live clients: full run + report files
         gpt, gem, claude = self._clients()
@@ -225,9 +231,12 @@ class TestClosedSourceEval:
         comparisons = compare_with_human_data(df, human_means, human_std=0.167,
                                               n_bootstrap=500, seed=42)
         assert set(comparisons["mae"]) >= {"GPT", "Claude", "Gemini", "Equanimity", "Random", "Normal"}
+        # reference semantics: predictions are verbalized confidences / 100
+        assert comparisons["mae"]["GPT"]["mae"] == pytest.approx(
+            np.mean([abs(0.85 - h) for h in human_means.values()]))
         assert comparisons["mae"]["Normal"]["human_std"] == pytest.approx(0.167)
         # constant predictions here -> no correlation recorded for GPT; the
-        # random baseline varies, so its correlation fields are present
+        # random evaluator varies, so its correlation fields are present
         assert {"correlation", "p_value", "n_matched"} <= set(comparisons["mae"]["Random"])
         corr = calculate_correlations(df)
         paths = write_report(df, comparisons, corr, str(tmp_path / "out"))

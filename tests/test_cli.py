@@ -60,6 +60,7 @@ def test_similarity_cli(tmp_path, capsys):
     assert os.path.exists(tmp_path / "sim" / "original_vs_rephrasings_similarity.xlsx")
 
 
+REF1 = "/root/reference/data/word_meaning_survey_results.csv"
 REF2 = "/root/reference/data/word_meaning_survey_results_part_2.csv"
 REF_INSTRUCT = "/root/reference/data/instruct_model_comparison_results.csv"
 
@@ -107,6 +108,7 @@ def test_run_closed_source_cli_short_circuit(tmp_path, capsys):
         "run-closed-source",
         "--questions-csv", REF_INSTRUCT,
         "--survey2-csv", REF2,
+        "--survey1-csv", REF1,
         "--output-dir", str(out),
         "--yes",
     ])
