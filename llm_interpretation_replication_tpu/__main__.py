@@ -104,8 +104,6 @@ def cmd_run_closed_source(args):
     import os
     import time
 
-    import numpy as np
-
     from .analysis.closed_source_eval import run_closed_source_evaluation
     from .analysis.questions import (
         load_human_survey_means,
@@ -119,7 +117,6 @@ def cmd_run_closed_source(args):
         instruct_csv=args.questions_csv, survey2_csv=args.survey2_csv,
     )
     human_means = load_human_survey_means(args.survey1_csv, args.survey2_csv)
-    human_std = float(np.std(list(human_means.values()))) if human_means else None
 
     def client(env, cls):
         key = os.environ.get(env)
@@ -129,7 +126,6 @@ def cmd_run_closed_source(args):
         questions,
         output_dir=args.output_dir,
         human_means=human_means,
-        human_std=human_std,
         cache_file=os.path.join(args.output_dir, "api_cache.json"),
         confirm_fn=None if args.yes else (
             lambda prompt: input(prompt).strip().lower() == "yes"

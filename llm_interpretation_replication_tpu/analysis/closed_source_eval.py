@@ -89,10 +89,10 @@ def evaluate_all_models(
             )
             sleep(sleeps["gemini"])
         if "claude" in missing and claude_client is not None:
-            c = evaluate_claude(claude_client, claude_model, question)
+            c = evaluate_claude(claude_client, claude_model, question,
+                                sleep=sleep, delay=sleeps["claude"])
             record.update(claude_response=c["response"], claude_confidence=c["confidence"])
-            sleep(sleeps["claude"])      # two messages inside evaluate_claude:
-            sleep(sleeps["claude"])      # one pause per call, like the reference
+            sleep(sleeps["claude"])
         if "random" in missing:
             r = evaluate_random_baseline(rng)
             record.update(
@@ -262,7 +262,13 @@ def write_report(
     with open(tex_path, "w") as f:
         f.write(tex)
     paths["latex"] = tex_path
-    errors = comparisons.get("errors", {})
+    # Per-question figures need vectors aligned to df row order; the
+    # Equanimity/Normal baselines run over ALL survey questions in
+    # human_means dict order, so they are excluded here (they still appear
+    # in the MAE tables and comparison bars).
+    aligned = ("GPT", "Gemini", "Claude", "Random")
+    errors = {k: v for k, v in comparisons.get("errors", {}).items()
+              if k in aligned}
     if errors:
         paths["error_strip"] = figures.per_question_error_strip(
             errors, "Per-question absolute error vs human mean",

@@ -12,6 +12,15 @@ from typing import Dict, List, Optional, Tuple
 import pandas as pd
 
 
+def question_from_header(text) -> Optional[str]:
+    """Question text out of a Qualtrics column header: the last ``' - '``
+    segment when it ends with '?' (shared by every survey-header consumer)."""
+    if not isinstance(text, str) or " - " not in text:
+        return None
+    question = text.split(" - ")[-1].strip()
+    return question if question.endswith("?") else None
+
+
 def extract_survey2_questions(survey_csv: str) -> Tuple[List[str], Dict[str, str]]:
     """Unique questions (and their columns) from a Qualtrics header row,
     skipping the *_8 attention checks."""
@@ -45,11 +54,9 @@ def load_ordinary_meaning_questions(
     part2: List[str] = []
     for col in survey2.columns:
         if "Left = No, Right = Yes" in col:
-            parts = col.split(" - ")
-            if len(parts) >= 2:
-                q = parts[-1].strip()
-                if q.endswith("?") and q not in part2:
-                    part2.append(q)
+            q = question_from_header(col)
+            if q is not None and q not in part2:
+                part2.append(q)
     questions.extend(part2[:n_part2])
     return questions
 
@@ -82,11 +89,8 @@ def load_human_survey_means(
         for col in df.columns:
             if "Left = No, Right = Yes" not in col:
                 continue
-            parts = col.split(" - ")
-            if len(parts) < 2:
-                continue
-            question = parts[-1].strip()
-            if not question.endswith("?"):
+            question = question_from_header(col)
+            if question is None:
                 continue
             values = pd.to_numeric(df[col], errors="coerce").dropna()
             if len(values):

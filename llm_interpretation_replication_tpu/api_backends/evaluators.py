@@ -123,12 +123,17 @@ def evaluate_gemini_confidence(client, model: str, question: str) -> Dict:
     }
 
 
-def evaluate_claude(client, model: str, question: str) -> Dict:
+def evaluate_claude(client, model: str, question: str,
+                    sleep=None, delay: float = 0.0) -> Dict:
     """Claude has no logprobs: binary text + verbalized confidence only
-    (evaluate_closed_source_models.py:514-552)."""
+    (evaluate_closed_source_models.py:514-552).  ``sleep``/``delay`` pace the
+    two requests like the reference's CLAUDE_DELAY after EACH call (:716,719)
+    — the pause must sit between the calls, not after the pair."""
     binary = client.create_message(
         model, [{"role": "user", "content": f"{question} {BINARY_SUFFIX}"}]
     )
+    if sleep is not None:
+        sleep(delay)
     confidence = client.create_message(
         model, [{"role": "user", "content": f"{question} {CONFIDENCE_SUFFIX}"}]
     )

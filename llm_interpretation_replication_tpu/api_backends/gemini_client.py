@@ -224,7 +224,8 @@ class GeminiClient:
         inlined = (batch.get("response", {}).get("inlinedResponses", {})
                    .get("inlinedResponses", []))
         keys = [r.get("metadata", {}).get("key") for r in inlined]
-        if all(k is not None for k in keys) and len(set(keys)) == len(keys):
+        if (keys and all(isinstance(k, str) and k.isdigit() for k in keys)
+                and len(set(keys)) == len(keys)):
             inlined = sorted(inlined, key=lambda r: int(r["metadata"]["key"]))
         return [r.get("response", {}) for r in inlined]
 

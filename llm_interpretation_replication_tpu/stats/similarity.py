@@ -43,18 +43,21 @@ class BM25Okapi:
             df.update(counts.keys())
         n = len(self.corpus)
         # rank_bm25's idf: log((N - df + 0.5)/(df + 0.5)); negative idfs are
-        # replaced by epsilon * average positive idf
+        # replaced by epsilon * average idf, where the average runs over ALL
+        # terms (negative contributions included in both sum and count —
+        # rank_bm25 BM25Okapi._calc_idf exactly; pinned bit-exact against the
+        # reference's recorded similarity workbook in
+        # tests/test_published_regression.py)
         idf = {}
         negative = []
         total = 0.0
         for term, freq in df.items():
             v = math.log((n - freq + 0.5) / (freq + 0.5))
             idf[term] = v
+            total += v
             if v < 0:
                 negative.append(term)
-            else:
-                total += v
-        avg_idf = total / max(len(idf) - len(negative), 1)
+        avg_idf = total / max(len(idf), 1)
         for term in negative:
             idf[term] = epsilon * avg_idf
         self.idf = idf
