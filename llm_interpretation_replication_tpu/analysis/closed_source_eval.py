@@ -34,6 +34,9 @@ from ..viz import figures, latex
 #: both the evaluation loop and the orchestrator's wall-time estimate.
 DEFAULT_SLEEPS = {"gpt": 0.5, "gemini": 6.0, "claude": 1.0}
 
+#: evaluator names in the human comparison (order = report row order)
+MODEL_NAMES = ("GPT", "Gemini", "Claude", "Random")
+
 RESULT_COLUMNS = [
     "question",
     "gpt_response", "gpt_yes_prob", "gpt_no_prob", "gpt_relative_prob",
@@ -179,21 +182,29 @@ def compare_with_human_data(
                               errors="coerce").iloc[0]
         return v
 
-    model_names = ("GPT", "Gemini", "Claude", "Random")
     errors: Dict[str, List[float]] = {}
     pairs: Dict[str, List[tuple]] = {}   # name -> [(prediction, human mean)]
     paired_h: Dict[str, List[float]] = {}
+    # df-row-aligned error matrix for per-question figures: one slot per
+    # matched row per model, NaN when that model had no parseable value (the
+    # stats vectors above skip instead — they must stay dense for bootstrap)
+    errors_aligned: Dict[str, List[float]] = {n: [] for n in MODEL_NAMES}
+    matched_questions: List[str] = []
     for _, row in df.iterrows():
         h = match(str(row["question"]))
         if h is None:
             continue
-        for name in model_names:
+        matched_questions.append(str(row["question"]))
+        for name in MODEL_NAMES:
             v = model_value(row, name)
             if pd.notna(v):
                 pred = float(v) / 100.0
                 errors.setdefault(name, []).append(abs(pred - h))
                 pairs.setdefault(name, []).append((pred, h))
                 paired_h.setdefault(name, []).append(h)
+                errors_aligned[name].append(abs(pred - h))
+            else:
+                errors_aligned[name].append(float("nan"))
 
     all_h = list(human_means.values())
     mu = float(np.mean(all_h)) if all_h else 0.5
@@ -242,6 +253,10 @@ def compare_with_human_data(
             diffs[baseline] = {"diff": d, "ci_lower": lo, "ci_upper": hi, "p_value": p}
         results["differences"][name] = diffs
     results["errors"] = errors
+    results["errors_aligned"] = {
+        k: v for k, v in errors_aligned.items() if np.isfinite(v).any()
+    }
+    results["matched_questions"] = matched_questions
     return results
 
 
@@ -262,13 +277,13 @@ def write_report(
     with open(tex_path, "w") as f:
         f.write(tex)
     paths["latex"] = tex_path
-    # Per-question figures need vectors aligned to df row order; the
-    # Equanimity/Normal baselines run over ALL survey questions in
-    # human_means dict order, so they are excluded here (they still appear
-    # in the MAE tables and comparison bars).
-    aligned = ("GPT", "Gemini", "Claude", "Random")
-    errors = {k: v for k, v in comparisons.get("errors", {}).items()
-              if k in aligned}
+    # Per-question figures use the NaN-padded df-row-aligned matrix so every
+    # column is the same question for every model (the dense stats vectors
+    # shift when a model skips a question; the all-questions baselines are
+    # in human_means order and excluded from these figures entirely).
+    errors = comparisons.get("errors_aligned") or {
+        k: v for k, v in comparisons.get("errors", {}).items() if k in MODEL_NAMES
+    }
     if errors:
         paths["error_strip"] = figures.per_question_error_strip(
             errors, "Per-question absolute error vs human mean",

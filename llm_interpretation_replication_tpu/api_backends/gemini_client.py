@@ -223,10 +223,15 @@ class GeminiClient:
         logprob to the wrong prompt).  Keyless responses keep wire order."""
         inlined = (batch.get("response", {}).get("inlinedResponses", {})
                    .get("inlinedResponses", []))
-        keys = [r.get("metadata", {}).get("key") for r in inlined]
-        if (keys and all(isinstance(k, str) and k.isdigit() for k in keys)
-                and len(set(keys)) == len(keys)):
-            inlined = sorted(inlined, key=lambda r: int(r["metadata"]["key"]))
+        def _key(r):
+            try:
+                return int(r.get("metadata", {}).get("key"))
+            except (TypeError, ValueError):
+                return None
+
+        keys = [_key(r) for r in inlined]
+        if keys and None not in keys and len(set(keys)) == len(keys):
+            inlined = [r for _, r in sorted(zip(keys, inlined))]
         return [r.get("response", {}) for r in inlined]
 
     def run_batch(self, model: str, prompts: Sequence[str],

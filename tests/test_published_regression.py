@@ -169,6 +169,8 @@ def test_perturbation_confidence_stats_match_recorded_analysis(model, paper_widt
     perturbations.json order (the analyzers' convention).  The mean 95%
     interval width rounds to the paper's Appendix B value (Claude 72.8,
     Gemini 78.0)."""
+    if not (os.path.exists(WORKBOOKS[model]) and os.path.exists(PERTURBATIONS_JSON)):
+        pytest.skip("perturbation artifacts not mounted")
     from llm_interpretation_replication_tpu.stats.normality import normality_tests
     from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
 
@@ -223,6 +225,8 @@ def test_similarity_metrics_match_recorded_workbook():
     TF-IDF/BM25 are corpus-dependent, so the comparison runs at full corpus
     (original + 2000 rephrasings); BM25 checks a 100-row slice of the
     symmetrized row to keep the O(n^2) matrix out of the test."""
+    if not os.path.exists(f"{REF}/results/prompt_similarity/original_vs_rephrasings_similarity.xlsx"):
+        pytest.skip("similarity workbook not mounted")
     from llm_interpretation_replication_tpu.stats import similarity as sim
     from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
 
@@ -255,3 +259,32 @@ def test_similarity_metrics_match_recorded_workbook():
     np.testing.assert_allclose(
         lev, sub["levenshtein_similarity"].to_numpy()[:k], atol=1e-12
     )
+
+
+def test_appendix_inter_model_correlations():
+    """Online-appendix inter-LLM correlation table (main_online_appendix.tex:
+    517-533): mean rho 0.051, median 0.045, sigma 0.220 over the 28
+    non-degenerate model pairs of the word-meaning sweep CSV (models with
+    all-NaN overlap drop out of the 45 raw pairs).  Point statistics are
+    deterministic; bootstrap CIs agree with the published intervals to
+    resampling noise."""
+    if not os.path.exists(f"{REF}/data/instruct_model_comparison_results.csv"):
+        pytest.skip("instruct sweep CSV not mounted")
+    from llm_interpretation_replication_tpu.stats.correlations import (
+        correlation_summary_bootstrap,
+        pairwise_correlations,
+        pivot_model_values,
+    )
+
+    df = pd.read_csv(f"{REF}/data/instruct_model_comparison_results.csv")
+    pivot = pivot_model_values(df)
+    pairs = pairwise_correlations(pivot)
+    r = pairs["pearson_r"].dropna()
+    assert len(r) == 28
+    assert round(float(r.mean()), 3) == 0.051
+    assert round(float(np.median(r)), 3) == 0.045
+    assert round(float(np.std(r)), 3) == 0.220
+    summary = correlation_summary_bootstrap(pivot, n_bootstrap=1000, seed=42)
+    assert summary["n_pairs"] == 28
+    lo, hi = summary["mean_ci"]
+    assert lo == pytest.approx(-0.015, abs=0.01) and hi == pytest.approx(0.126, abs=0.01)

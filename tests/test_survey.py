@@ -94,15 +94,18 @@ class TestRealSurveyData:
 
     def test_cross_prompt_human_vs_llm_gap(self, clean_survey):
         """Appendix result: humans correlate cross-prompt (~0.285) far more
-        than LLMs (~0.05) — main_online_appendix.tex:582-621.  Run with a
-        small bootstrap for speed; check the qualitative gap reproduces."""
+        than LLMs (~0.05) — main_online_appendix.tex:582-621.  Point
+        estimates reproduce the published 0.285 / 0.052 exactly (to paper
+        rounding); the bootstrap runs small for speed, so the difference CI
+        is checked qualitatively."""
         df, _, cols = clean_survey
         llm_df = pd.read_csv(LLM_CSV)
         _, mapping = match_survey_to_llm_questions(llm_df, SURVEYS)
         hum = human_cross_prompt_correlations(df, cols, n_bootstrap=5, seed=42)
         llm = llm_cross_prompt_correlations(llm_df, mapping, n_bootstrap=5, seed=42)
-        assert 0.2 <= hum["mean_correlation"] <= 0.4
-        assert -0.1 <= llm["mean_correlation"] <= 0.2
+        # point estimates are deterministic: they hit the published values
+        assert round(hum["mean_correlation"], 3) == 0.285
+        assert round(llm["mean_correlation"], 3) == 0.052
         diff = cross_prompt_difference_ci(hum, llm, n_bootstrap=500, seed=42)
         assert diff["difference"] > 0.1
         assert diff["p_value"] < 0.05
