@@ -1,12 +1,13 @@
 """Irrelevant-perturbation evaluation (3,400 insertions × 3 frontier models).
 
 Rebuild of evaluate_irrelevant_perturbations.py:372-1297: evaluate the
-original + every perturbed scenario at temperature 0.7 with
-``extract_final_number`` parsing for thinking-model outputs, resume via a
-processed-triple checkpoint + JSON progress heartbeat, per-scenario/model
-consistency statistics (mean/std/95% interval width), violin plots, and
-Excel/CSV/JSON outputs.  Vendor clients are injected (evaluator callables
-``(scenario_text) -> response_text``) so local models and tests plug in the
+original + every perturbed scenario (response leg + confidence leg per
+triple, temperature 0.7) with ``extract_final_number`` parsing for
+thinking-model outputs, resume via a processed-triple checkpoint + JSON
+progress heartbeat, per-scenario/model consistency + confidence statistics
+(pinned bit-exact against the reference's recorded summary.csv), violin
+plots, and Excel/CSV/JSON outputs.  Vendor clients are injected (evaluator
+callables ``(prompt) -> reply text``) so local models and tests plug in the
 same way.
 """
 
@@ -29,12 +30,19 @@ Evaluator = Callable[[str], str]  # perturbed scenario text -> model reply text
 
 RESULT_COLUMNS = [
     "model", "scenario_name", "perturbation_id", "irrelevant_statement",
-    "position_index", "position_description", "response_text", "confidence",
+    "position_index", "position_description", "response", "confidence",
+    "confidence_raw_response",
 ]
 
 
+def response_prompt(scenario: Dict, text: str) -> str:
+    """``{text}\n\n{response_format}`` (evaluate_irrelevant_perturbations
+    :407, :470)."""
+    return f"{text}\n\n{scenario['response_format']}"
+
+
 def confidence_prompt(scenario: Dict, text: str) -> str:
-    return f"{text} {scenario['confidence_format']}"
+    return f"{text}\n\n{scenario['confidence_format']}"
 
 
 def process_scenario_perturbations(
@@ -50,9 +58,18 @@ def process_scenario_perturbations(
     os.makedirs(output_dir, exist_ok=True)
     processed = ProcessedSet(os.path.join(output_dir, "processed_triples.json"))
     rows_path = os.path.join(output_dir, "raw_results.csv")
-    rows: List[Dict] = (
-        pd.read_csv(rows_path).to_dict("records") if os.path.exists(rows_path) else []
-    )
+    if os.path.exists(rows_path):
+        prior = pd.read_csv(rows_path)
+        if "response_text" in prior.columns and "response" not in prior.columns:
+            # pre-rename checkpoint: the old single-leg sweep stored only the
+            # confidence reply.  Keep it under its new name; the response leg
+            # for those rows is genuinely absent (NaN), which
+            # consistency_statistics excludes rather than counting as
+            # disagreement.
+            prior = prior.rename(columns={"response_text": "confidence_raw_response"})
+        rows: List[Dict] = prior.to_dict("records")
+    else:
+        rows = []
     total = sum(
         (len(s["perturbations_with_irrelevant"][:max_per_scenario])
          if max_per_scenario else len(s["perturbations_with_irrelevant"]))
@@ -65,18 +82,27 @@ def process_scenario_perturbations(
         key = (model, scenario["scenario_name"], pid)
         if key in processed:
             return
+        # two legs per triple, like the reference: the yes/no-style response
+        # prompt, then the 0-100 confidence prompt (:407-470).  Each leg
+        # fails independently so a broken confidence call can't clobber a
+        # good response (and vice versa); the sweep continues either way.
+        try:
+            response = evaluate(response_prompt(scenario, text))
+        except Exception as err:
+            response = f"ERROR: {str(err)[:100]}"
         try:
             reply = evaluate(confidence_prompt(scenario, text))
             confidence = extract_final_number(reply)
-        except Exception as err:  # keep the sweep alive past broken calls
+        except Exception as err:
             reply, confidence = f"ERROR: {str(err)[:100]}", None
         rows.append(
             {
                 "model": model,
                 "scenario_name": scenario["scenario_name"],
                 "perturbation_id": pid,
-                "response_text": str(reply)[:500],
+                "response": str(response)[:500],
                 "confidence": confidence,
+                "confidence_raw_response": str(reply)[:500],
                 **extra,
             }
         )
@@ -110,30 +136,61 @@ def process_scenario_perturbations(
 
 
 def consistency_statistics(df: pd.DataFrame) -> pd.DataFrame:
-    """Per (model, scenario): mean/std/95% interval width of confidence over
-    the perturbations; the original-scenario value for reference."""
+    """Per (model, scenario) consistency + confidence statistics, matching
+    evaluate_irrelevant_perturbations.analyze_results (:503-618) exactly
+    (pinned against the recorded ``summary.csv`` in
+    tests/test_published_regression.py): response consistency vs the
+    original, pooled original+perturbed confidence stats (pandas ddof=1 std,
+    2.5/97.5 percentiles), and the perturbed-only leg; plus our ``ci_width``
+    convenience column."""
     records = []
     for (model, scenario), sub in df.groupby(["model", "scenario_name"]):
         pert = sub[sub["perturbation_id"] != "original"]
-        vals = pd.to_numeric(pert["confidence"], errors="coerce").dropna().to_numpy()
         orig = sub[sub["perturbation_id"] == "original"]
-        orig_conf = (
-            pd.to_numeric(orig["confidence"], errors="coerce").iloc[0]
-            if len(orig)
-            else np.nan
+        vals_all = pd.to_numeric(sub["confidence"], errors="coerce").dropna()
+        vals_pert = pd.to_numeric(pert["confidence"], errors="coerce").dropna()
+        if len(orig):
+            orig_resp = orig["response"].iloc[0]
+            orig_conf = pd.to_numeric(orig["confidence"], errors="coerce").iloc[0]
+        elif len(pert):
+            # missing original (a failed eval): synthesize the reference's
+            # fallback — the modal perturbed response + mean perturbed
+            # confidence (:522-542)
+            modes = pert["response"].mode()
+            orig_resp = modes.iloc[0] if len(modes) else pert["response"].iloc[0]
+            orig_conf = float(vals_pert.mean()) if vals_pert.size else np.nan
+        else:
+            orig_resp, orig_conf = None, np.nan
+        # rows whose response leg is missing (legacy checkpoints, one-leg
+        # errors) are excluded from the consistency denominator instead of
+        # silently counting as disagreement
+        pert_resp = pert["response"].dropna()
+        consistency = (
+            float((pert_resp == orig_resp).mean()) if len(pert_resp) else 1.0
         )
         rec = {
             "model": model,
             "scenario_name": scenario,
-            "n": int(vals.size),
+            "consistency": consistency,
             "original_confidence": float(orig_conf) if pd.notna(orig_conf) else np.nan,
+            "original_response": orig_resp,
+            "num_perturbations": int(len(pert)),
+            "num_total_samples": int(len(sub)),
+            "n_samples": int(vals_all.size),
         }
-        if vals.size:
-            p = np.percentile(vals, [2.5, 97.5])
+        if vals_all.size:
+            p = np.percentile(vals_all, [2.5, 97.5])
             rec.update(
-                mean=float(vals.mean()), std=float(vals.std()),
-                p2_5=float(p[0]), p97_5=float(p[1]),
+                mean_all_confidence=float(vals_all.mean()),
+                std_all_confidence=float(vals_all.std()),
+                median_all_confidence=float(vals_all.median()),
+                ci_lower_95=float(p[0]), ci_upper_95=float(p[1]),
                 ci_width=float(p[1] - p[0]),
+            )
+        if vals_pert.size:
+            rec.update(
+                mean_perturbed_confidence=float(vals_pert.mean()),
+                std_perturbed_confidence=float(vals_pert.std()),
             )
         records.append(rec)
     return pd.DataFrame(records)

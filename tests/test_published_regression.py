@@ -288,3 +288,52 @@ def test_appendix_inter_model_correlations():
     assert summary["n_pairs"] == 28
     lo, hi = summary["mean_ci"]
     assert lo == pytest.approx(-0.015, abs=0.01) and hi == pytest.approx(0.126, abs=0.01)
+
+
+def test_irrelevant_perturbation_summary_matches_recorded():
+    """Irrelevant-insertion study (paper Appendix C): the reference's raw
+    results workbook through our consistency_statistics reproduces every
+    recorded row of its summary.csv (consistency, pooled and perturbed-only
+    confidence stats, CIs, sample counts) to float precision."""
+    from llm_interpretation_replication_tpu.analysis.irrelevant_eval import (
+        consistency_statistics,
+    )
+    from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
+
+    wb_path = f"{REF}/results/irrelevant_perturbations/results_analysis.xlsx"
+    if not os.path.exists(wb_path):
+        pytest.skip("irrelevant-perturbation workbook not mounted")
+    wb = read_xlsx(wb_path)
+    ref = pd.read_csv(f"{REF}/results/irrelevant_perturbations/summary.csv")
+    is_orig = wb["is_original"].astype(str).str.lower().isin(("true", "1", "1.0"))
+    frame = pd.DataFrame({
+        "model": wb["model"],
+        "scenario_name": wb["scenario"],
+        "perturbation_id": np.where(is_orig, "original", wb["perturbation_id"]),
+        "response": wb["response"],
+        "confidence": wb["confidence"],
+    })
+    stats = consistency_statistics(frame)
+    assert len(stats) == len(ref)
+    merged = 0
+    for _, want in ref.iterrows():
+        got = stats[(stats["model"] == want["model"])
+                    & (stats["scenario_name"] == want["scenario"])].iloc[0]
+        for ours, theirs in (
+            ("consistency", "consistency"),
+            ("original_confidence", "original_confidence"),
+            ("mean_all_confidence", "mean_all_confidence"),
+            ("std_all_confidence", "std_all_confidence"),
+            ("median_all_confidence", "median_all_confidence"),
+            ("ci_lower_95", "ci_lower_95"),
+            ("ci_upper_95", "ci_upper_95"),
+            ("mean_perturbed_confidence", "mean_perturbed_confidence"),
+            ("std_perturbed_confidence", "std_perturbed_confidence"),
+        ):
+            assert got[ours] == pytest.approx(want[theirs], abs=1e-9), (
+                want["scenario"], want["model"], ours)
+        assert int(got["n_samples"]) == int(want["n_samples"])
+        assert int(got["num_perturbations"]) == int(want["num_perturbations"])
+        assert got["original_response"] == want["original_response"]
+        merged += 1
+    assert merged == len(ref) == 15          # 5 scenarios x 3 models
