@@ -149,25 +149,39 @@ def consistency_statistics(df: pd.DataFrame) -> pd.DataFrame:
         orig = sub[sub["perturbation_id"] == "original"]
         vals_all = pd.to_numeric(sub["confidence"], errors="coerce").dropna()
         vals_pert = pd.to_numeric(pert["confidence"], errors="coerce").dropna()
+        def usable(series: pd.Series) -> pd.Series:
+            # a response is usable when present and not a one-leg ERROR
+            # sentinel (run_one records those to keep the sweep alive)
+            s = series.dropna()
+            return s[~s.astype(str).str.startswith("ERROR:")]
+
+        orig_resp, orig_conf = None, np.nan
         if len(orig):
-            orig_resp = orig["response"].iloc[0]
             orig_conf = pd.to_numeric(orig["confidence"], errors="coerce").iloc[0]
-        elif len(pert):
-            # missing original (a failed eval): synthesize the reference's
+            orig_usable = usable(orig["response"])
+            if len(orig_usable):
+                orig_resp = orig_usable.iloc[0]
+        if orig_resp is None and len(pert):
+            # missing (or errored) original: synthesize the reference's
             # fallback — the modal perturbed response + mean perturbed
             # confidence (:522-542)
-            modes = pert["response"].mode()
-            orig_resp = modes.iloc[0] if len(modes) else pert["response"].iloc[0]
-            orig_conf = float(vals_pert.mean()) if vals_pert.size else np.nan
+            modes = usable(pert["response"]).mode()
+            if len(modes):
+                orig_resp = modes.iloc[0]
+            if pd.isna(orig_conf):
+                orig_conf = float(vals_pert.mean()) if vals_pert.size else np.nan
+        # rows whose response leg is missing or errored (legacy checkpoints,
+        # one-leg failures) are excluded from the consistency denominator
+        # instead of silently counting as disagreement.  No perturbations at
+        # all -> trivially consistent (reference :565); perturbations exist
+        # but none measurable -> NaN, not a fabricated perfect score.
+        pert_resp = usable(pert["response"])
+        if len(pert_resp) and orig_resp is not None:
+            consistency = float((pert_resp == orig_resp).mean())
+        elif len(pert) == 0:
+            consistency = 1.0
         else:
-            orig_resp, orig_conf = None, np.nan
-        # rows whose response leg is missing (legacy checkpoints, one-leg
-        # errors) are excluded from the consistency denominator instead of
-        # silently counting as disagreement
-        pert_resp = pert["response"].dropna()
-        consistency = (
-            float((pert_resp == orig_resp).mean()) if len(pert_resp) else 1.0
-        )
+            consistency = float("nan")
         rec = {
             "model": model,
             "scenario_name": scenario,
