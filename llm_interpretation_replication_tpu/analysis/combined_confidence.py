@@ -60,7 +60,9 @@ class ModelConfidenceAnalyzer:
                         "model": model,
                         "n": int(vals.size),
                         "mean": float(vals.mean()),
-                        "std": float(vals.std()),
+                        # ddof=1: the reference's pandas .std() convention
+                        # (pinned against per_prompt_statistics.csv)
+                        "std": float(vals.std(ddof=1)) if vals.size > 1 else 0.0,
                         "p2_5": float(p[0]),
                         "p97_5": float(p[1]),
                         "ci_width": float(p[1] - p[0]),
@@ -138,9 +140,10 @@ class ModelConfidenceAnalyzer:
         return output_path
 
 
-def run_combined_analysis(frames: Dict[str, pd.DataFrame], output_dir: str) -> Dict:
+def run_combined_analysis(frames: Dict[str, pd.DataFrame], output_dir: str,
+                          confidence_col: str = "Weighted Confidence") -> Dict:
     os.makedirs(output_dir, exist_ok=True)
-    analyzer = ModelConfidenceAnalyzer(frames)
+    analyzer = ModelConfidenceAnalyzer(frames, confidence_col=confidence_col)
     stats = analyzer.summary_stats()
     corr = analyzer.cross_model_correlations()
     stats.to_csv(os.path.join(output_dir, "combined_confidence_stats.csv"), index=False)

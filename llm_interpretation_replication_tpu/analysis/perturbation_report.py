@@ -69,7 +69,9 @@ def analyze_model(
             p = np.percentile(finite, [2.5, 97.5])
             rec["summary"] = {
                 "mean": float(finite.mean()),
-                "std": float(finite.std()),
+                # ddof=1 to match the reference's pandas describe() stats
+                # (analyze_perturbation_results.py:1789-1845)
+                "std": float(finite.std(ddof=1)) if finite.size > 1 else 0.0,
                 "median": float(np.median(finite)),
                 "p2_5": float(p[0]),
                 "p97_5": float(p[1]),

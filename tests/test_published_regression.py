@@ -337,3 +337,39 @@ def test_irrelevant_perturbation_summary_matches_recorded():
         assert got["original_response"] == want["original_response"]
         merged += 1
     assert merged == len(ref) == 15          # 5 scenarios x 3 models
+
+
+def test_combined_analysis_per_prompt_stats():
+    """Three-model combiner (combine_model_confidence_analysis.py) vs the
+    recorded combined_analysis/per_prompt_statistics.csv: per-prompt mean and
+    (ddof=1) std for the two models whose raw workbooks survive in the mount
+    (Claude Opus 4, Gemini 2.0) match to float precision.  The GPT-4.1
+    workbook was stripped (.MISSING_LARGE_BLOBS) so its column is untestable."""
+    from llm_interpretation_replication_tpu.analysis.combined_confidence import (
+        ModelConfidenceAnalyzer,
+    )
+    from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
+
+    per_prompt = f"{REF}/results/combined_analysis/per_prompt_statistics.csv"
+    if not os.path.exists(per_prompt):
+        pytest.skip("combined-analysis artifacts not mounted")
+    analyzer = ModelConfidenceAnalyzer(
+        {
+            "Claude Opus 4": read_xlsx(f"{REF}/results/claude_opus_batch_perturbation_results.xlsx"),
+            "Gemini 2.0": read_xlsx(f"{REF}/results/gemini_perturbation_results.xlsx"),
+        },
+        confidence_col="Confidence Value",
+    )
+    stats = analyzer.summary_stats()
+    ref = pd.read_csv(per_prompt)
+    checked = 0
+    for _, want in ref.iterrows():
+        prefix = str(want["Original Prompt"])[:40]
+        for model in ("Claude Opus 4", "Gemini 2.0"):
+            got = stats[stats["scenario"].astype(str).str.startswith(prefix)
+                        & (stats["model"] == model)]
+            assert len(got) == 1
+            assert got["mean"].iloc[0] == pytest.approx(want[f"{model} Mean"], abs=1e-9)
+            assert got["std"].iloc[0] == pytest.approx(want[f"{model} Std"], abs=1e-9)
+            checked += 1
+    assert checked == 10          # 5 prompts x 2 surviving models
