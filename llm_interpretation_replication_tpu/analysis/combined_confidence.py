@@ -25,7 +25,7 @@ class ModelConfidenceAnalyzer:
     statistics."""
 
     def __init__(self, frames: Dict[str, pd.DataFrame],
-                 confidence_col: str = "Weighted Confidence"):
+                 confidence_col: str = "Confidence Value"):
         self.confidence_col = confidence_col
         self.frames = frames
         self.combined = self._combine()
@@ -34,7 +34,11 @@ class ModelConfidenceAnalyzer:
         keys = ["Original Main Part", "Rephrased Main Part"]
         combined: Optional[pd.DataFrame] = None
         for model, df in self.frames.items():
-            col = self.confidence_col if self.confidence_col in df.columns else "Confidence Value"
+            # the reference combiner reads 'Confidence Value' unconditionally
+            # (combine_model_confidence_analysis.py:52-55); fall back to the
+            # weighted column only when a frame lacks it
+            col = (self.confidence_col if self.confidence_col in df.columns
+                   else "Weighted Confidence")
             sub = df[keys + [col]].copy()
             sub[f"confidence_{model}"] = pd.to_numeric(sub[col], errors="coerce")
             sub = sub.drop(columns=[col])
@@ -61,8 +65,9 @@ class ModelConfidenceAnalyzer:
                         "n": int(vals.size),
                         "mean": float(vals.mean()),
                         # ddof=1: the reference's pandas .std() convention
-                        # (pinned against per_prompt_statistics.csv)
-                        "std": float(vals.std(ddof=1)) if vals.size > 1 else 0.0,
+                        # (pinned against per_prompt_statistics.csv); a
+                        # single sample has no ddof-1 std, like pandas
+                        "std": float(vals.std(ddof=1)) if vals.size > 1 else float("nan"),
                         "p2_5": float(p[0]),
                         "p97_5": float(p[1]),
                         "ci_width": float(p[1] - p[0]),
@@ -141,7 +146,7 @@ class ModelConfidenceAnalyzer:
 
 
 def run_combined_analysis(frames: Dict[str, pd.DataFrame], output_dir: str,
-                          confidence_col: str = "Weighted Confidence") -> Dict:
+                          confidence_col: str = "Confidence Value") -> Dict:
     os.makedirs(output_dir, exist_ok=True)
     analyzer = ModelConfidenceAnalyzer(frames, confidence_col=confidence_col)
     stats = analyzer.summary_stats()

@@ -70,8 +70,9 @@ def analyze_model(
             rec["summary"] = {
                 "mean": float(finite.mean()),
                 # ddof=1 to match the reference's pandas describe() stats
-                # (analyze_perturbation_results.py:1789-1845)
-                "std": float(finite.std(ddof=1)) if finite.size > 1 else 0.0,
+                # (analyze_perturbation_results.py:1789-1845); single-sample
+                # std is NaN, like pandas
+                "std": float(finite.std(ddof=1)) if finite.size > 1 else float("nan"),
                 "median": float(np.median(finite)),
                 "p2_5": float(p[0]),
                 "p97_5": float(p[1]),

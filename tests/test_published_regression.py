@@ -353,13 +353,12 @@ def test_combined_analysis_per_prompt_stats():
     per_prompt = f"{REF}/results/combined_analysis/per_prompt_statistics.csv"
     if not os.path.exists(per_prompt):
         pytest.skip("combined-analysis artifacts not mounted")
-    analyzer = ModelConfidenceAnalyzer(
-        {
-            "Claude Opus 4": read_xlsx(f"{REF}/results/claude_opus_batch_perturbation_results.xlsx"),
-            "Gemini 2.0": read_xlsx(f"{REF}/results/gemini_perturbation_results.xlsx"),
-        },
-        confidence_col="Confidence Value",
-    )
+    # default constructor args = the production path (the reference combiner
+    # reads 'Confidence Value' unconditionally)
+    analyzer = ModelConfidenceAnalyzer({
+        "Claude Opus 4": read_xlsx(f"{REF}/results/claude_opus_batch_perturbation_results.xlsx"),
+        "Gemini 2.0": read_xlsx(f"{REF}/results/gemini_perturbation_results.xlsx"),
+    })
     stats = analyzer.summary_stats()
     ref = pd.read_csv(per_prompt)
     checked = 0
