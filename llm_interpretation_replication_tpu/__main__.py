@@ -155,6 +155,55 @@ def cmd_run_perturbation(args):
     print(f"{len(df)} rows")
 
 
+def cmd_analyze_survey(args):
+    from .survey.pipeline import run_consolidated_analysis
+
+    run_consolidated_analysis(
+        [args.survey1_csv, args.survey2_csv], args.llm_csv, args.output_dir,
+        n_bootstrap=args.bootstrap, cross_prompt_bootstrap=args.cross_prompt_bootstrap,
+    )
+
+
+def cmd_analyze_combined(args):
+    from .analysis.combined_confidence import run_combined_analysis
+    from .utils.xlsx import read_xlsx
+
+    frames = {}
+    for spec in args.workbook:
+        name, sep, path = spec.partition("=")
+        if not sep or not name or not path:
+            raise SystemExit(f"--workbook expects NAME=PATH, got {spec!r}")
+        if name in frames:
+            raise SystemExit(f"duplicate workbook name {name!r}")
+        frames[name] = read_xlsx(path)
+    out = run_combined_analysis(frames, args.output_dir)
+    print(out["stats"].to_string(index=False))
+
+
+def cmd_demographics(args):
+    from .survey.demographics import demographics_latex_table, load_demographics
+
+    from .survey.demographics import summarize_age
+
+    df = load_demographics(list(args.csv))
+    columns = args.column or ["Sex", "Ethnicity simplified", "Employment status",
+                              "Student status"]
+    tex = demographics_latex_table(df, columns)
+    age = summarize_age(df)
+    age_block = (
+        "\n% Age summary (reference generate_demographics_table.py:115-120)\n"
+        f"% n={age['n']} mean={age['mean']:.1f} median={age['median']:.0f} "
+        f"range {age['min']:.0f}-{age['max']:.0f}\n"
+    )
+    tex = tex + age_block
+    if args.output:
+        with open(args.output, "w") as f:
+            f.write(tex)
+        print(f"wrote {args.output}")
+    else:
+        print(tex)
+
+
 def cmd_generate_irrelevant(args):
     from .config import irrelevant_scenarios, irrelevant_statements
     from .gen.irrelevant import generate_perturbations, save_perturbations
@@ -242,6 +291,34 @@ def main(argv=None):
     p.add_argument("--perturbations", required=True)
     p.add_argument("--max-rephrasings", type=int, default=None)
     p.set_defaults(fn=cmd_run_perturbation)
+
+    p = sub.add_parser("analyze-survey",
+                       help="consolidated human-vs-LLM survey analysis")
+    p.add_argument("--survey1-csv", required=True)
+    p.add_argument("--survey2-csv", required=True)
+    p.add_argument("--llm-csv", required=True,
+                   help="instruct_model_comparison_results_combined.csv")
+    p.add_argument("--output-dir", default="results/survey_analysis")
+    p.add_argument("--bootstrap", type=int, default=1000)
+    p.add_argument("--cross-prompt-bootstrap", type=int, default=100)
+    p.set_defaults(fn=cmd_analyze_survey)
+
+    p = sub.add_parser("analyze-combined",
+                       help="three-model confidence combiner over sweep workbooks")
+    p.add_argument("--workbook", action="append", required=True,
+                   metavar="NAME=PATH", help="repeat per model")
+    p.add_argument("--output-dir", default="results/combined_analysis")
+    p.set_defaults(fn=cmd_analyze_combined)
+
+    p = sub.add_parser("demographics-table",
+                       help="Prolific demographics LaTeX table")
+    p.add_argument("--csv", action="append", required=True)
+    p.add_argument("--column", action="append", default=None,
+                   help="repeat per categorical column (default: Sex, "
+                        "Ethnicity simplified, Employment status, Student "
+                        "status; an Age summary comment is always appended)")
+    p.add_argument("--output", default=None)
+    p.set_defaults(fn=cmd_demographics)
 
     p = sub.add_parser("generate-irrelevant", help="build perturbations_irrelevant.json")
     p.add_argument("--output", default="data/perturbations_irrelevant.json")

@@ -432,3 +432,132 @@ def cross_prompt_difference_ci(human_result: Dict, llm_result: Dict,
         "ci_upper": float(np.percentile(diffs, 97.5)),
         "p_value": min(p, 1.0),
     }
+
+
+def meta_correlation(human_agreements: Dict, llm_agreements: Dict,
+                     matches: Dict, n_bootstrap: int = 1000,
+                     seed: int = 42) -> Dict:
+    """Correlation between per-item human and LLM agreement patterns
+    (survey_analysis_consolidated.py:808-852): do humans and models find the
+    SAME questions contentious?"""
+    h_vals, l_vals = [], []
+    for llm_prompt, survey_q in matches.items():
+        h = human_agreements["per_item"].get(survey_q)
+        l = llm_agreements["per_item"].get(llm_prompt)
+        if h is not None and l is not None:
+            h_vals.append(h["mean_agreement"])
+            l_vals.append(l["mean_agreement"])
+    base = {
+        "n_matched_items": len(h_vals),
+        "human_mean_agreement": human_agreements["overall_mean"],
+        "human_std_agreement": human_agreements["overall_std"],
+        "llm_mean_agreement": llm_agreements["overall_mean"],
+        "llm_std_agreement": llm_agreements["overall_std"],
+    }
+    if len(h_vals) < 2:
+        return {**base, "correlation": None,
+                "interpretation": "Insufficient matched items for correlation"}
+    result = pearson_with_bootstrap(h_vals, l_vals, n_bootstrap=n_bootstrap, seed=seed)
+    return {**base, **result,
+            "interpretation": "Correlation between human and LLM per-item "
+                              "agreement patterns"}
+
+
+def run_consolidated_analysis(
+    survey_csvs: Sequence[str],
+    llm_csv: str,
+    output_dir: str,
+    n_bootstrap: int = 1000,
+    cross_prompt_bootstrap: int = 100,
+    seed: int = 42,
+    log=print,
+) -> Dict:
+    """The consolidated survey analysis end-to-end
+    (survey_analysis_consolidated.py main(), :1028-1104): load + clean both
+    survey parts, apply the preregistered exclusions, match LLM prompts,
+    compute human/LLM stats, question-level correlation, per-item agreements,
+    meta-correlation, cross-prompt correlations and their difference CI, then
+    write ``report.txt`` + ``results.json``."""
+    import json
+    import os
+
+    os.makedirs(output_dir, exist_ok=True)
+    df, cols = load_and_clean_survey_data(survey_csvs)
+    llm_df = pd.read_csv(llm_csv)
+    clean, exclusions = apply_exclusion_criteria(df, cols)
+    log(f"Exclusions: {exclusions}")
+    matches, mapping = match_survey_to_llm_questions(llm_df, survey_csvs)
+    human_stats = human_responses_by_question(clean, cols)
+    llm_stats = llm_responses_by_question(llm_df)
+    corr = human_llm_correlation(human_stats, llm_stats, matches, seed=seed)
+    hum_item = per_item_agreement_humans(clean, cols, n_bootstrap=n_bootstrap, seed=seed)
+    llm_item = per_item_agreement_llms(llm_df, n_bootstrap=n_bootstrap, seed=seed)
+    meta = meta_correlation(hum_item, llm_item, matches, n_bootstrap=n_bootstrap, seed=seed)
+    hum_cp = human_cross_prompt_correlations(clean, cols, n_bootstrap=cross_prompt_bootstrap, seed=seed)
+    llm_cp = llm_cross_prompt_correlations(llm_df, mapping, n_bootstrap=cross_prompt_bootstrap, seed=seed)
+    diff = cross_prompt_difference_ci(hum_cp, llm_cp, n_bootstrap=n_bootstrap, seed=seed)
+
+    results = {
+        "exclusions": exclusions,
+        "n_survey_questions": len(human_stats),
+        "n_llm_prompts": len(llm_stats),
+        "n_matched": len(matches),
+        "human_llm_correlation": (
+            {k: v for k, v in corr.items() if k != "matched_questions"}
+            if corr else None
+        ),
+        "human_agreement": {k: v for k, v in hum_item.items() if k != "per_item"},
+        "llm_agreement": {k: v for k, v in llm_item.items() if k != "per_item"},
+        "meta_correlation": meta,
+        # per-pair pools are large; the report keeps the summary statistics
+        "human_cross_prompt": {k: v for k, v in hum_cp.items()
+                               if k not in ("group_results", "pairwise_correlations")},
+        "llm_cross_prompt": {k: v for k, v in llm_cp.items()
+                             if k not in ("group_results", "pairwise_correlations")},
+        "cross_prompt_difference": diff,
+    }
+    with open(os.path.join(output_dir, "results.json"), "w") as f:
+        json.dump(results, f, indent=2, default=float)
+
+    lines = [
+        "=" * 80,
+        "CONSOLIDATED SURVEY ANALYSIS - HUMAN vs LLM ORDINARY MEANING AGREEMENT",
+        "=" * 80,
+        "",
+        "EXCLUSION STATISTICS:",
+        f"  Initial respondents: {exclusions['final_count'] + exclusions['total_excluded']}",
+        f"  Excluded for short duration: {exclusions['duration_excluded']}",
+        f"  Excluded for identical responses: {exclusions['identical_excluded']}",
+        f"  Excluded for attention check failure: {exclusions['attention_failed']}",
+        f"  Final sample size: {exclusions['final_count']}",
+        "",
+        "QUESTION MATCHING:",
+        f"  Survey questions: {len(human_stats)}; LLM prompts: {len(llm_stats)}; "
+        f"matched: {len(matches)}",
+        "",
+    ]
+    if corr:
+        lines += [
+            "HUMAN-LLM CORRELATION (question level):",
+            f"  Pearson r = {corr['correlation']:.3f} "
+            f"[{corr['ci_lower']:.3f}, {corr['ci_upper']:.3f}] "
+            f"(n={corr['n_questions']})",
+            "",
+        ]
+    lines += [
+        "PER-ITEM AGREEMENT (1 - |delta|):",
+        f"  Humans: {hum_item['overall_mean']:.3f} over {hum_item['n_items']} items",
+        f"  LLMs:   {llm_item['overall_mean']:.3f} over {llm_item['n_items']} items",
+        "",
+        "CROSS-PROMPT CORRELATIONS (within 10-question groups):",
+        f"  Humans: {hum_cp['mean_correlation']:.3f} "
+        f"[{hum_cp['ci_lower']:.3f}, {hum_cp['ci_upper']:.3f}]",
+        f"  LLMs:   {llm_cp['mean_correlation']:.3f} "
+        f"[{llm_cp['ci_lower']:.3f}, {llm_cp['ci_upper']:.3f}]",
+        f"  Difference: {diff['difference']:.3f} "
+        f"[{diff['ci_lower']:.3f}, {diff['ci_upper']:.3f}], p={diff['p_value']:.4f}",
+    ]
+    with open(os.path.join(output_dir, "report.txt"), "w") as f:
+        f.write("\n".join(lines) + "\n")
+    log("\n".join(lines))
+    return results

@@ -114,3 +114,55 @@ def test_run_closed_source_cli_short_circuit(tmp_path, capsys):
     ])
     assert (out / "correlations.json").exists()
     assert (out / "mae_results_tables.tex").exists()
+
+
+@pytest.mark.skipif(not os.path.exists(REF2), reason="reference not mounted")
+def test_analyze_survey_cli_real_data(tmp_path, capsys):
+    """analyze-survey end-to-end on the real exports: report + JSON with the
+    paper's exclusion counts and the published cross-prompt point estimates."""
+    out = tmp_path / "survey"
+    main([
+        "analyze-survey",
+        "--survey1-csv", "/root/reference/data/word_meaning_survey_results.csv",
+        "--survey2-csv", REF2,
+        "--llm-csv", "/root/reference/data/instruct_model_comparison_results_combined.csv",
+        "--output-dir", str(out),
+        "--bootstrap", "50", "--cross-prompt-bootstrap", "3",
+    ])
+    results = json.loads((out / "results.json").read_text())
+    assert results["exclusions"]["attention_failed"] == 115
+    assert results["exclusions"]["identical_excluded"] == 9
+    assert round(results["human_cross_prompt"]["mean_correlation"], 3) == 0.285
+    assert round(results["llm_cross_prompt"]["mean_correlation"], 3) == 0.052
+    assert results["meta_correlation"]["n_matched_items"] > 50
+    report = (out / "report.txt").read_text()
+    assert "Final sample size: 884" in report
+
+
+@pytest.mark.skipif(not os.path.exists(REF2), reason="reference not mounted")
+def test_demographics_table_cli(tmp_path, capsys):
+    out = tmp_path / "demo.tex"
+    main([
+        "demographics-table",
+        "--csv", "/root/reference/data/demographic_data.csv",
+        "--csv", "/root/reference/data/demographic_data_part_2.csv",
+        "--output", str(out),
+    ])
+    tex = out.read_text()
+    assert tex.startswith("\\begin{tabular}") and "\\textbf{Sex}" in tex
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/results/claude_opus_batch_perturbation_results.xlsx"),
+    reason="reference not mounted")
+def test_analyze_combined_cli(tmp_path, capsys):
+    out = tmp_path / "combined"
+    main([
+        "analyze-combined",
+        "--workbook", "Claude=/root/reference/results/claude_opus_batch_perturbation_results.xlsx",
+        "--workbook", "Gemini=/root/reference/results/gemini_perturbation_results.xlsx",
+        "--output-dir", str(out),
+    ])
+    assert (out / "combined_confidence_stats.csv").exists()
+    assert (out / "cross_model_correlations.csv").exists()
+    assert "Claude" in capsys.readouterr().out
