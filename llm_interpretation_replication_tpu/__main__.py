@@ -155,6 +155,30 @@ def cmd_run_perturbation(args):
     print(f"{len(df)} rows")
 
 
+def cmd_run_api_perturbation(args):
+    import os
+
+    from .api_backends.cost import CostTracker
+    from .api_backends.openai_client import OpenAIClient
+    from .config import legal_scenarios
+    from .gen.rephrase import load_perturbations
+    from .sweeps.api_perturbation import run_api_perturbation_sweep
+
+    key = os.environ.get("OPENAI_API_KEY")
+    if not key:
+        raise SystemExit("OPENAI_API_KEY not set")
+    scenarios = load_perturbations(args.perturbations,
+                                   expected_scenarios=legal_scenarios())
+    cost = CostTracker()
+    run_api_perturbation_sweep(
+        OpenAIClient(key), args.model, scenarios, args.output,
+        max_rephrasings=args.max_rephrasings,
+        skip_reasoning_logprobs=not args.reasoning_logprob_runs,
+        cost_tracker=cost,
+    )
+    print(cost.summary())
+
+
 def cmd_analyze_survey(args):
     from .survey.pipeline import run_consolidated_analysis
 
@@ -181,9 +205,11 @@ def cmd_analyze_combined(args):
 
 
 def cmd_demographics(args):
-    from .survey.demographics import demographics_latex_table, load_demographics
-
-    from .survey.demographics import summarize_age
+    from .survey.demographics import (
+        demographics_latex_table,
+        load_demographics,
+        summarize_age,
+    )
 
     df = load_demographics(list(args.csv))
     columns = args.column or ["Sex", "Ethnicity simplified", "Employment status",
@@ -291,6 +317,19 @@ def main(argv=None):
     p.add_argument("--perturbations", required=True)
     p.add_argument("--max-rephrasings", type=int, default=None)
     p.set_defaults(fn=cmd_run_perturbation)
+
+    p = sub.add_parser("run-api-perturbation",
+                       help="frontier-model 10k-perturbation sweep via the "
+                            "OpenAI Batch API (key via env)")
+    p.add_argument("--perturbations", required=True, help="perturbations.json")
+    p.add_argument("--model", action="append", required=True,
+                   help="repeat per model (<=3 run concurrently)")
+    p.add_argument("--output", default="results/perturbation_results_api.xlsx")
+    p.add_argument("--max-rephrasings", type=int, default=None)
+    p.add_argument("--reasoning-logprob-runs", action="store_true",
+                   help="approximate reasoning-model logprobs with 10 repeats "
+                        "instead of skipping the binary leg")
+    p.set_defaults(fn=cmd_run_api_perturbation)
 
     p = sub.add_parser("analyze-survey",
                        help="consolidated human-vs-LLM survey analysis")

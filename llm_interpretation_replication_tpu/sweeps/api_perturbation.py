@@ -1,0 +1,299 @@
+"""Frontier-API perturbation sweep — the study-1 batch orchestration.
+
+Rebuild of perturb_prompts.py's multi-model OpenAI Batch run
+(:190-269 create_batch_requests, :398-549 extract_results_from_batch,
+:551-667 process_model_batch, :917-946 ThreadPoolExecutor fan-out): per
+scenario x rephrasing build the binary + confidence request pair, skip
+triples already in the output workbook, submit through the client's
+chunked batch lifecycle (50k cap, 24h window, 60s polling), extract
+first-token target probabilities and the int-token weighted confidence,
+and append the 15-column workbook incrementally per model.
+
+Reasoning models (o*/gpt-5*) follow the reference's two modes: with
+``skip_reasoning_logprobs`` (the default, SKIP_REASONING_MODEL_LOGPROBS=True
+:48) only the confidence leg runs; otherwise the binary leg repeats
+``REASONING_MODEL_RUNS`` times and probabilities are response-frequency
+approximations (:412-445).
+"""
+
+from __future__ import annotations
+
+import time
+from concurrent.futures import ThreadPoolExecutor, as_completed
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+import numpy as np
+import pandas as pd
+
+from ..api_backends.openai_client import build_batch_request, is_reasoning_model
+from ..scoring.confidence import extract_first_int, weighted_confidence_single_tokens
+from ..utils.logging import SessionLogger
+from ..utils.xlsx import append_xlsx, read_xlsx
+from .writers import PERTURBATION_COLUMNS
+
+REASONING_MODEL_RUNS = 10  # perturb_prompts.py:46-47
+
+
+def load_processed_triples(output_xlsx: str) -> Set[Tuple[str, str, str]]:
+    """(Model, Original Main Part, Rephrased Main Part) triples already in the
+    output workbook (resume semantics, perturb_prompts.py:161-188)."""
+    import os
+
+    if not os.path.exists(output_xlsx):
+        return set()
+    df = read_xlsx(output_xlsx)
+    return {
+        (str(r["Model"]), str(r["Original Main Part"]), str(r["Rephrased Main Part"]))
+        for _, r in df.iterrows()
+    }
+
+
+def create_batch_requests(
+    model: str,
+    scenarios: Sequence[Dict],
+    processed: Optional[Set[Tuple[str, str, str]]] = None,
+    skip_reasoning_logprobs: bool = True,
+    max_rephrasings: Optional[int] = None,
+) -> Tuple[List[Dict], Dict[str, Dict]]:
+    """Request list + custom_id -> prompt-info mapping (reference :190-269).
+
+    ``scenarios`` are perturbations.json records (original_main,
+    response_format, target_tokens, confidence_format, rephrasings).
+    """
+    reasoning = is_reasoning_model(model)
+    requests: List[Dict] = []
+    id_mapping: Dict[str, Dict] = {}
+    counter = 0
+    for prompt_idx, scenario in enumerate(scenarios):
+        rephrasings = scenario["rephrasings"]
+        if max_rephrasings is not None:      # 0 means "none", not "all"
+            rephrasings = rephrasings[:max_rephrasings]
+        for rephrase_idx, rephrased in enumerate(rephrasings):
+            if processed and (model, scenario["original_main"], rephrased) in processed:
+                continue
+            formats = (
+                ["confidence"] if (reasoning and skip_reasoning_logprobs)
+                else ["binary", "confidence"]
+            )
+            for format_type in formats:
+                suffix = (scenario["response_format"] if format_type == "binary"
+                          else scenario["confidence_format"])
+                full_prompt = f"{rephrased} {suffix}"
+                runs = (REASONING_MODEL_RUNS
+                        if reasoning and format_type == "binary" else 1)
+                for run_idx in range(runs):
+                    custom_id = f"req-{counter}"
+                    id_mapping[custom_id] = {
+                        "prompt_idx": prompt_idx,
+                        "rephrase_idx": rephrase_idx,
+                        "format_type": format_type,
+                        "run_idx": run_idx,
+                        "original_main": scenario["original_main"],
+                        "response_format": scenario["response_format"],
+                        "confidence_format": scenario["confidence_format"],
+                        "rephrased_main": rephrased,
+                        "target_tokens": list(scenario["target_tokens"]),
+                        "model": model,
+                    }
+                    requests.append(
+                        build_batch_request(
+                            custom_id, model,
+                            [{"role": "user", "content": full_prompt}],
+                        )
+                    )
+                    counter += 1
+    return requests, id_mapping
+
+
+def group_batch_results(raw_results: Sequence[Dict],
+                        id_mapping: Dict[str, Dict]) -> Dict[Tuple[int, int], Dict]:
+    """Re-pair downloaded JSONL rows into per-(prompt, rephrasing) groups
+    (reference :352-396): binary runs accumulate, confidence is singular."""
+    grouped: Dict[Tuple[int, int], Dict] = {}
+    for row in raw_results:
+        info = id_mapping.get(row.get("custom_id"))
+        if info is None:
+            continue
+        body = (row.get("response") or {}).get("body")
+        if body is None or (row.get("error") is not None):
+            continue
+        key = (info["prompt_idx"], info["rephrase_idx"])
+        slot = grouped.setdefault(
+            key, {"mapping_info": info, "binary_results": [], "confidence_result": None}
+        )
+        if info["format_type"] == "binary":
+            slot["binary_results"].append(body)
+        else:
+            slot["confidence_result"] = body
+    return grouped
+
+
+def extract_results_from_batch(
+    grouped: Dict[Tuple[int, int], Dict],
+    model: str,
+    skip_reasoning_logprobs: bool = True,
+    log=None,
+) -> List[Dict]:
+    """Batch bodies -> 15-column workbook rows (reference :398-549)."""
+    reasoning = is_reasoning_model(model)
+    rows: List[Dict] = []
+    for key in sorted(grouped):
+        slot = grouped[key]
+        info = slot["mapping_info"]
+        binary_results = slot["binary_results"]
+        confidence_result = slot["confidence_result"]
+        if not binary_results and not (reasoning and skip_reasoning_logprobs):
+            if log:
+                log(f"Warning: no binary results for {key}")
+            continue
+
+        response_body = None
+        skip_mode = False
+        confidence_value = None
+        confidence_answer = ""
+        weighted_confidence = None
+        if reasoning and not skip_reasoning_logprobs:
+            # frequency-based probability approximation over the runs
+            t1 = t2 = 0
+            texts = []
+            for body in binary_results:
+                text = body["choices"][0]["message"]["content"].strip()
+                texts.append(text)
+                if info["target_tokens"][0] in text:
+                    t1 += 1
+                elif info["target_tokens"][1] in text:
+                    t2 += 1
+            n = len(binary_results)
+            token_1_prob = t1 / n if n else 0.0
+            token_2_prob = t2 / n if n else 0.0
+            answer_text = max(set(texts), key=texts.count) if texts else ""
+            if confidence_result:
+                confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
+                confidence_value = extract_first_int(confidence_answer)
+            weighted_confidence = confidence_value
+        elif reasoning:
+            answer_text = "N/A (skipped for reasoning model)"
+            token_1_prob = token_2_prob = 0.0
+            skip_mode = True
+            if confidence_result:
+                confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
+                confidence_value = extract_first_int(confidence_answer)
+                weighted_confidence = confidence_value
+        else:
+            response_body = binary_results[0]
+            answer_text = response_body["choices"][0]["message"]["content"].strip()
+            token_1_prob = token_2_prob = 0.0
+            content = ((response_body["choices"][0].get("logprobs") or {})
+                       .get("content") or [])
+            if content:
+                for cand in content[0].get("top_logprobs", []):
+                    if cand["token"] == info["target_tokens"][0]:
+                        token_1_prob = float(np.exp(cand["logprob"]))
+                    elif cand["token"] == info["target_tokens"][1]:
+                        token_2_prob = float(np.exp(cand["logprob"]))
+            if confidence_result:
+                confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
+                confidence_value = extract_first_int(confidence_answer)
+                # logprob-weighted expected value over int tokens 0-100
+                # across ALL positions (reference :505-526 — the batch path's
+                # simple int scan; scoring/confidence holds the shared impl)
+                positions = [
+                    [(c["token"], c["logprob"])
+                     for c in token_info.get("top_logprobs", [])]
+                    for token_info in ((confidence_result["choices"][0]
+                                        .get("logprobs") or {}).get("content") or [])
+                ]
+                weighted_confidence = weighted_confidence_single_tokens(positions)
+
+        # reference: skip-logprobs rows record 0.0, not inf (:455)
+        odds_ratio = (0.0 if skip_mode
+                      else token_1_prob / token_2_prob if token_2_prob > 0
+                      else float("inf"))
+        rows.append({
+            "Model": model,
+            "Original Main Part": info["original_main"],
+            "Response Format": info["response_format"],
+            "Confidence Format": info["confidence_format"],
+            "Rephrased Main Part": info["rephrased_main"],
+            "Full Rephrased Prompt": f"{info['rephrased_main']} {info['response_format']}",
+            "Full Confidence Prompt": f"{info['rephrased_main']} {info['confidence_format']}",
+            "Model Response": answer_text,
+            "Model Confidence Response": confidence_answer,
+            "Log Probabilities": (
+                "N/A for reasoning models" if reasoning
+                else str((response_body or {}).get("choices", [{}])[0].get("logprobs", {}))
+            ),
+            "Token_1_Prob": token_1_prob,
+            "Token_2_Prob": token_2_prob,
+            "Odds_Ratio": odds_ratio,
+            "Confidence Value": confidence_value,
+            "Weighted Confidence": weighted_confidence,
+        })
+    return rows
+
+
+def run_api_perturbation_sweep(
+    client,
+    models: Sequence[str],
+    scenarios: Sequence[Dict],
+    output_xlsx: str,
+    max_workers: int = 3,
+    poll_interval: float = 60.0,
+    skip_reasoning_logprobs: bool = True,
+    max_rephrasings: Optional[int] = None,
+    cost_tracker=None,
+    sleep=time.sleep,
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    """Fan ≤``max_workers`` models through the Batch API concurrently
+    (reference :917-946), appending each model's rows to ``output_xlsx`` as it
+    finishes so a crash keeps completed models (resume skips their triples)."""
+    log = log or SessionLogger()
+    processed = load_processed_triples(output_xlsx)
+
+    def run_model(model: str) -> List[Dict]:
+        requests, id_mapping = create_batch_requests(
+            model, scenarios, processed=processed,
+            skip_reasoning_logprobs=skip_reasoning_logprobs,
+            max_rephrasings=max_rephrasings,
+        )
+        if not requests:
+            log(f"{model}: nothing to do (all triples processed)")
+            return []
+        log(f"{model}: submitting {len(requests)} batch requests")
+        raw = client.run_batch(requests, poll_interval=poll_interval, sleep=sleep)
+        if cost_tracker is not None:
+            for row in raw:
+                usage = ((row.get("response") or {}).get("body") or {}).get("usage")
+                if usage:
+                    cost_tracker.record(
+                        model,
+                        usage.get("prompt_tokens", 0),
+                        usage.get("completion_tokens", 0),
+                    )
+        grouped = group_batch_results(raw, id_mapping)
+        return extract_results_from_batch(
+            grouped, model, skip_reasoning_logprobs=skip_reasoning_logprobs, log=log
+        )
+
+    failures: List[Tuple[str, Exception]] = []
+    with ThreadPoolExecutor(max_workers=max_workers) as pool:
+        futures = {pool.submit(run_model, model): model for model in models}
+        for future in as_completed(futures):
+            model = futures[future]
+            try:                 # one failed batch must not lose the others
+                rows = future.result()
+            except Exception as err:  # reference :929-946 per-model guard
+                log(f"{model}: FAILED — {err}")
+                failures.append((model, err))
+                continue
+            if rows:
+                append_xlsx(pd.DataFrame(rows, columns=PERTURBATION_COLUMNS), output_xlsx)
+                log(f"{model}: appended {len(rows)} rows to {output_xlsx}")
+    if failures and len(failures) == len(models):
+        raise RuntimeError(f"every model failed: {failures}")
+    import os
+
+    return read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else pd.DataFrame(
+        columns=PERTURBATION_COLUMNS
+    )

@@ -458,3 +458,168 @@ class TestCacheAndCost:
         tracker.record_response("m", {"usage": {"prompt_tokens": 10, "completion_tokens": 2}})
         assert tracker.usage["m"]["requests"] == 2
         assert tracker.total_cost() > 6.0
+
+
+class TestApiPerturbationSweep:
+    """Study-1 batch orchestration (perturb_prompts.py:190-667) through
+    FakeTransport: request pairing, chunked submit, extraction, resume,
+    reasoning-model modes."""
+
+    def _scenarios(self):
+        return [{
+            "original_main": "Scenario text one.",
+            "response_format": "Answer 'Covered' or 'Not'.",
+            "target_tokens": ["Covered", "Not"],
+            "confidence_format": "Confidence 0-100?",
+            "rephrasings": ["Rephrase A.", "Rephrase B."],
+        }]
+
+    def _client(self):
+        import math
+
+        from llm_interpretation_replication_tpu.api_backends.openai_client import (
+            OpenAIClient,
+        )
+
+        ft = FakeTransport()
+        uploads = {}
+
+        def upload(call):
+            fid = f"file-{len(uploads)}"
+            # multipart body carries the JSONL; stash per file id
+            uploads[fid] = call["data"]
+            return 200, {"id": fid}
+
+        ft.add("POST", "/files", upload)
+        ft.add("POST", "/batches", lambda c: (200, {
+            "id": "batch-1", "status": "validating",
+            "input_file_id": c["json"]["input_file_id"],
+        }))
+
+        def poll(_c):
+            # completed immediately; results derived from the uploaded JSONL
+            fid = next(iter(uploads))
+            return 200, {"id": "batch-1", "status": "completed",
+                         "output_file_id": f"out-{fid}"}
+
+        ft.add("GET", "/batches/batch-1", poll)
+
+        def download(call):
+            import json as _json
+
+            fid = call["url"].rsplit("/files/out-", 1)[1].split("/content")[0]
+            lines = []
+            for line in uploads[fid].decode(errors="ignore").splitlines():
+                line = line.strip()
+                if not line.startswith("{"):
+                    continue
+                req = _json.loads(line)
+                content = req["body"]["messages"][0]["content"]
+                if "Confidence" in content:
+                    body = {"choices": [{"message": {"content": "85"}, "logprobs": {
+                        "content": [{"top_logprobs": [
+                            {"token": "85", "logprob": math.log(0.6)},
+                            {"token": "90", "logprob": math.log(0.2)},
+                        ]}]}}],
+                        "usage": {"prompt_tokens": 9, "completion_tokens": 2}}
+                else:
+                    body = {"choices": [{"message": {"content": "Covered"}, "logprobs": {
+                        "content": [{"top_logprobs": [
+                            {"token": "Covered", "logprob": math.log(0.7)},
+                            {"token": "Not", "logprob": math.log(0.2)},
+                        ]}]}}],
+                        "usage": {"prompt_tokens": 9, "completion_tokens": 1}}
+                lines.append(_json.dumps({
+                    "custom_id": req["custom_id"], "response": {"body": body},
+                }))
+            return 200, "\n".join(lines).encode()
+
+        ft.add("GET", "/content", download)
+        return OpenAIClient("k", transport=ft, retry_policy=fast_retry()), ft
+
+    def test_full_sweep_schema_extraction_and_resume(self, tmp_path):
+        from llm_interpretation_replication_tpu.api_backends.cost import CostTracker
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            run_api_perturbation_sweep,
+        )
+        from llm_interpretation_replication_tpu.sweeps.writers import (
+            PERTURBATION_COLUMNS,
+        )
+
+        client, ft = self._client()
+        out = str(tmp_path / "results.xlsx")
+        cost = CostTracker(pricing={"gpt-4.1": {"input": 2.0, "output": 8.0}})
+        df = run_api_perturbation_sweep(
+            client, ["gpt-4.1"], self._scenarios(), out,
+            sleep=lambda _s: None, cost_tracker=cost,
+        )
+        assert list(df.columns) == PERTURBATION_COLUMNS
+        assert len(df) == 2                       # 2 rephrasings
+        assert df["Token_1_Prob"].iloc[0] == pytest.approx(0.7)
+        assert df["Token_2_Prob"].iloc[0] == pytest.approx(0.2)
+        assert df["Odds_Ratio"].iloc[0] == pytest.approx(0.7 / 0.2)
+        assert df["Confidence Value"].iloc[0] == 85
+        # weighted = (85*0.6 + 90*0.2) / 0.8
+        assert df["Weighted Confidence"].iloc[0] == pytest.approx(
+            (85 * 0.6 + 90 * 0.2) / 0.8)
+        assert cost.total_cost() > 0
+
+        # resume: everything processed -> no new uploads
+        uploads_before = sum(1 for c in ft.calls if c["url"].endswith("/files"))
+        df2 = run_api_perturbation_sweep(
+            client, ["gpt-4.1"], self._scenarios(), out, sleep=lambda _s: None,
+        )
+        uploads_after = sum(1 for c in ft.calls if c["url"].endswith("/files"))
+        assert uploads_after == uploads_before
+        assert len(df2) == 2
+
+    def test_reasoning_model_confidence_only(self, tmp_path):
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            create_batch_requests, extract_results_from_batch, group_batch_results,
+            run_api_perturbation_sweep,
+        )
+
+        requests, mapping = create_batch_requests("gpt-5", self._scenarios())
+        # skip_reasoning_logprobs default: confidence leg only
+        assert len(requests) == 2
+        assert all("max_completion_tokens" in r["body"] for r in requests)
+        assert all(m["format_type"] == "confidence" for m in mapping.values())
+
+        client, _ = self._client()
+        out = str(tmp_path / "r.xlsx")
+        df = run_api_perturbation_sweep(
+            client, ["gpt-5"], self._scenarios(), out, sleep=lambda _s: None,
+        )
+        assert (df["Model Response"] == "N/A (skipped for reasoning model)").all()
+        assert (df["Token_1_Prob"] == 0).all()
+        assert (df["Confidence Value"] == 85).all()
+        assert (df["Log Probabilities"] == "N/A for reasoning models").all()
+
+    def test_reasoning_model_frequency_runs(self):
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            REASONING_MODEL_RUNS, create_batch_requests, extract_results_from_batch,
+            group_batch_results,
+        )
+
+        requests, mapping = create_batch_requests(
+            "o3", self._scenarios(), skip_reasoning_logprobs=False,
+            max_rephrasings=1,
+        )
+        binary = [m for m in mapping.values() if m["format_type"] == "binary"]
+        assert len(binary) == REASONING_MODEL_RUNS
+        # 7 of 10 runs say Covered, 3 say Not -> frequency probabilities
+        raw = []
+        for cid, info in mapping.items():
+            if info["format_type"] == "binary":
+                text = "Covered" if info["run_idx"] < 7 else "Not"
+            else:
+                text = "60"
+            raw.append({"custom_id": cid, "response": {"body": {
+                "choices": [{"message": {"content": text}}]}}})
+        rows = extract_results_from_batch(
+            group_batch_results(raw, mapping), "o3", skip_reasoning_logprobs=False,
+        )
+        assert rows[0]["Token_1_Prob"] == pytest.approx(0.7)
+        assert rows[0]["Token_2_Prob"] == pytest.approx(0.3)
+        assert rows[0]["Model Response"] == "Covered"          # modal
+        assert rows[0]["Weighted Confidence"] == 60
