@@ -179,6 +179,25 @@ def cmd_run_api_perturbation(args):
     print(cost.summary())
 
 
+def cmd_run_claude_perturbation(args):
+    import os
+
+    from .api_backends.anthropic_client import AnthropicClient
+    from .config import legal_scenarios
+    from .gen.rephrase import load_perturbations
+    from .sweeps.api_perturbation import run_claude_perturbation_sweep
+
+    key = os.environ.get("ANTHROPIC_API_KEY")
+    if not key:
+        raise SystemExit("ANTHROPIC_API_KEY not set")
+    scenarios = load_perturbations(args.perturbations,
+                                   expected_scenarios=legal_scenarios())
+    run_claude_perturbation_sweep(
+        AnthropicClient(key), args.model, scenarios, args.output,
+        max_rephrasings=args.max_rephrasings,
+    )
+
+
 def cmd_analyze_survey(args):
     from .survey.pipeline import run_consolidated_analysis
 
@@ -330,6 +349,15 @@ def main(argv=None):
                    help="approximate reasoning-model logprobs with 10 repeats "
                         "instead of skipping the binary leg")
     p.set_defaults(fn=cmd_run_api_perturbation)
+
+    p = sub.add_parser("run-claude-perturbation",
+                       help="confidence-only Claude Message-Batches sweep "
+                            "(key via env)")
+    p.add_argument("--perturbations", required=True, help="perturbations.json")
+    p.add_argument("--model", default="claude-opus-4-1-20250805")
+    p.add_argument("--output", default="results/claude_batch_perturbation_results.xlsx")
+    p.add_argument("--max-rephrasings", type=int, default=None)
+    p.set_defaults(fn=cmd_run_claude_perturbation)
 
     p = sub.add_parser("analyze-survey",
                        help="consolidated human-vs-LLM survey analysis")

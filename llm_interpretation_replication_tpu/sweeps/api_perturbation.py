@@ -29,7 +29,7 @@ from ..api_backends.openai_client import build_batch_request, is_reasoning_model
 from ..scoring.confidence import extract_first_int, weighted_confidence_single_tokens
 from ..utils.logging import SessionLogger
 from ..utils.xlsx import append_xlsx, read_xlsx
-from .writers import PERTURBATION_COLUMNS
+from .writers import PERTURBATION_COLUMNS, perturbation_frame
 
 REASONING_MODEL_RUNS = 10  # perturb_prompts.py:46-47
 
@@ -288,7 +288,7 @@ def run_api_perturbation_sweep(
                 failures.append((model, err))
                 continue
             if rows:
-                append_xlsx(pd.DataFrame(rows, columns=PERTURBATION_COLUMNS), output_xlsx)
+                append_xlsx(perturbation_frame(rows), output_xlsx)
                 log(f"{model}: appended {len(rows)} rows to {output_xlsx}")
     if failures and len(failures) == len(models):
         raise RuntimeError(f"every model failed: {failures}")
@@ -296,4 +296,143 @@ def run_api_perturbation_sweep(
 
     return read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else pd.DataFrame(
         columns=PERTURBATION_COLUMNS
+    )
+
+
+# ---------------------------------------------------------------------------
+# Claude Message-Batches leg (perturb_prompts_claude_batch.py)
+# ---------------------------------------------------------------------------
+#
+# Claude exposes no logprobs, so the batch sweep runs CONFIDENCE-ONLY at
+# temperature 1.0 (:137-147); binary fields carry the reference's literal
+# N/A sentinels and zeroed probabilities (:281-296).
+
+def create_claude_batch_requests(
+    model: str,
+    scenarios: Sequence[Dict],
+    processed: Optional[Set[Tuple[str, str]]] = None,
+    max_rephrasings: Optional[int] = None,
+) -> Tuple[List[Dict], Dict[str, Dict]]:
+    """Confidence-only request list + id map; ``processed`` holds
+    (original_main, rephrased_main) pairs already in the workbook."""
+    from ..api_backends.anthropic_client import build_batch_request
+
+    requests: List[Dict] = []
+    id_mapping: Dict[str, Dict] = {}
+    counter = 0
+    for prompt_idx, scenario in enumerate(scenarios):
+        rephrasings = scenario["rephrasings"]
+        if max_rephrasings is not None:
+            rephrasings = rephrasings[:max_rephrasings]
+        for rephrase_idx, rephrased in enumerate(rephrasings):
+            if processed and (scenario["original_main"], rephrased) in processed:
+                continue
+            custom_id = f"confidence-{counter}"
+            id_mapping[custom_id] = {
+                "prompt_idx": prompt_idx,
+                "rephrase_idx": rephrase_idx,
+                "original_main": scenario["original_main"],
+                "response_format": scenario["response_format"],
+                "confidence_format": scenario["confidence_format"],
+                "rephrased_main": rephrased,
+                "target_tokens": list(scenario["target_tokens"]),
+            }
+            requests.append(build_batch_request(
+                custom_id, model,
+                [{"role": "user",
+                  "content": f"{rephrased} {scenario['confidence_format']}"}],
+                temperature=1.0,
+            ))
+            counter += 1
+    return requests, id_mapping
+
+
+def extract_claude_batch_rows(raw_results: Sequence[Dict], id_mapping: Dict[str, Dict],
+                              model: str, log=None) -> List[Dict]:
+    """Batch result JSONL -> the reference's 16-column Claude workbook rows
+    (incl. the extra 'Target Tokens' column, :276-296)."""
+    rows: List[Dict] = []
+    for row in raw_results:
+        info = id_mapping.get(row.get("custom_id"))
+        if info is None:
+            continue
+        result = row.get("result") or {}
+        if result.get("type") != "succeeded":
+            # leave errored/expired pairs OUT of the workbook so resume
+            # retries them (the OpenAI leg's semantics; the reference wrote
+            # empty rows that its own resume then skipped forever)
+            if log:
+                log(f"Warning: failed request {row.get('custom_id')} — will retry on resume")
+            continue
+        content = (result.get("message") or {}).get("content") or []
+        text = (content[0].get("text", "") if content else "").strip()
+        confidence = extract_first_int(text)
+        rows.append({
+            "Model": model,
+            "Original Main Part": info["original_main"],
+            "Response Format": info["response_format"],
+            "Confidence Format": info["confidence_format"],
+            "Rephrased Main Part": info["rephrased_main"],
+            "Target Tokens": str(info["target_tokens"]),
+            "Model Confidence Response": text,
+            "Full Confidence Prompt": f"{info['rephrased_main']} {info['confidence_format']}",
+            "Confidence Value": confidence,
+            "Weighted Confidence": confidence,
+            "Model Response": "N/A (Confidence-only mode)",
+            "Full Rephrased Prompt": "N/A (Confidence-only mode)",
+            "Log Probabilities": "N/A (Batch processing - logprobs not available)",
+            "Token_1_Prob": 0.0,
+            "Token_2_Prob": 0.0,
+            "Odds_Ratio": 0.0,
+        })
+    return rows
+
+
+CLAUDE_PERTURBATION_COLUMNS = [
+    "Model", "Original Main Part", "Response Format", "Confidence Format",
+    "Rephrased Main Part", "Target Tokens", "Model Confidence Response",
+    "Full Confidence Prompt", "Confidence Value", "Weighted Confidence",
+    "Model Response", "Full Rephrased Prompt", "Log Probabilities",
+    "Token_1_Prob", "Token_2_Prob", "Odds_Ratio",
+]
+
+
+def run_claude_perturbation_sweep(
+    client,
+    model: str,
+    scenarios: Sequence[Dict],
+    output_xlsx: str,
+    poll_interval: float = 30.0,
+    max_rephrasings: Optional[int] = None,
+    sleep=time.sleep,
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    """Submit-or-resume the confidence-only Claude batch sweep and append the
+    16-column workbook (reference main flow, 10k chunks handled by
+    ``client.run_batches``)."""
+    import os
+
+    log = log or SessionLogger()
+    # resume per model: another model's rows in the same workbook must not
+    # mask this one (the reference script was hardcoded single-model)
+    processed = {
+        (orig, reph)
+        for m, orig, reph in load_processed_triples(output_xlsx)
+        if m == model
+    }
+    requests, id_mapping = create_claude_batch_requests(
+        model, scenarios, processed=processed, max_rephrasings=max_rephrasings
+    )
+    if requests:
+        log(f"{model}: submitting {len(requests)} message-batch requests")
+        raw = client.run_batches(requests, poll_interval=poll_interval, sleep=sleep)
+        rows = extract_claude_batch_rows(raw, id_mapping, model, log=log)
+        if rows:
+            append_xlsx(pd.DataFrame(rows, columns=CLAUDE_PERTURBATION_COLUMNS),
+                        output_xlsx)
+            log(f"{model}: appended {len(rows)} rows to {output_xlsx}")
+    else:
+        log(f"{model}: nothing to do (all pairs processed)")
+    return read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else pd.DataFrame(
+        columns=CLAUDE_PERTURBATION_COLUMNS
     )

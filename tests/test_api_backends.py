@@ -623,3 +623,77 @@ class TestApiPerturbationSweep:
         assert rows[0]["Token_2_Prob"] == pytest.approx(0.3)
         assert rows[0]["Model Response"] == "Covered"          # modal
         assert rows[0]["Weighted Confidence"] == 60
+
+
+class TestClaudePerturbationSweep:
+    """Confidence-only Message-Batches sweep (perturb_prompts_claude_batch.py)."""
+
+    def _scenarios(self):
+        return [{
+            "original_main": "Scenario text one.",
+            "response_format": "Answer 'Covered' or 'Not'.",
+            "target_tokens": ["Covered", "Not"],
+            "confidence_format": "Confidence 0-100?",
+            "rephrasings": ["Rephrase A.", "Rephrase B."],
+        }]
+
+    def _client(self):
+        import json as _json
+
+        ft = FakeTransport()
+        submitted = {}
+
+        def create(call):
+            submitted["requests"] = call["json"]["requests"]
+            return 200, {"id": "mb-1", "processing_status": "in_progress"}
+
+        ft.add("POST", "/messages/batches", create)
+        ft.add("GET", "/messages/batches/mb-1/results", lambda c: (200, "\n".join(
+            _json.dumps({
+                "custom_id": r["custom_id"],
+                "result": {"type": "succeeded", "message": {
+                    "content": [{"type": "text", "text": "Confidence: 85"}]}},
+            }) for r in submitted["requests"]
+        ).encode()))
+        ft.add("GET", "/messages/batches/mb-1",
+               lambda c: (200, {"id": "mb-1", "processing_status": "ended"}))
+        return AnthropicClient("k", transport=ft, retry_policy=fast_retry()), ft
+
+    def test_sweep_matches_reference_workbook_schema(self, tmp_path):
+        import os
+
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            CLAUDE_PERTURBATION_COLUMNS, run_claude_perturbation_sweep,
+        )
+
+        client, ft = self._client()
+        out = str(tmp_path / "claude.xlsx")
+        df = run_claude_perturbation_sweep(
+            client, "claude-opus-4-1-20250805", self._scenarios(), out,
+            sleep=lambda _s: None,
+        )
+        assert list(df.columns) == CLAUDE_PERTURBATION_COLUMNS
+        ref_wb = "/root/reference/results/claude_opus_batch_perturbation_results.xlsx"
+        if os.path.exists(ref_wb):
+            from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
+
+            # byte-identical column order to the study's recorded workbook
+            assert list(read_xlsx(ref_wb).columns) == CLAUDE_PERTURBATION_COLUMNS
+        assert len(df) == 2
+        assert (df["Confidence Value"] == 85).all()
+        assert (df["Weighted Confidence"] == 85).all()
+        assert (df["Odds_Ratio"] == 0.0).all()
+        assert (df["Model Response"] == "N/A (Confidence-only mode)").all()
+        sent = ft.calls[0]["json"]["requests"][0]["params"]
+        assert sent["temperature"] == 1.0 and sent["max_tokens"] == 500
+
+        # resume: all pairs in the workbook -> no new batch submitted
+        n_creates = sum(1 for c in ft.calls
+                        if c["url"].endswith("/messages/batches") and c["method"] == "POST")
+        run_claude_perturbation_sweep(
+            client, "claude-opus-4-1-20250805", self._scenarios(), out,
+            sleep=lambda _s: None,
+        )
+        n_creates2 = sum(1 for c in ft.calls
+                         if c["url"].endswith("/messages/batches") and c["method"] == "POST")
+        assert n_creates2 == n_creates
