@@ -198,6 +198,26 @@ def cmd_run_claude_perturbation(args):
     )
 
 
+def cmd_run_gemini_perturbation(args):
+    import os
+
+    from .api_backends.gemini_client import GeminiClient
+    from .config import legal_scenarios
+    from .gen.rephrase import load_perturbations
+    from .sweeps.api_perturbation import run_gemini_perturbation_sweep
+
+    key = os.environ.get("GEMINI_API_KEY")
+    if not key:
+        raise SystemExit("GEMINI_API_KEY not set")
+    scenarios = load_perturbations(args.perturbations,
+                                   expected_scenarios=legal_scenarios())
+    run_gemini_perturbation_sweep(
+        GeminiClient(key, requests_per_second=args.rps), args.model, scenarios,
+        args.output, max_workers=args.threads,
+        max_rephrasings=args.max_rephrasings,
+    )
+
+
 def cmd_analyze_survey(args):
     from .survey.pipeline import run_consolidated_analysis
 
@@ -358,6 +378,17 @@ def main(argv=None):
     p.add_argument("--output", default="results/claude_batch_perturbation_results.xlsx")
     p.add_argument("--max-rephrasings", type=int, default=None)
     p.set_defaults(fn=cmd_run_claude_perturbation)
+
+    p = sub.add_parser("run-gemini-perturbation",
+                       help="threaded Gemini sync perturbation sweep (key via env)")
+    p.add_argument("--perturbations", required=True, help="perturbations.json")
+    p.add_argument("--model", default="gemini-2.5-pro")
+    p.add_argument("--output", default="results/gemini_perturbation_results.xlsx")
+    p.add_argument("--threads", type=int, default=20)
+    p.add_argument("--rps", type=float, default=2.3,
+                   help="token-bucket rate limit (reference: ~2.3 req/s)")
+    p.add_argument("--max-rephrasings", type=int, default=None)
+    p.set_defaults(fn=cmd_run_gemini_perturbation)
 
     p = sub.add_parser("analyze-survey",
                        help="consolidated human-vs-LLM survey analysis")

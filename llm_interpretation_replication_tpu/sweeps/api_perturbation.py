@@ -29,7 +29,11 @@ from ..api_backends.openai_client import build_batch_request, is_reasoning_model
 from ..scoring.confidence import extract_first_int, weighted_confidence_single_tokens
 from ..utils.logging import SessionLogger
 from ..utils.xlsx import append_xlsx, read_xlsx
-from .writers import PERTURBATION_COLUMNS, perturbation_frame
+from .writers import (
+    CLAUDE_PERTURBATION_COLUMNS,
+    PERTURBATION_COLUMNS,
+    perturbation_frame,
+)
 
 REASONING_MODEL_RUNS = 10  # perturb_prompts.py:46-47
 
@@ -388,15 +392,6 @@ def extract_claude_batch_rows(raw_results: Sequence[Dict], id_mapping: Dict[str,
     return rows
 
 
-CLAUDE_PERTURBATION_COLUMNS = [
-    "Model", "Original Main Part", "Response Format", "Confidence Format",
-    "Rephrased Main Part", "Target Tokens", "Model Confidence Response",
-    "Full Confidence Prompt", "Confidence Value", "Weighted Confidence",
-    "Model Response", "Full Rephrased Prompt", "Log Probabilities",
-    "Token_1_Prob", "Token_2_Prob", "Odds_Ratio",
-]
-
-
 def run_claude_perturbation_sweep(
     client,
     model: str,
@@ -435,4 +430,127 @@ def run_claude_perturbation_sweep(
         log(f"{model}: nothing to do (all pairs processed)")
     return read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else pd.DataFrame(
         columns=CLAUDE_PERTURBATION_COLUMNS
+    )
+
+
+# ---------------------------------------------------------------------------
+# Gemini sync/threaded leg (perturb_prompts_gemini.py / _parallel.py)
+# ---------------------------------------------------------------------------
+#
+# Gemini's sync API returns logprobs (responseLogprobs=True, top 19), so the
+# sweep evaluates binary + confidence per rephrasing directly: first-position
+# target-token probabilities, multi-token digit reconstruction for weighted
+# confidence (:270-416), 20-thread fan-out behind the client's token-bucket
+# rate limiter (:30-64), and a workbook checkpoint every ``checkpoint_every``
+# completions (:33, 295-311).
+
+def _gemini_perturbation_row(client, model: str, scenario: Dict,
+                             rephrased: str) -> Dict:
+    import math
+
+    from ..scoring.confidence import weighted_confidence_digits
+
+    binary_prompt = f"{rephrased} {scenario['response_format']}"
+    confidence_prompt = f"{rephrased} {scenario['confidence_format']}"
+    t1, t2 = scenario["target_tokens"][0], scenario["target_tokens"][1]
+
+    binary = client.generate_content(model, binary_prompt, response_logprobs=True)
+    positions = client.top_candidates_of(binary)
+    p1 = p2 = 0.0
+    if positions:
+        for token, logprob in positions[0]:
+            if token.strip() == t1:
+                p1 = math.exp(logprob)
+            elif token.strip() == t2:
+                p2 = math.exp(logprob)
+
+    conf = client.generate_content(model, confidence_prompt, response_logprobs=True)
+    conf_text = client.text_of(conf)
+    return {
+        "Model": model,
+        "Original Main Part": scenario["original_main"],
+        "Response Format": scenario["response_format"],
+        "Confidence Format": scenario["confidence_format"],
+        "Rephrased Main Part": rephrased,
+        "Full Rephrased Prompt": binary_prompt,
+        "Full Confidence Prompt": confidence_prompt,
+        "Model Response": client.text_of(binary),
+        "Model Confidence Response": conf_text,
+        "Log Probabilities": str(positions[:3]),
+        "Token_1_Prob": p1,
+        "Token_2_Prob": p2,
+        "Odds_Ratio": p1 / p2 if p2 > 0 else float("inf"),
+        "Confidence Value": extract_first_int(conf_text),
+        "Weighted Confidence": weighted_confidence_digits(
+            client.top_candidates_of(conf)
+        ),
+    }
+
+
+def run_gemini_perturbation_sweep(
+    client,
+    model: str,
+    scenarios: Sequence[Dict],
+    output_xlsx: str,
+    max_workers: int = 20,
+    checkpoint_every: int = 50,
+    max_rephrasings: Optional[int] = None,
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    """Threaded sync sweep with incremental workbook checkpoints and
+    (model, original, rephrased) resume — the 15-column schema shared with
+    the OpenAI leg (gemini_perturbation_results.xlsx matches it exactly)."""
+    import os
+    import threading
+
+    log = log or SessionLogger()
+    processed = load_processed_triples(output_xlsx)
+    work: List[Tuple[Dict, str]] = []
+    for scenario in scenarios:
+        rephrasings = scenario["rephrasings"]
+        if max_rephrasings is not None:
+            rephrasings = rephrasings[:max_rephrasings]
+        for rephrased in rephrasings:
+            if (model, scenario["original_main"], rephrased) not in processed:
+                work.append((scenario, rephrased))
+    if not work:
+        log(f"{model}: nothing to do (all triples processed)")
+    else:
+        log(f"{model}: evaluating {len(work)} perturbations on {max_workers} threads")
+        pending: List[Dict] = []
+        lock = threading.Lock()
+
+        def flush_locked():
+            if pending:
+                append_xlsx(perturbation_frame(pending), output_xlsx)
+                log(f"{model}: checkpointed {len(pending)} rows")
+                pending.clear()
+
+        def run_one(item):
+            scenario, rephrased = item
+            row = _gemini_perturbation_row(client, model, scenario, rephrased)
+            with lock:
+                pending.append(row)
+                if len(pending) >= checkpoint_every:
+                    flush_locked()
+
+        with ThreadPoolExecutor(max_workers=max_workers) as pool:
+            futures = [pool.submit(run_one, item) for item in work]
+            errors = 0
+            for future in as_completed(futures):
+                try:
+                    future.result()
+                except Exception as err:   # broken call: keep the sweep alive
+                    errors += 1
+                    log(f"{model}: evaluation failed — {err}")
+        with lock:
+            flush_locked()
+        if errors:
+            log(f"{model}: {errors} evaluations failed (will retry on resume)")
+            if errors == len(work):
+                raise RuntimeError(
+                    f"{model}: every evaluation failed ({errors}/{len(work)})"
+                )
+    return read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else pd.DataFrame(
+        columns=PERTURBATION_COLUMNS
     )

@@ -30,6 +30,17 @@ PERTURBATION_COLUMNS = [
     "Weighted Confidence",
 ]
 
+#: the Claude Message-Batches workbook adds a 'Target Tokens' column and
+#: reorders (perturb_prompts_claude_batch.py:276-296; byte-identical to the
+#: recorded claude_opus_batch_perturbation_results.xlsx)
+CLAUDE_PERTURBATION_COLUMNS = [
+    "Model", "Original Main Part", "Response Format", "Confidence Format",
+    "Rephrased Main Part", "Target Tokens", "Model Confidence Response",
+    "Full Confidence Prompt", "Confidence Value", "Weighted Confidence",
+    "Model Response", "Full Rephrased Prompt", "Log Probabilities",
+    "Token_1_Prob", "Token_2_Prob", "Odds_Ratio",
+]
+
 #: base_vs_instruct_100q_results.csv (run_base_vs_instruct_100q.py:376-382,472-476,547-567)
 BASE_VS_INSTRUCT_100Q_COLUMNS = [
     "yes_prob", "no_prob", "relative_prob", "completion", "success",

@@ -697,3 +697,67 @@ class TestClaudePerturbationSweep:
         n_creates2 = sum(1 for c in ft.calls
                          if c["url"].endswith("/messages/batches") and c["method"] == "POST")
         assert n_creates2 == n_creates
+
+
+class TestGeminiPerturbationSweep:
+    def test_threaded_sweep_with_checkpoints_and_resume(self, tmp_path):
+        import math
+
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            run_gemini_perturbation_sweep,
+        )
+        from llm_interpretation_replication_tpu.sweeps.writers import (
+            PERTURBATION_COLUMNS,
+        )
+
+        scenarios = [{
+            "original_main": "Scenario text one.",
+            "response_format": "Answer 'Covered' or 'Not'.",
+            "target_tokens": ["Covered", "Not"],
+            "confidence_format": "Confidence 0-100?",
+            "rephrasings": [f"Rephrase {i}." for i in range(5)],
+        }]
+        ft = FakeTransport()
+
+        def respond(call):
+            content = call["json"]["contents"][0]["parts"][0]["text"]
+            if "Confidence" in content:
+                return 200, {"candidates": [{
+                    "content": {"parts": [{"text": "85"}]},
+                    "logprobsResult": {"topCandidates": [
+                        {"candidates": [{"token": "8", "logProbability": math.log(0.6)},
+                                        {"token": "9", "logProbability": math.log(0.3)}]},
+                        {"candidates": [{"token": "5", "logProbability": math.log(0.9)}]},
+                    ]},
+                }]}
+            return 200, {"candidates": [{
+                "content": {"parts": [{"text": "Covered"}]},
+                "logprobsResult": {"topCandidates": [
+                    {"candidates": [{"token": "Covered", "logProbability": math.log(0.7)},
+                                    {"token": "Not", "logProbability": math.log(0.2)}]},
+                ]},
+            }]}
+
+        ft.add("POST", ":generateContent", respond)
+        client = GeminiClient("k", transport=ft, retry_policy=fast_retry())
+        out = str(tmp_path / "gemini.xlsx")
+        df = run_gemini_perturbation_sweep(
+            client, "gemini-2.5-pro", scenarios, out,
+            max_workers=3, checkpoint_every=2,
+        )
+        assert list(df.columns) == PERTURBATION_COLUMNS
+        assert len(df) == 5
+        assert df["Token_1_Prob"].iloc[0] == pytest.approx(0.7)
+        assert df["Token_2_Prob"].iloc[0] == pytest.approx(0.2)
+        assert df["Confidence Value"].iloc[0] == 85
+        assert df["Weighted Confidence"].iloc[0] is not None
+        calls_before = len(ft.calls)
+        df2 = run_gemini_perturbation_sweep(
+            client, "gemini-2.5-pro", scenarios, out, max_workers=3,
+        )
+        assert len(ft.calls) == calls_before     # resume: no new API calls
+        assert len(df2) == 5
+        # a different model re-evaluates
+        run_gemini_perturbation_sweep(client, "gemini-2.0-flash", scenarios, out,
+                                      max_workers=2)
+        assert len(ft.calls) > calls_before
