@@ -198,6 +198,39 @@ def cmd_run_claude_perturbation(args):
     )
 
 
+def cmd_generate_rephrasings(args):
+    import os
+
+    from .api_backends.anthropic_client import AnthropicClient
+    from .config import legal_scenarios
+    from .gen.rephrase import generate_rephrasings, save_perturbations
+
+    key = os.environ.get("ANTHROPIC_API_KEY")
+    if not key:
+        raise SystemExit("ANTHROPIC_API_KEY not set")
+    client = AnthropicClient(key)
+
+    def complete(prompt):
+        # reference: 100 sessions x temperature 0.9 rephrasing requests
+        # (perturb_prompts.py:787-809)
+        msg = client.create_message(
+            args.model, [{"role": "user", "content": prompt}],
+            temperature=0.9, max_tokens=4000,
+        )
+        return client.text_of(msg)
+
+    records = generate_rephrasings(
+        legal_scenarios(), complete,
+        sessions_per_scenario=args.sessions,
+        target_per_scenario=args.target,
+        on_error=lambda s, e: print(f"session {s} failed: {e}"),
+    )
+    save_perturbations(records, args.output)
+    print(f"wrote {args.output}: "
+          + ", ".join(str(len(r["rephrasings"])) for r in records)
+          + " rephrasings per scenario")
+
+
 def cmd_run_gemini_perturbation(args):
     import os
 
@@ -378,6 +411,15 @@ def main(argv=None):
     p.add_argument("--output", default="results/claude_batch_perturbation_results.xlsx")
     p.add_argument("--max-rephrasings", type=int, default=None)
     p.set_defaults(fn=cmd_run_claude_perturbation)
+
+    p = sub.add_parser("generate-rephrasings",
+                       help="build perturbations.json via Claude rephrasing "
+                            "sessions (key via env)")
+    p.add_argument("--model", default="claude-sonnet-4-20250514")
+    p.add_argument("--sessions", type=int, default=100)
+    p.add_argument("--target", type=int, default=2000)
+    p.add_argument("--output", default="data/perturbations.json")
+    p.set_defaults(fn=cmd_generate_rephrasings)
 
     p = sub.add_parser("run-gemini-perturbation",
                        help="threaded Gemini sync perturbation sweep (key via env)")

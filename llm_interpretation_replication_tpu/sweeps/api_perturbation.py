@@ -26,13 +26,18 @@ import numpy as np
 import pandas as pd
 
 from ..api_backends.openai_client import build_batch_request, is_reasoning_model
-from ..scoring.confidence import extract_first_int, weighted_confidence_single_tokens
+from ..scoring.confidence import (
+    extract_first_int,
+    weighted_confidence_digits,
+    weighted_confidence_single_tokens,
+)
 from ..utils.logging import SessionLogger
 from ..utils.xlsx import append_xlsx, read_xlsx
 from .writers import (
     CLAUDE_PERTURBATION_COLUMNS,
     PERTURBATION_COLUMNS,
     perturbation_frame,
+    perturbation_row,
 )
 
 REASONING_MODEL_RUNS = 10  # perturb_prompts.py:46-47
@@ -448,8 +453,6 @@ def _gemini_perturbation_row(client, model: str, scenario: Dict,
                              rephrased: str) -> Dict:
     import math
 
-    from ..scoring.confidence import weighted_confidence_digits
-
     binary_prompt = f"{rephrased} {scenario['response_format']}"
     confidence_prompt = f"{rephrased} {scenario['confidence_format']}"
     t1, t2 = scenario["target_tokens"][0], scenario["target_tokens"][1]
@@ -466,25 +469,17 @@ def _gemini_perturbation_row(client, model: str, scenario: Dict,
 
     conf = client.generate_content(model, confidence_prompt, response_logprobs=True)
     conf_text = client.text_of(conf)
-    return {
-        "Model": model,
-        "Original Main Part": scenario["original_main"],
-        "Response Format": scenario["response_format"],
-        "Confidence Format": scenario["confidence_format"],
-        "Rephrased Main Part": rephrased,
-        "Full Rephrased Prompt": binary_prompt,
-        "Full Confidence Prompt": confidence_prompt,
-        "Model Response": client.text_of(binary),
-        "Model Confidence Response": conf_text,
-        "Log Probabilities": str(positions[:3]),
-        "Token_1_Prob": p1,
-        "Token_2_Prob": p2,
-        "Odds_Ratio": p1 / p2 if p2 > 0 else float("inf"),
-        "Confidence Value": extract_first_int(conf_text),
-        "Weighted Confidence": weighted_confidence_digits(
-            client.top_candidates_of(conf)
-        ),
-    }
+    return perturbation_row(
+        model, scenario, rephrased,
+        response_text=client.text_of(binary),
+        confidence_text=conf_text,
+        logprobs_repr=str(positions[:3]),
+        token_1_prob=p1,
+        token_2_prob=p2,
+        odds_ratio=p1 / p2 if p2 > 0 else float("inf"),
+        confidence_value=extract_first_int(conf_text),
+        weighted_confidence=weighted_confidence_digits(client.top_candidates_of(conf)),
+    )
 
 
 def run_gemini_perturbation_sweep(

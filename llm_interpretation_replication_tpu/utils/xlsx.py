@@ -114,6 +114,14 @@ def write_xlsx(df: pd.DataFrame, path, sheet_name: str = "Sheet1") -> None:
         dir=os.path.dirname(os.path.abspath(path)) or ".", suffix=".xlsx.tmp"
     )
     os.close(fd)
+    # mkstemp creates 0600; restore umask-default permissions (or keep the
+    # destination's existing mode) so shared results dirs stay readable
+    if os.path.exists(path):
+        os.chmod(tmp, os.stat(path).st_mode & 0o777)
+    else:
+        umask = os.umask(0)
+        os.umask(umask)
+        os.chmod(tmp, 0o666 & ~umask)
     try:
         with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
             zf.writestr("[Content_Types].xml", _CONTENT_TYPES)

@@ -750,7 +750,16 @@ class TestGeminiPerturbationSweep:
         assert df["Token_1_Prob"].iloc[0] == pytest.approx(0.7)
         assert df["Token_2_Prob"].iloc[0] == pytest.approx(0.2)
         assert df["Confidence Value"].iloc[0] == 85
-        assert df["Weighted Confidence"].iloc[0] is not None
+        from llm_interpretation_replication_tpu.scoring.confidence import (
+            weighted_confidence_digits,
+        )
+
+        expected_wc = weighted_confidence_digits([
+            [("8", math.log(0.6)), ("9", math.log(0.3))],
+            [("5", math.log(0.9))],
+        ])
+        assert expected_wc is not None
+        assert df["Weighted Confidence"].iloc[0] == pytest.approx(expected_wc)
         calls_before = len(ft.calls)
         df2 = run_gemini_perturbation_sweep(
             client, "gemini-2.5-pro", scenarios, out, max_workers=3,

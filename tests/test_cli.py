@@ -166,3 +166,19 @@ def test_analyze_combined_cli(tmp_path, capsys):
     assert (out / "combined_confidence_stats.csv").exists()
     assert (out / "cross_model_correlations.csv").exists()
     assert "Claude" in capsys.readouterr().out
+
+
+def test_api_keyed_commands_require_env(monkeypatch, tmp_path):
+    """Every API-keyed command exits loudly (not silently) without its key."""
+    for var in ("ANTHROPIC_API_KEY", "OPENAI_API_KEY", "GEMINI_API_KEY"):
+        monkeypatch.delenv(var, raising=False)
+    pert = tmp_path / "p.json"
+    pert.write_text("[]")
+    for argv in (
+        ["generate-rephrasings"],
+        ["run-api-perturbation", "--perturbations", str(pert), "--model", "gpt-4.1"],
+        ["run-claude-perturbation", "--perturbations", str(pert)],
+        ["run-gemini-perturbation", "--perturbations", str(pert)],
+    ):
+        with pytest.raises(SystemExit, match="API_KEY not set"):
+            main(argv)
