@@ -274,10 +274,16 @@ def attention(q, k, v, lengths, causal: bool = True, force: Optional[str] = None
             if s <= GROUPED_MAX_SEQ and s % 16 == 0:
                 backend = "grouped"    # VPU sublane tiling needs S%16 (all
                 # runtime/batching buckets qualify; raw lengths may not)
-            elif pick_block(s, DEFAULT_BLOCK_Q) is None:
-                backend = "dense"  # no valid block for this length: XLA path
-                # (auto-selected only; an explicit force='pallas' still raises
-                # so parity tests can't silently compare dense against itself)
+            else:
+                blk = pick_block(s, DEFAULT_BLOCK_Q)
+                if blk is None or blk < 32:
+                    # no valid block, or only a tiny one: block 16 crashed
+                    # the TPU worker (observed at S=432) — fall back to XLA.
+                    # Dense can be memory-hungry at long S, but a loud OOM
+                    # beats a worker crash.  Auto-selected only: an explicit
+                    # force='pallas' bypasses this guard (and raises only
+                    # when no power-of-two block exists at all).
+                    backend = "dense"
     if backend == "grouped":
         return grouped_attention(q, k, v, lengths, causal, interpret=interpret)
     if k.shape[1] != n:                    # grouped K/V on a non-grouped path
