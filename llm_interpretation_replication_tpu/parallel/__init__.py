@@ -15,6 +15,7 @@ from .pipeline import (
     split_stage_params,
 )
 from .ring_attention import ring_attention, ring_attention_sharded
+from .ulysses import ulysses_attention, ulysses_attention_sharded
 from .sharding import (
     activation_spec,
     batch_spec,
@@ -38,6 +39,8 @@ __all__ = [
     "replicated",
     "ring_attention",
     "ring_attention_sharded",
+    "ulysses_attention",
+    "ulysses_attention_sharded",
     "activation_spec",
     "batch_spec",
     "constrain",

@@ -74,9 +74,14 @@ def ring_attention(
         return (m_new, l_new, o_new, k, v, kv_pos, kv_valid), None
 
     (m, l, o, *_), _ = lax.scan(step, (m, l, o, k, v, kv_pos, kv_valid), None, length=n)
-    # rows with no valid key anywhere (fully masked) produce 0/0 → return 0
     denom = jnp.moveaxis(l, 1, 2)[..., None]
     out = jnp.where(denom > 0, o / jnp.maximum(denom, 1e-30), 0.0)
+    # Batch rows with no valid key on ANY shard: the finite NEG_INF bias makes
+    # p = exp(0-ish) per masked entry, so denom stays positive and the result
+    # is softmax-of-garbage.  Zero those rows explicitly (the Ulysses leg does
+    # the same, keeping the two SP strategies bit-consistent).
+    has_key = lax.psum(jnp.any(kv_valid, axis=-1).astype(jnp.int32), axis_name) > 0
+    out = jnp.where(has_key[:, None, None, None], out, 0.0)
     return out.astype(q.dtype)
 
 

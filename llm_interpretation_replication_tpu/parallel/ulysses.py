@@ -1,0 +1,100 @@
+"""Ulysses-style all-to-all sequence parallelism.
+
+The second long-context strategy next to ring attention
+(parallel/ring_attention.py): instead of rotating K/V blocks around a ring,
+one ``all_to_all`` redistributes the sequence-sharded activations into
+head-sharded ones — each device then holds the FULL sequence for a subset of
+heads and runs ordinary dense attention locally — and a second ``all_to_all``
+restores sequence sharding afterwards (DeepSpeed-Ulysses, arXiv:2309.14509).
+
+Tradeoffs vs the ring (why both exist):
+- Ulysses moves activations twice over ICI regardless of sequence length and
+  needs ``num_heads`` divisible by the seq-axis size; attention itself is the
+  plain XLA kernel (full S locally, so peak memory carries an S x S score
+  block per local head).
+- Ring keeps O(S/n) K/V per device (no head-count constraint, O(S/n) score
+  blocks) but pays n ppermute hops and an online-softmax recurrence.
+For the sweep's bucket lengths Ulysses wins on simplicity; for very long
+sequences where S x S scores do not fit, use the ring.
+"""
+
+from __future__ import annotations
+
+import functools
+
+import jax
+import jax.numpy as jnp
+from jax import lax
+from jax.sharding import PartitionSpec as P
+
+from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
+from .ring_attention import _block_bias
+
+
+def ulysses_attention(
+    q,            # [B, S/n, N, D]  sequence-sharded local block
+    k,            # [B, S/n, N, D]
+    v,            # [B, S/n, N, D]
+    q_pos,        # [B, S/n]  absolute positions of local queries
+    kv_valid,     # [B, S/n]  bool validity of local keys
+    axis_name: str = SEQ_AXIS,
+    causal: bool = True,
+):
+    """Per-shard Ulysses body (run under shard_map with ``axis_name`` bound).
+
+    all_to_all #1: seq-sharded [B, S/n, N, D] -> head-sharded [B, S, N/n, D];
+    dense attention over the full sequence locally; all_to_all #2 back.
+    """
+    n = lax.axis_size(axis_name)
+    b, s_local, nh, d = q.shape
+    if nh % n != 0:
+        raise ValueError(f"num_heads {nh} not divisible by seq axis {n}")
+
+    def scatter_heads(x):
+        # split the head axis n-ways, concatenate the sequence axis
+        return lax.all_to_all(x, axis_name, split_axis=2, concat_axis=1, tiled=True)
+
+    qg = scatter_heads(q)                      # [B, S, N/n, D]
+    kg = scatter_heads(k)
+    vg = scatter_heads(v)
+    pos = lax.all_gather(q_pos, axis_name, axis=1, tiled=True)      # [B, S]
+    valid = lax.all_gather(kv_valid, axis_name, axis=1, tiled=True)
+
+    scale = 1.0 / jnp.sqrt(d).astype(qg.dtype)
+    scores = jnp.einsum("bsnd,btnd->bnst", qg * scale, kg).astype(jnp.float32)
+    scores = scores + _block_bias(pos, pos, valid, causal)  # shared mask logic
+    probs = jax.nn.softmax(scores, axis=-1).astype(qg.dtype)
+    out = jnp.einsum("bnst,btnd->bsnd", probs, vg)                  # [B, S, N/n, D]
+    # fully-masked batch rows (no valid key anywhere) would softmax uniformly
+    # over the NEG_INF scores; return 0 like the ring's l>0 guard
+    out = jnp.where(jnp.any(valid, axis=-1)[:, None, None, None], out, 0.0)
+
+    # inverse redistribution: split sequence, concatenate heads
+    return lax.all_to_all(out, axis_name, split_axis=1, concat_axis=2, tiled=True)
+
+
+def ulysses_attention_sharded(mesh, q, k, v, attention_mask, causal: bool = True):
+    """Drive Ulysses attention over a (data, model, seq) mesh — the same
+    calling convention as ``ring_attention_sharded``.
+
+    q/k/v: [B, S, N, D] with S divisible by the seq-axis size and N divisible
+    by seq_axis * model_axis; attention_mask [B, S].
+    """
+    b, s, nh, d = q.shape
+    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
+    valid = attention_mask.astype(bool)
+
+    qkv_spec = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
+    meta_spec = P(DATA_AXIS, SEQ_AXIS)
+
+    @functools.partial(
+        jax.shard_map,
+        mesh=mesh,
+        in_specs=(qkv_spec, qkv_spec, qkv_spec, meta_spec, meta_spec),
+        out_specs=qkv_spec,
+        check_vma=False,
+    )
+    def _run(q, k, v, pos, val):
+        return ulysses_attention(q, k, v, pos, val, SEQ_AXIS, causal)
+
+    return _run(q, k, v, positions, valid)

@@ -139,6 +139,80 @@ class TestRingAttention:
         np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5, rtol=1e-4)
 
 
+class TestUlyssesAttention:
+    """All-to-all sequence parallelism (parallel/ulysses.py) — the second
+    long-context strategy next to the ring."""
+
+    @pytest.mark.parametrize("causal", [True, False])
+    def test_matches_dense(self, eight_cpu_devices, causal):
+        from llm_interpretation_replication_tpu.parallel import (
+            ulysses_attention_sharded,
+        )
+
+        mesh = make_mesh(data=2, model=1, seq=4)
+        rng = np.random.default_rng(3)
+        B, S, N, D = 2, 16, 4, 8
+        q = rng.standard_normal((B, S, N, D)).astype(np.float32)
+        k = rng.standard_normal((B, S, N, D)).astype(np.float32)
+        v = rng.standard_normal((B, S, N, D)).astype(np.float32)
+        mask = np.ones((B, S), bool)
+        mask[1, 11:] = False
+        with jax.default_matmul_precision("highest"):
+            out = ulysses_attention_sharded(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(mask), causal=causal,
+            )
+        expected = _dense_attention(q, k, v, mask, causal)
+        real = mask[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(out) * real, expected * real, atol=2e-5, rtol=1e-4
+        )
+
+    def test_composes_with_model_axis(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.parallel import (
+            ulysses_attention_sharded,
+        )
+
+        mesh = make_mesh(data=2, model=2, seq=2)
+        rng = np.random.default_rng(4)
+        B, S, N, D = 2, 8, 4, 4
+        q, k, v = (rng.standard_normal((B, S, N, D)).astype(np.float32) for _ in range(3))
+        mask = np.ones((B, S), bool)
+        with jax.default_matmul_precision("highest"):
+            out = ulysses_attention_sharded(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(mask), causal=True,
+            )
+        expected = _dense_attention(q, k, v, mask, True)
+        np.testing.assert_allclose(np.asarray(out), expected, atol=2e-5, rtol=1e-4)
+
+    def test_agrees_with_ring(self, eight_cpu_devices):
+        """Both SP strategies must produce identical attention outputs."""
+        from llm_interpretation_replication_tpu.parallel import (
+            ulysses_attention_sharded,
+        )
+
+        mesh = make_mesh(data=1, model=1, seq=8)
+        rng = np.random.default_rng(5)
+        B, S, N, D = 1, 32, 8, 4
+        q, k, v = (rng.standard_normal((B, S, N, D)).astype(np.float32) for _ in range(3))
+        mask = np.ones((B, S), bool)
+        mask[0, 29:] = False
+        with jax.default_matmul_precision("highest"):
+            ring = ring_attention_sharded(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(mask), causal=True,
+            )
+            uly = ulysses_attention_sharded(
+                mesh, jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                jnp.asarray(mask), causal=True,
+            )
+        real = mask[:, :, None, None]
+        np.testing.assert_allclose(
+            np.asarray(uly) * real, np.asarray(ring) * real, atol=2e-5, rtol=1e-4
+        )
+
+
 class TestPipeline:
     """GPipe-style pipeline over the ``pipe`` mesh axis (parallel/pipeline.py)."""
 
