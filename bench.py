@@ -25,6 +25,9 @@ int8 and 16.5 bf16 at the old batch-128/512 config — reproduce with
 ``--batch 128 --seq 512 [--quant none]``).  Batch 224+ OOMs 16 GB HBM;
 ``--attn flash`` (the grouped Pallas kernel) measures 33.3 here — see
 ops/attention.py for why XLA dense attention wins at sweep shapes.
+``--decode 10`` (the reference's MAX_LOOK_AHEAD scan as one device program:
+prompt forward + 10 cached greedy steps) measures 34.4 — full generate-parity
+still runs at 34x the serial-A100 baseline.
 
 Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
 """
@@ -152,13 +155,20 @@ def main():
                         help="attention impl: XLA dense (the DecoderConfig "
                              "'xla' value) or the Pallas kernels "
                              "(ops/attention.py)")
+    parser.add_argument("--decode", type=int, default=0, metavar="N",
+                        help="also greedy-decode N tokens per prompt (the "
+                             "reference's MAX_LOOK_AHEAD=10 scan parity mode; "
+                             "0 = single-forward scoring, the default)")
     args = parser.parse_args()
 
     import jax
     import jax.numpy as jnp
 
     from llm_interpretation_replication_tpu.models.config import DecoderConfig
-    from llm_interpretation_replication_tpu.models.decoder import forward_last_logits
+    from llm_interpretation_replication_tpu.models.decoder import (
+        forward_last_logits,
+        greedy_decode,
+    )
     from llm_interpretation_replication_tpu.scoring.yes_no import relative_prob_first_token
 
     geometry = FALCON_7B if args.model == "falcon-7b" else SMALL_1B
@@ -187,9 +197,16 @@ def main():
     mask = jnp.asarray(mask)
     yes_id, no_id = 5, 9
 
-    def score(params, ids, mask):
-        logits = forward_last_logits(params, cfg, ids, mask)
-        return relative_prob_first_token(logits, yes_id, no_id)
+    if args.decode:
+        def score(params, ids, mask):
+            # parity mode: the reference's generate + MAX_LOOK_AHEAD scan —
+            # prompt forward + N cached single-token steps in one program
+            _, logits = greedy_decode(params, cfg, ids, mask, args.decode)
+            return relative_prob_first_token(logits[:, 0, :], yes_id, no_id)
+    else:
+        def score(params, ids, mask):
+            logits = forward_last_logits(params, cfg, ids, mask)
+            return relative_prob_first_token(logits, yes_id, no_id)
 
     score_jit = jax.jit(score)
     # NOTE: on the axon-tunneled chip, block_until_ready does NOT actually
@@ -209,7 +226,9 @@ def main():
             {
                 "metric": f"prompts/sec/chip (yes-no scoring sweep, {args.model} geometry, "
                           f"{'w8a8 int8' if args.quant == 'int8' else 'bf16'}, "
-                          f"batch {args.batch}, {args.prompt_tokens}-token prompts)",
+                          f"batch {args.batch}, {args.prompt_tokens}-token prompts"
+                          + (f", {args.decode}-token look-ahead decode" if args.decode else "")
+                          + ")",
                 "value": round(prompts_per_sec, 2),
                 "unit": "prompts/sec",
                 "vs_baseline": round(prompts_per_sec / A100_BASELINE_PROMPTS_PER_SEC, 2),

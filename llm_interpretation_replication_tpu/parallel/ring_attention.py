@@ -85,13 +85,10 @@ def ring_attention(
     return out.astype(q.dtype)
 
 
-def ring_attention_sharded(mesh, q, k, v, attention_mask, causal: bool = True):
-    """Drive ring attention over a (data, model, seq) mesh.
-
-    q/k/v: [B, S, N, D] with S divisible by the seq-axis size; attention_mask
-    [B, S].  Heads shard over ``model``, batch over ``data``, sequence over
-    ``seq``.
-    """
+def sharded_seq_attention(mesh, body, q, k, v, attention_mask):
+    """Shared shard_map driver for every sequence-parallel attention strategy
+    (ring, Ulysses): batch over ``data``, heads over ``model``, sequence over
+    ``seq``.  ``body(q, k, v, pos, valid)`` is the per-shard computation."""
     b, s, nh, d = q.shape
     positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
     valid = attention_mask.astype(bool)
@@ -99,14 +96,23 @@ def ring_attention_sharded(mesh, q, k, v, attention_mask, causal: bool = True):
     qkv_spec = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
     meta_spec = P(DATA_AXIS, SEQ_AXIS)
 
-    @functools.partial(
+    run = functools.partial(
         jax.shard_map,
         mesh=mesh,
         in_specs=(qkv_spec, qkv_spec, qkv_spec, meta_spec, meta_spec),
         out_specs=qkv_spec,
         check_vma=False,
-    )
-    def _run(q, k, v, pos, val):
+    )(body)
+    return run(q, k, v, positions, valid)
+
+
+def ring_attention_sharded(mesh, q, k, v, attention_mask, causal: bool = True):
+    """Drive ring attention over a (data, model, seq) mesh.
+
+    q/k/v: [B, S, N, D] with S divisible by the seq-axis size; attention_mask
+    [B, S].
+    """
+    def body(q, k, v, pos, val):
         return ring_attention(q, k, v, pos, pos, val, SEQ_AXIS, causal)
 
-    return _run(q, k, v, positions, valid)
+    return sharded_seq_attention(mesh, body, q, k, v, attention_mask)

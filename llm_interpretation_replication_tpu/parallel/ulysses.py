@@ -20,15 +20,12 @@ sequences where S x S scores do not fit, use the ring.
 
 from __future__ import annotations
 
-import functools
-
 import jax
 import jax.numpy as jnp
 from jax import lax
-from jax.sharding import PartitionSpec as P
 
-from .mesh import DATA_AXIS, MODEL_AXIS, SEQ_AXIS
-from .ring_attention import _block_bias
+from .mesh import SEQ_AXIS
+from .ring_attention import _block_bias, sharded_seq_attention
 
 
 def ulysses_attention(
@@ -75,26 +72,12 @@ def ulysses_attention(
 
 def ulysses_attention_sharded(mesh, q, k, v, attention_mask, causal: bool = True):
     """Drive Ulysses attention over a (data, model, seq) mesh — the same
-    calling convention as ``ring_attention_sharded``.
+    calling convention (and shared driver) as ``ring_attention_sharded``.
 
     q/k/v: [B, S, N, D] with S divisible by the seq-axis size and N divisible
     by seq_axis * model_axis; attention_mask [B, S].
     """
-    b, s, nh, d = q.shape
-    positions = jnp.broadcast_to(jnp.arange(s, dtype=jnp.int32)[None], (b, s))
-    valid = attention_mask.astype(bool)
-
-    qkv_spec = P(DATA_AXIS, SEQ_AXIS, MODEL_AXIS, None)
-    meta_spec = P(DATA_AXIS, SEQ_AXIS)
-
-    @functools.partial(
-        jax.shard_map,
-        mesh=mesh,
-        in_specs=(qkv_spec, qkv_spec, qkv_spec, meta_spec, meta_spec),
-        out_specs=qkv_spec,
-        check_vma=False,
-    )
-    def _run(q, k, v, pos, val):
+    def body(q, k, v, pos, val):
         return ulysses_attention(q, k, v, pos, val, SEQ_AXIS, causal)
 
-    return _run(q, k, v, positions, valid)
+    return sharded_seq_attention(mesh, body, q, k, v, attention_mask)
