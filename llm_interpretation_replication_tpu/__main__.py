@@ -49,10 +49,15 @@ def _engine_factory(run_config):
     """model name -> ScoringEngine over local snapshots."""
     import jax
 
+    from .parallel import initialize_distributed
     from .runtime import EngineConfig, ScoringEngine, load_model, load_tokenizer
 
     if run_config.device == "cpu":
         jax.config.update("jax_platforms", "cpu")
+    else:
+        # multi-host bootstrap: honors JAX_COORDINATOR_ADDRESS /
+        # JAX_NUM_PROCESSES / JAX_PROCESS_ID; no-op on a single host
+        initialize_distributed()
     mesh = run_config.make_mesh() if (run_config.mesh_model > 1 or run_config.mesh_seq > 1) else None
 
     def factory(model_name: str) -> ScoringEngine:
