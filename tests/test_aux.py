@@ -99,3 +99,16 @@ class TestDemographicsRealData:
         tex = demographics_latex_table(df, ["Sex", "Employment status"])
         assert tex.startswith("\\begin{tabular}") and tex.endswith("\\end{tabular}")
         assert "\\textbf{Sex}" in tex and "Male" in tex
+
+
+class TestDistributedBootstrap:
+    def test_noop_outside_cluster(self, monkeypatch):
+        """Single-host: no coordinator env vars -> returns False, no init
+        attempt (the CLI calls this unconditionally on the TPU path)."""
+        from llm_interpretation_replication_tpu.parallel import (
+            initialize_distributed,
+        )
+
+        for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID"):
+            monkeypatch.delenv(var, raising=False)
+        assert initialize_distributed() is False
