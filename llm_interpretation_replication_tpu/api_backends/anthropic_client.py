@@ -115,16 +115,19 @@ class AnthropicClient:
         return json.loads(self._request("GET", f"/messages/batches/{batch_id}"))
 
     def wait_for_batch(self, batch_id: str, poll_interval: float = 30.0,
-                       timeout: float = 24 * 3600, sleep=time.sleep) -> Dict:
-        waited = 0.0
+                       timeout: float = 24 * 3600, sleep=time.sleep,
+                       clock=time.monotonic) -> Dict:
+        """Poll until ``processing_status == "ended"``; elapsed time uses a
+        monotonic clock (injectable) so request latency and retry backoffs
+        count toward ``timeout``, not just the sleeps."""
+        started = clock()
         while True:
             batch = self.get_batch(batch_id)
             if batch.get("processing_status") == "ended":
                 return batch
-            if waited >= timeout:
+            if clock() - started >= timeout:
                 raise TimeoutError(f"batch {batch_id} not done after {timeout}s")
             sleep(poll_interval)
-            waited += poll_interval
 
     def batch_results(self, batch: Dict) -> List[Dict]:
         raw = self._request("GET", f"/messages/batches/{batch['id']}/results")

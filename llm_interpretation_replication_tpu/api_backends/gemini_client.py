@@ -194,13 +194,20 @@ class GeminiClient:
                 or batch.get("state", "JOB_STATE_UNSPECIFIED"))
 
     def wait_for_batch(self, name: str, poll_interval: float = 30.0,
-                       max_wait: float = 24 * 3600.0, sleep_fn=None) -> Dict:
+                       max_wait: float = 24 * 3600.0, sleep_fn=None,
+                       clock_fn=None) -> Dict:
         """Poll until a terminal JOB_STATE_*; raises on failed/cancelled/
-        expired (the reference treats them as run-ending, :337-343)."""
+        expired (the reference treats them as run-ending, :337-343).
+
+        Elapsed time is measured with a monotonic clock (injectable as
+        ``clock_fn`` for tests), not by summing sleep intervals — get_batch
+        latency and its retry backoffs count toward ``max_wait`` too.
+        """
         import time as _time
 
         sleep_fn = sleep_fn or _time.sleep
-        waited = 0.0
+        clock_fn = clock_fn or _time.monotonic
+        started = clock_fn()
         while True:
             batch = self.get_batch(name)
             state = self.batch_state(batch)
@@ -208,10 +215,10 @@ class GeminiClient:
                 return batch
             if state in self.TERMINAL_STATES:
                 raise BatchTerminalError(f"gemini batch {name} ended in {state}")
+            waited = clock_fn() - started
             if waited >= max_wait:
                 raise TimeoutError(f"gemini batch {name} still {state} after {waited:.0f}s")
             sleep_fn(poll_interval)
-            waited += poll_interval
 
     @staticmethod
     def batch_responses(batch: Dict) -> List[Dict]:

@@ -115,10 +115,13 @@ class OpenAIClient:
         return self._request("GET", f"/files/{file_id}/content")
 
     def wait_for_batch(self, batch_id: str, poll_interval: float = 60.0,
-                       timeout: float = 24 * 3600, sleep=time.sleep) -> Dict:
+                       timeout: float = 24 * 3600, sleep=time.sleep,
+                       clock=time.monotonic) -> Dict:
         """Poll until terminal state (reference: 60 s loop, failed/cancelled/
-        expired are errors — perturb_prompts.py:313-330)."""
-        waited = 0.0
+        expired are errors — perturb_prompts.py:313-330).  Elapsed time is
+        measured with a monotonic clock (injectable), so get_batch latency
+        and retry backoffs count toward ``timeout`` too."""
+        started = clock()
         while True:
             batch = self.get_batch(batch_id)
             status = batch.get("status")
@@ -126,10 +129,9 @@ class OpenAIClient:
                 return batch
             if status in ("failed", "cancelled", "expired"):
                 raise RuntimeError(f"batch {batch_id} terminal state: {status}")
-            if waited >= timeout:
+            if clock() - started >= timeout:
                 raise TimeoutError(f"batch {batch_id} not done after {timeout}s")
             sleep(poll_interval)
-            waited += poll_interval
 
     def retrieve_batch_results(self, batch: Dict) -> List[Dict]:
         raw = self.download_file(batch["output_file_id"])

@@ -40,7 +40,11 @@ def _dense_attention(q, k, v, lengths, causal: bool):
         mask = mask & (cols[None, None, :, None] >= cols[None, None, None, :])
     scores = jnp.where(mask, scores, NEG_INF)
     probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
-    return jnp.einsum("bnst,bntd->bnsd", probs, v)
+    out = jnp.einsum("bnst,bntd->bnsd", probs, v)
+    # NEG_INF is finite, so a fully-masked row softmaxes to uniform 1/S and
+    # would return the mean of V; zero it instead (length-0 padded rows),
+    # matching the ring/Ulysses semantics.
+    return jnp.where(lengths[:, None, None, None] > 0, out, 0)
 
 
 def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
@@ -77,7 +81,10 @@ def _flash_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_q, block_k,
     l0 = jnp.zeros((block_q, 1), jnp.float32)
     acc0 = jnp.zeros((block_q, q.shape[-1]), jnp.float32)
     m, l, acc = jax.lax.fori_loop(0, num_kv, body, (m0, l0, acc0))
-    out = jnp.where(l > 0, acc / jnp.maximum(l, 1e-30), 0.0)
+    # NEG_INF is finite, so l is always > 0 (a fully-masked row sums exp(0)
+    # over every column); the real fully-masked condition is a zero valid-key
+    # count — causal rows always see >= 1 column when length > 0.
+    out = jnp.where(length > 0, acc / jnp.maximum(l, 1e-30), 0.0)
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 
@@ -202,7 +209,10 @@ def _grouped_kernel(len_ref, q_ref, k_ref, v_ref, o_ref, *, block_rows,
     p = jnp.exp(s - m)
     l = jnp.sum(p, axis=-1, keepdims=True)
     out = jnp.dot(p.astype(v.dtype), v, preferred_element_type=jnp.float32)
-    out = jnp.where(l > 0, out / jnp.maximum(l, 1e-30), 0.0)   # [BR, D]
+    # NEG_INF is finite so l is always > 0; a row is truly fully masked iff
+    # its valid-column bound is 0 — zero those rows (length-0 padded batch
+    # rows) instead of returning a uniform average of V.
+    out = jnp.where(bound > 0, out / jnp.maximum(l, 1e-30), 0.0)  # [BR, D]
     o_ref[0, 0] = out.astype(o_ref.dtype)
 
 

@@ -155,12 +155,24 @@ def extract_results_from_batch(
             if log:
                 log(f"Warning: no binary results for {key}")
             continue
+        if confidence_result is None:
+            # half-failed pair: binary succeeded but confidence errored
+            # (reasoning models in frequency mode included — skip-logprobs
+            # mode only ever creates slots from confidence responses).
+            # Writing the row would let triple-based resume skip it forever
+            # with a null confidence — leave it out so resume retries, the
+            # same semantics the Claude leg adopted for failed requests.
+            if log:
+                log(f"Warning: no confidence result for {key} — will retry on resume")
+            continue
 
+        # past the guards above, confidence_result is always present — a
+        # null-confidence row is never a representable output
         response_body = None
         skip_mode = False
-        confidence_value = None
-        confidence_answer = ""
         weighted_confidence = None
+        confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
+        confidence_value = extract_first_int(confidence_answer)
         if reasoning and not skip_reasoning_logprobs:
             # frequency-based probability approximation over the runs
             t1 = t2 = 0
@@ -176,18 +188,12 @@ def extract_results_from_batch(
             token_1_prob = t1 / n if n else 0.0
             token_2_prob = t2 / n if n else 0.0
             answer_text = max(set(texts), key=texts.count) if texts else ""
-            if confidence_result:
-                confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
-                confidence_value = extract_first_int(confidence_answer)
             weighted_confidence = confidence_value
         elif reasoning:
             answer_text = "N/A (skipped for reasoning model)"
             token_1_prob = token_2_prob = 0.0
             skip_mode = True
-            if confidence_result:
-                confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
-                confidence_value = extract_first_int(confidence_answer)
-                weighted_confidence = confidence_value
+            weighted_confidence = confidence_value
         else:
             response_body = binary_results[0]
             answer_text = response_body["choices"][0]["message"]["content"].strip()
@@ -200,19 +206,16 @@ def extract_results_from_batch(
                         token_1_prob = float(np.exp(cand["logprob"]))
                     elif cand["token"] == info["target_tokens"][1]:
                         token_2_prob = float(np.exp(cand["logprob"]))
-            if confidence_result:
-                confidence_answer = confidence_result["choices"][0]["message"]["content"].strip()
-                confidence_value = extract_first_int(confidence_answer)
-                # logprob-weighted expected value over int tokens 0-100
-                # across ALL positions (reference :505-526 — the batch path's
-                # simple int scan; scoring/confidence holds the shared impl)
-                positions = [
-                    [(c["token"], c["logprob"])
-                     for c in token_info.get("top_logprobs", [])]
-                    for token_info in ((confidence_result["choices"][0]
-                                        .get("logprobs") or {}).get("content") or [])
-                ]
-                weighted_confidence = weighted_confidence_single_tokens(positions)
+            # logprob-weighted expected value over int tokens 0-100
+            # across ALL positions (reference :505-526 — the batch path's
+            # simple int scan; scoring/confidence holds the shared impl)
+            positions = [
+                [(c["token"], c["logprob"])
+                 for c in token_info.get("top_logprobs", [])]
+                for token_info in ((confidence_result["choices"][0]
+                                    .get("logprobs") or {}).get("content") or [])
+            ]
+            weighted_confidence = weighted_confidence_single_tokens(positions)
 
         # reference: skip-logprobs rows record 0.0, not inf (:455)
         odds_ratio = (0.0 if skip_mode

@@ -293,6 +293,56 @@ class TestGeminiClient:
         with pytest.raises(RuntimeError, match="JOB_STATE_FAILED"):
             client.wait_for_batch("batches/b1", sleep_fn=lambda _s: None)
 
+    def test_wait_timeout_uses_wall_clock_not_sleep_sum(self):
+        """max_wait is enforced against a monotonic clock: get_batch latency
+        and retry backoffs count toward the budget, not just the sleeps
+        (summing poll intervals let real elapsed time overshoot 24h)."""
+        client, _, _ = self._batch_client(["JOB_STATE_RUNNING"] * 100)
+        now = [0.0]
+
+        def clock():
+            return now[0]
+
+        def slow_sleep(s):
+            now[0] += s + 45.0          # each poll round-trip costs 45 s extra
+
+        with pytest.raises(TimeoutError, match="after 150s"):
+            client.wait_for_batch("batches/b1", poll_interval=30,
+                                  max_wait=140.0, sleep_fn=slow_sleep,
+                                  clock_fn=clock)
+
+    def test_openai_anthropic_wait_also_wall_clock(self):
+        """The sibling OpenAI/Anthropic poll loops share the monotonic-clock
+        timeout semantics (the defect was fixed in all three copies)."""
+        import json as _json
+
+        from llm_interpretation_replication_tpu.api_backends.anthropic_client import (
+            AnthropicClient,
+        )
+        from llm_interpretation_replication_tpu.api_backends.openai_client import (
+            OpenAIClient,
+        )
+
+        class Poll:
+            def __init__(self, body):
+                self.body = _json.dumps(body).encode()
+
+            def request(self, method, url, headers, *payload):
+                return 200, self.body
+
+        for client, kwargs in [
+            (OpenAIClient("k", transport=Poll({"id": "b", "status": "in_progress"})),
+             {}),
+            (AnthropicClient("k", transport=Poll(
+                {"id": "b", "processing_status": "in_progress"})), {}),
+        ]:
+            now = [0.0]
+            with pytest.raises(TimeoutError):
+                client.wait_for_batch(
+                    "b", poll_interval=30, timeout=100.0,
+                    sleep=lambda s: now.__setitem__(0, now[0] + s + 45.0),
+                    clock=lambda: now[0], **kwargs)
+
     def test_run_batch_resumes_from_saved_id(self, tmp_path):
         """A saved batch id re-attaches (NO second submit) and is cleared on
         success (reference save/load/clear_batch_id :349-381)."""
@@ -594,6 +644,44 @@ class TestApiPerturbationSweep:
         assert (df["Token_1_Prob"] == 0).all()
         assert (df["Confidence Value"] == 85).all()
         assert (df["Log Probabilities"] == "N/A for reasoning models").all()
+
+    def test_half_failed_pair_left_out_for_resume(self):
+        """Binary succeeded but confidence errored: the pair must NOT be
+        written (a null-confidence row would be skipped forever by
+        triple-based resume) — mirroring the Claude leg's retry-on-resume
+        semantics."""
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            create_batch_requests, extract_results_from_batch, group_batch_results,
+        )
+
+        _, mapping = create_batch_requests("gpt-4.1", self._scenarios(),
+                                           max_rephrasings=2)
+        raw = []
+        for cid, info in mapping.items():
+            if info["format_type"] == "confidence" and info["rephrase_idx"] == 0:
+                raw.append({"custom_id": cid, "error": {"message": "boom"},
+                            "response": None})
+                continue
+            raw.append({"custom_id": cid, "response": {"body": {
+                "choices": [{"message": {"content": "Covered"},
+                             "logprobs": {"content": []}}]}}})
+        rows = extract_results_from_batch(group_batch_results(raw, mapping),
+                                          "gpt-4.1")
+        assert len(rows) == 1                       # only the complete pair
+        assert rows[0]["Rephrased Main Part"] == "Rephrase B."
+
+        # reasoning frequency mode (skip_reasoning_logprobs=False) has the
+        # same failure shape: successful binary runs + errored confidence
+        # must not be written either
+        _, rmap = create_batch_requests("o3", self._scenarios(),
+                                        skip_reasoning_logprobs=False,
+                                        max_rephrasings=1)
+        rraw = [{"custom_id": cid, "response": {"body": {
+                    "choices": [{"message": {"content": "Covered"}}]}}}
+                for cid, info in rmap.items() if info["format_type"] == "binary"]
+        rrows = extract_results_from_batch(group_batch_results(rraw, rmap), "o3",
+                                           skip_reasoning_logprobs=False)
+        assert rrows == []
 
     def test_reasoning_model_frequency_runs(self):
         from llm_interpretation_replication_tpu.sweeps.api_perturbation import (

@@ -149,6 +149,33 @@ def test_grouped_matches_dense(causal, n_heads, n_kv):
     )
 
 
+def test_fully_masked_rows_return_zero():
+    """Length-0 padded batch rows must come back as zeros on EVERY backend —
+    NEG_INF is finite, so without an explicit guard a fully-masked row
+    softmaxes to uniform 1/S and returns the mean of V (matching the
+    ring/Ulysses zero-row semantics)."""
+    rng = np.random.default_rng(6)
+    B, N, S, D = 2, 4, 64, 16
+    q = rng.standard_normal((B, N, S, D)).astype(np.float32)
+    k = rng.standard_normal((B, N, S, D)).astype(np.float32)
+    v = rng.standard_normal((B, N, S, D)).astype(np.float32)
+    lengths = np.array([S, 0], np.int32)                     # row 1 fully padded
+
+    dense = _dense_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                             jnp.asarray(lengths), True)
+    np.testing.assert_array_equal(np.asarray(dense)[1], 0.0)
+    assert np.abs(np.asarray(dense)[0]).sum() > 0            # live row untouched
+
+    flash = flash_attention(jnp.asarray(q), jnp.asarray(k), jnp.asarray(v),
+                            lengths, causal=True, interpret=True)
+    np.testing.assert_array_equal(np.asarray(flash)[1], 0.0)
+
+    grouped = grouped_attention(jnp.asarray(q), jnp.asarray(k[:, :1]),
+                                jnp.asarray(v[:, :1]), lengths,
+                                causal=True, block_rows=32, interpret=True)
+    np.testing.assert_array_equal(np.asarray(grouped)[1], 0.0)
+
+
 def test_attention_dispatch_accepts_grouped_kv():
     """The dispatcher takes unrepeated [B, G, S, D] K/V on every backend; on
     the dense path it must repeat to full heads itself."""
