@@ -317,6 +317,98 @@ def cmd_generate_irrelevant(args):
     print(f"{total} perturbations -> {args.output}")
 
 
+def cmd_run_irrelevant(args):
+    """The irrelevant-insertion study end-to-end (Appendix C's data leg) —
+    evaluate_irrelevant_perturbations.py:942-1297 as a subcommand: 3,400
+    perturbations × {response, confidence} legs over GPT-4.1 / Claude Opus
+    4.1 / Gemini 2.5 Pro at temperature 0.7, triple-set resume, and the full
+    artifact set (raw/summary CSVs, three-sheet workbook, analysis.json,
+    reports, stacked violins)."""
+    import os
+
+    from .analysis.irrelevant_eval import (
+        analyze_results,
+        build_vendor_evaluators,
+        create_stacked_visualization,
+        run_irrelevant_evaluation,
+    )
+    from .gen.irrelevant import load_perturbations
+
+    out = args.output_dir
+    analysis_json = os.path.join(out, "analysis.json")
+    raw_csv = os.path.join(out, "raw_results.csv")
+
+    if args.regenerate_plots:
+        # reference :1009-1026: plots only, from the saved analysis
+        if not os.path.exists(analysis_json):
+            raise SystemExit(f"no analysis at {analysis_json}; run the evaluation first")
+        with open(analysis_json) as f:
+            analysis = json.load(f)
+        fig = create_stacked_visualization(analysis, out)
+        print(f"regenerated {fig}")
+        return
+
+    fresh_start = args.no_resume or args.clear_checkpoint
+    if args.load_existing and not args.force_rerun and not fresh_start:
+        # reference :1028-1078: loading saved results is the DEFAULT; a new
+        # evaluation only starts when nothing is saved, --force-rerun asks,
+        # or a fresh start (--no-resume/--clear-checkpoint) signals intent
+        # to re-evaluate (silently ignoring those flags would be worse)
+        if os.path.exists(raw_csv) and os.path.exists(analysis_json):
+            import pandas as pd
+
+            df = pd.read_csv(raw_csv)
+            with open(analysis_json) as f:
+                analysis = json.load(f)
+            for model in args.models:
+                sub = df[df["model"] == model]
+                if len(sub):
+                    print(f"{model.upper()}: {len(sub)} evaluations across "
+                          f"{sub['scenario_name'].nunique()} scenarios")
+            fig = create_stacked_visualization(analysis, out)
+            print(f"loaded {len(df)} results from {raw_csv}; figure: {fig}")
+            print("to force re-running evaluations, use: --force-rerun")
+            return
+
+    if fresh_start:
+        for name in ("processed_triples.json", "progress.json", "raw_results.csv"):
+            path = os.path.join(out, name)
+            if os.path.exists(path):
+                os.remove(path)
+        print("cleared resume state")
+
+    scenarios = load_perturbations(args.perturbations)
+
+    def key_for(env):
+        key = os.environ.get(env)
+        if key is None:
+            raise SystemExit(f"{env} not set")
+        return key
+
+    clients = {}
+    if "gpt" in args.models:
+        from .api_backends.openai_client import OpenAIClient
+
+        clients["gpt_client"] = OpenAIClient(key_for("OPENAI_API_KEY"))
+    if "claude" in args.models:
+        from .api_backends.anthropic_client import AnthropicClient
+
+        clients["claude_client"] = AnthropicClient(key_for("ANTHROPIC_API_KEY"))
+    if "gemini" in args.models:
+        from .api_backends.gemini_client import GeminiClient
+
+        clients["gemini_client"] = GeminiClient(key_for("GEMINI_API_KEY"))
+    import time
+
+    evaluators = build_vendor_evaluators(sleep=time.sleep, **clients)
+    test_mode = args.test_mode and not args.full_mode
+    paths = run_irrelevant_evaluation(
+        evaluators, scenarios, out,
+        limit_total=args.limit if test_mode else None,
+    )
+    print(json.dumps(paths, indent=2))
+
+
 def cmd_analyze_perturbations(args):
     from .analysis import analyze_workbook
     from .config import legal_scenarios
@@ -468,6 +560,36 @@ def main(argv=None):
     p = sub.add_parser("generate-irrelevant", help="build perturbations_irrelevant.json")
     p.add_argument("--output", default="data/perturbations_irrelevant.json")
     p.set_defaults(fn=cmd_generate_irrelevant)
+
+    p = sub.add_parser("run-irrelevant",
+                       help="irrelevant-insertion study: 3,400 perturbations "
+                            "over GPT/Claude/Gemini at temperature 0.7 "
+                            "(keys via env)")
+    p.add_argument("--perturbations", default="data/perturbations_irrelevant.json")
+    p.add_argument("--output-dir", default="results/irrelevant_perturbations")
+    p.add_argument("--test-mode", action="store_true", default=False,
+                   help="limited run (see --limit)")
+    p.add_argument("--full-mode", action="store_true",
+                   help="run on all data (overrides test mode)")
+    p.add_argument("--limit", type=int, default=100,
+                   help="total evaluations in test mode, split across models")
+    p.add_argument("--models", nargs="+", choices=["gpt", "claude", "gemini"],
+                   default=["gpt", "claude", "gemini"])
+    p.add_argument("--resume", action="store_true",
+                   help="resume from checkpoint (the default behavior; "
+                        "accepted for reference-CLI parity)")
+    p.add_argument("--no-resume", action="store_true",
+                   help="start from scratch, discarding any checkpoint")
+    p.add_argument("--clear-checkpoint", action="store_true",
+                   help="clear existing checkpoint before starting")
+    p.add_argument("--load-existing", action="store_true", default=True,
+                   help="load saved results/analysis instead of evaluating "
+                        "(default: True)")
+    p.add_argument("--force-rerun", action="store_true",
+                   help="run new evaluations even if results exist")
+    p.add_argument("--regenerate-plots", action="store_true",
+                   help="only rebuild plots from the saved analysis.json")
+    p.set_defaults(fn=cmd_run_irrelevant)
 
     p = sub.add_parser("analyze-perturbations", help="statistics over a sweep workbook")
     p.add_argument("--workbook", required=True)

@@ -7,8 +7,14 @@ from .closed_source_eval import (
 )
 from .combined_confidence import ModelConfidenceAnalyzer, run_combined_analysis
 from .irrelevant_eval import (
+    analyze_results,
+    build_vendor_evaluators,
     consistency_statistics,
+    create_stacked_visualization,
     process_scenario_perturbations,
+    run_irrelevant_evaluation,
+    save_results,
+    summary_frame,
     write_outputs,
 )
 from .model_comparison import (

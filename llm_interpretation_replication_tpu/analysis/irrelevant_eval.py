@@ -31,8 +31,85 @@ Evaluator = Callable[[str], str]  # perturbed scenario text -> model reply text
 RESULT_COLUMNS = [
     "model", "scenario_name", "perturbation_id", "irrelevant_statement",
     "position_index", "position_description", "response", "confidence",
-    "confidence_raw_response",
+    "confidence_raw_response", "is_original", "response_prompt",
+    "confidence_prompt",
 ]
+
+DELAY_BETWEEN_REQUESTS = 0.1  # reference :62
+
+
+def build_vendor_evaluators(
+    gpt_client=None,
+    claude_client=None,
+    gemini_client=None,
+    models: Optional[Dict[str, Dict]] = None,
+    sleep: Optional[Callable[[float], None]] = None,
+    delay: float = DELAY_BETWEEN_REQUESTS,
+) -> Dict[str, Evaluator]:
+    """``{"gpt"|"claude"|"gemini": prompt -> reply text}`` over the vendor
+    clients, with each vendor's quirks preserved:
+
+    - GPT: plain chat completion, no logprobs (reference :295-314)
+    - Claude: create_message at the study temperature (:316-334)
+    - Gemini: safety thresholds BLOCK_NONE and ``max_output_tokens``
+      deliberately UNSET — setting it triggered empty-reply truncation on
+      gemini-2.5-pro (:336-369 and the client's own bug-dodge note)
+
+    Only vendors whose client is provided get an evaluator.  ``models``
+    defaults to the study roster asset (temperature 0.7, 500-token replies,
+    reference :41-57).  ``sleep`` adds the reference's inter-request pacing
+    (:62); omit it in tests.
+    """
+    from ..config import irrelevant_eval_models
+
+    models = models or irrelevant_eval_models()
+    evaluators: Dict[str, Evaluator] = {}
+
+    def paced(fn: Evaluator) -> Evaluator:
+        if sleep is None:
+            return fn
+
+        def wrapped(prompt: str) -> str:
+            out = fn(prompt)
+            sleep(delay)
+            return out
+
+        return wrapped
+
+    # each vendor's spec is bound as a default argument: a shared closure
+    # variable would be rebound to the LAST vendor's spec by the time the
+    # evaluators run, sending e.g. the Gemini model name to OpenAI
+    if gpt_client is not None:
+
+        def eval_gpt(prompt: str, spec=models["gpt"]) -> str:
+            resp = gpt_client.chat_completion(
+                spec["name"], [{"role": "user", "content": prompt}],
+                temperature=spec["temperature"], max_tokens=spec["max_tokens"],
+                logprobs=False,
+            )
+            return resp["choices"][0]["message"]["content"].strip()
+
+        evaluators["gpt"] = paced(eval_gpt)
+    if claude_client is not None:
+
+        def eval_claude(prompt: str, spec=models["claude"]) -> str:
+            msg = claude_client.create_message(
+                spec["name"], [{"role": "user", "content": prompt}],
+                max_tokens=spec["max_tokens"], temperature=spec["temperature"],
+            )
+            return claude_client.text_of(msg)
+
+        evaluators["claude"] = paced(eval_claude)
+    if gemini_client is not None:
+
+        def eval_gemini(prompt: str, spec=models["gemini"]) -> str:
+            resp = gemini_client.generate_content(
+                spec["name"], prompt, temperature=spec["temperature"],
+            )
+            return gemini_client.text_of(resp)
+
+        evaluators["gemini"] = paced(eval_gemini)
+    return evaluators
 
 
 def response_prompt(scenario: Dict, text: str) -> str:
@@ -51,9 +128,15 @@ def process_scenario_perturbations(
     output_dir: str,
     include_original: bool = True,
     max_per_scenario: Optional[int] = None,
+    limit_per_model: Optional[Dict[str, int]] = None,
     log: Optional[SessionLogger] = None,
 ) -> pd.DataFrame:
-    """Evaluate every (model, scenario, perturbation) triple with resume."""
+    """Evaluate every (model, scenario, perturbation) triple with resume.
+
+    ``limit_per_model`` caps NEW evaluations per model for this run (the
+    reference's test-mode distribution, evaluate_irrelevant_perturbations.py
+    :1138-1146, 1188-1223); already-processed triples don't count against it,
+    and a scenario may be cut mid-way to honor the cap exactly."""
     log = log or SessionLogger()
     os.makedirs(output_dir, exist_ok=True)
     processed = ProcessedSet(os.path.join(output_dir, "processed_triples.json"))
@@ -78,20 +161,22 @@ def process_scenario_perturbations(
     ) * len(evaluators)
     progress = Progress(total, path=os.path.join(output_dir, "progress.json"))
 
-    def run_one(model: str, evaluate: Evaluator, scenario: Dict, pid, text: str, extra: Dict):
+    def run_one(model: str, evaluate: Evaluator, scenario: Dict, pid, text: str, extra: Dict) -> bool:
         key = (model, scenario["scenario_name"], pid)
         if key in processed:
-            return
+            return False
         # two legs per triple, like the reference: the yes/no-style response
         # prompt, then the 0-100 confidence prompt (:407-470).  Each leg
         # fails independently so a broken confidence call can't clobber a
         # good response (and vice versa); the sweep continues either way.
+        r_prompt = response_prompt(scenario, text)
+        c_prompt = confidence_prompt(scenario, text)
         try:
-            response = evaluate(response_prompt(scenario, text))
+            response = evaluate(r_prompt)
         except Exception as err:
             response = f"ERROR: {str(err)[:100]}"
         try:
-            reply = evaluate(confidence_prompt(scenario, text))
+            reply = evaluate(c_prompt)
             confidence = extract_final_number(reply)
         except Exception as err:
             reply, confidence = f"ERROR: {str(err)[:100]}", None
@@ -103,23 +188,34 @@ def process_scenario_perturbations(
                 "response": str(response)[:500],
                 "confidence": confidence,
                 "confidence_raw_response": str(reply)[:500],
+                "is_original": pid == "original",
+                "response_prompt": r_prompt,
+                "confidence_prompt": c_prompt,
                 **extra,
             }
         )
         processed.add(key, flush=False)
         progress.update(1, model=model, scenario=scenario["scenario_name"])
+        return True
 
     for model, evaluate in evaluators.items():
+        budget = (limit_per_model or {}).get(model, float("inf"))
         for scenario in scenarios:
+            if budget <= 0:
+                log(f"{model}: reached evaluation limit, moving on")
+                break
             perturbations = scenario["perturbations_with_irrelevant"]
             if max_per_scenario:
                 perturbations = perturbations[:max_per_scenario]
             if include_original:
-                run_one(model, evaluate, scenario, "original", scenario["original_main"],
-                        {"irrelevant_statement": "", "position_index": -1,
-                         "position_description": "original"})
+                budget -= run_one(
+                    model, evaluate, scenario, "original", scenario["original_main"],
+                    {"irrelevant_statement": "", "position_index": -1,
+                     "position_description": "original"})
             for p in perturbations:
-                run_one(
+                if budget <= 0:
+                    break
+                budget -= run_one(
                     model, evaluate, scenario, p["perturbation_id"], p["perturbed_text"],
                     {
                         "irrelevant_statement": p["irrelevant_statement"],
@@ -133,6 +229,46 @@ def process_scenario_perturbations(
     df = pd.DataFrame(rows, columns=RESULT_COLUMNS)
     df.to_csv(rows_path, index=False)
     return df
+
+
+def _usable(series: pd.Series) -> pd.Series:
+    """Responses that are present and not a one-leg ERROR sentinel (run_one
+    records those to keep the sweep alive)."""
+    s = series.dropna()
+    return s[~s.astype(str).str.startswith("ERROR:")]
+
+
+def _original_reference(orig: pd.DataFrame, pert: pd.DataFrame):
+    """(original_response, original_confidence) with the reference's missing-
+    original fallback — modal perturbed response + mean perturbed confidence
+    (evaluate_irrelevant_perturbations.py:522-542)."""
+    orig_resp, orig_conf = None, np.nan
+    if len(orig):
+        orig_conf = pd.to_numeric(orig["confidence"], errors="coerce").iloc[0]
+        orig_usable = _usable(orig["response"])
+        if len(orig_usable):
+            orig_resp = orig_usable.iloc[0]
+    if orig_resp is None and len(pert):
+        modes = _usable(pert["response"]).mode()
+        if len(modes):
+            orig_resp = modes.iloc[0]
+        if pd.isna(orig_conf):
+            vals_pert = pd.to_numeric(pert["confidence"], errors="coerce").dropna()
+            orig_conf = float(vals_pert.mean()) if vals_pert.size else np.nan
+    return orig_resp, orig_conf
+
+
+def _consistency(pert: pd.DataFrame, orig_resp) -> float:
+    """Share of usable perturbed responses equal to the original's.  No
+    perturbations at all -> trivially consistent (reference :565);
+    perturbations exist but none measurable -> NaN, not a fabricated
+    perfect score."""
+    pert_resp = _usable(pert["response"])
+    if len(pert_resp) and orig_resp is not None:
+        return float((pert_resp == orig_resp).mean())
+    if len(pert) == 0:
+        return 1.0
+    return float("nan")
 
 
 def consistency_statistics(df: pd.DataFrame) -> pd.DataFrame:
@@ -149,39 +285,11 @@ def consistency_statistics(df: pd.DataFrame) -> pd.DataFrame:
         orig = sub[sub["perturbation_id"] == "original"]
         vals_all = pd.to_numeric(sub["confidence"], errors="coerce").dropna()
         vals_pert = pd.to_numeric(pert["confidence"], errors="coerce").dropna()
-        def usable(series: pd.Series) -> pd.Series:
-            # a response is usable when present and not a one-leg ERROR
-            # sentinel (run_one records those to keep the sweep alive)
-            s = series.dropna()
-            return s[~s.astype(str).str.startswith("ERROR:")]
-
-        orig_resp, orig_conf = None, np.nan
-        if len(orig):
-            orig_conf = pd.to_numeric(orig["confidence"], errors="coerce").iloc[0]
-            orig_usable = usable(orig["response"])
-            if len(orig_usable):
-                orig_resp = orig_usable.iloc[0]
-        if orig_resp is None and len(pert):
-            # missing (or errored) original: synthesize the reference's
-            # fallback — the modal perturbed response + mean perturbed
-            # confidence (:522-542)
-            modes = usable(pert["response"]).mode()
-            if len(modes):
-                orig_resp = modes.iloc[0]
-            if pd.isna(orig_conf):
-                orig_conf = float(vals_pert.mean()) if vals_pert.size else np.nan
+        orig_resp, orig_conf = _original_reference(orig, pert)
         # rows whose response leg is missing or errored (legacy checkpoints,
         # one-leg failures) are excluded from the consistency denominator
-        # instead of silently counting as disagreement.  No perturbations at
-        # all -> trivially consistent (reference :565); perturbations exist
-        # but none measurable -> NaN, not a fabricated perfect score.
-        pert_resp = usable(pert["response"])
-        if len(pert_resp) and orig_resp is not None:
-            consistency = float((pert_resp == orig_resp).mean())
-        elif len(pert) == 0:
-            consistency = 1.0
-        else:
-            consistency = float("nan")
+        # instead of silently counting as disagreement.
+        consistency = _consistency(pert, orig_resp)
         rec = {
             "model": model,
             "scenario_name": scenario,
@@ -208,6 +316,280 @@ def consistency_statistics(df: pd.DataFrame) -> pd.DataFrame:
             )
         records.append(rec)
     return pd.DataFrame(records)
+
+
+def analyze_results(df: pd.DataFrame) -> Dict:
+    """Nested ``{scenario: {model: {...}}}`` analysis — the reference's
+    ``analysis.json`` shape (evaluate_irrelevant_perturbations.py:503-618):
+    consistency, confidence_stats (pooled + perturbed-only), per-position
+    consistency, the original's prompts/raw reply, and the raw confidence
+    values the violin plots draw from."""
+    analysis: Dict = {}
+    for (model, scenario), sub in df.groupby(["model", "scenario_name"]):
+        pert = sub[sub["perturbation_id"] != "original"]
+        orig = sub[sub["perturbation_id"] == "original"]
+        vals_all = pd.to_numeric(sub["confidence"], errors="coerce").dropna()
+        vals_pert = pd.to_numeric(pert["confidence"], errors="coerce").dropna()
+        if vals_all.size == 0:
+            continue                       # reference :556: nothing to analyze
+        orig_resp, orig_conf = _original_reference(orig, pert)
+
+        confidence_stats = {
+            "original_confidence": float(orig_conf) if pd.notna(orig_conf) else None,
+            "mean_all_confidence": float(vals_all.mean()),
+            "std_all_confidence": float(vals_all.std()),
+            "median_all_confidence": float(vals_all.median()),
+            "ci_lower_95": float(np.percentile(vals_all, 2.5)),
+            "ci_upper_95": float(np.percentile(vals_all, 97.5)),
+            "min_confidence": float(vals_all.min()),
+            "max_confidence": float(vals_all.max()),
+            "n_samples": int(vals_all.size),
+        }
+        if vals_pert.size:
+            confidence_stats.update(
+                mean_perturbed_confidence=float(vals_pert.mean()),
+                std_perturbed_confidence=float(vals_pert.std()),
+                median_perturbed_confidence=float(vals_pert.median()),
+                perturbed_ci_lower_95=float(np.percentile(vals_pert, 2.5)),
+                perturbed_ci_upper_95=float(np.percentile(vals_pert, 97.5)),
+            )
+
+        position_consistency = {}
+        if len(pert) and orig_resp is not None:
+            for pos_idx in pert["position_index"].dropna().unique():
+                pos = pert[pert["position_index"] == pos_idx]
+                desc = pos["position_description"].iloc[0] if len(pos) else str(pos_idx)
+                pos_resp = _usable(pos["response"])
+                if len(pos_resp):
+                    position_consistency[f"{int(pos_idx)}_{desc}"] = float(
+                        (pos_resp == orig_resp).mean()
+                    )
+
+        def _orig_field(col: str) -> str:
+            if len(orig) and col in orig.columns and pd.notna(orig[col].iloc[0]):
+                return str(orig[col].iloc[0])
+            return "N/A - Original missing"
+
+        analysis.setdefault(scenario, {})[model] = {
+            "consistency": _consistency(pert, orig_resp),
+            "confidence_stats": confidence_stats,
+            "position_consistency": position_consistency,
+            "num_perturbations": int(len(pert)),
+            "num_total_samples": int(len(sub)),
+            "original_response": orig_resp,
+            "original_response_prompt": _orig_field("response_prompt"),
+            "original_confidence_prompt": _orig_field("confidence_prompt"),
+            "original_confidence_raw_response": _orig_field("confidence_raw_response"),
+            "confidence_values": [float(v) for v in vals_all],
+        }
+    return analysis
+
+
+def summary_frame(analysis: Dict) -> pd.DataFrame:
+    """The reference's ``summary.csv`` row set (:640-656)."""
+    records = []
+    for scenario, per_model in analysis.items():
+        for model, a in per_model.items():
+            cs = a["confidence_stats"]
+            records.append({
+                "scenario": scenario,
+                "model": model,
+                "consistency": a["consistency"],
+                "original_confidence": cs.get("original_confidence"),
+                "mean_all_confidence": cs.get("mean_all_confidence"),
+                "std_all_confidence": cs.get("std_all_confidence"),
+                "median_all_confidence": cs.get("median_all_confidence"),
+                "ci_lower_95": cs.get("ci_lower_95"),
+                "ci_upper_95": cs.get("ci_upper_95"),
+                "n_samples": cs.get("n_samples"),
+                "mean_perturbed_confidence": cs.get("mean_perturbed_confidence"),
+                "std_perturbed_confidence": cs.get("std_perturbed_confidence"),
+                "original_response": a["original_response"],
+                "num_perturbations": a.get("num_perturbations", 0),
+                "num_total_samples": a.get("num_total_samples", 0),
+            })
+    return pd.DataFrame(records)
+
+
+def position_frame(analysis: Dict) -> pd.DataFrame:
+    """Long-form per-position consistency (the Position Analysis sheet's
+    source, :663-673)."""
+    records = [
+        {"scenario": scenario, "model": model, "position": position,
+         "consistency": consistency}
+        for scenario, per_model in analysis.items()
+        for model, a in per_model.items()
+        for position, consistency in a["position_consistency"].items()
+    ]
+    return pd.DataFrame(records, columns=["scenario", "model", "position",
+                                          "consistency"])
+
+
+MODEL_DISPLAY_NAMES = {  # reference :848-853
+    "gpt": "GPT-4.1", "claude": "Claude Opus 4.1", "gemini": "Gemini 2.5 Pro",
+}
+
+
+def create_stacked_visualization(analysis: Dict, output_dir: str) -> Optional[str]:
+    """``three_model_stacked_visualization.png`` — vertically stacked violin
+    panels, one per model in gpt/claude/gemini order (:803-941)."""
+    scenarios = sorted(analysis)
+    present = [m for m in MODEL_DISPLAY_NAMES
+               if any(m in analysis[s] for s in scenarios)]
+    if not present:
+        return None
+    values = {
+        MODEL_DISPLAY_NAMES[m]: {
+            s: analysis[s][m]["confidence_values"]
+            for s in scenarios if m in analysis[s]
+        }
+        for m in present
+    }
+    return figures.stacked_violin_panels(
+        values, os.path.join(output_dir, "three_model_stacked_visualization.png"),
+        group_order=scenarios,
+    )
+
+
+def summary_report_text(analysis: Dict) -> str:
+    """The human-readable ``summary_report.txt`` (:726-765)."""
+    lines = ["IRRELEVANT STATEMENT PERTURBATION ANALYSIS", "=" * 60, ""]
+    for scenario, per_model in analysis.items():
+        lines += ["", scenario, "-" * 40]
+        for model, a in per_model.items():
+            cs = a["confidence_stats"]
+            lines += [
+                "", f"{model}:",
+                f"  Consistency: {a['consistency']:.2%}",
+                f"  Original Response: {a['original_response']}",
+                f"  Number of Samples: {cs.get('n_samples', 'N/A')}",
+                "", "  Confidence Statistics:",
+                f"    Original: {cs.get('original_confidence', 'N/A')}",
+                f"    Mean (all): {cs.get('mean_all_confidence', 0):.1f}",
+                f"    Std Dev (all): {cs.get('std_all_confidence', 0):.1f}",
+                f"    Median (all): {cs.get('median_all_confidence', 0):.1f}",
+                f"    95% CI: [{cs.get('ci_lower_95', 0):.1f}, "
+                f"{cs.get('ci_upper_95', 0):.1f}]",
+            ]
+            if "mean_perturbed_confidence" in cs:
+                lines += [
+                    f"    Mean (perturbed only): {cs['mean_perturbed_confidence']:.1f}",
+                    f"    Std Dev (perturbed only): {cs['std_perturbed_confidence']:.1f}",
+                ]
+            lines.append("\n  Position Consistency:")
+            for position, consistency in a["position_consistency"].items():
+                lines.append(f"    {position}: {consistency:.2%}")
+    return "\n".join(lines) + "\n"
+
+
+def detailed_prompts_text(df: pd.DataFrame, per_scenario: int = 5) -> str:
+    """``detailed_prompts.txt`` — first few full prompt/response examples per
+    scenario (:767-800)."""
+    lines = ["DETAILED PROMPTS USED IN EVALUATION", "=" * 60, ""]
+    counts: Dict[str, int] = {}
+    seen = set()
+    for _, row in df.iterrows():
+        key = (row["scenario_name"], row["perturbation_id"])
+        if key in seen:
+            continue
+        seen.add(key)
+        n = counts.get(row["scenario_name"], 0)
+        if n >= per_scenario:
+            continue
+        counts[row["scenario_name"]] = n + 1
+        lines += [
+            "", f"Scenario: {row['scenario_name']}",
+            f"Perturbation ID: {row['perturbation_id']}",
+        ]
+        # original rows reloaded from a resume CSV carry NaN (truthy!) here
+        if pd.notna(row.get("irrelevant_statement")) and row.get("irrelevant_statement"):
+            lines.append(f"Irrelevant Statement: {row['irrelevant_statement']}")
+        lines += [
+            f"Model: {row['model']}", "-" * 40,
+            "", "RESPONSE PROMPT:", str(row.get("response_prompt", "")),
+            "", "CONFIDENCE PROMPT:", str(row.get("confidence_prompt", "")),
+            "", f"Model Response: {row['response']}",
+            f"Model Confidence: {row['confidence']}",
+            f"Raw Confidence Response: {row['confidence_raw_response']}",
+            "=" * 60,
+        ]
+        if counts[row["scenario_name"]] == per_scenario:
+            lines.append(
+                f"\n[Showing first {per_scenario} perturbations for "
+                f"{row['scenario_name']}. Full data in raw_results.csv]"
+            )
+    return "\n".join(lines) + "\n"
+
+
+def save_results(df: pd.DataFrame, analysis: Dict, output_dir: str,
+                 make_figures: bool = True) -> Dict[str, str]:
+    """The reference's full artifact set (:620-800): raw_results.csv,
+    summary.csv, the three-sheet results_analysis.xlsx, analysis.json,
+    summary_report.txt, detailed_prompts.txt, and the stacked violin
+    visualization."""
+    from ..utils.xlsx import write_xlsx_sheets
+
+    os.makedirs(output_dir, exist_ok=True)
+    summary = summary_frame(analysis)
+    positions = position_frame(analysis)
+    paths = {
+        "csv": os.path.join(output_dir, "raw_results.csv"),
+        "summary_csv": os.path.join(output_dir, "summary.csv"),
+        "xlsx": os.path.join(output_dir, "results_analysis.xlsx"),
+        "analysis_json": os.path.join(output_dir, "analysis.json"),
+        "report": os.path.join(output_dir, "summary_report.txt"),
+        "prompts": os.path.join(output_dir, "detailed_prompts.txt"),
+    }
+    df.to_csv(paths["csv"], index=False)
+    summary.to_csv(paths["summary_csv"], index=False)
+    sheets = {"Raw Results": df, "Summary": summary}
+    if len(positions):
+        sheets["Position Analysis"] = (
+            positions.pivot_table(index=["scenario", "model"],
+                                  columns="position", values="consistency")
+            .reset_index()
+        )
+    write_xlsx_sheets(sheets, paths["xlsx"])
+    with open(paths["analysis_json"], "w", encoding="utf-8") as f:
+        json.dump(analysis, f, indent=2, default=float)
+    with open(paths["report"], "w", encoding="utf-8") as f:
+        f.write(summary_report_text(analysis))
+    with open(paths["prompts"], "w", encoding="utf-8") as f:
+        f.write(detailed_prompts_text(df))
+    if make_figures:
+        fig = create_stacked_visualization(analysis, output_dir)
+        if fig:
+            paths["figure"] = fig
+    return paths
+
+
+def run_irrelevant_evaluation(
+    evaluators: Dict[str, Evaluator],
+    scenarios: Sequence[Dict],
+    output_dir: str,
+    limit_total: Optional[int] = None,
+    make_figures: bool = True,
+    log: Optional[SessionLogger] = None,
+) -> Dict[str, str]:
+    """End-to-end study leg: evaluate (with resume), analyze, save everything.
+
+    ``limit_total`` is the reference's test-mode budget, split evenly across
+    the models with the remainder going to the first ones (:1138-1146)."""
+    log = log or SessionLogger()
+    limit_per_model = None
+    if limit_total is not None:
+        n = len(evaluators)
+        per, rem = divmod(limit_total, n)
+        limit_per_model = {
+            m: per + (1 if i < rem else 0) for i, m in enumerate(evaluators)
+        }
+        log(f"test mode: {limit_total} evaluations split as {limit_per_model}")
+    df = process_scenario_perturbations(
+        evaluators, scenarios, output_dir,
+        limit_per_model=limit_per_model, log=log,
+    )
+    analysis = analyze_results(df)
+    return save_results(df, analysis, output_dir, make_figures=make_figures)
 
 
 def write_outputs(df: pd.DataFrame, stats: pd.DataFrame, output_dir: str,

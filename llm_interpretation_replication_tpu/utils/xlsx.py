@@ -9,6 +9,10 @@ inline and shared strings on read.
 
 Public API:
     write_xlsx(df, path, sheet_name="Sheet1")
+    write_xlsx_sheets({name: df, ...}, path)   -> multi-sheet workbook (the
+                                reference's results_analysis.xlsx carries Raw
+                                Results / Summary / Position Analysis sheets,
+                                evaluate_irrelevant_perturbations.py:676-713)
     read_xlsx(path, sheet=0) -> pandas.DataFrame
     append_xlsx(df, path)    -> read existing + concat + rewrite (the reference's
                                 incremental-append pattern, perturb_prompts_claude.py:250-253)
@@ -32,9 +36,10 @@ _CONTENT_TYPES = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
 <Default Extension="rels" ContentType="application/vnd.openxmlformats-package.relationships+xml"/>
 <Default Extension="xml" ContentType="application/xml"/>
 <Override PartName="/xl/workbook.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.sheet.main+xml"/>
-<Override PartName="/xl/worksheets/sheet1.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.worksheet+xml"/>
-</Types>
+{sheet_overrides}</Types>
 """
+
+_SHEET_OVERRIDE = '<Override PartName="/xl/worksheets/sheet{i}.xml" ContentType="application/vnd.openxmlformats-officedocument.spreadsheetml.worksheet+xml"/>\n'
 
 _RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
 <Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
@@ -44,14 +49,13 @@ _RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
 
 _WORKBOOK = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
 <workbook xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main" xmlns:r="http://schemas.openxmlformats.org/officeDocument/2006/relationships">
-<sheets><sheet name="{name}" sheetId="1" r:id="rId1"/></sheets>
+<sheets>{sheets}</sheets>
 </workbook>
 """
 
 _WORKBOOK_RELS = """<?xml version="1.0" encoding="UTF-8" standalone="yes"?>
 <Relationships xmlns="http://schemas.openxmlformats.org/package/2006/relationships">
-<Relationship Id="rId1" Type="http://schemas.openxmlformats.org/officeDocument/2006/relationships/worksheet" Target="worksheets/sheet1.xml"/>
-</Relationships>
+{rels}</Relationships>
 """
 
 # Characters illegal in XML 1.0 (except tab/newline/CR) — strip on write.
@@ -87,7 +91,7 @@ def _cell_xml(ref: str, value) -> str:
     return f'<c r="{ref}" t="inlineStr"><is><t xml:space="preserve">{text}</t></is></c>'
 
 
-def write_xlsx(df: pd.DataFrame, path, sheet_name: str = "Sheet1") -> None:
+def _sheet_xml(df: pd.DataFrame) -> str:
     rows_xml = []
     header_cells = "".join(
         _cell_xml(f"{_col_letter(c)}1", col) for c, col in enumerate(df.columns)
@@ -98,11 +102,30 @@ def write_xlsx(df: pd.DataFrame, path, sheet_name: str = "Sheet1") -> None:
             _cell_xml(f"{_col_letter(c)}{r}", v) for c, v in enumerate(row.tolist())
         )
         rows_xml.append(f'<row r="{r}">{cells}</row>')
-    sheet = (
+    return (
         '<?xml version="1.0" encoding="UTF-8" standalone="yes"?>'
         '<worksheet xmlns="http://schemas.openxmlformats.org/spreadsheetml/2006/main">'
         f'<sheetData>{"".join(rows_xml)}</sheetData></worksheet>'
     )
+
+
+def write_xlsx_sheets(sheets: "dict[str, pd.DataFrame]", path) -> None:
+    """Write a workbook with one worksheet per (name, frame) entry, in order.
+
+    ``read_xlsx(path, sheet=i)`` reads them back positionally."""
+    if not sheets:
+        raise ValueError("write_xlsx_sheets needs at least one sheet")
+    names = [escape(str(n)[:31]) for n in sheets]
+    sheet_tags = "".join(
+        f'<sheet name="{n}" sheetId="{i}" r:id="rId{i}"/>'
+        for i, n in enumerate(names, start=1)
+    )
+    rels = "".join(
+        f'<Relationship Id="rId{i}" Type="http://schemas.openxmlformats.org/'
+        f'officeDocument/2006/relationships/worksheet" Target="worksheets/sheet{i}.xml"/>\n'
+        for i in range(1, len(names) + 1)
+    )
+    overrides = "".join(_SHEET_OVERRIDE.format(i=i) for i in range(1, len(names) + 1))
     # atomic: write to a sibling temp file then os.replace, so a crash mid-
     # write can never truncate an existing workbook (the sweeps checkpoint by
     # rewriting in place — a corrupt file would break their resume)
@@ -124,16 +147,23 @@ def write_xlsx(df: pd.DataFrame, path, sheet_name: str = "Sheet1") -> None:
         os.chmod(tmp, 0o666 & ~umask)
     try:
         with zipfile.ZipFile(tmp, "w", zipfile.ZIP_DEFLATED) as zf:
-            zf.writestr("[Content_Types].xml", _CONTENT_TYPES)
+            zf.writestr("[Content_Types].xml",
+                        _CONTENT_TYPES.format(sheet_overrides=overrides))
             zf.writestr("_rels/.rels", _RELS)
-            zf.writestr("xl/workbook.xml", _WORKBOOK.format(name=escape(sheet_name[:31])))
-            zf.writestr("xl/_rels/workbook.xml.rels", _WORKBOOK_RELS)
-            zf.writestr("xl/worksheets/sheet1.xml", sheet)
+            zf.writestr("xl/workbook.xml", _WORKBOOK.format(sheets=sheet_tags))
+            zf.writestr("xl/_rels/workbook.xml.rels",
+                        _WORKBOOK_RELS.format(rels=rels))
+            for i, df in enumerate(sheets.values(), start=1):
+                zf.writestr(f"xl/worksheets/sheet{i}.xml", _sheet_xml(df))
         os.replace(tmp, path)
     except BaseException:
         if os.path.exists(tmp):
             os.remove(tmp)
         raise
+
+
+def write_xlsx(df: pd.DataFrame, path, sheet_name: str = "Sheet1") -> None:
+    write_xlsx_sheets({sheet_name: df}, path)
 
 
 def _parse_shared_strings(zf: zipfile.ZipFile):

@@ -189,6 +189,81 @@ def violin_by_group(values_by_group: Dict[str, Sequence[float]], title: str,
     return _save(fig, output_path)
 
 
+def stacked_violin_panels(
+    values_by_panel: Dict[str, Dict[str, Sequence[float]]],
+    output_path: str,
+    group_order: Optional[Sequence[str]] = None,
+    ylabel: str = "Confidence (0-100)",
+    xlabel: str = "Prompt Number",
+    ylim=(0, 100),
+    refline: Optional[float] = 50.0,
+    seed: int = 42,
+) -> str:
+    """Vertically stacked per-model violin+jitter panels — the irrelevant-
+    insertion study's ``three_model_stacked_visualization.png``
+    (evaluate_irrelevant_perturbations.py:803-941): one subplot per panel
+    (model), scenarios as numbered x positions with a consistent color per
+    scenario across panels, jittered points, black mean dot, and capped
+    2.5/97.5-percentile error bars.
+    """
+    panels = list(values_by_panel)
+    groups = list(group_order) if group_order is not None else sorted(
+        {g for per_group in values_by_panel.values() for g in per_group}
+    )
+    colors = plt.rcParams["axes.prop_cycle"].by_key()["color"]
+    fig, axes = plt.subplots(len(panels), 1,
+                             figsize=(14, 5.6 * len(panels)), squeeze=False)
+    for pi, panel in enumerate(panels):
+        ax = axes[pi][0]
+        per_group = values_by_panel[panel]
+        pos = 0
+        ticks, labels = [], []
+        for gi, group in enumerate(groups):
+            vals = np.asarray(per_group.get(group, []), float)
+            vals = vals[np.isfinite(vals)]
+            if vals.size == 0:
+                continue
+            pos += 1
+            ticks.append(pos)
+            labels.append(str(gi + 1))
+            color = colors[gi % len(colors)]
+            parts = ax.violinplot([vals], [pos], widths=0.3, showmeans=False,
+                                  showmedians=False, showextrema=False)
+            for pc in parts["bodies"]:
+                pc.set_facecolor(color)
+                pc.set_edgecolor("none")
+                pc.set_alpha(0.3)
+            rng = np.random.default_rng(seed + gi)
+            ax.scatter(rng.normal(pos, 0.08, vals.size), vals, alpha=0.4,
+                       s=30, color=color)
+            mean = vals.mean()
+            lo, hi = np.percentile(vals, [2.5, 97.5])
+            ax.scatter([pos], [mean], color="black", s=80, zorder=5)
+            ax.plot([pos, pos], [lo, hi], color="black", lw=2, zorder=4)
+            for y in (lo, hi):
+                ax.plot([pos - 0.1, pos + 0.1], [y, y], color="black", lw=2,
+                        zorder=4)
+        if pos == 0:
+            ax.text(0.5, 0.5, f"No data available for {panel}",
+                    transform=ax.transAxes, ha="center", va="center",
+                    fontsize=14)
+            ax.set_xlim(0, len(groups) + 1)
+        else:
+            ax.set_xticks(ticks)
+            ax.set_xticklabels(labels, fontsize=14)
+            if refline is not None:
+                ax.axhline(y=refline, color="gray", linestyle="--", alpha=0.7)
+        ax.tick_params(axis="y", labelsize=14)
+        ax.set_ylabel(ylabel, fontsize=16)
+        if ylim:
+            ax.set_ylim(*ylim)
+        ax.set_title(panel, fontsize=18, fontweight="bold", pad=10)
+        if pi == len(panels) - 1:
+            ax.set_xlabel(xlabel, fontsize=16)
+    fig.tight_layout()
+    return _save(fig, output_path)
+
+
 def _mae_bars(ax, human_comparisons: Dict, capsize: int = 5) -> None:
     """Shared MAE-vs-baselines bar panel (evaluate_closed_source_models.py:
     1690-1780 and the standalone figure :1832-1901): per-model MAE with

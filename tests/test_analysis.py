@@ -314,6 +314,78 @@ class TestIrrelevantEval:
         assert calls["n"] == before
 
 
+class TestIrrelevantAnalyzeResults:
+    def _df(self):
+        rows = [
+            {"model": "gpt", "scenario_name": "S1", "perturbation_id": "original",
+             "irrelevant_statement": "", "position_index": -1,
+             "position_description": "original", "response": "Covered",
+             "confidence": 80.0, "confidence_raw_response": "80",
+             "is_original": True, "response_prompt": "P-orig-r",
+             "confidence_prompt": "P-orig-c"},
+        ]
+        for pid, (pos, resp, conf) in enumerate(
+            [(0, "Covered", 70.0), (0, "Covered", 90.0),
+             (1, "Not Covered", 60.0), (1, "Covered", 85.0)], start=1
+        ):
+            rows.append({
+                "model": "gpt", "scenario_name": "S1", "perturbation_id": pid,
+                "irrelevant_statement": f"Fact {pid}.", "position_index": pos,
+                "position_description": f"pos{pos}", "response": resp,
+                "confidence": conf, "confidence_raw_response": str(conf),
+                "is_original": False, "response_prompt": f"P{pid}-r",
+                "confidence_prompt": f"P{pid}-c",
+            })
+        import pandas as pd
+
+        return pd.DataFrame(rows)
+
+    def test_nested_analysis_matches_reference_shape(self, tmp_path):
+        from llm_interpretation_replication_tpu.analysis.irrelevant_eval import (
+            analyze_results, save_results, summary_frame,
+        )
+
+        df = self._df()
+        analysis = analyze_results(df)
+        a = analysis["S1"]["gpt"]
+        assert a["consistency"] == pytest.approx(0.75)     # 3 of 4 match
+        cs = a["confidence_stats"]
+        assert cs["original_confidence"] == 80.0
+        assert cs["mean_all_confidence"] == pytest.approx(77.0)
+        assert cs["n_samples"] == 5
+        assert cs["min_confidence"] == 60.0 and cs["max_confidence"] == 90.0
+        assert cs["mean_perturbed_confidence"] == pytest.approx(76.25)
+        # per-position consistency: pos0 2/2, pos1 1/2
+        assert a["position_consistency"] == {"0_pos0": 1.0, "1_pos1": 0.5}
+        assert a["original_response_prompt"] == "P-orig-r"
+        assert len(a["confidence_values"]) == 5
+
+        paths = save_results(df, analysis, str(tmp_path))
+        from llm_interpretation_replication_tpu.utils.xlsx import read_xlsx
+
+        assert len(read_xlsx(paths["xlsx"], sheet=0)) == len(df)   # Raw Results
+        assert read_xlsx(paths["xlsx"], sheet=1)["consistency"].iloc[0] == 0.75
+        pos_sheet = read_xlsx(paths["xlsx"], sheet=2)              # Position
+        assert "0_pos0" in pos_sheet.columns
+        report = open(paths["report"]).read()
+        assert "Consistency: 75.00%" in report
+        prompts = open(paths["prompts"]).read()
+        assert "P-orig-r" in prompts and "CONFIDENCE PROMPT" in prompts
+        assert summary_frame(analysis)["n_samples"].iloc[0] == 5
+
+    def test_missing_original_falls_back_to_mode(self):
+        from llm_interpretation_replication_tpu.analysis.irrelevant_eval import (
+            analyze_results,
+        )
+
+        df = self._df()
+        df = df[df["perturbation_id"] != "original"]
+        a = analyze_results(df)["S1"]["gpt"]
+        assert a["original_response"] == "Covered"          # modal perturbed
+        assert a["confidence_stats"]["original_confidence"] == pytest.approx(76.25)
+        assert a["original_response_prompt"] == "N/A - Original missing"
+
+
 class TestCombinedConfidence:
     def test_combiner_and_figure(self, tmp_path):
         rng = np.random.default_rng(2)

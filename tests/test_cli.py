@@ -179,6 +179,134 @@ def test_api_keyed_commands_require_env(monkeypatch, tmp_path):
         ["run-api-perturbation", "--perturbations", str(pert), "--model", "gpt-4.1"],
         ["run-claude-perturbation", "--perturbations", str(pert)],
         ["run-gemini-perturbation", "--perturbations", str(pert)],
+        ["run-irrelevant", "--perturbations", str(pert), "--force-rerun"],
     ):
         with pytest.raises(SystemExit, match="API_KEY not set"):
             main(argv)
+
+
+class TestRunIrrelevantCli:
+    """run-irrelevant end-to-end against FakeTransport — the Appendix C study
+    leg (evaluate_irrelevant_perturbations.py:942-1297 as a subcommand)."""
+
+    def _fixture(self, tmp_path, monkeypatch):
+        from llm_interpretation_replication_tpu.api_backends import (
+            anthropic_client, gemini_client, openai_client,
+        )
+        from llm_interpretation_replication_tpu.api_backends.transport import (
+            FakeTransport,
+        )
+        from llm_interpretation_replication_tpu.gen.irrelevant import (
+            generate_perturbations, save_perturbations,
+        )
+
+        scenarios = generate_perturbations(
+            [{"original_main": "Main text one. Second sentence.",
+              "scenario_name": "S1",
+              "response_format": "Answer 'Covered' or 'Not Covered'.",
+              "target_tokens": ["Covered", "Not"],
+              "confidence_format": "How confident are you, 0-100?"}],
+            ["Fact A.", "Fact B."],
+        )
+        pert_path = tmp_path / "p.json"
+        save_perturbations(scenarios, str(pert_path))
+
+        ft = FakeTransport()
+
+        def openai_handler(call):
+            content = call["json"]["messages"][0]["content"]
+            text = "85" if "confident" in content else "Covered"
+            return 200, {"choices": [{"message": {"content": text}}]}
+
+        def claude_handler(call):
+            content = call["json"]["messages"][0]["content"]
+            text = "70" if "confident" in content else "Covered"
+            return 200, {"content": [{"type": "text", "text": text}]}
+
+        def gemini_handler(call):
+            content = call["json"]["contents"][0]["parts"][0]["text"]
+            text = "60" if "confident" in content else "Not Covered"
+            return 200, {"candidates": [{"content": {"parts": [{"text": text}]}}]}
+
+        ft.add("POST", "/chat/completions", openai_handler)
+        ft.add("POST", "/messages", claude_handler)
+        ft.add("POST", ":generateContent", gemini_handler)
+        for mod in (openai_client, anthropic_client, gemini_client):
+            monkeypatch.setattr(mod, "UrllibTransport", lambda: ft)
+        for var in ("OPENAI_API_KEY", "ANTHROPIC_API_KEY", "GEMINI_API_KEY"):
+            monkeypatch.setenv(var, "test-key")
+        return pert_path, ft
+
+    def test_full_flow_resume_and_plot_modes(self, tmp_path, monkeypatch, capsys):
+        import time
+
+        pert_path, ft = self._fixture(tmp_path, monkeypatch)
+        monkeypatch.setattr(time, "sleep", lambda _s: None)  # no pacing in tests
+        out = tmp_path / "irr"
+        argv = ["run-irrelevant", "--perturbations", str(pert_path),
+                "--output-dir", str(out), "--test-mode", "--limit", "9"]
+        main(argv)
+        for name in ("raw_results.csv", "summary.csv", "results_analysis.xlsx",
+                     "analysis.json", "summary_report.txt",
+                     "detailed_prompts.txt",
+                     "three_model_stacked_visualization.png"):
+            assert (out / name).exists(), name
+
+        df = pd.read_csv(out / "raw_results.csv")
+        # limit 9 split 3/3/3: per model the original + first 2 perturbations
+        assert len(df) == 9
+        assert set(df["model"]) == {"gpt", "claude", "gemini"}
+        assert (df.groupby("model").size() == 3).all()
+        # vendor quirks rode through: temperature 0.7 everywhere, Gemini with
+        # safety BLOCK_NONE and maxOutputTokens UNSET (the truncation dodge)
+        gemini_calls = [c for c in ft.calls if ":generateContent" in c["url"]]
+        assert gemini_calls
+        for c in gemini_calls:
+            assert c["json"]["generationConfig"]["temperature"] == 0.7
+            assert "maxOutputTokens" not in c["json"]["generationConfig"]
+            assert c["json"]["safetySettings"]
+        openai_calls = [c for c in ft.calls if "/chat/completions" in c["url"]]
+        assert all(c["json"]["temperature"] == 0.7 for c in openai_calls)
+        # each vendor got ITS OWN model name (regression: a shared late-bound
+        # closure once sent the last vendor's spec to every client)
+        assert all(c["json"]["model"] == "gpt-4.1-2025-04-14" for c in openai_calls)
+        claude_calls = [c for c in ft.calls if "/messages" in c["url"]]
+        assert all(c["json"]["model"] == "claude-opus-4-1-20250805"
+                   for c in claude_calls)
+        assert all("gemini-2.5-pro" in c["url"] for c in gemini_calls)
+        summary = pd.read_csv(out / "summary.csv")
+        assert list(summary.columns) == [
+            "scenario", "model", "consistency", "original_confidence",
+            "mean_all_confidence", "std_all_confidence",
+            "median_all_confidence", "ci_lower_95", "ci_upper_95", "n_samples",
+            "mean_perturbed_confidence", "std_perturbed_confidence",
+            "original_response", "num_perturbations", "num_total_samples",
+        ]
+        # every model answered consistently with its own original
+        assert (summary["consistency"] == 1.0).all()
+
+        # 2nd invocation: --load-existing default short-circuits, NO new calls
+        calls_before = len(ft.calls)
+        main(argv)
+        assert len(ft.calls) == calls_before
+        assert "force-rerun" in capsys.readouterr().out
+
+        # --force-rerun resumes via the triple set: only the evaluations the
+        # limit previously cut off are sent (4 remaining per model x 2 legs)
+        main(argv + ["--force-rerun", "--full-mode"])
+        assert len(pd.read_csv(out / "raw_results.csv")) == 21  # 7 per model
+        assert len(ft.calls) == calls_before + 3 * 4 * 2
+        # original rows reloaded from the resume CSV carry NaN statements —
+        # they must not leak into the prompts report as 'nan'
+        assert "nan" not in (out / "detailed_prompts.txt").read_text()
+
+        # --regenerate-plots touches no data, rebuilds the figure
+        (out / "three_model_stacked_visualization.png").unlink()
+        calls_before = len(ft.calls)
+        main(["run-irrelevant", "--output-dir", str(out), "--regenerate-plots"])
+        assert (out / "three_model_stacked_visualization.png").exists()
+        assert len(ft.calls) == calls_before
+
+        # --no-resume clears state: a fresh run re-evaluates everything
+        main(argv + ["--force-rerun", "--no-resume"])
+        assert len(pd.read_csv(out / "raw_results.csv")) == 9
