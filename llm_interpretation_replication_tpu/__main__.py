@@ -370,13 +370,6 @@ def cmd_run_irrelevant(args):
             print("to force re-running evaluations, use: --force-rerun")
             return
 
-    if fresh_start:
-        for name in ("processed_triples.json", "progress.json", "raw_results.csv"):
-            path = os.path.join(out, name)
-            if os.path.exists(path):
-                os.remove(path)
-        print("cleared resume state")
-
     scenarios = load_perturbations(args.perturbations)
 
     def key_for(env):
@@ -398,13 +391,29 @@ def cmd_run_irrelevant(args):
         from .api_backends.gemini_client import GeminiClient
 
         clients["gemini_client"] = GeminiClient(key_for("GEMINI_API_KEY"))
+
+    # Destroy saved state only after inputs/keys validated above — a typo'd
+    # path or missing key must fail fast WITHOUT erasing paid-for results.
+    if fresh_start:
+        for name in ("processed_triples.json", "progress.json",
+                     "raw_results.csv", "analysis.json"):
+            path = os.path.join(out, name)
+            if os.path.exists(path):
+                os.remove(path)
+        print("cleared resume state")
     import time
 
     evaluators = build_vendor_evaluators(sleep=time.sleep, **clients)
     test_mode = args.test_mode and not args.full_mode
+    if args.limit is not None and not args.full_mode:
+        # an explicit cap implies a limited run — it must never silently
+        # escalate into the full 3,400×3×2 paid sweep; only an explicit
+        # --full-mode overrides it
+        test_mode = True
     paths = run_irrelevant_evaluation(
         evaluators, scenarios, out,
-        limit_total=args.limit if test_mode else None,
+        limit_total=(args.limit if args.limit is not None else 100)
+        if test_mode else None,
     )
     print(json.dumps(paths, indent=2))
 
@@ -571,8 +580,10 @@ def main(argv=None):
                    help="limited run (see --limit)")
     p.add_argument("--full-mode", action="store_true",
                    help="run on all data (overrides test mode)")
-    p.add_argument("--limit", type=int, default=100,
-                   help="total evaluations in test mode, split across models")
+    p.add_argument("--limit", type=int, default=None,
+                   help="cap on total evaluations, split across models "
+                        "(implies a limited run unless --full-mode; "
+                        "test-mode default: 100)")
     p.add_argument("--models", nargs="+", choices=["gpt", "claude", "gemini"],
                    default=["gpt", "claude", "gemini"])
     p.add_argument("--resume", action="store_true",
@@ -582,9 +593,9 @@ def main(argv=None):
                    help="start from scratch, discarding any checkpoint")
     p.add_argument("--clear-checkpoint", action="store_true",
                    help="clear existing checkpoint before starting")
-    p.add_argument("--load-existing", action="store_true", default=True,
-                   help="load saved results/analysis instead of evaluating "
-                        "(default: True)")
+    p.add_argument("--load-existing", action=argparse.BooleanOptionalAction,
+                   default=True,
+                   help="load saved results/analysis instead of evaluating")
     p.add_argument("--force-rerun", action="store_true",
                    help="run new evaluations even if results exist")
     p.add_argument("--regenerate-plots", action="store_true",

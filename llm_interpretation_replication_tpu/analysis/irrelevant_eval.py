@@ -38,6 +38,18 @@ RESULT_COLUMNS = [
 DELAY_BETWEEN_REQUESTS = 0.1  # reference :62
 
 
+def _nan_to_null(obj):
+    """Non-finite floats → None so the dumped JSON stays strict (json.dump
+    would otherwise emit bare ``NaN`` tokens that jq/JSON.parse reject)."""
+    if isinstance(obj, dict):
+        return {k: _nan_to_null(v) for k, v in obj.items()}
+    if isinstance(obj, (list, tuple)):
+        return [_nan_to_null(v) for v in obj]
+    if isinstance(obj, (float, np.floating)):
+        return float(obj) if np.isfinite(obj) else None
+    return obj
+
+
 def build_vendor_evaluators(
     gpt_client=None,
     claude_client=None,
@@ -223,8 +235,13 @@ def process_scenario_perturbations(
                         "position_description": p["position_description"],
                     },
                 )
+            # Rows first (atomic rename), processed-set second: a kill in
+            # between re-evaluates at most one scenario on resume instead of
+            # permanently dropping paid evaluations marked done but unsaved.
+            tmp = rows_path + ".tmp"
+            pd.DataFrame(rows).to_csv(tmp, index=False)
+            os.replace(tmp, rows_path)
             processed.flush()
-            pd.DataFrame(rows).to_csv(rows_path, index=False)
             log(f"{model} / {scenario['scenario_name']}: checkpointed ({len(rows)} rows)")
     df = pd.DataFrame(rows, columns=RESULT_COLUMNS)
     df.to_csv(rows_path, index=False)
@@ -464,7 +481,8 @@ def summary_report_text(analysis: Dict) -> str:
                 f"  Original Response: {a['original_response']}",
                 f"  Number of Samples: {cs.get('n_samples', 'N/A')}",
                 "", "  Confidence Statistics:",
-                f"    Original: {cs.get('original_confidence', 'N/A')}",
+                "    Original: "
+                f"{'N/A' if cs.get('original_confidence') is None else cs['original_confidence']}",
                 f"    Mean (all): {cs.get('mean_all_confidence', 0):.1f}",
                 f"    Std Dev (all): {cs.get('std_all_confidence', 0):.1f}",
                 f"    Median (all): {cs.get('median_all_confidence', 0):.1f}",
@@ -504,10 +522,16 @@ def detailed_prompts_text(df: pd.DataFrame, per_scenario: int = 5) -> str:
         # original rows reloaded from a resume CSV carry NaN (truthy!) here
         if pd.notna(row.get("irrelevant_statement")) and row.get("irrelevant_statement"):
             lines.append(f"Irrelevant Statement: {row['irrelevant_statement']}")
+        def text(col):
+            # NaN-guarded like irrelevant_statement above: rows resumed from
+            # pre-prompt-column checkpoints reindex to NaN, not missing
+            val = row.get(col, "")
+            return "" if pd.isna(val) else str(val)
+
         lines += [
             f"Model: {row['model']}", "-" * 40,
-            "", "RESPONSE PROMPT:", str(row.get("response_prompt", "")),
-            "", "CONFIDENCE PROMPT:", str(row.get("confidence_prompt", "")),
+            "", "RESPONSE PROMPT:", text("response_prompt"),
+            "", "CONFIDENCE PROMPT:", text("confidence_prompt"),
             "", f"Model Response: {row['response']}",
             f"Model Confidence: {row['confidence']}",
             f"Raw Confidence Response: {row['confidence_raw_response']}",
@@ -551,7 +575,9 @@ def save_results(df: pd.DataFrame, analysis: Dict, output_dir: str,
         )
     write_xlsx_sheets(sheets, paths["xlsx"])
     with open(paths["analysis_json"], "w", encoding="utf-8") as f:
-        json.dump(analysis, f, indent=2, default=float)
+        # strict JSON: NaN/inf stats (all-error groups, single-sample std)
+        # become null, not bare NaN tokens that non-Python consumers reject
+        json.dump(_nan_to_null(analysis), f, indent=2, default=float)
     with open(paths["report"], "w", encoding="utf-8") as f:
         f.write(summary_report_text(analysis))
     with open(paths["prompts"], "w", encoding="utf-8") as f:

@@ -1,0 +1,33 @@
+"""In-process fixtures for tests and driver dryruns.
+
+The image is zero-egress (no HF hub), so anything that needs a real tokenizer
+builds a tiny byte-level BPE in process.  Shared by ``tests/helpers.py`` and
+``__graft_entry__.dryrun_multichip``'s scoring leg so the dryrun exercises the
+exact ScoringEngine path (tokenize → bucket → decode → scan) the sweeps use.
+"""
+
+from __future__ import annotations
+
+
+def build_inprocess_tokenizer(vocab_size: int = 300):
+    """Byte-level BPE tokenizer trained in-process.  Distinguishes " Yes" from
+    "Yes" like real GPT-style vocabs (the leading-space convention of
+    run_base_vs_instruct_100q.py:332-335)."""
+    from tokenizers import ByteLevelBPETokenizer
+    from transformers import PreTrainedTokenizerFast
+
+    tok = ByteLevelBPETokenizer()
+    corpus = [
+        "Yes No Answer: Yes.",
+        "Answer: No.",
+        "Is a tweet a publication? Yes",
+        "Is soup a beverage? No",
+        "confidence 0 1 2 3 4 5 6 7 8 9 10 42 85 90 100",
+        "The quick brown fox jumps over the lazy dog.",
+    ] * 50
+    tok.train_from_iterator(corpus, vocab_size=vocab_size, min_frequency=1)
+    inner = tok._tokenizer if hasattr(tok, "_tokenizer") else tok
+    fast = PreTrainedTokenizerFast(tokenizer_object=inner)
+    fast.pad_token = fast.decode([0])
+    fast.pad_token_id = 0
+    return fast

@@ -39,21 +39,8 @@ def random_decoder_params(cfg, seed: int = 0):
 def build_test_tokenizer(vocab_size: int = 300):
     """Byte-level BPE tokenizer trained in-process (zero-egress image: no hub
     downloads).  Distinguishes " Yes" from "Yes" like real GPT-style vocabs."""
-    from tokenizers import ByteLevelBPETokenizer
-    from transformers import PreTrainedTokenizerFast
+    from llm_interpretation_replication_tpu.utils.testing import (
+        build_inprocess_tokenizer,
+    )
 
-    tok = ByteLevelBPETokenizer()
-    corpus = [
-        "Yes No Answer: Yes.",
-        "Answer: No.",
-        "Is a tweet a publication? Yes",
-        "Is soup a beverage? No",
-        "confidence 0 1 2 3 4 5 6 7 8 9 10 42 85 90 100",
-        "The quick brown fox jumps over the lazy dog.",
-    ] * 50
-    tok.train_from_iterator(corpus, vocab_size=vocab_size, min_frequency=1)
-    inner = tok._tokenizer if hasattr(tok, "_tokenizer") else tok
-    fast = PreTrainedTokenizerFast(tokenizer_object=inner)
-    fast.pad_token = fast.decode([0])
-    fast.pad_token_id = 0
-    return fast
+    return build_inprocess_tokenizer(vocab_size)
