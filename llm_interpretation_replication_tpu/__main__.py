@@ -440,29 +440,199 @@ def cmd_similarity(args):
     print(summary.to_string(index=False))
 
 
+def _mae_100q_families(results_csv, survey_csvs):
+    """Shared Table-5 machinery: survey loading + exclusions + human means
+    (0-1) + question matching + per-family paired bootstrap
+    (analyze_base_vs_instruct_mae_100q.py:421-560)."""
+    import pandas as pd
+
+    from .survey import (
+        analyze_families,
+        apply_exclusion_criteria,
+        human_responses_by_question,
+        load_and_clean_survey_data,
+        match_survey_to_llm_questions,
+    )
+
+    df, cols = load_and_clean_survey_data(survey_csvs)
+    df, excl = apply_exclusion_criteria(df, cols)
+    model_df = pd.read_csv(results_csv)
+    if {"yes_prob", "no_prob"}.issubset(model_df.columns):
+        # reference recomputes relative_prob from the raw probs and fills
+        # both-zero rows with 0.5 (analyze_base_vs_instruct_mae_100q.py:212-222)
+        model_df["relative_prob"] = (
+            model_df["yes_prob"] / (model_df["yes_prob"] + model_df["no_prob"])
+        ).fillna(0.5)
+    matches, _ = match_survey_to_llm_questions(model_df, survey_csvs)
+    human = human_responses_by_question(df, cols)
+    human_avgs = {q: s["mean"] / 100.0 for q, s in human.items()}  # 0-100 → 0-1
+    families = analyze_families(model_df, human_avgs, matches)
+    meta = {
+        "respondents": int(excl["final_count"]),
+        "questions_with_humans": len(human_avgs),
+        "matched_prompts": len(matches),
+        "model_rows": len(model_df),
+    }
+    return families, meta
+
+
 def cmd_analyze_100q(args):
     import pandas as pd
 
     from .stats.bootstrap import base_vs_instruct_analysis
-    from .viz.latex import base_vs_instruct_table
 
     df = pd.read_csv(args.results)
     out = base_vs_instruct_analysis(df)
     print(json.dumps(out, indent=2, default=float))
     if args.latex:
-        families = {
-            fam: {
-                "base_model": "", "instruct_model": "", "excluded": rec.get("skipped", False),
-                "base_mae": rec.get("mae", float("nan")),
-                "instruct_mae": rec.get("mae", float("nan")),
-                "observed_diff": rec.get("mean_diff", float("nan")),
-                "ci_lower": rec.get("ci_lower", float("nan")),
-                "ci_upper": rec.get("ci_upper", float("nan")),
-                "p_value": rec.get("p_value", float("nan")),
-            }
-            for fam, rec in out.items()
-        }
+        # Table 5 needs human survey means — delegate to the real machinery
+        # (the old mapping printed NaN MAE columns from bootstrap-only keys)
+        if not args.survey1_csv:
+            raise SystemExit(
+                "--latex emits paper Table 5 (MAE vs human means): pass "
+                "--survey1-csv/--survey2-csv, or use the analyze-mae-100q "
+                "subcommand"
+            )
+        from .viz.latex import base_vs_instruct_table
+
+        surveys = [args.survey1_csv] + (
+            [args.survey2_csv] if args.survey2_csv else []
+        )
+        families, _ = _mae_100q_families(args.results, surveys)
         print(base_vs_instruct_table(families))
+
+
+def cmd_analyze_mae_100q(args):
+    """Paper Table 5 end-to-end: per-family base→instruct MAE vs human means
+    with paired bootstrap — analyze_base_vs_instruct_mae_100q.py's main."""
+    from .viz.latex import base_vs_instruct_table
+
+    surveys = [args.survey1_csv] + ([args.survey2_csv] if args.survey2_csv else [])
+    families, meta = _mae_100q_families(args.results, surveys)
+    print(f"Respondents after exclusions: {meta['respondents']}")
+    print(f"Questions with human responses: {meta['questions_with_humans']}")
+    print(f"Matched prompts: {meta['matched_prompts']}")
+    for fam, rec in families.items():
+        if fam.startswith("_"):
+            continue
+        if rec.get("excluded"):
+            print(f"{fam}: excluded ({rec.get('reason', '')})")
+            continue
+        print(
+            f"{fam}: base {rec['base_mae']:.3f} -> instruct "
+            f"{rec['instruct_mae']:.3f}  diff {rec['observed_diff']:+.3f} "
+            f"[{rec['ci_lower']:+.3f}, {rec['ci_upper']:+.3f}] "
+            f"p={rec['p_value']:.4f} (n={rec['n']})"
+        )
+    overall = families.get("_overall")
+    if overall:
+        print(
+            f"Overall: base {overall['base_mae']:.3f} -> instruct "
+            f"{overall['instruct_mae']:.3f}  diff {overall['observed_diff']:+.3f} "
+            f"[{overall['ci_lower']:+.3f}, {overall['ci_upper']:+.3f}] "
+            f"p={overall['p_value']:.4f}"
+        )
+    if args.latex or args.output_tex:
+        table = base_vs_instruct_table(families)
+        if args.output_tex:
+            with open(args.output_tex, "w", encoding="utf-8") as f:
+                f.write(table + "\n")
+            print(f"wrote {args.output_tex}")
+        if args.latex:
+            print(table)
+    if args.output_json:
+        with open(args.output_json, "w", encoding="utf-8") as f:
+            json.dump({"families": families, "meta": meta}, f, indent=2,
+                      default=float)
+        print(f"wrote {args.output_json}")
+
+
+def cmd_model_comparison(args):
+    """Inter-model correlation engine as a runnable leg
+    (model_comparison_graph.py:389-494): pairwise Pearson/Spearman, bootstrap
+    summary, pairwise+aggregate kappa, heatmap/distribution/strip figures."""
+    import pandas as pd
+
+    from .analysis import model_comparison_report
+
+    df = pd.read_csv(args.results)
+    reference_model = args.reference_model
+    if reference_model is None:
+        # reference default: a Baichuan model anchors the strip plot when
+        # present (model_comparison_graph.py:59-79)
+        baichuan = [m for m in df["model"].unique() if "baichuan" in m.lower()]
+        reference_model = baichuan[0] if baichuan else None
+    report = model_comparison_report(
+        df, args.output_dir, n_bootstrap=args.bootstrap,
+        reference_model=reference_model, make_figures=not args.no_figures,
+    )
+    s = report["summary"]
+    print(f"{len(report['pairwise'])} model pairs")
+    print(f"mean correlation {s['mean']:.3f} "
+          f"[{s['mean_ci'][0]:.3f}, {s['mean_ci'][1]:.3f}], "
+          f"median {s['median']:.3f}, std {s['std']:.3f}")
+    print(f"mean kappa {report['kappa']['mean_kappa']:.3f}")
+    for key in ("heatmap", "distribution", "difference_strip"):
+        if report.get(key):
+            print(f"figure: {report[key]}")
+    print(f"wrote {args.output_dir}/pairwise_correlations.csv, "
+          f"correlation_summary.json")
+
+
+def cmd_cross_kappa(args):
+    """Cross-experiment Cohen's kappa (calculate_cohens_kappa.py): merge
+    result frames from multiple experiments, binarize at the threshold, and
+    bootstrap the aggregate agreement."""
+    import pandas as pd
+
+    frames = [pd.read_csv(path) for path in args.results]
+    from .analysis import cross_experiment_kappa
+
+    kappa = cross_experiment_kappa(
+        frames, threshold=args.threshold, n_bootstrap=args.bootstrap,
+    )
+    out = {
+        "n_frames": len(frames),
+        "mean_kappa": kappa["mean_kappa"],
+        "mean_kappa_ci": kappa["mean_kappa_ci"],
+        "n_pairs": len(kappa["pairs"]),
+    }
+    print(json.dumps(out, indent=2, default=float))
+    if args.output_json:
+        with open(args.output_json, "w", encoding="utf-8") as f:
+            json.dump({**out, "pairs": kappa["pairs"]}, f, indent=2, default=float)
+        print(f"wrote {args.output_json}")
+
+
+def cmd_power_analysis(args):
+    """Sample-size / power report (power_analysis.py:10-278) from pilot MAEs;
+    writes power_analysis_report.tex."""
+    import os
+
+    from .config import power_pilot_results
+    from .stats import power_report
+
+    if args.pilot_json:
+        with open(args.pilot_json) as f:
+            pilot = json.load(f)
+    else:
+        pilot = power_pilot_results()
+    os.makedirs(args.output_dir, exist_ok=True)
+    tex = os.path.join(args.output_dir, "power_analysis_report.tex")
+    report = power_report(
+        pilot["models"], baseline_mae=pilot["baseline_mae"],
+        sample_size=pilot["sample_size"], alpha=args.alpha,
+        n_simulations=args.simulations, output_tex=tex,
+    )
+    for name, analysis in report["models"].items():
+        n80 = analysis["sample_sizes"]["power_80"]["raw"]
+        print(f"{name}: effect d={analysis['effect_size']:.3f}, "
+              f"power@N={pilot['sample_size']} "
+              f"{analysis['achieved_power']:.2f}, N(80%)={n80}")
+    rec = report["recommendation"]["power_80"]
+    print(f"recommendation (80% power): N={rec['with_margin']} "
+          f"(limiting model: {rec['limiting_model']})")
+    print(f"wrote {tex}")
 
 
 def main(argv=None):
@@ -616,8 +786,53 @@ def main(argv=None):
 
     p = sub.add_parser("analyze-100q", help="instruct-base bootstrap over 100q results")
     p.add_argument("--results", required=True)
-    p.add_argument("--latex", action="store_true")
+    p.add_argument("--latex", action="store_true",
+                   help="also emit paper Table 5 (needs --survey1-csv)")
+    p.add_argument("--survey1-csv", default=None)
+    p.add_argument("--survey2-csv", default=None)
     p.set_defaults(fn=cmd_analyze_100q)
+
+    p = sub.add_parser("model-comparison",
+                       help="inter-model correlation report + heatmap + kappa "
+                            "over a results CSV (prompt/model/relative_prob)")
+    p.add_argument("--results", required=True,
+                   help="instruct_model_comparison_results*.csv-style CSV")
+    p.add_argument("--output-dir", default="results/model_comparison")
+    p.add_argument("--reference-model", default=None,
+                   help="strip-plot anchor (default: auto-detect Baichuan)")
+    p.add_argument("--bootstrap", type=int, default=1000)
+    p.add_argument("--no-figures", action="store_true")
+    p.set_defaults(fn=cmd_model_comparison)
+
+    p = sub.add_parser("cross-kappa",
+                       help="aggregate Cohen's kappa across experiment CSVs")
+    p.add_argument("--results", nargs="+", required=True,
+                   help="one or more results CSVs (same schema)")
+    p.add_argument("--threshold", type=float, default=0.5)
+    p.add_argument("--bootstrap", type=int, default=1000)
+    p.add_argument("--output-json", default=None)
+    p.set_defaults(fn=cmd_cross_kappa)
+
+    p = sub.add_parser("power-analysis",
+                       help="sample-size / power report from pilot MAEs")
+    p.add_argument("--pilot-json", default=None,
+                   help="override the built-in pilot results asset")
+    p.add_argument("--output-dir", default="results/power_analysis")
+    p.add_argument("--alpha", type=float, default=0.05)
+    p.add_argument("--simulations", type=int, default=10_000)
+    p.set_defaults(fn=cmd_power_analysis)
+
+    p = sub.add_parser("analyze-mae-100q",
+                       help="paper Table 5: per-family base-vs-instruct MAE "
+                            "vs human survey means (paired bootstrap)")
+    p.add_argument("--results", required=True,
+                   help="base_vs_instruct_100q_results.csv")
+    p.add_argument("--survey1-csv", required=True)
+    p.add_argument("--survey2-csv", default=None)
+    p.add_argument("--latex", action="store_true", help="print the LaTeX table")
+    p.add_argument("--output-tex", default=None, help="write the LaTeX table here")
+    p.add_argument("--output-json", default=None, help="write family records here")
+    p.set_defaults(fn=cmd_analyze_mae_100q)
 
     args = parser.parse_args(argv)
     args.fn(args)

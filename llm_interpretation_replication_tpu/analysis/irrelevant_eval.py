@@ -163,6 +163,19 @@ def process_scenario_perturbations(
             # disagreement.
             prior = prior.rename(columns={"response_text": "confidence_raw_response"})
         rows: List[Dict] = prior.to_dict("records")
+        # Seed the processed-set from the loaded rows: a kill between the
+        # rows-CSV rename and the processed-set flush would otherwise make
+        # run_one re-evaluate (and re-append) the last scenario's triples,
+        # double-counting them in every downstream statistic.  Numeric pids
+        # round-trip through the mixed-type CSV column as strings — restore
+        # them so the keys match run_one's int pids.
+        for r in rows:
+            pid = r["perturbation_id"]
+            if isinstance(pid, str) and pid.isdigit():
+                pid = int(pid)
+            elif isinstance(pid, float):
+                pid = int(pid)
+            processed.add((r["model"], r["scenario_name"], pid), flush=False)
     else:
         rows = []
     total = sum(

@@ -56,6 +56,13 @@ def irrelevant_statements() -> List[str]:
         return [line.strip() for line in f if line.strip()]
 
 
+def power_pilot_results() -> dict:
+    """Pilot MAE results the reference hardcodes for its power analysis
+    (power_analysis.py:103-132): baseline_mae, sample_size, per-model
+    mae/mae_std/mae_diff/CI."""
+    return _load("power_pilot_results.json")
+
+
 def ordinary_meaning_questions() -> List[str]:
     """The 100 ordinary-meaning questions (survey 1 + survey 2 —
     run_base_vs_instruct_100q.py:120-231)."""

@@ -313,6 +313,41 @@ class TestIrrelevantEval:
         process_scenario_perturbations({"model-x": evaluator}, scenarios, str(tmp_path))
         assert calls["n"] == before
 
+    def test_resume_after_lost_processed_set_does_not_duplicate(self, tmp_path):
+        """Kill window between the rows-CSV rename and the processed-set
+        flush: the triple set is stale/absent but the CSV has the rows.  The
+        CSV must seed the processed-set on resume — without it every loaded
+        triple would be re-evaluated AND re-appended (duplicated rows,
+        double-counted stats)."""
+        from llm_interpretation_replication_tpu.gen.irrelevant import (
+            generate_perturbations,
+        )
+
+        scenarios = generate_perturbations(
+            [dict(s, main=s["original_main"], name=s["scenario_name"])
+             for s in _scenarios(1)],
+            ["Fact A.", "Fact B."],
+        )
+        calls = {"n": 0}
+
+        def evaluator(prompt):
+            calls["n"] += 1
+            return "Covered\n85"
+
+        df1 = process_scenario_perturbations(
+            {"model-x": evaluator}, scenarios, str(tmp_path),
+        )
+        os.remove(os.path.join(tmp_path, "processed_triples.json"))
+        before = calls["n"]
+        df2 = process_scenario_perturbations(
+            {"model-x": evaluator}, scenarios, str(tmp_path),
+        )
+        assert calls["n"] == before          # nothing re-evaluated
+        assert len(df2) == len(df1)          # and nothing duplicated
+        assert not df2.duplicated(
+            subset=["model", "scenario_name", "perturbation_id"]
+        ).any()
+
 
 class TestIrrelevantAnalyzeResults:
     def _df(self):
