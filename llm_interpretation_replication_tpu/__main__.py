@@ -444,8 +444,6 @@ def _mae_100q_families(results_csv, survey_csvs):
     """Shared Table-5 machinery: survey loading + exclusions + human means
     (0-1) + question matching + per-family paired bootstrap
     (analyze_base_vs_instruct_mae_100q.py:421-560)."""
-    import pandas as pd
-
     from .survey import (
         analyze_families,
         apply_exclusion_criteria,
@@ -456,13 +454,7 @@ def _mae_100q_families(results_csv, survey_csvs):
 
     df, cols = load_and_clean_survey_data(survey_csvs)
     df, excl = apply_exclusion_criteria(df, cols)
-    model_df = pd.read_csv(results_csv)
-    if {"yes_prob", "no_prob"}.issubset(model_df.columns):
-        # reference recomputes relative_prob from the raw probs and fills
-        # both-zero rows with 0.5 (analyze_base_vs_instruct_mae_100q.py:212-222)
-        model_df["relative_prob"] = (
-            model_df["yes_prob"] / (model_df["yes_prob"] + model_df["no_prob"])
-        ).fillna(0.5)
+    model_df = _load_llm_csv(results_csv)
     matches, _ = match_survey_to_llm_questions(model_df, survey_csvs)
     human = human_responses_by_question(df, cols)
     human_avgs = {q: s["mean"] / 100.0 for q, s in human.items()}  # 0-100 → 0-1
@@ -545,6 +537,117 @@ def cmd_analyze_mae_100q(args):
             json.dump({"families": families, "meta": meta}, f, indent=2,
                       default=float)
         print(f"wrote {args.output_json}")
+
+
+def _load_llm_csv(path):
+    """Model-results CSV with relative_prob guaranteed: recomputed from the
+    raw probs with both-zero rows at 0.5 when yes/no columns exist
+    (analyze_base_vs_instruct_mae_100q.py:212-222)."""
+    import pandas as pd
+
+    df = pd.read_csv(path)
+    if {"yes_prob", "no_prob"}.issubset(df.columns):
+        df["relative_prob"] = (
+            df["yes_prob"] / (df["yes_prob"] + df["no_prob"])
+        ).fillna(0.5)
+    return df
+
+
+def _load_clean_survey(survey_csvs):
+    from .survey import apply_exclusion_criteria, load_and_clean_survey_data
+    from .survey.pipeline import extract_question_text
+
+    df, cols = load_and_clean_survey_data(survey_csvs)
+    df, excl = apply_exclusion_criteria(df, cols)
+    mapping = extract_question_text(survey_csvs)
+    return df, cols, mapping, excl
+
+
+def cmd_analyze_3way(args):
+    """Base-vs-instruct-vs-human 3-way comparison
+    (analyze_base_vs_instruct_vs_human.py as a subcommand)."""
+    from .survey import three_way_report
+
+    surveys = [args.survey1_csv] + ([args.survey2_csv] if args.survey2_csv else [])
+    survey_df, cols, mapping, _ = _load_clean_survey(surveys)
+    llm_df = _load_llm_csv(args.llm_csv)
+    out = three_way_report(llm_df, survey_df, cols, mapping, args.output_dir,
+                           make_figures=not args.no_figures)
+    print(f"Loaded human data for {out['human_questions']} questions")
+    print("Model correlations with human responses:")
+    print(out["correlations"].to_string())
+    print(f"Found {len(out['invalid_responses'])} invalid responses "
+          f"(not containing Yes/No)")
+    for _, row in out["distribution_stats"].iterrows():
+        line = (f"{row['model']}: mean {row['mean']:.3f}, std {row['std']:.3f}, "
+                f"range [{row['min']:.3f}, {row['max']:.3f}]")
+        if row["warning"]:
+            line += f"  WARNING: {row['warning']}"
+        print(line)
+    print(f"wrote {out['correlations_csv']}")
+    if out.get("figure"):
+        print(f"figure: {out['figure']}")
+
+
+def cmd_analyze_family_differences(args):
+    """Respondent-level agreement bootstrap + per-family MAE/MSE/MAPE
+    differences (analyze_llm_human_agreement_bootstrap.py +
+    analyze_model_family_differences.py)."""
+    import os
+
+    from .survey import (
+        agreement_bootstrap,
+        family_differences,
+        family_differences_text,
+    )
+    from .survey.variants import save_agreement_json
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    agreement_path = os.path.join(args.output_dir,
+                                  "llm_human_agreement_bootstrap.json")
+    if args.agreement_json:
+        with open(args.agreement_json) as f:
+            agreement = json.load(f)
+    else:
+        if not (args.llm_csv and args.survey1_csv):
+            raise SystemExit(
+                "pass --llm-csv and --survey1-csv, or --agreement-json"
+            )
+        surveys = [args.survey1_csv] + (
+            [args.survey2_csv] if args.survey2_csv else []
+        )
+        survey_df, cols, mapping, _ = _load_clean_survey(surveys)
+        llm_df = _load_llm_csv(args.llm_csv)
+        agreement = agreement_bootstrap(
+            llm_df, survey_df, cols, mapping,
+            n_bootstrap=args.bootstrap,
+        )
+        save_agreement_json(agreement, agreement_path)
+        print(f"wrote {agreement_path}")
+    records = family_differences(agreement)
+    text = family_differences_text(records)
+    print(text)
+    report_path = os.path.join(args.output_dir, "family_differences.txt")
+    with open(report_path, "w", encoding="utf-8") as f:
+        f.write(text)
+    print(f"wrote {report_path}")
+
+
+def cmd_ground_truth_figure(args):
+    """Ground-truth distribution figures
+    (visualize_ground_truth_distribution.py)."""
+    from .survey import ground_truth_figures, ground_truth_values
+
+    surveys = [args.survey1_csv] + ([args.survey2_csv] if args.survey2_csv else [])
+    survey_df, cols, _, _ = _load_clean_survey(surveys)
+    values = ground_truth_values(survey_df, cols)
+    if not values.size:
+        raise SystemExit("no human ground-truth values found")
+    out = ground_truth_figures(values, args.output_dir)
+    print(f"Loaded {out['n']} human ground truth values")
+    print(f"Mean: {out['mean']:.3f} ({out['mean'] * 100:.1f}%)")
+    print(f"Std:  {out['std']:.3f} ({out['std'] * 100:.1f}%)")
+    print(f"figures: {out['two_panel']}, {out['simple']}")
 
 
 def cmd_model_comparison(args):
@@ -791,6 +894,35 @@ def main(argv=None):
     p.add_argument("--survey1-csv", default=None)
     p.add_argument("--survey2-csv", default=None)
     p.set_defaults(fn=cmd_analyze_100q)
+
+    p = sub.add_parser("analyze-3way",
+                       help="base-vs-instruct-vs-human comparison "
+                            "(correlations, validity audit, scatter)")
+    p.add_argument("--llm-csv", required=True)
+    p.add_argument("--survey1-csv", required=True)
+    p.add_argument("--survey2-csv", default=None)
+    p.add_argument("--output-dir", default="results/three_way")
+    p.add_argument("--no-figures", action="store_true")
+    p.set_defaults(fn=cmd_analyze_3way)
+
+    p = sub.add_parser("analyze-family-differences",
+                       help="respondent-bootstrap agreement + per-family "
+                            "MAE/MSE/MAPE differences")
+    p.add_argument("--llm-csv", default=None)
+    p.add_argument("--survey1-csv", default=None)
+    p.add_argument("--survey2-csv", default=None)
+    p.add_argument("--agreement-json", default=None,
+                   help="reuse a saved llm_human_agreement_bootstrap.json")
+    p.add_argument("--output-dir", default="results/family_differences")
+    p.add_argument("--bootstrap", type=int, default=100)
+    p.set_defaults(fn=cmd_analyze_family_differences)
+
+    p = sub.add_parser("ground-truth-figure",
+                       help="human ground-truth distribution figures")
+    p.add_argument("--survey1-csv", required=True)
+    p.add_argument("--survey2-csv", default=None)
+    p.add_argument("--output-dir", default="results/ground_truth")
+    p.set_defaults(fn=cmd_ground_truth_figure)
 
     p = sub.add_parser("model-comparison",
                        help="inter-model correlation report + heatmap + kappa "

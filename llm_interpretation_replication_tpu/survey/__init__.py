@@ -11,6 +11,18 @@ from .mae_100q import (
     paired_bootstrap_mae_difference,
     validate_model_data,
 )
+from .variants import (
+    agreement_bootstrap,
+    family_differences,
+    family_differences_text,
+    ground_truth_figures,
+    ground_truth_values,
+    human_proportions_by_prompt,
+    model_human_correlations,
+    output_validity_audit,
+    probability_distribution_stats,
+    three_way_report,
+)
 from .pipeline import (
     apply_exclusion_criteria,
     cross_prompt_difference_ci,

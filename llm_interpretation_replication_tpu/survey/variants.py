@@ -1,0 +1,444 @@
+"""Survey-analysis variants: 3-way base/instruct/human comparison, per-family
+metric differences, and the ground-truth distribution figure.
+
+Rebuilds the three standalone reference scripts that have no condensed
+equivalent in the main pipeline:
+
+- ``analyze_base_vs_instruct_vs_human.py:1-244`` — per-model Pearson/Spearman/
+  MAE against human proportions, output-validity audit, probability-
+  distribution stats, best-model scatter figure.
+- ``analyze_llm_human_agreement_bootstrap.py`` (the JSON producer) +
+  ``analyze_model_family_differences.py:1-231`` — respondent-level bootstrap
+  of MAE/MSE/MAPE per model, then per-family instruct − base differences with
+  quadrature-combined CIs.
+- ``visualize_ground_truth_distribution.py:1-265`` — human ground-truth
+  histogram with fitted normal + random-baseline panel, and the simplified
+  single-panel variant.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from typing import Dict, List, Optional, Sequence
+
+import numpy as np
+import pandas as pd
+
+from .mae_100q import MODEL_FAMILIES
+
+
+# ---------------------------------------------------------------------------
+# 3-way base vs instruct vs human (analyze_base_vs_instruct_vs_human.py)
+# ---------------------------------------------------------------------------
+
+def human_proportions_by_prompt(survey_df: pd.DataFrame,
+                                question_cols: Sequence[str],
+                                mapping: Dict[str, str]) -> Dict[str, float]:
+    """prompt -> human proportion-yes (mean slider / 100).  The reference
+    consumed a pre-built ``proportion_yes`` from its saved JSON
+    (analyze_base_vs_instruct_vs_human.py:71-74); the producer is not in the
+    replication package, so the paper's convention (mean response normalized
+    to 0-1) is used throughout — one source: pipeline.human_responses_by_question."""
+    from .pipeline import human_responses_by_question
+
+    cols = [q for q in mapping if q in survey_df.columns]
+    stats = human_responses_by_question(survey_df, cols)
+    return {mapping[qid]: s["mean"] / 100.0 for qid, s in stats.items()}
+
+
+def model_human_correlations(llm_df: pd.DataFrame,
+                             human_proportions: Dict[str, float],
+                             min_questions: int = 10) -> pd.DataFrame:
+    """Per-model Pearson/Spearman/MAE vs human proportions, sorted by Pearson
+    (reference :81-125)."""
+    from scipy.stats import pearsonr, spearmanr
+
+    records = []
+    for model in llm_df["model"].unique():
+        sub = llm_df[llm_df["model"] == model]
+        pairs = [
+            (human_proportions[row["prompt"]], row["relative_prob"])
+            for _, row in sub.iterrows()
+            if row["prompt"] in human_proportions
+            and pd.notna(row["relative_prob"])
+        ]
+        if len(pairs) < min_questions:
+            continue
+        h, m = np.array(pairs).T
+        pr, pp = pearsonr(h, m)
+        sr, sp = spearmanr(h, m)
+        records.append({
+            "model": model, "n_questions": len(pairs),
+            "pearson_r": float(pr), "pearson_p": float(pp),
+            "spearman_r": float(sr), "spearman_p": float(sp),
+            "mae": float(np.mean(np.abs(h - m))),
+        })
+    df = pd.DataFrame(records)
+    if len(df):
+        df = df.sort_values("pearson_r", ascending=False).reset_index(drop=True)
+    return df
+
+
+def output_validity_audit(llm_df: pd.DataFrame) -> List[Dict]:
+    """Rows whose model_output contains neither Yes nor No (reference
+    :128-148)."""
+    invalid = []
+    if "model_output" not in llm_df.columns:
+        return invalid
+    for _, row in llm_df.iterrows():
+        output = str(row["model_output"]).lower()
+        if "yes" not in output and "no" not in output:
+            invalid.append({"model": row["model"], "prompt": row["prompt"],
+                            "output": row["model_output"]})
+    return invalid
+
+
+def probability_distribution_stats(llm_df: pd.DataFrame) -> pd.DataFrame:
+    """Per-model relative_prob mean/std/min/max with the reference's
+    yes/no-bias warnings (:151-172)."""
+    records = []
+    for model in llm_df["model"].unique():
+        probs = llm_df[llm_df["model"] == model]["relative_prob"].dropna()
+        if not len(probs):
+            continue
+        mean = float(probs.mean())
+        warning = ""
+        if mean < 0.3:
+            warning = "tends to answer 'No' (low mean probability)"
+        elif mean > 0.7:
+            warning = "tends to answer 'Yes' (high mean probability)"
+        records.append({
+            "model": model, "mean": mean, "std": float(probs.std()),
+            "min": float(probs.min()), "max": float(probs.max()),
+            "warning": warning,
+        })
+    return pd.DataFrame(records)
+
+
+def human_vs_model_scatter(llm_df: pd.DataFrame,
+                           human_proportions: Dict[str, float],
+                           model: str, pearson_r: float,
+                           output_path: str) -> str:
+    """Scatter of the best-correlated model vs humans with identity line
+    (reference :175-214)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    sub = llm_df[llm_df["model"] == model]
+    pairs = [
+        (human_proportions[row["prompt"]], row["relative_prob"])
+        for _, row in sub.iterrows() if row["prompt"] in human_proportions
+    ]
+    h, m = np.array(pairs).T
+    fig, ax = plt.subplots(figsize=(12, 8))
+    ax.scatter(h, m, alpha=0.6)
+    ax.plot([0, 1], [0, 1], "r--", alpha=0.5)
+    ax.set_xlabel('Human Proportion "Yes"')
+    ax.set_ylabel('Model Probability "Yes"')
+    ax.set_title(f"Human vs Model Responses\n({model})")
+    ax.set_xlim(-0.05, 1.05)
+    ax.set_ylim(-0.05, 1.05)
+    ax.text(0.05, 0.95, f"Pearson r = {pearson_r:.3f}",
+            transform=ax.transAxes, verticalalignment="top")
+    os.makedirs(os.path.dirname(os.path.abspath(output_path)), exist_ok=True)
+    fig.tight_layout()
+    fig.savefig(output_path, dpi=150)
+    plt.close(fig)
+    return output_path
+
+
+def three_way_report(llm_df: pd.DataFrame, survey_df: pd.DataFrame,
+                     question_cols: Sequence[str], mapping: Dict[str, str],
+                     output_dir: str, make_figures: bool = True) -> Dict:
+    """The full 3-way analysis: correlations CSV + audit + distribution stats
+    + best-model scatter (analyze_base_vs_instruct_vs_human.py end-to-end)."""
+    os.makedirs(output_dir, exist_ok=True)
+    props = human_proportions_by_prompt(survey_df, question_cols, mapping)
+    corr = model_human_correlations(llm_df, props)
+    invalid = output_validity_audit(llm_df)
+    dist = probability_distribution_stats(llm_df)
+    corr_path = os.path.join(output_dir, "model_human_correlations.csv")
+    corr.to_csv(corr_path, index=False)
+    out = {
+        "human_questions": len(props),
+        "correlations": corr,
+        "invalid_responses": invalid,
+        "distribution_stats": dist,
+        "correlations_csv": corr_path,
+    }
+    if make_figures and len(corr):
+        best = corr.iloc[0]
+        out["figure"] = human_vs_model_scatter(
+            llm_df, props, best["model"], best["pearson_r"],
+            os.path.join(output_dir, "human_vs_model_comparison.png"),
+        )
+    return out
+
+
+# ---------------------------------------------------------------------------
+# Respondent-level agreement bootstrap + per-family differences
+# ---------------------------------------------------------------------------
+
+def agreement_bootstrap(llm_df: pd.DataFrame, survey_df: pd.DataFrame,
+                        question_cols: Sequence[str], mapping: Dict[str, str],
+                        n_bootstrap: int = 100, seed: int = 42,
+                        min_questions: int = 10) -> Dict:
+    """Per-model MAE/MSE/MAPE/pearson vs human means with a respondent-level
+    bootstrap (analyze_llm_human_agreement_bootstrap.py): resample survey
+    respondents, recompute per-question human means, re-score every model
+    against them; report mean/std/95% CI per metric."""
+    prompt_for = {qid: prompt for qid, prompt in mapping.items()
+                  if not qid.endswith("_8")}
+    cols = [q for q in question_cols
+            if q in prompt_for and q in survey_df.columns]
+    rng = np.random.default_rng(seed)
+    n_resp = len(survey_df)
+    model_rows = {
+        model: {
+            row["prompt"]: row["relative_prob"]
+            for _, row in llm_df[llm_df["model"] == model].iterrows()
+            if pd.notna(row["relative_prob"])
+        }
+        for model in llm_df["model"].unique()
+    }
+    # [n_bootstrap, n_cols] bootstrapped human means (0-1)
+    values = survey_df[cols].to_numpy(dtype=float)
+    boot_means = np.empty((n_bootstrap, len(cols)))
+    for b in range(n_bootstrap):
+        idx = rng.integers(0, n_resp, size=n_resp)
+        boot_means[b] = np.nanmean(values[idx], axis=0) / 100.0
+
+    results = []
+    for model, by_prompt in model_rows.items():
+        keep = [j for j, q in enumerate(cols) if prompt_for[q] in by_prompt]
+        if len(keep) < min_questions:
+            continue
+        preds = np.array([by_prompt[prompt_for[cols[j]]] for j in keep])
+        h = boot_means[:, keep]                    # [n_bootstrap, n_q]
+        err = h - preds[None, :]
+        mae = np.abs(err).mean(axis=1)
+        mse = (err ** 2).mean(axis=1)
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ape = np.where(h > 0.01, np.abs(err) / h, np.nan)
+        mape = np.nanmean(ape, axis=1) * 100
+        hc = h - h.mean(axis=1, keepdims=True)
+        pc = preds - preds.mean()
+        denom = np.sqrt((hc ** 2).sum(axis=1) * (pc ** 2).sum())
+        pearson = np.where(denom > 0, (hc * pc[None, :]).sum(axis=1) / denom, np.nan)
+        rec = {"model": model, "n_questions": len(keep),
+               "n_bootstrap": n_bootstrap}
+        for name, vals in (("mae", mae), ("mse", mse), ("mape", mape),
+                           ("pearson_r", pearson)):
+            vals = vals[np.isfinite(vals)]
+            rec[f"{name}_mean"] = float(np.mean(vals)) if vals.size else float("nan")
+            rec[f"{name}_std"] = float(np.std(vals)) if vals.size else float("nan")
+            rec[f"{name}_ci_lower"] = float(np.percentile(vals, 2.5)) if vals.size else float("nan")
+            rec[f"{name}_ci_upper"] = float(np.percentile(vals, 97.5)) if vals.size else float("nan")
+        results.append(rec)
+    return {
+        "analysis_type": "llm_human_agreement_bootstrap",
+        "bootstrap_parameters": {"n_iterations": n_bootstrap, "seed": seed},
+        "model_results": results,
+    }
+
+
+def family_differences(agreement: Dict,
+                       families: Optional[Dict] = None,
+                       metrics: Sequence[str] = ("mae", "mse", "mape")) -> List[Dict]:
+    """Per-family instruct − base differences per metric with the reference's
+    quadrature-combined CI (analyze_model_family_differences.py:51-120):
+    half-width = sqrt(base_range² + instruct_range²) / 2; significant when the
+    CI excludes zero."""
+    families = families or {
+        k: v for k, v in MODEL_FAMILIES.items()
+        if k in ("Falcon", "StableLM", "RedPajama")
+    }
+    by_model = {r["model"]: r for r in agreement["model_results"]}
+    records = []
+    for family, pair in families.items():
+        base = by_model.get(pair["base"])
+        inst = by_model.get(pair["instruct"])
+        if base is None or inst is None:
+            records.append({"family": family, "missing": True})
+            continue
+        for metric in metrics:
+            diff = inst[f"{metric}_mean"] - base[f"{metric}_mean"]
+            base_range = base[f"{metric}_ci_upper"] - base[f"{metric}_ci_lower"]
+            inst_range = inst[f"{metric}_ci_upper"] - inst[f"{metric}_ci_lower"]
+            half = float(np.sqrt(base_range ** 2 + inst_range ** 2)) / 2
+            lo, hi = diff - half, diff + half
+            records.append({
+                "family": family, "metric": metric, "missing": False,
+                "base_mean": base[f"{metric}_mean"],
+                "base_ci": (base[f"{metric}_ci_lower"], base[f"{metric}_ci_upper"]),
+                "instruct_mean": inst[f"{metric}_mean"],
+                "instruct_ci": (inst[f"{metric}_ci_lower"], inst[f"{metric}_ci_upper"]),
+                "diff": float(diff), "ci_lower": float(lo), "ci_upper": float(hi),
+                "relative_change_pct": float(diff / base[f"{metric}_mean"] * 100)
+                if base[f"{metric}_mean"] else float("nan"),
+                "significant": bool(lo * hi > 0),
+            })
+    return records
+
+
+def family_differences_text(records: List[Dict]) -> str:
+    """The reference's printed per-family report + summary table."""
+    lines = ["=== PER-FAMILY BASE vs INSTRUCT DIFFERENCES ===",
+             "With 95% Confidence Intervals", "=" * 100]
+    for rec in records:
+        if rec.get("missing"):
+            lines.append(f"\n{rec['family'].upper()}\nMissing data")
+            continue
+        if rec["metric"] == "mae":
+            lines.append(f"\n{rec['family'].upper()}\n" + "-" * 60)
+        pct = "%" if rec["metric"] == "mape" else ""
+        fmt = ".1f" if rec["metric"] == "mape" else ".4f"
+        lines += [
+            f"\n{rec['metric'].upper()} Difference (Instruct - Base):",
+            f"  Base:     {rec['base_mean']:{fmt}}{pct} "
+            f"[{rec['base_ci'][0]:{fmt}}, {rec['base_ci'][1]:{fmt}}]",
+            f"  Instruct: {rec['instruct_mean']:{fmt}}{pct} "
+            f"[{rec['instruct_ci'][0]:{fmt}}, {rec['instruct_ci'][1]:{fmt}}]",
+            f"  Absolute Difference: {rec['diff']:+{fmt}}{pct} "
+            f"[{rec['ci_lower']:+{fmt}}, {rec['ci_upper']:+{fmt}}]",
+            f"  Relative Change: {rec['relative_change_pct']:+.1f}%",
+            ("  -> " + ("Significantly worse" if rec["diff"] > 0
+                        else "Significantly better") + " (95% CI excludes 0)")
+            if rec["significant"] else "  -> Not significant (95% CI includes 0)",
+        ]
+    lines += ["", "=== SUMMARY TABLE ===", "-" * 100,
+              f"{'Family':<12} {'Metric':<6} {'Base':<12} {'Instruct':<12} "
+              f"{'Difference':<14} {'Significant?':<14}", "-" * 100]
+    for rec in records:
+        if rec.get("missing"):
+            continue
+        fmt = ".1f" if rec["metric"] == "mape" else ".4f"
+        lines.append(
+            f"{rec['family']:<12} {rec['metric'].upper():<6} "
+            f"{rec['base_mean']:<12{fmt}} {rec['instruct_mean']:<12{fmt}} "
+            f"{rec['diff']:<+14{fmt}} "
+            f"{'YES' if rec['significant'] else 'no':<14}"
+        )
+    return "\n".join(lines) + "\n"
+
+
+# ---------------------------------------------------------------------------
+# Ground-truth distribution figures
+# ---------------------------------------------------------------------------
+
+def ground_truth_values(survey_df: pd.DataFrame,
+                        question_cols: Sequence[str]) -> np.ndarray:
+    """Per-question human mean (0-1) — the 'ground truth' each model is scored
+    against (visualize_ground_truth_distribution.py:22-76); delegates to the
+    pipeline helper so the normalization convention has one home."""
+    from .pipeline import human_responses_by_question
+
+    cols = [q for q in question_cols if q in survey_df.columns]
+    stats = human_responses_by_question(survey_df, cols)
+    return np.asarray([s["mean"] / 100.0 for s in stats.values()])
+
+
+def ground_truth_figures(human_values: np.ndarray, output_dir: str) -> Dict:
+    """Two-panel (histogram + fitted normal; random-baseline overlay) and
+    simplified single-panel figures (reference :79-199)."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+    from scipy import stats as sstats
+
+    os.makedirs(output_dir, exist_ok=True)
+    pct = human_values * 100
+    mean_pct, std_pct = float(np.mean(pct)), float(np.std(pct))
+    x = np.linspace(0, 100, 200)
+
+    fig, (ax1, ax2) = plt.subplots(1, 2, figsize=(14, 6))
+    ax1.hist(pct, bins=30, density=True, alpha=0.7, color="#2ca02c",
+             edgecolor="black", label="Actual Human Responses")
+    ax1.plot(x, sstats.norm.pdf(x, mean_pct, std_pct), "r-", linewidth=2,
+             label=f"Fitted Normal\nN({mean_pct:.1f}, {std_pct:.1f})")
+    ax1.axvline(mean_pct, color="red", linestyle="--", linewidth=1.5,
+                alpha=0.8, label=f"Mean: {mean_pct:.1f}%")
+    ax1.axvline(mean_pct - std_pct, color="orange", linestyle=":", alpha=0.6)
+    ax1.axvline(mean_pct + std_pct, color="orange", linestyle=":", alpha=0.6,
+                label=f"±1 SD: {std_pct:.1f}%")
+    ax1.set_xlabel('Percentage "Yes" Responses (%)')
+    ax1.set_ylabel("Probability Density")
+    ax1.set_title("Distribution of Human Ground Truth Values")
+    ax1.set_xlim(0, 100)
+    ax1.legend(loc="upper left", fontsize=9)
+
+    rng = np.random.default_rng(42)
+    samples = np.clip(rng.normal(mean_pct, std_pct, 10_000), 0, 100)
+    ax2.hist(pct, bins=30, density=True, alpha=0.5, color="#2ca02c",
+             edgecolor="black", label="Actual Human Data")
+    ax2.hist(samples, bins=30, density=True, alpha=0.5, color="#17becf",
+             edgecolor="black", label="Random Baseline\n(Sampled)")
+    ax2.plot(x, sstats.norm.pdf(x, mean_pct, std_pct), "r-", linewidth=2,
+             alpha=0.8, label=f"Theoretical N({mean_pct:.1f}, {std_pct:.1f})")
+    ax2.axvline(mean_pct, color="red", linestyle="--", alpha=0.8)
+    ax2.set_xlabel('Percentage "Yes" Responses (%)')
+    ax2.set_ylabel("Probability Density")
+    ax2.set_title("Random Baseline Distribution")
+    ax2.set_xlim(0, 100)
+    ax2.legend(loc="upper left", fontsize=9)
+    fig.suptitle("Ground Truth Distribution Analysis for Random Baseline")
+    fig.tight_layout()
+    two_panel = os.path.join(output_dir, "ground_truth_distribution.png")
+    fig.savefig(two_panel, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+    fig, ax = plt.subplots(figsize=(10, 6))
+    n, bins, _ = ax.hist(pct, bins=30, density=True, alpha=0.7,
+                         color="#1f77b4", edgecolor="black")
+    centers = (bins[:-1] + bins[1:]) / 2
+    smoothed = _lowess(n, centers, frac=0.3)
+    ax.plot(smoothed[:, 0], smoothed[:, 1], "r-", linewidth=2.5,
+            label="Smoothed empirical distribution")
+    ax.axvline(mean_pct, color="red", linestyle="--", linewidth=2, alpha=0.8,
+               label=f"Mean = {mean_pct:.1f}%")
+    ax.set_xlabel('Percentage of "Yes" Responses (%)')
+    ax.set_ylabel("Probability Density")
+    ax.set_xlim(0, 100)
+    ax.legend(loc="upper left")
+    fig.tight_layout()
+    simple = os.path.join(output_dir, "ground_truth_distribution_simple.png")
+    fig.savefig(simple, dpi=150, bbox_inches="tight")
+    plt.close(fig)
+
+    return {"two_panel": two_panel, "simple": simple,
+            "mean": mean_pct / 100, "std": std_pct / 100,
+            "n": int(human_values.size)}
+
+
+def _lowess(y: np.ndarray, x: np.ndarray, frac: float = 0.3) -> np.ndarray:
+    """Minimal tricube-weighted local linear smoother — the reference uses
+    statsmodels' lowess (visualize_ground_truth_distribution.py:176-182),
+    which is not in this image; same algorithm, one iteration."""
+    order = np.argsort(x)
+    x, y = np.asarray(x, float)[order], np.asarray(y, float)[order]
+    n = len(x)
+    span = max(2, int(np.ceil(frac * n)))
+    out = np.empty(n)
+    for i in range(n):
+        d = np.abs(x - x[i])
+        cutoff = np.sort(d)[span - 1]
+        w = np.clip(1 - (d / max(cutoff, 1e-12)) ** 3, 0, 1) ** 3
+        sw = w.sum()
+        xm = (w * x).sum() / sw
+        ym = (w * y).sum() / sw
+        cov = (w * (x - xm) * (y - ym)).sum()
+        var = (w * (x - xm) ** 2).sum()
+        beta = cov / var if var > 0 else 0.0
+        out[i] = ym + beta * (x[i] - xm)
+    return np.column_stack([x, out])
+
+
+def save_agreement_json(agreement: Dict, path: str) -> str:
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(agreement, f, indent=2, default=float)
+    return path

@@ -81,6 +81,74 @@ def test_cross_kappa_cli(tmp_path, capsys):
     assert data["n_pairs"] >= 28  # 8 models -> 28 pairs minimum
 
 
+REF1_SURVEY = "/root/reference/data/word_meaning_survey_results.csv"
+REF2_SURVEY = "/root/reference/data/word_meaning_survey_results_part_2.csv"
+
+
+@pytest.mark.skipif(not os.path.exists(REF_INSTRUCT_COMBINED),
+                    reason="reference not mounted")
+def test_analyze_3way_cli(tmp_path, capsys):
+    """3-way comparison on real data: correlations CSV + validity audit +
+    bias warnings + best-model scatter (analyze_base_vs_instruct_vs_human.py)."""
+    out = str(tmp_path / "3way")
+    main(["analyze-3way", "--llm-csv", REF_INSTRUCT_COMBINED,
+          "--survey1-csv", REF1_SURVEY, "--survey2-csv", REF2_SURVEY,
+          "--output-dir", out])
+    printed = capsys.readouterr().out
+    assert "Loaded human data for 100 questions" in printed
+    assert "invalid responses" in printed
+    assert "WARNING: tends to answer" in printed
+    corr = pd.read_csv(os.path.join(out, "model_human_correlations.csv"))
+    assert {"model", "pearson_r", "spearman_r", "mae"} <= set(corr.columns)
+    assert len(corr) >= 8
+    # sorted by pearson descending
+    valid = corr["pearson_r"].dropna()
+    assert (valid.diff().dropna() <= 1e-12).all()
+    assert os.path.exists(os.path.join(out, "human_vs_model_comparison.png"))
+
+
+@pytest.mark.skipif(not os.path.exists(REF_MODEL_COMPARISON),
+                    reason="reference not mounted")
+def test_analyze_family_differences_cli(tmp_path, capsys):
+    """Respondent-bootstrap agreement + family diffs on real data: the MAE
+    direction must agree with Table 5 (Falcon worse, StableLM better)."""
+    out = str(tmp_path / "fam")
+    main(["analyze-family-differences", "--llm-csv", REF_MODEL_COMPARISON,
+          "--survey1-csv", REF1_SURVEY, "--survey2-csv", REF2_SURVEY,
+          "--output-dir", out, "--bootstrap", "60"])
+    printed = capsys.readouterr().out
+    assert "PER-FAMILY BASE vs INSTRUCT DIFFERENCES" in printed
+    agreement = json.load(
+        open(os.path.join(out, "llm_human_agreement_bootstrap.json")))
+    by_model = {r["model"]: r for r in agreement["model_results"]}
+    falcon_b = by_model["tiiuae/falcon-7b"]
+    falcon_i = by_model["tiiuae/falcon-7b-instruct"]
+    assert falcon_i["mae_mean"] > falcon_b["mae_mean"]          # Table 5 sign
+    assert abs(falcon_b["mae_mean"] - 0.213) < 0.02             # near MAE val
+    report = open(os.path.join(out, "family_differences.txt")).read()
+    assert "SUMMARY TABLE" in report and "Falcon" in report
+    # reuse path: --agreement-json skips the bootstrap
+    main(["analyze-family-differences",
+          "--agreement-json",
+          os.path.join(out, "llm_human_agreement_bootstrap.json"),
+          "--output-dir", str(tmp_path / "fam2")])
+    assert "StableLM" in capsys.readouterr().out
+
+
+@pytest.mark.skipif(not os.path.exists(REF1_SURVEY),
+                    reason="reference not mounted")
+def test_ground_truth_figure_cli(tmp_path, capsys):
+    out = str(tmp_path / "gt")
+    main(["ground-truth-figure", "--survey1-csv", REF1_SURVEY,
+          "--survey2-csv", REF2_SURVEY, "--output-dir", out])
+    printed = capsys.readouterr().out
+    assert "Loaded 100 human ground truth values" in printed
+    assert "Mean: 0.610" in printed                             # real-data pin
+    assert os.path.exists(os.path.join(out, "ground_truth_distribution.png"))
+    assert os.path.exists(
+        os.path.join(out, "ground_truth_distribution_simple.png"))
+
+
 def test_power_analysis_cli(tmp_path, capsys):
     out = str(tmp_path / "power")
     main(["power-analysis", "--output-dir", out, "--simulations", "500"])
