@@ -240,16 +240,13 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
     else:
         new_cache = None
     if flash_lengths is not None and cache_kv is None:
-        from ..ops.attention import attention as fused_attention
+        from ..ops.attention import attention_bsnd
 
-        # dispatcher: Pallas kernel on TPU, equivalent dense path elsewhere.
-        # K/V go in UNREPEATED ([B, G, S, D]) — the grouped kernel reads each
-        # group's K/V once from VMEM instead of materializing N copies.
-        out = fused_attention(
-            jnp.swapaxes(q, 1, 2), jnp.swapaxes(k, 1, 2), jnp.swapaxes(v, 1, 2),
-            flash_lengths, causal=True,
-        )
-        out = jnp.swapaxes(out, 1, 2)
+        # layout-native dispatcher: the causal block-skipping Pallas kernel
+        # consumes the projection layout ([B, S, N, D] queries, UNREPEATED
+        # [B, S, G, D] K/V) directly — no head-major transpose of the big
+        # q/out tensors, K/V read once from VMEM per group.
+        out = attention_bsnd(q, k, v, flash_lengths, causal=True)
     else:
         k = _repeat_kv(k, n // nkv)
         v = _repeat_kv(v, n // nkv)

@@ -104,3 +104,244 @@ def test_100q_sweep_cli_roundtrip(snapshot, tmp_path, capsys):
     assert len(df) == 200                      # 100 questions x 2 legs
     main(["analyze-100q", "--results", str(csv)])
     assert "tiny" in capsys.readouterr().out
+
+
+def test_instruct_sweep_cli_roundtrip(snapshot, tmp_path, capsys):
+    """run-instruct-sweep with two snapshot stand-ins for the 9-model roster,
+    asserting the CSV byte-matches the writers contract
+    (INSTRUCT_COMPARISON_COLUMNS), then model-comparison over the result —
+    the full appendix inter-LLM-correlation chain via the CLI."""
+    import shutil
+
+    from llm_interpretation_replication_tpu.sweeps import instruct_sweep as sweep_mod
+    from llm_interpretation_replication_tpu.sweeps.writers import (
+        INSTRUCT_COMPARISON_COLUMNS,
+    )
+
+    out = tmp_path / "run_instruct"
+    snap2 = str(tmp_path / "snap_b")
+    shutil.copytree(snapshot, snap2)
+    orig = sweep_mod.instruct_sweep_models
+    sweep_mod.instruct_sweep_models = lambda: [snapshot, snap2]
+    try:
+        main([
+            "run-instruct-sweep", "--device", "cpu", "--dtype", "float32",
+            "--batch-size", "8", "--output-dir", str(out),
+            "--checkpoint-dir", str(tmp_path / "ckpt_instr"),
+        ])
+    finally:
+        sweep_mod.instruct_sweep_models = orig
+    csv = out / "instruct_model_comparison_results.csv"
+    assert csv.exists()
+    df = pd.read_csv(csv)
+    assert list(df.columns) == INSTRUCT_COMPARISON_COLUMNS
+    assert len(df) == 200                      # 100 questions x 2 models
+    rel = pd.to_numeric(df["relative_prob"], errors="coerce")
+    assert rel.notna().all() and ((rel >= 0) & (rel <= 1)).all()
+
+    mc_out = tmp_path / "mc"
+    main(["model-comparison", "--results", str(csv),
+          "--output-dir", str(mc_out), "--bootstrap", "50", "--no-figures"])
+    assert (mc_out / "pairwise_correlations.csv").exists()
+    assert "model pairs" in capsys.readouterr().out
+
+
+def test_api_perturbation_cli_full_batch_lifecycle(tmp_path, monkeypatch, capsys):
+    """run-api-perturbation via the CLI against a faked OpenAI Batch service
+    (upload -> create -> poll -> download), on the real 5 legal scenarios:
+    the produced workbook must match the PERTURBATION_COLUMNS contract."""
+    import math
+
+    from llm_interpretation_replication_tpu.api_backends import (
+        openai_client as oc_mod,
+    )
+    from llm_interpretation_replication_tpu.api_backends.transport import (
+        FakeTransport,
+    )
+    from llm_interpretation_replication_tpu.sweeps.writers import (
+        PERTURBATION_COLUMNS,
+    )
+
+    scenarios = legal_scenarios()
+    pert = [
+        {**s, "rephrasings": [f"V1: {s['original_main'][:60]}",
+                              f"V2: {s['original_main'][:60]}"]}
+        for s in scenarios
+    ]
+    pert_path = tmp_path / "perturbations.json"
+    pert_path.write_text(json.dumps(pert))
+
+    ft = FakeTransport()
+    uploads = {}
+
+    def upload(call):
+        fid = f"file-{len(uploads)}"
+        uploads[fid] = call["data"]
+        return 200, {"id": fid}
+
+    ft.add("POST", "/files", upload)
+    ft.add("POST", "/batches", lambda c: (200, {
+        "id": f"batch-{c['json']['input_file_id']}", "status": "validating",
+        "input_file_id": c["json"]["input_file_id"],
+    }))
+
+    def poll(call):
+        fid = call["url"].rsplit("/batches/batch-", 1)[1]
+        return 200, {"id": f"batch-{fid}", "status": "completed",
+                     "output_file_id": f"out-{fid}"}
+
+    ft.add("GET", "/batches/", poll)
+
+    def download(call):
+        fid = call["url"].rsplit("/files/out-", 1)[1].split("/content")[0]
+        lines = []
+        for line in uploads[fid].decode(errors="ignore").splitlines():
+            line = line.strip()
+            if not line.startswith("{"):
+                continue
+            req = json.loads(line)
+            content = req["body"]["messages"][0]["content"]
+            scenario = next(s for s in scenarios
+                            if s["confidence_format"] in content
+                            or s["response_format"] in content)
+            t1, t2 = scenario["target_tokens"]
+            if scenario["confidence_format"] in content:
+                body = {"choices": [{"message": {"content": "70"},
+                                     "logprobs": {"content": [{"top_logprobs": [
+                                         {"token": "70", "logprob": math.log(0.5)},
+                                     ]}]}}],
+                        "usage": {"prompt_tokens": 5, "completion_tokens": 1}}
+            else:
+                body = {"choices": [{"message": {"content": t1},
+                                     "logprobs": {"content": [{"top_logprobs": [
+                                         {"token": t1, "logprob": math.log(0.6)},
+                                         {"token": t2, "logprob": math.log(0.3)},
+                                     ]}]}}],
+                        "usage": {"prompt_tokens": 5, "completion_tokens": 1}}
+            lines.append(json.dumps({
+                "custom_id": req["custom_id"], "response": {"body": body},
+            }))
+        return 200, "\n".join(lines).encode()
+
+    ft.add("GET", "/content", download)
+    monkeypatch.setattr(oc_mod, "UrllibTransport", lambda: ft)
+    monkeypatch.setenv("OPENAI_API_KEY", "test-key")
+
+    out = tmp_path / "api_results.xlsx"
+    main(["run-api-perturbation", "--perturbations", str(pert_path),
+          "--model", "gpt-4.1", "--output", str(out)])
+    assert "gpt-4.1" in capsys.readouterr().out
+    df = read_xlsx(str(out))
+    assert list(df.columns) == PERTURBATION_COLUMNS
+    assert len(df) == 10                       # 5 scenarios x 2 rephrasings
+    t1 = pd.to_numeric(df["Token_1_Prob"], errors="coerce")
+    assert t1.notna().all() and (t1 > 0).all()
+
+
+def test_claude_perturbation_cli_batch_lifecycle(tmp_path, monkeypatch, capsys):
+    """run-claude-perturbation via the CLI against a faked Message-Batches
+    service (create -> poll -> results), real 5 scenarios."""
+    from llm_interpretation_replication_tpu.api_backends import (
+        anthropic_client as ac_mod,
+    )
+    from llm_interpretation_replication_tpu.api_backends.transport import (
+        FakeTransport,
+    )
+    from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+        CLAUDE_PERTURBATION_COLUMNS,
+    )
+
+    scenarios = legal_scenarios()
+    pert = [
+        {**s, "rephrasings": [f"V1: {s['original_main'][:60]}",
+                              f"V2: {s['original_main'][:60]}"]}
+        for s in scenarios
+    ]
+    pert_path = tmp_path / "perturbations.json"
+    pert_path.write_text(json.dumps(pert))
+
+    ft = FakeTransport()
+    submitted = {}
+
+    def create(call):
+        submitted["requests"] = call["json"]["requests"]
+        return 200, {"id": "b1", "processing_status": "in_progress"}
+
+    def results(_call):
+        lines = []
+        for req in submitted["requests"]:
+            lines.append(json.dumps({
+                "custom_id": req["custom_id"],
+                "result": {"type": "succeeded", "message": {
+                    "content": [{"type": "text", "text": "65"}]}},
+            }))
+        return 200, "\n".join(lines).encode()
+
+    ft.add("POST", "/messages/batches", create)
+    ft.add("GET", "/messages/batches/b1/results", results)
+    ft.add("GET", "/messages/batches/b1",
+           lambda c: (200, {"id": "b1", "processing_status": "ended"}))
+    monkeypatch.setattr(ac_mod, "UrllibTransport", lambda: ft)
+    monkeypatch.setenv("ANTHROPIC_API_KEY", "test-key")
+
+    out = tmp_path / "claude_results.xlsx"
+    main(["run-claude-perturbation", "--perturbations", str(pert_path),
+          "--output", str(out)])
+    df = read_xlsx(str(out))
+    assert list(df.columns) == CLAUDE_PERTURBATION_COLUMNS
+    assert len(df) == 10
+    conf = pd.to_numeric(df["Confidence Value"], errors="coerce")
+    assert (conf == 65).all()
+
+
+def test_gemini_perturbation_cli_threaded_sync(tmp_path, monkeypatch, capsys):
+    """run-gemini-perturbation via the CLI against a faked sync API with
+    logprobs — binary + confidence legs per rephrasing, threaded."""
+    import math
+
+    from llm_interpretation_replication_tpu.api_backends import (
+        gemini_client as gc_mod,
+    )
+    from llm_interpretation_replication_tpu.api_backends.transport import (
+        FakeTransport,
+    )
+    from llm_interpretation_replication_tpu.sweeps.writers import (
+        PERTURBATION_COLUMNS,
+    )
+
+    scenarios = legal_scenarios()
+    pert = [
+        {**s, "rephrasings": [f"V1: {s['original_main'][:60]}"]}
+        for s in scenarios
+    ]
+    pert_path = tmp_path / "perturbations.json"
+    pert_path.write_text(json.dumps(pert))
+
+    ft = FakeTransport()
+
+    def handler(call):
+        content = call["json"]["contents"][0]["parts"][0]["text"]
+        scenario = next(s for s in scenarios
+                        if s["confidence_format"] in content
+                        or s["response_format"] in content)
+        t1 = scenario["target_tokens"][0]
+        text = "55" if scenario["confidence_format"] in content else t1
+        return 200, {"candidates": [{
+            "content": {"parts": [{"text": text}]},
+            "logprobsResult": {"topCandidates": [{"candidates": [
+                {"token": text, "logProbability": math.log(0.8)},
+            ]}]},
+        }]}
+
+    ft.add("POST", ":generateContent", handler)
+    monkeypatch.setattr(gc_mod, "UrllibTransport", lambda: ft)
+    monkeypatch.setenv("GEMINI_API_KEY", "test-key")
+
+    out = tmp_path / "gemini_results.xlsx"
+    main(["run-gemini-perturbation", "--perturbations", str(pert_path),
+          "--output", str(out), "--threads", "2"])
+    df = read_xlsx(str(out))
+    assert list(df.columns) == PERTURBATION_COLUMNS
+    assert len(df) == 5
+    t1 = pd.to_numeric(df["Token_1_Prob"], errors="coerce")
+    assert t1.notna().all() and (t1 > 0.7).all()

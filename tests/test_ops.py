@@ -193,6 +193,107 @@ def test_attention_dispatch_accepts_grouped_kv():
     np.testing.assert_allclose(np.asarray(got), np.asarray(expected), atol=2e-5)
 
 
+@pytest.mark.parametrize("causal", [True, False])
+@pytest.mark.parametrize("n_heads,n_kv", [(6, 1), (8, 4), (4, 4)])
+def test_causal_grouped_matches_dense(causal, n_heads, n_kv):
+    """Causal block-skipping kernel (layout-native [B,S,N,D], dynamic k-block
+    trip counts, mask only on boundary blocks) vs dense attention.  block_k=16
+    with S=48 exercises clean blocks, boundary blocks, and skipped blocks;
+    ragged lengths exercise the length bound inside a clean region."""
+    from llm_interpretation_replication_tpu.ops.attention import (
+        causal_grouped_attention,
+    )
+
+    rng = np.random.default_rng(8)
+    B, S, D = 2, 48, 16
+    q = rng.standard_normal((B, S, n_heads, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, n_kv, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, n_kv, D)).astype(np.float32)
+    lengths = np.array([S, S - 17], np.int32)
+    out = causal_grouped_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), lengths,
+        causal=causal, block_k=16, interpret=True,
+    )
+    reps = n_heads // n_kv
+    qh = np.swapaxes(q, 1, 2)
+    expected = _dense_attention(
+        jnp.asarray(qh),
+        jnp.asarray(np.repeat(np.swapaxes(k, 1, 2), reps, axis=1)),
+        jnp.asarray(np.repeat(np.swapaxes(v, 1, 2), reps, axis=1)),
+        jnp.asarray(lengths), causal,
+    )
+    expected = np.swapaxes(np.asarray(expected), 1, 2)
+    valid = (np.arange(S)[None, :] < lengths[:, None])[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(out) * valid, expected * valid, atol=2e-5, rtol=1e-4
+    )
+
+
+def test_causal_grouped_padded_seq_and_zero_rows():
+    """S not a block_k multiple pads K/V inside the wrapper (pad cols must be
+    masked as boundary blocks); length-0 rows come back all-zero."""
+    from llm_interpretation_replication_tpu.ops.attention import (
+        causal_grouped_attention,
+    )
+
+    rng = np.random.default_rng(9)
+    B, S, N, D = 2, 40, 4, 16                            # 40 % 16 != 0
+    q = rng.standard_normal((B, S, N, D)).astype(np.float32)
+    k = rng.standard_normal((B, S, 1, D)).astype(np.float32)
+    v = rng.standard_normal((B, S, 1, D)).astype(np.float32)
+    lengths = np.array([S - 3, 0], np.int32)
+    out = causal_grouped_attention(
+        jnp.asarray(q), jnp.asarray(k), jnp.asarray(v), lengths,
+        causal=True, block_k=16, interpret=True,
+    )
+    np.testing.assert_array_equal(np.asarray(out)[1], 0.0)
+    expected = _dense_attention(
+        jnp.asarray(np.swapaxes(q, 1, 2)),
+        jnp.asarray(np.repeat(np.swapaxes(k, 1, 2), N, axis=1)),
+        jnp.asarray(np.repeat(np.swapaxes(v, 1, 2), N, axis=1)),
+        jnp.asarray(lengths), True,
+    )
+    expected = np.swapaxes(np.asarray(expected), 1, 2)
+    valid = (np.arange(S)[None, :] < lengths[:, None])[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(out) * valid, expected * valid, atol=2e-5, rtol=1e-4
+    )
+
+
+def test_pick_block_pos():
+    from llm_interpretation_replication_tpu.ops.attention import pick_block_pos
+
+    assert pick_block_pos(432, 71) == 8        # Falcon MQA: 568 rows
+    # nq >= 4 preferred so the causal skip stays alive (one giant block would
+    # make every k-tile a boundary tile)
+    assert pick_block_pos(432, 1) == 72        # MHA: 6 query blocks
+    assert pick_block_pos(448, 4) == 112       # 448 rows, 4 query blocks
+    assert pick_block_pos(48, 3) == 8          # 24 rows, 6 blocks
+    assert pick_block_pos(8, 3) == 8           # fallback: no divisor leaves 4
+    assert pick_block_pos(7, 3) is None        # no sublane-aligned block
+
+
+def test_attention_bsnd_dispatch_matches_dense():
+    """The layout-native dispatcher must agree with dense on every forced
+    backend (causal kernel in interpret mode; dense via transpose)."""
+    from llm_interpretation_replication_tpu.ops.attention import attention_bsnd
+
+    rng = np.random.default_rng(10)
+    B, S, N, G, D = 2, 64, 8, 2, 16
+    q = jnp.asarray(rng.standard_normal((B, S, N, D)).astype(np.float32))
+    k = jnp.asarray(rng.standard_normal((B, S, G, D)).astype(np.float32))
+    v = jnp.asarray(rng.standard_normal((B, S, G, D)).astype(np.float32))
+    lengths = np.array([S, S - 11], np.int32)
+    via_causal = attention_bsnd(q, k, v, lengths, causal=True,
+                                force="causal", interpret=True)
+    via_dense = attention_bsnd(q, k, v, lengths, causal=True, force="dense")
+    valid = (np.arange(S)[None, :] < lengths[:, None])[:, :, None, None]
+    np.testing.assert_allclose(
+        np.asarray(via_causal) * valid, np.asarray(via_dense) * valid,
+        atol=2e-5, rtol=1e-4,
+    )
+
+
 def test_decoder_flash_mqa_matches_xla():
     """attention_impl='flash' on an MQA decoder (num_kv_heads=1) routes
     unrepeated K/V through the dispatcher — outputs must match the XLA path."""
