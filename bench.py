@@ -232,7 +232,9 @@ def main():
             return relative_prob_first_token(logits, yes_id, no_id)
 
     if args.microbatch > 1:
-        assert args.batch % args.microbatch == 0
+        if args.batch % args.microbatch:
+            parser.error(f"--batch {args.batch} not divisible by "
+                         f"--microbatch {args.microbatch}")
         chunk = args.batch // args.microbatch
 
         def score(params, ids, mask):

@@ -539,6 +539,47 @@ def cmd_analyze_mae_100q(args):
         print(f"wrote {args.output_json}")
 
 
+def cmd_repair_batch(args):
+    """Rewrite a corrupted batch-response JSONL (fix_batch_responses.py as a
+    subcommand)."""
+    from .api_backends.gemini_client import repair_batch_responses
+
+    n = repair_batch_responses(args.requests, args.responses, args.output)
+    print(f"repaired {n} rows -> {args.output}")
+
+
+def cmd_extract_survey2(args):
+    """Pull the part-2 questions out of the Qualtrics headers
+    (analysis/extract_survey2_questions.py:9-82)."""
+    from .analysis.questions import extract_survey2_questions
+
+    import os
+
+    questions, _ = extract_survey2_questions(args.survey_csv)
+    parent = os.path.dirname(os.path.abspath(args.output))
+    os.makedirs(parent, exist_ok=True)
+    with open(args.output, "w", encoding="utf-8") as f:
+        f.write("\n".join(questions) + "\n")
+    print(f"wrote {len(questions)} questions -> {args.output}")
+
+
+def cmd_sample_statements(args):
+    """Seeded LaTeX sample of the irrelevant statements for the appendix
+    (data/generate_latex_statements.py)."""
+    from .config import irrelevant_statements
+    from .viz.latex import irrelevant_statements_sample
+
+    tex = irrelevant_statements_sample(
+        irrelevant_statements(), k=args.k, seed=args.seed
+    )
+    if args.output:
+        with open(args.output, "w", encoding="utf-8") as f:
+            f.write(tex + "\n")
+        print(f"wrote {args.output}")
+    else:
+        print(tex)
+
+
 def _load_llm_csv(path):
     """Model-results CSV with relative_prob guaranteed: recomputed from the
     raw probs with both-zero rows at 0.5 when yes/no columns exist
@@ -894,6 +935,26 @@ def main(argv=None):
     p.add_argument("--survey1-csv", default=None)
     p.add_argument("--survey2-csv", default=None)
     p.set_defaults(fn=cmd_analyze_100q)
+
+    p = sub.add_parser("repair-batch",
+                       help="re-pair a corrupted batch-response JSONL")
+    p.add_argument("--requests", required=True, help="request JSONL")
+    p.add_argument("--responses", required=True, help="corrupted response JSONL")
+    p.add_argument("--output", required=True)
+    p.set_defaults(fn=cmd_repair_batch)
+
+    p = sub.add_parser("extract-survey2-questions",
+                       help="extract part-2 questions from Qualtrics headers")
+    p.add_argument("--survey-csv", required=True)
+    p.add_argument("--output", default="data/question_list_part_2_actual.txt")
+    p.set_defaults(fn=cmd_extract_survey2)
+
+    p = sub.add_parser("sample-statements",
+                       help="seeded LaTeX sample of the irrelevant statements")
+    p.add_argument("--k", type=int, default=50)
+    p.add_argument("--seed", type=int, default=42)
+    p.add_argument("--output", default=None)
+    p.set_defaults(fn=cmd_sample_statements)
 
     p = sub.add_parser("analyze-3way",
                        help="base-vs-instruct-vs-human comparison "

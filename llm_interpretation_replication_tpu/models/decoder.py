@@ -169,6 +169,29 @@ def dot_product_attention(q, k, v, bias):
     return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
 
+def grouped_dot_product_attention(q, k, v, bias):
+    """MQA/GQA attention on UNREPEATED K/V: q [B,S,N,D], k/v [B,T,G,D],
+    bias broadcastable to [B,N,S,T] with N either full heads or 1.
+
+    ``_repeat_kv`` + :func:`dot_product_attention` forces XLA to materialize
+    an [B,T,N,D] K/V copy (770 MB/layer at the sweep shape for Falcon's 71:1
+    MQA) — harmless amortized over a 432-token prompt forward, dominant at
+    decode steps where S=1.  The grouped einsum keeps K/V at [B,T,G,D]."""
+    b, s, n, d = q.shape
+    g = k.shape[2]
+    hpg = n // g
+    qg = q.reshape(b, s, g, hpg, d)
+    scores = jnp.einsum("bsghd,btgd->bghst", qg, k) / jnp.sqrt(d).astype(q.dtype)
+    bias = jnp.broadcast_to(bias, (b, bias.shape[1], s, k.shape[1]))
+    bias_g = (
+        bias.reshape(b, g, hpg, s, -1) if bias.shape[1] == n
+        else bias[:, :, None]                          # head-agnostic [B,1,1,S,T]
+    )
+    probs = jax.nn.softmax(scores.astype(jnp.float32) + bias_g, axis=-1)
+    out = jnp.einsum("bghst,btgd->bsghd", probs.astype(q.dtype), v)
+    return out.reshape(b, s, n, d)
+
+
 def make_attention_bias(
     cfg: DecoderConfig,
     q_positions,      # [B, S] absolute position of each query token
@@ -508,9 +531,11 @@ def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
     onehot = (jnp.arange(t)[None, :] == write_pos[:, None]).astype(ck.dtype)  # [B,T]
     ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k.astype(ck.dtype)
     cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v.astype(cv.dtype)
-    kf = _repeat_kv(ck.astype(x.dtype), n // nkv)
-    vf = _repeat_kv(cv.astype(x.dtype), n // nkv)
-    out = dot_product_attention(q, kf, vf, bias)
+    # grouped attention on the unrepeated cache: at S=1 a [B,T,N,D] repeat
+    # would dwarf the step's real work (770 MB/layer for Falcon's 71:1 MQA)
+    out = grouped_dot_product_attention(
+        q, ck.astype(x.dtype), cv.astype(x.dtype), bias
+    )
     out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
     if "bo" in ap:
         out = out + ap["bo"]
