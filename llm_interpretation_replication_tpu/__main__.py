@@ -21,6 +21,16 @@ import json
 import sys
 
 
+def _write_json(obj, path):
+    """Shared artifact writer: parent dir, utf-8, indent-2, NaN-safe floats."""
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        json.dump(obj, f, indent=2, default=float)
+    print(f"wrote {path}")
+
+
 def _add_run_config_args(p: argparse.ArgumentParser):
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
     p.add_argument("--dtype", default="bfloat16")
@@ -476,6 +486,8 @@ def cmd_analyze_100q(args):
     df = pd.read_csv(args.results)
     out = base_vs_instruct_analysis(df)
     print(json.dumps(out, indent=2, default=float))
+    if args.output_json:
+        _write_json(out, args.output_json)
     if args.latex:
         # Table 5 needs human survey means — delegate to the real machinery
         # (the old mapping printed NaN MAE columns from bootstrap-only keys)
@@ -533,10 +545,7 @@ def cmd_analyze_mae_100q(args):
         if args.latex:
             print(table)
     if args.output_json:
-        with open(args.output_json, "w", encoding="utf-8") as f:
-            json.dump({"families": families, "meta": meta}, f, indent=2,
-                      default=float)
-        print(f"wrote {args.output_json}")
+        _write_json({"families": families, "meta": meta}, args.output_json)
 
 
 def cmd_repair_batch(args):
@@ -743,9 +752,7 @@ def cmd_cross_kappa(args):
     }
     print(json.dumps(out, indent=2, default=float))
     if args.output_json:
-        with open(args.output_json, "w", encoding="utf-8") as f:
-            json.dump({**out, "pairs": kappa["pairs"]}, f, indent=2, default=float)
-        print(f"wrote {args.output_json}")
+        _write_json({**out, "pairs": kappa["pairs"]}, args.output_json)
 
 
 def cmd_power_analysis(args):
@@ -934,6 +941,8 @@ def main(argv=None):
                    help="also emit paper Table 5 (needs --survey1-csv)")
     p.add_argument("--survey1-csv", default=None)
     p.add_argument("--survey2-csv", default=None)
+    p.add_argument("--output-json", default=None,
+                   help="also write the analysis records here")
     p.set_defaults(fn=cmd_analyze_100q)
 
     p = sub.add_parser("repair-batch",

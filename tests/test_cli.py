@@ -488,14 +488,21 @@ def test_repair_batch_cli(tmp_path, capsys):
     reqs.write_text("\n".join(
         json.dumps({"custom_id": f"id-{i}", "request": {}}) for i in range(2)
     ))
+    # real corruption shape: the text field holds a stringified response
+    # object (see test_api_backends.py's repair tests)
     corrupted = tmp_path / "bad.jsonl"
-    corrupted.write_text("\n".join(json.dumps({
-        "response": "candidates=[Candidate(content=Content(parts=[Part(\n"
-                    f"text=\"\"\"Answer {i}\"\"\"\n)]))]"}) for i in range(2)))
+    corrupted.write_text("\n".join(json.dumps(
+        {"response": {"candidates": [{"content": {"parts": [{
+            "text": f"Candidate(content=Content(parts=[Part(text='Answer {i}')]))"
+        }]}}]}}
+    ) for i in range(2)))
     out = tmp_path / "fixed.jsonl"
     main(["repair-batch", "--requests", str(reqs), "--responses", str(corrupted),
           "--output", str(out)])
     rows = [json.loads(l) for l in open(out).read().splitlines()]
     assert len(rows) == 2
     assert rows[0]["custom_id"] == "id-0"
+    texts = [r["response"]["candidates"][0]["content"]["parts"][0]["text"]
+             for r in rows]
+    assert texts == ["Answer 0", "Answer 1"]   # extraction actually recovered
     assert "repaired 2 rows" in capsys.readouterr().out
