@@ -22,12 +22,11 @@ import sys
 
 
 def _write_json(obj, path):
-    """Shared artifact writer: parent dir, utf-8, indent-2, NaN-safe floats."""
-    import os
+    """Shared artifact writer: parent dir, utf-8, indent-2, strict JSON
+    (non-finite floats become null — utils/strict_json)."""
+    from .utils.strict_json import dump_strict
 
-    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    with open(path, "w", encoding="utf-8") as f:
-        json.dump(obj, f, indent=2, default=float)
+    dump_strict(obj, path)
     print(f"wrote {path}")
 
 
@@ -319,12 +318,24 @@ def cmd_demographics(args):
 
 def cmd_generate_irrelevant(args):
     from .config import irrelevant_scenarios, irrelevant_statements
-    from .gen.irrelevant import generate_perturbations, save_perturbations
+    from .gen.irrelevant import (
+        generate_perturbations,
+        save_perturbations,
+        save_readable,
+    )
 
     perturbed = generate_perturbations(irrelevant_scenarios(), irrelevant_statements())
     save_perturbations(perturbed, args.output)
     total = sum(len(s["perturbations_with_irrelevant"]) for s in perturbed)
     print(f"{total} perturbations -> {args.output}")
+    if args.readable_output:
+        import datetime
+
+        save_readable(
+            perturbed, args.readable_output,
+            generated_at=datetime.datetime.now().strftime("%Y-%m-%d %H:%M:%S"),
+        )
+        print(f"readable dump -> {args.readable_output}")
 
 
 def cmd_run_irrelevant(args):
@@ -889,6 +900,9 @@ def main(argv=None):
 
     p = sub.add_parser("generate-irrelevant", help="build perturbations_irrelevant.json")
     p.add_argument("--output", default="data/perturbations_irrelevant.json")
+    p.add_argument("--readable-output", default=None,
+                   help="also write the human-readable dump "
+                        "(perturbations_irrelevant_readable.txt)")
     p.set_defaults(fn=cmd_generate_irrelevant)
 
     p = sub.add_parser("run-irrelevant",

@@ -38,16 +38,9 @@ RESULT_COLUMNS = [
 DELAY_BETWEEN_REQUESTS = 0.1  # reference :62
 
 
-def _nan_to_null(obj):
-    """Non-finite floats → None so the dumped JSON stays strict (json.dump
-    would otherwise emit bare ``NaN`` tokens that jq/JSON.parse reject)."""
-    if isinstance(obj, dict):
-        return {k: _nan_to_null(v) for k, v in obj.items()}
-    if isinstance(obj, (list, tuple)):
-        return [_nan_to_null(v) for v in obj]
-    if isinstance(obj, (float, np.floating)):
-        return float(obj) if np.isfinite(obj) else None
-    return obj
+from ..utils.strict_json import nan_to_null as _nan_to_null  # noqa: E402
+# non-finite stats (all-error groups, single-sample std) must not become
+# bare NaN tokens that jq/JSON.parse reject — shared strict-JSON sanitizer
 
 
 def build_vendor_evaluators(

@@ -102,6 +102,59 @@ def save_perturbations(perturbed: Sequence[dict], path: str) -> None:
         json.dump(list(perturbed), f, indent=2)
 
 
+def readable_dump(perturbed: Sequence[dict], generated_at: str = "") -> str:
+    """Human-readable companion of the JSON
+    (perturb_with_irrelevant_statements.py:204-232's exact layout).
+
+    ``generated_at`` fills the reference's ``Generated:`` timestamp line —
+    injectable so tests (and reproducible builds) don't depend on the clock.
+    """
+    lines = [
+        "PERTURBATIONS WITH IRRELEVANT STATEMENTS",
+        "=" * 80,
+        f"Generated: {generated_at}",
+        f"Total scenarios: {len(perturbed)}",
+        f"Total perturbations: "
+        f"{sum(len(p['perturbations_with_irrelevant']) for p in perturbed)}",
+    ]
+    for p in perturbed:
+        lines.append(f"  {p['scenario_name']}: "
+                     f"{len(p['perturbations_with_irrelevant'])} perturbations")
+    lines += ["=" * 80, ""]
+    for scenario in perturbed:
+        lines += [
+            "",
+            f"SCENARIO: {scenario['scenario_name']}",
+            "-" * 60,
+            f"ORIGINAL:\n{scenario['original_main']}",
+            "",
+            f"RESPONSE FORMAT: {scenario['response_format']}",
+            f"TARGET TOKENS: {scenario['target_tokens']}",
+            "-" * 60,
+        ]
+        for pert in scenario["perturbations_with_irrelevant"]:
+            lines += [
+                "",
+                f"Perturbation #{pert['perturbation_id']}:",
+                f"Irrelevant Statement: {pert['irrelevant_statement']}",
+                f"Position: {pert['position_description']} "
+                f"(index: {pert['position_index']})",
+                f"Perturbed Text:\n{pert['perturbed_text']}",
+                "-" * 40,
+            ]
+        lines += ["", "=" * 80]
+    return "\n".join(lines) + "\n"
+
+
+def save_readable(perturbed: Sequence[dict], path: str,
+                  generated_at: str = "") -> None:
+    import os
+
+    os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
+    with open(path, "w", encoding="utf-8") as f:
+        f.write(readable_dump(perturbed, generated_at))
+
+
 def load_perturbations(path: str) -> List[dict]:
     with open(path) as f:
         return json.load(f)

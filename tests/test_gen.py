@@ -99,3 +99,29 @@ class TestRephrasings:
         save_perturbations(records, path)
         with pytest.raises(ValueError):
             load_perturbations(path, expected_scenarios=scenarios)
+
+
+def test_readable_dump_golden_vs_reference():
+    """The human-readable companion dump is byte-identical to the reference's
+    recorded perturbations_irrelevant_readable.txt (timestamp injected)."""
+    import os
+
+    ref_path = "/root/reference/data/perturbations_irrelevant_readable.txt"
+    if not os.path.exists(ref_path):
+        import pytest
+
+        pytest.skip("reference not mounted")
+    from llm_interpretation_replication_tpu.config import (
+        irrelevant_scenarios,
+        irrelevant_statements,
+    )
+    from llm_interpretation_replication_tpu.gen.irrelevant import (
+        generate_perturbations,
+        readable_dump,
+    )
+
+    perturbed = generate_perturbations(irrelevant_scenarios(),
+                                       irrelevant_statements())
+    ours = readable_dump(perturbed, generated_at="2025-11-09 14:23:48")
+    ref = open(ref_path, encoding="utf-8").read()
+    assert ours == ref

@@ -345,3 +345,87 @@ def test_gemini_perturbation_cli_threaded_sync(tmp_path, monkeypatch, capsys):
     assert len(df) == 5
     t1 = pd.to_numeric(df["Token_1_Prob"], errors="coerce")
     assert t1.notna().all() and (t1 > 0.7).all()
+
+
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/data/word_meaning_survey_results.csv"),
+    reason="reference not mounted")
+def test_closed_source_cli_full_evaluation(tmp_path, monkeypatch, capsys):
+    """run-closed-source end-to-end via the CLI against all three faked
+    vendor APIs on the real 100-question inputs: cache, per-vendor
+    evaluators, baselines, MAE tables, figures."""
+    import math
+    import time
+
+    from llm_interpretation_replication_tpu.api_backends import (
+        anthropic_client as ac_mod,
+        gemini_client as gc_mod,
+        openai_client as oc_mod,
+    )
+    from llm_interpretation_replication_tpu.api_backends.transport import (
+        FakeTransport,
+    )
+
+    ft = FakeTransport()
+
+    def openai_handler(call):
+        content = call["json"]["messages"][0]["content"]
+        conf = "confident" in content or "0 and 100" in content
+        text = "80" if conf else "Yes"
+        top = ([{"token": "80", "logprob": math.log(0.6)},
+                {"token": "90", "logprob": math.log(0.2)}] if conf else
+               [{"token": "Yes", "logprob": math.log(0.7)},
+                {"token": "No", "logprob": math.log(0.2)}])
+        return 200, {"choices": [{"message": {"content": text},
+                                  "logprobs": {"content": [{"top_logprobs": top}]}}]}
+
+    def gemini_handler(call):
+        content = call["json"]["contents"][0]["parts"][0]["text"]
+        conf = "confident" in content or "0 and 100" in content
+        text = "70" if conf else "No"
+        cands = [{"token": text, "logProbability": math.log(0.8)}]
+        return 200, {"candidates": [{
+            "content": {"parts": [{"text": text}]},
+            "logprobsResult": {"topCandidates": [{"candidates": cands}]},
+        }]}
+
+    def claude_handler(call):
+        content = call["json"]["messages"][0]["content"]
+        conf = "confident" in content or "0 and 100" in content
+        return 200, {"content": [{"type": "text",
+                                  "text": "60" if conf else "Yes"}]}
+
+    ft.add("POST", "/chat/completions", openai_handler)
+    ft.add("POST", ":generateContent", gemini_handler)
+    ft.add("POST", "/messages", claude_handler)
+    for mod in (oc_mod, gc_mod, ac_mod):
+        monkeypatch.setattr(mod, "UrllibTransport", lambda: ft)
+    for var in ("OPENAI_API_KEY", "GEMINI_API_KEY", "ANTHROPIC_API_KEY"):
+        monkeypatch.setenv(var, "test-key")
+    monkeypatch.setattr(time, "sleep", lambda _s: None)
+
+    out = tmp_path / "closed"
+    main([
+        "run-closed-source",
+        "--questions-csv", "/root/reference/data/instruct_model_comparison_results.csv",
+        "--survey2-csv", "/root/reference/data/word_meaning_survey_results_part_2.csv",
+        "--survey1-csv", "/root/reference/data/word_meaning_survey_results.csv",
+        "--output-dir", str(out), "--yes",
+    ])
+    df = pd.read_csv(out / "closed_source_evaluation_results.csv")
+    assert len(df) == 100
+    assert {"gpt_relative_prob", "gemini_relative_prob", "claude_response",
+            "random_relative_prob"} <= set(df.columns)
+    assert df["gpt_relative_prob"].between(0, 1).all()
+    assert (out / "api_cache.json").exists()
+    assert (out / "mae_results_tables.tex").exists()
+    # re-run short-circuits to the saved CSV (no new API calls)
+    calls_before = len(ft.calls)
+    main([
+        "run-closed-source",
+        "--questions-csv", "/root/reference/data/instruct_model_comparison_results.csv",
+        "--survey2-csv", "/root/reference/data/word_meaning_survey_results_part_2.csv",
+        "--survey1-csv", "/root/reference/data/word_meaning_survey_results.csv",
+        "--output-dir", str(out), "--yes",
+    ])
+    assert len(ft.calls) == calls_before
