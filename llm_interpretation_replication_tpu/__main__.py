@@ -36,6 +36,11 @@ def _add_run_config_args(p: argparse.ArgumentParser):
     p.add_argument("--quant", choices=["none", "int8"], default="none",
                    help="w8a8 int8 projections — ~1.9x scoring throughput on "
                         "v5e, ~0.9997 logit correlation vs bf16")
+    p.add_argument("--attention-impl", choices=["xla", "flash", "auto"],
+                   default="xla",
+                   help="'auto' keeps XLA dense at sweep lengths and "
+                        "switches to the Pallas kernel past 1k tokens, "
+                        "where dense's S^2 scores would exhaust HBM")
     p.add_argument("--mesh-model", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=16)
@@ -48,6 +53,7 @@ def _run_config(args):
 
     return RunConfig(
         device=args.device, dtype=args.dtype, quant=args.quant,
+        attention_impl=args.attention_impl,
         mesh_model=args.mesh_model,
         mesh_seq=args.mesh_seq, batch_size=args.batch_size,
         checkpoint_dir=args.checkpoint_dir, output_dir=args.output_dir,
@@ -74,6 +80,7 @@ def _engine_factory(run_config):
         family, cfg, params = load_model(
             path, dtype=run_config.resolve_dtype(), mesh=mesh,
             quant=run_config.quant,
+            attention_impl=run_config.attention_impl,
         )
         tokenizer = load_tokenizer(path)
         return ScoringEngine(

@@ -109,6 +109,9 @@ class RunConfig:
     quant: str = "none"                  # 'none' | 'int8' (w8a8, decoder-only;
                                          # the TPU answer to the reference's
                                          # bitsandbytes load_in_8bit)
+    attention_impl: str = "xla"          # 'xla' | 'flash' | 'auto' (dense up
+                                         # to 1k tokens, Pallas kernel beyond
+                                         # — models/config.DecoderConfig)
     mesh_data: Optional[int] = None      # None = all remaining devices
     mesh_model: int = 1
     mesh_seq: int = 1

@@ -69,9 +69,15 @@ class DecoderConfig:
     tie_word_embeddings: bool = False
     final_norm: bool = True
     logit_scale: float = 1.0
-    # "xla" (fused by the compiler) | "flash" (Pallas TPU kernel; causal +
-    # right-padding only — rejected for ALiBi / sliding-window configs)
+    # "xla"   — compiler-fused dense attention (fastest in situ at sweep
+    #           lengths; the measured tradeoff lives in ops/attention.py)
+    # "flash" — the causal block-skipping Pallas kernel always (causal +
+    #           right-padding only — rejected for ALiBi / sliding window)
+    # "auto"  — dense up to ``auto_flash_seq``, Pallas beyond it, where
+    #           dense's S² score tensor would exhaust HBM (ALiBi /
+    #           sliding-window configs always stay dense)
     attention_impl: str = "xla"
+    auto_flash_seq: int = 1024
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -80,6 +86,8 @@ class DecoderConfig:
             object.__setattr__(self, "head_dim", self.hidden_size // self.num_heads)
         if self.intermediate_size is None:
             object.__setattr__(self, "intermediate_size", 4 * self.hidden_size)
+        if self.attention_impl not in ("xla", "flash", "auto"):
+            raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
         if self.attention_impl == "flash" and (
             self.position_embedding == "alibi" or self.sliding_window is not None
         ):
@@ -87,6 +95,16 @@ class DecoderConfig:
                 "flash attention kernel supports causal+padding only "
                 "(no ALiBi / sliding window)"
             )
+
+    def use_flash_attention(self, seq_len: int) -> bool:
+        """Resolve the attention impl for a prompt forward at ``seq_len``."""
+        if self.attention_impl == "flash":
+            return True
+        if self.attention_impl == "auto":
+            return (seq_len > self.auto_flash_seq
+                    and self.position_embedding != "alibi"
+                    and self.sliding_window is None)
+        return False
 
 
 @dataclasses.dataclass(frozen=True)

@@ -258,17 +258,23 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
         ck, cv = cache_kv
         ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
         cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
-        k, v = ck.astype(x.dtype), cv.astype(x.dtype)
         new_cache = (ck, cv)
+        if flash_lengths is None:
+            # dense path attends over the whole (zero-padded) cache; the
+            # flash path below attends over the prompt K/V directly —
+            # equivalent, since unwritten cache slots are masked anyway
+            k, v = ck.astype(x.dtype), cv.astype(x.dtype)
     else:
         new_cache = None
-    if flash_lengths is not None and cache_kv is None:
+    if flash_lengths is not None:
         from ..ops.attention import attention_bsnd
 
         # layout-native dispatcher: the causal block-skipping Pallas kernel
         # consumes the projection layout ([B, S, N, D] queries, UNREPEATED
         # [B, S, G, D] K/V) directly — no head-major transpose of the big
-        # q/out tensors, K/V read once from VMEM per group.
+        # q/out tensors, K/V read once from VMEM per group.  Works for the
+        # cached prompt forward too (greedy_decode's first phase), which
+        # would otherwise materialize both the S×T bias and S×T scores.
         out = attention_bsnd(q, k, v, flash_lengths, causal=True)
     else:
         k = _repeat_kv(k, n // nkv)
@@ -349,7 +355,7 @@ def run_layers(cfg: DecoderConfig, layers, x, positions, attention_mask):
     if cfg.position_embedding == "rotary":
         rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
         sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, x.dtype)
-    use_flash = cfg.attention_impl == "flash"
+    use_flash = cfg.use_flash_attention(x.shape[1])
     bias = None if use_flash else make_attention_bias(cfg, positions, positions, mask)
     flash_lengths = jnp.sum(attention_mask, axis=-1).astype(jnp.int32) if use_flash else None
 
@@ -380,15 +386,26 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
 
     t = cache_len
     cache_dtype = params["embed"]["tokens"].dtype
-    # Attention runs over the whole (zero-padded) cache: extend the key-side
-    # mask/positions from S to T.  Slot index == position for right-padded rows.
-    kv_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
-    kv_valid = jnp.pad(mask, ((0, 0), (0, t - s)))
-    bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
+    # The prompt forward honors flash/auto here too — the dense cached path
+    # materializes BOTH an S×T bias and S×T scores, exactly the HBM blowup
+    # 'auto' exists to avoid on long prompts.  Decode steps (S=1) stay dense.
+    use_flash = cfg.use_flash_attention(s)
+    flash_lengths = (jnp.sum(attention_mask, axis=-1).astype(jnp.int32)
+                     if use_flash else None)
+    if use_flash:
+        bias = None
+    else:
+        # Attention runs over the whole (zero-padded) cache: extend the
+        # key-side mask/positions from S to T.  Slot index == position for
+        # right-padded rows.
+        kv_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+        kv_valid = jnp.pad(mask, ((0, 0), (0, t - s)))
+        bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
 
     def body(h, lp):
         zeros = jnp.zeros((b, t, cfg.num_kv_heads, cfg.head_dim), cache_dtype)
-        h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, (zeros, zeros), 0)
+        h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, (zeros, zeros), 0,
+                             flash_lengths)
         return h, (ck, cv)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])

@@ -136,6 +136,7 @@ def load_model(
     dtype=None,
     mesh=None,
     quant: str = "none",
+    attention_impl: Optional[str] = None,
 ) -> Tuple[str, object, dict]:
     """Load (family, config, params) from a local snapshot dir.
 
@@ -154,6 +155,22 @@ def load_model(
 
     hf = load_hf_config(path)
     family, cfg = mcfg.from_hf_config(hf)
+    if attention_impl and family != "t5":
+        import dataclasses
+
+        if attention_impl not in ("xla", "flash", "auto"):
+            # validate BEFORE the try: the fallback below is only for the
+            # flash/ALiBi incompatibility, not for typo'd impl names
+            raise ValueError(f"unknown attention_impl {attention_impl!r}")
+        # 'auto' falls back to dense inside the config for ALiBi /
+        # sliding-window models; explicit 'flash' rejects them — degrade to
+        # dense with a warning so a roster-wide flag survives mixed families
+        try:
+            cfg = dataclasses.replace(cfg, attention_impl=attention_impl)
+        except ValueError as err:
+            import warnings
+
+            warnings.warn(f"{path}: {err}; keeping attention_impl='xla'")
     ckpt = CheckpointDir(path)
     dtype = dtype or jnp.bfloat16
     params = mconvert.convert(family, ckpt.get, cfg, dtype=None)

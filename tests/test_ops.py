@@ -553,3 +553,88 @@ class TestMoE:
         x = jnp.zeros((8, self.H), jnp.float32)
         with pytest.raises(ValueError, match="divisible"):
             moe.moe_mlp_sharded(params, x, mesh, axis_name="data")
+
+
+def test_attention_impl_auto_resolution():
+    """'auto' keeps dense at sweep lengths, flips to the Pallas kernel past
+    auto_flash_seq, and never flips for ALiBi / sliding-window configs."""
+    import dataclasses
+
+    from llm_interpretation_replication_tpu.models.config import DecoderConfig
+
+    cfg = DecoderConfig(
+        vocab_size=96, hidden_size=32, num_layers=1, num_heads=4,
+        intermediate_size=64, position_embedding="rotary",
+        max_position_embeddings=8192, attention_impl="auto",
+    )
+    assert not cfg.use_flash_attention(432)      # sweep bucket: dense wins
+    assert cfg.use_flash_attention(2048)         # dense S^2 scores would OOM
+    alibi = dataclasses.replace(cfg, position_embedding="alibi")
+    assert not alibi.use_flash_attention(2048)   # kernel can't do ALiBi
+    sw = dataclasses.replace(cfg, sliding_window=256)
+    assert not sw.use_flash_attention(2048)
+    flash = dataclasses.replace(cfg, attention_impl="flash")
+    assert flash.use_flash_attention(16)         # explicit flash: always
+    with pytest.raises(ValueError, match="attention_impl"):
+        DecoderConfig(vocab_size=8, hidden_size=8, num_layers=1, num_heads=1,
+                      attention_impl="bogus")
+
+
+def test_decoder_auto_impl_matches_xla_past_threshold():
+    """attention_impl='auto' past the threshold routes through the dispatcher
+    (dense fallback on CPU) and must not change decoder outputs."""
+    import dataclasses
+
+    from helpers import random_decoder_params
+
+    from llm_interpretation_replication_tpu.models import decoder
+    from llm_interpretation_replication_tpu.models.config import DecoderConfig
+
+    cfg = DecoderConfig(
+        vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=1, intermediate_size=64, position_embedding="rotary",
+        max_position_embeddings=64, attention_impl="auto", auto_flash_seq=8,
+    )
+    params = random_decoder_params(cfg, seed=6)
+    rng = np.random.default_rng(12)
+    ids = rng.integers(3, 96, size=(2, 16)).astype(np.int32)  # 16 > threshold
+    mask = np.ones_like(ids)
+    mask[1, 12:] = 0
+    auto = decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask))
+    base_cfg = dataclasses.replace(cfg, attention_impl="xla")
+    base = decoder.forward(params, base_cfg, jnp.asarray(ids), jnp.asarray(mask))
+    valid = mask.astype(bool)
+    np.testing.assert_allclose(
+        np.asarray(auto)[valid], np.asarray(base)[valid], atol=2e-4, rtol=1e-4
+    )
+
+
+def test_greedy_decode_flash_matches_xla():
+    """attention_impl='flash' must also cover greedy_decode's cached prompt
+    forward (dense dispatch on CPU validates the plumbing): tokens identical,
+    scores equal within dispatch tolerance."""
+    import dataclasses
+
+    from helpers import random_decoder_params
+
+    from llm_interpretation_replication_tpu.models import decoder
+    from llm_interpretation_replication_tpu.models.config import DecoderConfig
+
+    cfg = DecoderConfig(
+        vocab_size=96, hidden_size=32, num_layers=2, num_heads=4,
+        num_kv_heads=1, intermediate_size=64, position_embedding="rotary",
+        max_position_embeddings=64,
+    )
+    params = random_decoder_params(cfg, seed=8)
+    rng = np.random.default_rng(13)
+    ids = rng.integers(3, 96, size=(2, 12)).astype(np.int32)
+    mask = np.ones_like(ids)
+    mask[1, 9:] = 0
+    tok_b, sc_b = decoder.greedy_decode(params, cfg, jnp.asarray(ids),
+                                        jnp.asarray(mask), num_steps=4)
+    flash_cfg = dataclasses.replace(cfg, attention_impl="flash")
+    tok_f, sc_f = decoder.greedy_decode(params, flash_cfg, jnp.asarray(ids),
+                                        jnp.asarray(mask), num_steps=4)
+    np.testing.assert_array_equal(np.asarray(tok_f), np.asarray(tok_b))
+    np.testing.assert_allclose(np.asarray(sc_f), np.asarray(sc_b),
+                               atol=2e-4, rtol=1e-4)

@@ -345,3 +345,42 @@ class TestTrainStep:
             state, loss = step(state, jnp.asarray(ids), jnp.asarray(mask))
             losses.append(float(loss))
         assert losses[-1] < losses[0], losses
+
+
+class TestLoaderAttentionImpl:
+    def test_attention_impl_override_and_alibi_degrade(self, tmp_path):
+        """load_model(attention_impl=...) overrides the config; explicit
+        'flash' on an ALiBi family degrades to dense with a warning instead
+        of crashing a mixed-roster sweep."""
+        import warnings
+
+        import torch
+        from transformers import BloomConfig, BloomForCausalLM
+        from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        from llm_interpretation_replication_tpu.runtime.loader import load_model
+
+        neox_dir = tmp_path / "neox"
+        torch.manual_seed(3)
+        GPTNeoXForCausalLM(GPTNeoXConfig(
+            vocab_size=64, hidden_size=16, num_hidden_layers=1,
+            num_attention_heads=2, intermediate_size=32,
+            max_position_embeddings=32,
+        )).save_pretrained(neox_dir, safe_serialization=True)
+        _, cfg, _ = load_model(str(neox_dir), attention_impl="auto")
+        assert cfg.attention_impl == "auto"
+        assert not cfg.use_flash_attention(432)
+        assert cfg.use_flash_attention(2048)
+
+        bloom_dir = tmp_path / "bloom"
+        BloomForCausalLM(BloomConfig(
+            vocab_size=64, hidden_size=16, n_layer=1, n_head=2,
+        )).save_pretrained(bloom_dir, safe_serialization=True)
+        with warnings.catch_warnings(record=True) as caught:
+            warnings.simplefilter("always")
+            _, cfg_b, _ = load_model(str(bloom_dir), attention_impl="flash")
+        assert cfg_b.attention_impl == "xla"
+        assert any("causal+padding" in str(w.message) for w in caught)
+        # 'auto' on ALiBi needs no warning: the resolver just stays dense
+        _, cfg_b2, _ = load_model(str(bloom_dir), attention_impl="auto")
+        assert not cfg_b2.use_flash_attention(4096)
