@@ -112,13 +112,29 @@ def cmd_run_instruct_sweep(args):
     from .sweeps import run_instruct_sweep
 
     rc = _run_config(args)
+    if args.questions_file:
+        # survey-2 leg: the question list extracted from the Qualtrics
+        # headers (extract-survey2-questions), the reference's
+        # compare_instruct_models_survey2.py:298-355 prompts
+        with open(args.questions_file, encoding="utf-8") as f:
+            prompts = [line.strip() for line in f if line.strip()]
+    else:
+        prompts = ordinary_meaning_questions()
+    results_csv = args.results_csv or os.path.join(
+        rc.output_dir, "instruct_model_comparison_results.csv"
+    )
+    if args.results_csv:
+        stem = os.path.splitext(os.path.basename(results_csv))[0]
+        checkpoint = os.path.join(rc.output_dir, f"{stem}_checkpoint.json")
+    else:
+        checkpoint = os.path.join(rc.output_dir, "instruct_sweep_checkpoint.json")
     df = run_instruct_sweep(
         _engine_factory(rc),
-        prompts=ordinary_meaning_questions(),
-        checkpoint_path=os.path.join(rc.output_dir, "instruct_sweep_checkpoint.json"),
-        results_csv=os.path.join(rc.output_dir, "instruct_model_comparison_results.csv"),
+        prompts=prompts,
+        checkpoint_path=checkpoint,
+        results_csv=results_csv,
     )
-    print(f"{len(df)} rows")
+    print(f"{len(df)} rows over {len(prompts)} questions")
 
 
 def cmd_run_closed_source(args):
@@ -583,6 +599,10 @@ def cmd_extract_survey2(args):
     import os
 
     questions, _ = extract_survey2_questions(args.survey_csv)
+    if getattr(args, "ascii_quotes", False):
+        table = str.maketrans({"“": '"', "”": '"',
+                               "‘": "'", "’": "'"})
+        questions = [q.translate(table) for q in questions]
     parent = os.path.dirname(os.path.abspath(args.output))
     os.makedirs(parent, exist_ok=True)
     with open(args.output, "w", encoding="utf-8") as f:
@@ -814,6 +834,16 @@ def main(argv=None):
 
     p = sub.add_parser("run-instruct-sweep", help="instruct-model roster sweep")
     _add_run_config_args(p)
+    p.add_argument("--questions-file", default=None,
+                   help="newline-delimited question list (e.g. the output of "
+                        "extract-survey2-questions) — drives the survey-2 "
+                        "leg (compare_instruct_models_survey2.py:298-355); "
+                        "default: the 50 ordinary-meaning questions")
+    p.add_argument("--results-csv", default=None,
+                   help="output CSV path (e.g. instruct_model_comparison_"
+                        "results_survey2.csv); the checkpoint file is derived "
+                        "from its basename so the 50q and survey-2 sweeps "
+                        "can share an output dir")
     p.set_defaults(fn=cmd_run_instruct_sweep)
 
     p = sub.add_parser("run-closed-source",
@@ -977,6 +1007,13 @@ def main(argv=None):
                        help="extract part-2 questions from Qualtrics headers")
     p.add_argument("--survey-csv", required=True)
     p.add_argument("--output", default="data/question_list_part_2_actual.txt")
+    p.add_argument("--ascii-quotes", action="store_true",
+                   help="normalize the 7 curly-quoted Qualtrics headers to "
+                        "straight quotes — the form the reference sweep "
+                        "actually ran (its hardcoded prompts list, "
+                        "compare_instruct_models_survey2.py:298-355, is a "
+                        "straight-quote transcription of this extractor's "
+                        "output)")
     p.set_defaults(fn=cmd_extract_survey2)
 
     p = sub.add_parser("sample-statements",

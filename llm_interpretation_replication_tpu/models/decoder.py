@@ -192,6 +192,45 @@ def grouped_dot_product_attention(q, k, v, bias):
     return out.reshape(b, s, n, d)
 
 
+def _grouped_scores(q, k):
+    """q [B,S,N,D] × unrepeated k [B,T,G,D] → scores [B,G,N/G,S,T]."""
+    b, s, n, d = q.shape
+    g = k.shape[2]
+    qg = q.reshape(b, s, g, n // g, d)
+    return jnp.einsum("bsghd,btgd->bghst", qg, k) / jnp.sqrt(d).astype(q.dtype)
+
+
+def _bias_grouped(bias, b, n, g, s, t):
+    bias = jnp.broadcast_to(bias, (b, bias.shape[1], s, t))
+    if bias.shape[1] == n:
+        return bias.reshape(b, g, n // g, s, t)
+    return bias[:, :, None]                        # head-agnostic [B,1,1,S,T]
+
+
+def grouped_attention_two_block(q, kp, vp, bias_p, kt, vt, bias_t):
+    """Attention over TWO K/V blocks with one joint softmax: a large
+    read-only block (the prompt KV cache) and a small mutable tail (this
+    chunk's generated tokens).  Splitting the softmax flash-attention-style
+    (shared running max + denominator) means decode never concatenates new
+    K/V onto the cached block, so the big block stays loop-invariant across
+    the decode scan — no per-step cache copy, scatter, or relayout."""
+    b, s, n, d = q.shape
+    g = kp.shape[2]
+    sp = _grouped_scores(q, kp).astype(jnp.float32) + _bias_grouped(
+        bias_p, b, n, g, s, kp.shape[1]
+    )
+    st = _grouped_scores(q, kt).astype(jnp.float32) + _bias_grouped(
+        bias_t, b, n, g, s, kt.shape[1]
+    )
+    m = jnp.maximum(sp.max(-1, keepdims=True), st.max(-1, keepdims=True))
+    ep = jnp.exp(sp - m)
+    et = jnp.exp(st - m)
+    denom = ep.sum(-1, keepdims=True) + et.sum(-1, keepdims=True)
+    op = jnp.einsum("bghst,btgd->bsghd", (ep / denom).astype(q.dtype), vp)
+    ot = jnp.einsum("bghst,btgd->bsghd", (et / denom).astype(q.dtype), vt)
+    return (op + ot).reshape(b, s, n, d)
+
+
 def make_attention_bias(
     cfg: DecoderConfig,
     q_positions,      # [B, S] absolute position of each query token
@@ -216,15 +255,30 @@ def make_attention_bias(
 
 
 class KVCache(NamedTuple):
-    k: jnp.ndarray  # [L, B, T, Nkv, D]
-    v: jnp.ndarray  # [L, B, T, Nkv, D]
-    length: jnp.ndarray  # [] int32 — slots filled so far
+    """Read-only K/V block for decode.
+
+    ``positions``/``valid`` make the slot→position mapping explicit so the
+    cache can hold ragged content: prompt slots (slot index == position for
+    right-padded rows) and, after a decode chunk, per-row generated slots at
+    ragged positions.  Decode NEVER writes into these arrays — new K/V
+    accumulate in a small per-chunk tail and are concatenated once per chunk
+    (decode_steps) — so XLA keeps one loop-invariant buffer instead of
+    round-tripping a ~700 MB cache through every step (the scatter-based
+    cache cost a full-cache relayout loop, ~150-310 ms/batch, on v5e)."""
+    k: jnp.ndarray          # [L, B, T, Nkv, D]
+    v: jnp.ndarray          # [L, B, T, Nkv, D]
+    positions: jnp.ndarray  # [B, T] int32 absolute position of each slot
+    valid: jnp.ndarray      # [B, T] bool: slot holds a real token
+    length: jnp.ndarray     # [] int32 — slots filled so far
 
 
 def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
     shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
     return KVCache(
-        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype), length=jnp.zeros((), jnp.int32)
+        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
+        positions=jnp.broadcast_to(jnp.arange(max_len)[None], (batch, max_len)),
+        valid=jnp.zeros((batch, max_len), bool),
+        length=jnp.zeros((), jnp.int32),
     )
 
 
@@ -232,10 +286,13 @@ def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=jnp.bfloat16)
 # Block + full forward
 # ---------------------------------------------------------------------------
 
-def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None,
+def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_len=None,
           flash_lengths=None):
-    """One attention sub-block.  When ``cache_kv`` is given, new K/V are written
-    at ``cache_index`` and attention runs over the whole cache.  When
+    """One attention sub-block.  When ``cache_len`` is given, the prompt K/V
+    are zero-padded out to that many slots and returned as this layer's KV
+    cache (a pad, NOT a dynamic-update-slice into a zeros buffer — the DUS
+    form made XLA pick a T-minor cache layout that cost a full-cache relayout
+    loop, ~309 ms at sweep shapes, before every decode).  When
     ``flash_lengths`` is given (no-cache path only), the Pallas flash kernel
     replaces the dense bias-based attention."""
     b, s, h = x.shape
@@ -254,16 +311,14 @@ def _attn(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=N
         rd = int(cfg.rotary_pct * d) // 2 * 2
         q = apply_rotary(q, sin, cos, rd, cfg.rotary_style)
         k = apply_rotary(k, sin, cos, rd, cfg.rotary_style)
-    if cache_kv is not None:
-        ck, cv = cache_kv
-        ck = lax.dynamic_update_slice(ck, k.astype(ck.dtype), (0, cache_index, 0, 0))
-        cv = lax.dynamic_update_slice(cv, v.astype(cv.dtype), (0, cache_index, 0, 0))
-        new_cache = (ck, cv)
+    if cache_len is not None:
+        pad = ((0, 0), (0, cache_len - s), (0, 0), (0, 0))
+        new_cache = (jnp.pad(k, pad), jnp.pad(v, pad))
         if flash_lengths is None:
             # dense path attends over the whole (zero-padded) cache; the
             # flash path below attends over the prompt K/V directly —
             # equivalent, since unwritten cache slots are masked anyway
-            k, v = ck.astype(x.dtype), cv.astype(x.dtype)
+            k, v = new_cache
     else:
         new_cache = None
     if flash_lengths is not None:
@@ -305,11 +360,11 @@ def _mlp(cfg: DecoderConfig, lp, x):
     return out
 
 
-def _block(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_kv=None, cache_index=None,
+def _block(cfg: DecoderConfig, lp, x, sin_cos, bias, cache_len=None,
            flash_lengths=None):
     ln1_out = _norm(cfg, x, lp["ln1"])
-    attn_out, new_cache = _attn(cfg, lp, ln1_out, sin_cos, bias, cache_kv,
-                                cache_index, flash_lengths)
+    attn_out, new_cache = _attn(cfg, lp, ln1_out, sin_cos, bias, cache_len,
+                                flash_lengths)
     if cfg.parallel_residual:
         # NeoX/Falcon: mlp reads the same (or its own) LN of the block input.
         mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
@@ -338,7 +393,15 @@ def _unembed(cfg: DecoderConfig, params, x):
     table = params.get("lm_head")
     if table is None:
         table = params["embed"]["tokens"].T
-    logits = (x.astype(jnp.float32) @ table.astype(jnp.float32)) * cfg.logit_scale
+    # fp32 ACCUMULATION without fp32 INPUT upcasts: upcasting a bf16 table
+    # materializes a 1.2 GB fp32 copy (65k-vocab 7B) on every decode step,
+    # and fp32×fp32 MXU matmuls are multi-pass; bf16 products accumulated in
+    # fp32 are bit-identical to the products of the upcast values, so
+    # preferred_element_type gives the same logits modulo summation order.
+    logits = lax.dot_general(
+        x, table, (((x.ndim - 1,), (0,)), ((), ())),
+        preferred_element_type=jnp.float32,
+    ) * cfg.logit_scale
     bias = params.get("lm_head_bias")          # GPT-J ships an lm_head bias
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
@@ -360,7 +423,7 @@ def run_layers(cfg: DecoderConfig, layers, x, positions, attention_mask):
     flash_lengths = jnp.sum(attention_mask, axis=-1).astype(jnp.int32) if use_flash else None
 
     def body(h, lp):
-        h, _ = _block(cfg, lp, h, sin_cos, bias, None, None, flash_lengths)
+        h, _ = _block(cfg, lp, h, sin_cos, bias, None, flash_lengths)
         return h, None
 
     out, _ = lax.scan(body, x, layers)
@@ -385,7 +448,6 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
         sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, params["embed"]["tokens"].dtype)
 
     t = cache_len
-    cache_dtype = params["embed"]["tokens"].dtype
     # The prompt forward honors flash/auto here too — the dense cached path
     # materializes BOTH an S×T bias and S×T scores, exactly the HBM blowup
     # 'auto' exists to avoid on long prompts.  Decode steps (S=1) stay dense.
@@ -403,14 +465,17 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
         bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
 
     def body(h, lp):
-        zeros = jnp.zeros((b, t, cfg.num_kv_heads, cfg.head_dim), cache_dtype)
-        h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, (zeros, zeros), 0,
-                             flash_lengths)
+        h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, t, flash_lengths)
         return h, (ck, cv)
 
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     lengths = jnp.sum(attention_mask, axis=-1)  # [B] per-row prompt length
-    cache = KVCache(k=ks, v=vs, length=jnp.max(lengths).astype(jnp.int32))
+    cache = KVCache(
+        k=ks, v=vs,
+        positions=jnp.broadcast_to(jnp.arange(t)[None, :], (b, t)),
+        valid=jnp.pad(mask, ((0, 0), (0, t - s))),
+        length=jnp.max(lengths).astype(jnp.int32),
+    )
     return x, cache
 
 
@@ -447,6 +512,133 @@ def forward_last_logits(params, cfg: DecoderConfig, token_ids, attention_mask):
     return _unembed(cfg, params, last)[:, 0, :]
 
 
+def _prefill_impl(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len):
+    """Prompt forward with KV cache; logits at each row's last real token."""
+    x, cache = _trunk(params, cfg, token_ids, attention_mask, cache_len)
+    lengths = jnp.sum(attention_mask, axis=-1)  # [B]
+    # Hidden state at the last real prompt token predicts the first generated
+    # token; unembed only there (full [B,S,V] fp32 logits would be ~1 GB for
+    # 7B-vocab models at sweep batch sizes).
+    last_h = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
+    last = _unembed(cfg, params, last_h)[:, 0, :]
+    return last, cache
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "cache_len"))
+def prefill(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len: int):
+    """Phase-1 of the two-phase sweep: one prompt forward that returns BOTH the
+    position-0 logits (enough to settle every row whose top-k already contains
+    a target — the reference reads position 0 for those rows,
+    run_base_vs_instruct_100q.py:349-364) AND the KV cache, so rows that do
+    need look-ahead continue via :func:`decode_steps` without re-running the
+    prompt.
+
+    Returns (last_logits [B, V] fp32, KVCache padded to ``cache_len``).
+    """
+    return _prefill_impl(params, cfg, token_ids, attention_mask, cache_len)
+
+
+def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
+                       offset, num_steps, eos_token_id, done, with_scores):
+    b = prev_logits.shape[0]
+    n = num_steps
+    cdt = cache.k.dtype
+    tail_shape = (cfg.num_layers, b, n, cfg.num_kv_heads, cfg.head_dim)
+    tail_k0 = jnp.zeros(tail_shape, cdt)
+    tail_v0 = jnp.zeros(tail_shape, cdt)
+    # Tail slot j (for every row) holds the step-j token, generated at
+    # per-row position lengths + offset + j.
+    tail_positions = lengths[:, None] + offset + jnp.arange(n)[None, :]  # [B,n]
+    step_idx = jnp.arange(n)
+
+    def step(carry, i):
+        tail_k, tail_v, prev_logits, done = carry
+        next_tok = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)  # [B]
+        if eos_token_id is not None:
+            next_tok = jnp.where(done, eos_token_id, next_tok)
+        pos = lengths + offset + i                                      # [B]
+        q_pos = pos[:, None]                                            # [B,1]
+        bias_p = make_attention_bias(cfg, q_pos, cache.positions, cache.valid)
+        tail_valid = jnp.broadcast_to(step_idx[None, :] <= i, (b, n))
+        bias_t = make_attention_bias(cfg, q_pos, tail_positions, tail_valid)
+        sin_cos = None
+        if cfg.position_embedding == "rotary":
+            rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+            sin_cos = rotary_embedding(q_pos, rd, cfg.rope_theta, cdt)
+        x = _embed(cfg, params, next_tok[:, None], q_pos)
+
+        def body(carry_h, xs):
+            h = carry_h
+            lp, kp_l, vp_l, tk_l, tv_l = xs
+            h, (tk_l, tv_l) = _block_decode(
+                cfg, lp, h, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i
+            )
+            return h, (tk_l, tv_l)
+
+        x, (tail_k, tail_v) = lax.scan(
+            body, x, (params["layers"], cache.k, cache.v, tail_k, tail_v)
+        )
+        step_logits = _unembed(cfg, params, x)[:, 0, :]                 # [B,V]
+        if eos_token_id is not None:
+            done = done | (next_tok == eos_token_id)
+        out = (next_tok, prev_logits) if with_scores else next_tok
+        return (tail_k, tail_v, step_logits, done), out
+
+    (tail_k, tail_v, last_logits, done), out = lax.scan(
+        step, (tail_k0, tail_v0, prev_logits, done), jnp.arange(n)
+    )
+    # One concat per CHUNK (not per step) folds the tail into the read-only
+    # block for the next chunk; callers that ignore the returned cache (the
+    # scored look-ahead subset) get it DCE'd by XLA.
+    cache = KVCache(
+        k=jnp.concatenate([cache.k, tail_k], axis=2),
+        v=jnp.concatenate([cache.v, tail_v], axis=2),
+        positions=jnp.concatenate([cache.positions, tail_positions], axis=1),
+        valid=jnp.concatenate([cache.valid, jnp.ones((b, n), bool)], axis=1),
+        length=cache.length + n,
+    )
+    if with_scores:
+        tokens, step_scores = out
+        scores = jnp.swapaxes(step_scores, 0, 1)
+    else:
+        tokens, scores = out, None
+    return jnp.swapaxes(tokens, 0, 1), scores, cache, last_logits, done
+
+
+@functools.partial(jax.jit, static_argnames=("cfg", "num_steps", "with_scores"))
+def decode_steps(
+    params,
+    cfg: DecoderConfig,
+    cache: KVCache,     # from :func:`prefill` or a previous decode_steps call
+    prev_logits,        # [B, V] fp32 logits predicting the next token
+    lengths,            # [B] prompt lengths (real tokens per row)
+    offset,             # [] int32 — tokens already generated before this call
+    num_steps: int,
+    eos_token_id: Optional[int] = None,
+    done=None,          # [B] bool — rows already finished (EOS seen)
+    with_scores: bool = True,
+):
+    """Continue a batched greedy decode from an existing KV cache.
+
+    Chunked driver behind both halves of the reference's ``generate``
+    semantics: the scores chunk (MAX_LOOK_AHEAD=10 positions feeding the
+    yes/no scan) and the score-free completion chunks up to
+    ``max_new_tokens=50`` (run_base_vs_instruct_100q.py:337-346) — the host
+    stops between chunks once every row has emitted EOS, the batched
+    equivalent of HF generate's per-sequence EOS stop.  ``with_scores=False``
+    skips stacking the [B, n, V] fp32 score buffer (~500 MB at sweep shapes),
+    which completion chunks never need.
+
+    Returns (tokens [B, n], scores [B, n, V] | None, cache, last_logits, done);
+    ``scores[:, 0]`` is exactly ``prev_logits``, so a chunk started from
+    :func:`prefill`'s output reproduces the reference's position-0 read.
+    """
+    if done is None:
+        done = jnp.zeros((prev_logits.shape[0],), bool)
+    return _decode_steps_impl(params, cfg, cache, prev_logits, lengths,
+                              offset, num_steps, eos_token_id, done, with_scores)
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "num_steps"))
 def greedy_decode(
     params,
@@ -465,68 +657,32 @@ def greedy_decode(
         logits  [B, num_steps, V] fp32 scores at each generated position
     """
     b, s = token_ids.shape
-    total = s + num_steps
-    x, cache = _trunk(params, cfg, token_ids, attention_mask, cache_len=total)
+    last, cache = _prefill_impl(params, cfg, token_ids, attention_mask, s)
     lengths = jnp.sum(attention_mask, axis=-1)  # [B]
-    # Hidden state at the last real prompt token predicts the first generated
-    # token; unembed only there (full [B,S,V] fp32 logits would be ~1 GB for
-    # 7B-vocab models at sweep batch sizes).
-    last_h = jnp.take_along_axis(x, (lengths - 1)[:, None, None], axis=1)
-    last = _unembed(cfg, params, last_h)[:, 0, :]
-
-    kv_positions = jnp.broadcast_to(jnp.arange(total)[None, :], (b, total))
-
-    def step(carry, i):
-        cache, prev_logits, done = carry
-        next_tok = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)  # [B]
-        if eos_token_id is not None:
-            next_tok = jnp.where(done, eos_token_id, next_tok)
-        q_pos = (lengths + i)[:, None]                                  # [B,1]
-        kv_valid = kv_positions < (lengths + i + 1)[:, None]
-        bias = make_attention_bias(cfg, q_pos, kv_positions, kv_valid)
-        sin_cos = None
-        if cfg.position_embedding == "rotary":
-            rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
-            sin_cos = rotary_embedding(q_pos, rd, cfg.rope_theta, cache.k.dtype)
-        x = _embed(cfg, params, next_tok[:, None], q_pos)
-
-        def body(carry_h, xs):
-            h = carry_h
-            lp, ck, cv = xs
-            # Rows have ragged lengths; each row writes its K/V at its own
-            # position via per-row dynamic updates expressed as a masked
-            # scatter over the time axis.
-            h, (ck, cv) = _block_ragged(cfg, lp, h, sin_cos, bias, (ck, cv), lengths + i)
-            return h, (ck, cv)
-
-        x, (ks, vs) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
-        cache = KVCache(k=ks, v=vs, length=cache.length + 1)
-        step_logits = _unembed(cfg, params, x)[:, 0, :]                 # [B,V]
-        if eos_token_id is not None:
-            done = done | (next_tok == eos_token_id)
-        return (cache, step_logits, done), (next_tok, prev_logits)
-
-    done0 = jnp.zeros((b,), bool)
-    (_, _, _), (tokens, step_scores) = lax.scan(
-        step, (cache, last, done0), jnp.arange(num_steps)
+    tokens, scores, _, _, _ = _decode_steps_impl(
+        params, cfg, cache, last, lengths, jnp.int32(0), num_steps,
+        eos_token_id, jnp.zeros((b,), bool), True,
     )
-    return jnp.swapaxes(tokens, 0, 1), jnp.swapaxes(step_scores, 0, 1)
+    return tokens, scores
 
 
-def _block_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
-    """_block variant for decode: write each row's K/V at its own position."""
+def _block_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i):
+    """_block variant for decode: the layer's new K/V land in the small tail
+    buffer; the prompt cache slice (kp_l/vp_l) is read-only."""
     ln1_out = _norm(cfg, x, lp["ln1"])
-    attn_out, new_cache = _attn_ragged(cfg, lp, ln1_out, sin_cos, bias, cache_kv, write_pos)
+    attn_out, new_tail = _attn_decode(
+        cfg, lp, ln1_out, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i
+    )
     if cfg.parallel_residual:
         mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
         x = x + attn_out + _mlp(cfg, lp, mlp_in)
     else:
         x = x + attn_out
         x = x + _mlp(cfg, lp, _norm(cfg, x, lp["ln2"]))
-    return x, new_cache
+    return x, new_tail
 
 
-def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
+def _attn_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i):
     b, s, h = x.shape  # s == 1 during decode
     n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     ap = lp["attn"]
@@ -543,17 +699,15 @@ def _attn_ragged(cfg, lp, x, sin_cos, bias, cache_kv, write_pos):
         rd = int(cfg.rotary_pct * d) // 2 * 2
         q = apply_rotary(q, sin, cos, rd, cfg.rotary_style)
         k = apply_rotary(k, sin, cos, rd, cfg.rotary_style)
-    ck, cv = cache_kv
-    t = ck.shape[1]
-    onehot = (jnp.arange(t)[None, :] == write_pos[:, None]).astype(ck.dtype)  # [B,T]
-    ck = ck * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * k.astype(ck.dtype)
-    cv = cv * (1 - onehot)[:, :, None, None] + onehot[:, :, None, None] * v.astype(cv.dtype)
-    # grouped attention on the unrepeated cache: at S=1 a [B,T,N,D] repeat
-    # would dwarf the step's real work (770 MB/layer for Falcon's 71:1 MQA)
-    out = grouped_dot_product_attention(
-        q, ck.astype(x.dtype), cv.astype(x.dtype), bias
+    # This step's K/V go into tail slot i — a [B, 1, G, D] dynamic-update-
+    # slice into the ~5 MB tail, not a scatter into the ~700 MB prompt cache.
+    tk_l = lax.dynamic_update_slice(tk_l, k.astype(tk_l.dtype), (0, i, 0, 0))
+    tv_l = lax.dynamic_update_slice(tv_l, v.astype(tv_l.dtype), (0, i, 0, 0))
+    out = grouped_attention_two_block(
+        q, kp_l.astype(x.dtype), vp_l.astype(x.dtype), bias_p,
+        tk_l.astype(x.dtype), tv_l.astype(x.dtype), bias_t,
     )
     out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
     if "bo" in ap:
         out = out + ap["bo"]
-    return out, (ck, cv)
+    return out, (tk_l, tv_l)

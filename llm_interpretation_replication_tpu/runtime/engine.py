@@ -29,9 +29,16 @@ from . import batching
 @dataclasses.dataclass
 class EngineConfig:
     batch_size: int = 32
-    max_new_tokens: int = 50        # reference generate cap
+    max_new_tokens: int = 50        # reference generate cap — completion
+                                    # chunks decode up to this many tokens
     score_steps: int = 10           # MAX_LOOK_AHEAD — steps that need scores
     max_look_ahead: int = 10
+    scan_chunk: int = 5             # scored-decode chunk: the subset scan
+                                    # stops early once every undecided row has
+                                    # its answer (rows hit at positions 1-3 in
+                                    # practice, so the 10-step tail is usually
+                                    # never decoded — semantics unchanged, the
+                                    # reference stops reading at the first hit)
     top_k: int = 5
     buckets: Sequence[int] = batching.DEFAULT_BUCKETS
     decode_completions: bool = True
@@ -107,25 +114,256 @@ class ScoringEngine:
 
         Returns one dict per prompt: yes_prob, no_prob, relative_prob,
         odds_ratio, completion, success — the ``get_yes_no_logprobs``
-        contract (run_base_vs_instruct_100q.py:376-382)."""
+        contract (run_base_vs_instruct_100q.py:376-382).
+
+        Decoder-only models run TWO-PHASE: one prompt forward (prefill)
+        settles every row whose position-0 top-k already contains a target —
+        the reference reads position 0 for those rows and never inspects
+        positions 1..9 (run_base_vs_instruct_100q.py:349-364) — and only the
+        undecided rows continue into the 10-step scored decode, reusing the
+        prefill's KV cache.  When ``decode_completions`` is on, all rows also
+        greedy-generate up to ``max_new_tokens=50`` score-free tokens in
+        EOS-early-exit chunks so the ``completion`` column matches the
+        reference's ``generate(max_new_tokens=50)`` text (ibid.:337-346,379).
+        """
+        if self.is_encoder_decoder:
+            return self._score_encdec(prompts, targets, with_confidence)
+        return self._score_decoder(prompts, targets, with_confidence)
+
+    def _gen_plan(self):
+        """(scan_steps, total_new_tokens) for the current engine config."""
+        ecfg = self.ecfg
+        steps = max(ecfg.score_steps, ecfg.max_look_ahead)
+        total = max(steps, ecfg.max_new_tokens) if ecfg.decode_completions else steps
+        return steps, total
+
+    def _completion_text(self, row_tokens, eos_id) -> str:
+        """Decode one row's generated tokens the way the reference records
+        ``completion``: cut at the first EOS (HF generate stops there),
+        skip specials, strip, truncate (run_base_vs_instruct_100q.py:366-379).
+        """
+        ids = []
+        for t in row_tokens:
+            t = int(t)
+            if eos_id is not None and t == eos_id:
+                break
+            ids.append(t)
+        return self.tokenizer.decode(ids, skip_special_tokens=True).strip()[
+            : self.ecfg.completion_chars
+        ]
+
+    def _score_decoder(self, prompts, targets, with_confidence) -> List[Dict]:
         ecfg = self.ecfg
         yes_id, no_id = self.target_ids(targets)[:2]
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
-        steps = max(ecfg.score_steps, ecfg.max_look_ahead)
+        steps, gen_total = self._gen_plan()
 
         def launch(batch):
             ids = self._put(batch.token_ids)
             mask = self._put(batch.attention_mask)
-            decode = t5mod.greedy_decode if self.is_encoder_decoder else dmod.greedy_decode
-            tokens, scores = decode(self.params, self.cfg, ids, mask, num_steps=steps)
+            # cache_len == prompt length: generated K/V are concatenated as
+            # per-chunk tails by decode_steps, so pre-padding slots for them
+            # would only add permanently-invalid slots to every attention
+            last, cache = dmod.prefill(
+                self.params, self.cfg, ids, mask, cache_len=batch.bucket_len,
+            )
+            lengths = jnp.sum(mask, axis=-1)
+            scan0 = yn.first_token_scan(last, yes_id, no_id, top_k=ecfg.top_k)
+            return last, cache, lengths, scan0
+
+        def consume(batch, out):
+            last, cache, lengths, scan0 = out
+            yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+            valid = batch.indices >= 0
+            undecided = np.flatnonzero(~hit0 & valid)
+            if with_confidence:
+                undecided = np.flatnonzero(valid)  # every row needs scores
+            need_scores = undecided.size > 0
+
+            tokens_np = None      # [B, n_generated] when completions decoded
+            scores_np = None      # [B|m, steps, V] fp32 when confidence needs it
+            res_np = None         # scan over positions 0..steps-1
+            sub_pos = None        # batch row -> row in the subset arrays
+
+            if ecfg.decode_completions:
+                # Completion chunks: every row generates (the reference's
+                # generate does, regardless of where the scan hit); the first
+                # chunk doubles as the scored look-ahead when any row needs it.
+                prev, done, offset = last, None, 0
+                chunk_toks, scores_dev = [], None
+                while offset < gen_total:
+                    n = min(steps, gen_total - offset)
+                    ws = offset == 0 and need_scores
+                    toks, sc, cache, prev, done = dmod.decode_steps(
+                        self.params, self.cfg, cache, prev, lengths,
+                        np.int32(offset), n, eos_id, done, with_scores=ws,
+                    )
+                    if ws:
+                        scores_dev = sc
+                    chunk_toks.append(toks)
+                    offset += n
+                    if (eos_id is not None and offset < gen_total
+                            and bool(np.asarray(done).all())):
+                        break  # every row has emitted EOS — HF generate stops
+                tokens_np = np.concatenate(
+                    [np.asarray(t) for t in chunk_toks], axis=1
+                )
+                if need_scores:
+                    res = yn.yes_no_from_scores(
+                        scores_dev[:, :steps], yes_id, no_id,
+                        max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                        valid_steps=yn.steps_until_eos(
+                            chunk_toks[0][:, :steps], eos_id
+                        ),
+                    )
+                    res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+                    if with_confidence:
+                        scores_np = np.asarray(scores_dev)
+            elif need_scores:
+                # No completions wanted: scored decode only, and only for the
+                # undecided rows — gathered out of the prefill cache so the
+                # prompt forward never re-runs (when most of the batch is
+                # undecided the gather-copy is pointless; decode in place).
+                m = _pad_pow2(undecided.size, hit0.shape[0])
+                if m == hit0.shape[0]:
+                    sub_cache, last_s, len_s, sub_pos = cache, last, lengths, None
+                else:
+                    idx = np.zeros((m,), np.int32)
+                    idx[: undecided.size] = undecided
+                    sub_cache, last_s, len_s = _gather_rows(
+                        cache, last, lengths, jnp.asarray(idx)
+                    )
+                    sub_pos = {int(r): j for j, r in enumerate(undecided)}
+                sc, toks_s = self._scan_decode_chunked(
+                    sub_cache, last_s, len_s, steps, eos_id, yes_id, no_id,
+                    min_steps=3 if with_confidence else 0,
+                    n_real=None if sub_pos is None else undecided.size,
+                )
+                res = yn.yes_no_from_scores(
+                    sc, yes_id, no_id,
+                    max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                    valid_steps=yn.steps_until_eos(toks_s, eos_id),
+                )
+                res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+                if with_confidence:
+                    scores_np = np.asarray(sc)
+
+            for r, orig in enumerate(batch.indices):
+                if orig < 0:
+                    continue
+                j = r if sub_pos is None else sub_pos.get(r)
+                if hit0[r] and not with_confidence:
+                    vals = (yes0[r], no0[r], rel0[r], odds0[r], True)
+                else:
+                    vals = (
+                        res_np["yes_prob"][j], res_np["no_prob"][j],
+                        res_np["relative_prob"][j], res_np["odds_ratio"][j],
+                        res_np["found"][j],
+                    )
+                completion = ""
+                if ecfg.decode_completions:
+                    completion = self._completion_text(tokens_np[r], eos_id)
+                row = {
+                    "yes_prob": float(vals[0]),
+                    "no_prob": float(vals[1]),
+                    "relative_prob": float(vals[2]),
+                    "odds_ratio": float(vals[3]),
+                    "scan_found": bool(vals[4]),
+                    "completion": completion,
+                    "success": True,
+                }
+                if with_confidence:
+                    k = r if sub_pos is None else sub_pos[r]
+                    cands = top_candidates_from_scores(
+                        scores_np[k], self.tokenizer, num_positions=3, top_k=19
+                    )
+                    row["weighted_confidence"] = weighted_confidence_digits(cands)
+                results[int(orig)] = row
+
+        self._run_pipelined(
+            batching.batches_for_prompts(
+                encoded, ecfg.batch_size, ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+            ),
+            launch, consume,
+        )
+        return [r if r is not None else _error_row("missing") for r in results]
+
+    def _scan_decode_chunked(self, sub_cache, last_s, len_s, steps, eos_id,
+                             yes_id, no_id, min_steps: int = 0,
+                             n_real: Optional[int] = None):
+        """Scored look-ahead decode in ``scan_chunk``-step chunks with early
+        exit: once every row has either a top-k hit or an EOS-terminated
+        score list, later positions can never be read by the reference's scan
+        (it stops at the first hit, run_base_vs_instruct_100q.py:349-358), so
+        decoding them is pure waste.  In real sweeps undecided rows usually
+        hit at positions 1-3, so the 10-step tail is rarely decoded.
+
+        ``n_real``: rows past this index are padding (duplicates of batch
+        row 0) and must not hold the exit open.  Returns (scores [m, P, V],
+        tokens [m, P]) with P <= steps."""
+        ecfg = self.ecfg
+        chunk = max(1, ecfg.scan_chunk)
+        sc_parts, tok_parts = [], []
+        cur_cache, prev, done = sub_cache, last_s, None
+        offset = 0
+        while offset < steps:
+            n = min(chunk, steps - offset)
+            toks_c, sc_c, cur_cache, prev, done = dmod.decode_steps(
+                self.params, self.cfg, cur_cache, prev, len_s,
+                np.int32(offset), n, eos_id, done, with_scores=True,
+            )
+            sc_parts.append(sc_c)
+            tok_parts.append(toks_c)
+            offset += n
+            if offset >= steps:
+                break
+            toks_sofar = jnp.concatenate(tok_parts, axis=1)
+            part = yn.yes_no_from_scores(
+                jnp.concatenate(sc_parts, axis=1), yes_id, no_id,
+                max_look_ahead=offset, top_k=ecfg.top_k,
+                valid_steps=yn.steps_until_eos(toks_sofar, eos_id),
+            )
+            # resolved = scan hit so far, or EOS actually emitted (the `done`
+            # mask from decode_steps) — no later position can change the row
+            resolved = np.asarray(part.found) | np.asarray(done)
+            if n_real is not None:
+                resolved = resolved[:n_real]
+            if offset >= min_steps and bool(resolved.all()):
+                break
+        return (jnp.concatenate(sc_parts, axis=1),
+                jnp.concatenate(tok_parts, axis=1))
+
+    def _score_encdec(self, prompts, targets, with_confidence) -> List[Dict]:
+        """T5 path: one scanned decode per batch (the decoder re-runs its
+        short prefix each step — models/t5.py greedy_decode), generating
+        ``max_new_tokens`` when completions are recorded and scanning only
+        the first MAX_LOOK_AHEAD positions, like the reference's
+        encoder-decoder branch (run_base_vs_instruct_100q.py:291-326)."""
+        ecfg = self.ecfg
+        yes_id, no_id = self.target_ids(targets)[:2]
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
+        encoded = batching.encode_prompts(self.tokenizer, prompts)
+        results: List[Optional[Dict]] = [None] * len(prompts)
+        steps, gen_total = self._gen_plan()
+
+        def launch(batch):
+            ids = self._put(batch.token_ids)
+            mask = self._put(batch.attention_mask)
+            tokens, scores = t5mod.greedy_decode(
+                self.params, self.cfg, ids, mask, num_steps=gen_total,
+                eos_token_id=eos_id,
+            )
             res = yn.yes_no_from_scores(
-                scores, yes_id, no_id,
+                scores[:, :steps], yes_id, no_id,
                 max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                valid_steps=yn.steps_until_eos(tokens[:, :steps], eos_id),
             )
             # Only pin the [B, steps, V] scores buffer in the pending queue
             # when the confidence leg needs it — ~250 MB/batch at sweep sizes.
-            return tokens, scores if with_confidence else None, res
+            return tokens, scores[:, :steps] if with_confidence else None, res
 
         def consume(batch, out):
             tokens, scores, res = out
@@ -141,9 +379,7 @@ class ScoringEngine:
                     continue
                 completion = ""
                 if ecfg.decode_completions:
-                    completion = self.tokenizer.decode(
-                        [int(t) for t in tokens_np[r]], skip_special_tokens=True
-                    ).strip()[: ecfg.completion_chars]
+                    completion = self._completion_text(tokens_np[r], eos_id)
                 row = {
                     "yes_prob": float(yes_np[r]),
                     "no_prob": float(no_np[r]),
@@ -203,6 +439,29 @@ class ScoringEngine:
             launch, consume,
         )
         return out
+
+
+def _pad_pow2(n: int, cap: int) -> int:
+    """Pad a phase-2 subset to a small fixed menu of sizes (powers of two,
+    capped at the batch size) so XLA compiles at most log2(B) decode shapes."""
+    m = 8
+    while m < n:
+        m *= 2
+    return min(m, cap)
+
+
+@jax.jit
+def _gather_rows(cache, last, lengths, idx):
+    """Gather the phase-2 subset's rows out of the prefill outputs: cache
+    k/v are [L, B, T, G, D] (batch axis 1); everything else batch-leading."""
+    from ..models.decoder import KVCache
+
+    sub = KVCache(
+        k=cache.k[:, idx], v=cache.v[:, idx],
+        positions=cache.positions[idx], valid=cache.valid[idx],
+        length=cache.length,
+    )
+    return sub, last[idx], lengths[idx]
 
 
 def _error_row(msg: str) -> Dict:

@@ -16,7 +16,9 @@ from .prompts import (
 )
 from .yes_no import (
     YesNoResult,
+    first_token_scan,
     relative_prob_first_token,
+    steps_until_eos,
     target_token_ids,
     yes_no_from_scores,
 )
@@ -35,7 +37,9 @@ __all__ = [
     "format_instruct_prompt",
     "format_prompt",
     "YesNoResult",
+    "first_token_scan",
     "relative_prob_first_token",
+    "steps_until_eos",
     "target_token_ids",
     "yes_no_from_scores",
 ]

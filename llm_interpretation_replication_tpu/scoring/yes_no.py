@@ -36,6 +36,10 @@ def yes_no_from_scores(
     no_id: jnp.ndarray,
     max_look_ahead: int = 10,
     top_k: int = 5,
+    valid_steps=None,      # [B] int: scan-visible positions per row — HF
+                           # generate stops at EOS, so the reference's scores
+                           # list ends at the eos-emitting position (incl.);
+                           # later positions must not produce hits
 ) -> YesNoResult:
     b, p, v = scores.shape
     probs = jax.nn.softmax(scores.astype(jnp.float32), axis=-1)
@@ -48,6 +52,8 @@ def yes_no_from_scores(
     kth = jax.lax.top_k(probs, top_k)[0][..., -1]                               # [B,P]
     look = min(max_look_ahead, p)
     hit = ((p_yes >= kth) | (p_no >= kth))[:, :look]
+    if valid_steps is not None:
+        hit = hit & (jnp.arange(look)[None, :] < valid_steps[:, None])
     found = jnp.any(hit, axis=1)
     first = jnp.argmax(hit, axis=1).astype(jnp.int32)
     sel = jnp.where(found, first, 0)
@@ -57,6 +63,38 @@ def yes_no_from_scores(
     relative = jnp.where(total > 0, yes / jnp.where(total > 0, total, 1.0), 0.5)
     odds = jnp.where(no > 0, yes / jnp.where(no > 0, no, 1.0), jnp.inf)
     return YesNoResult(yes, no, relative, odds, found, sel)
+
+
+def steps_until_eos(tokens: jnp.ndarray, eos_id) -> jnp.ndarray:
+    """[B, P] greedy tokens → [B] scan-visible position count.
+
+    HF ``generate`` appends a score entry, then samples; emitting EOS stops
+    the loop — so the reference's scores list runs up to AND INCLUDING the
+    eos-emitting position (run_base_vs_instruct_100q.py:337-358).  Batched
+    decode keeps generating forced EOS past that point; those positions do
+    not exist for the reference and must be invisible to the scan."""
+    b, p = tokens.shape
+    if eos_id is None:
+        return jnp.full((b,), p, jnp.int32)
+    is_eos = tokens == eos_id
+    first = jnp.argmax(is_eos, axis=1)
+    return jnp.where(jnp.any(is_eos, axis=1), first + 1, p).astype(jnp.int32)
+
+
+def first_token_scan(logits: jnp.ndarray, yes_id, no_id, top_k: int = 5):
+    """Position-0 leg of the scan, on prefill logits alone: [B, V] fp32 →
+    (yes, no, relative, odds, hit).  ``hit`` marks rows whose position-0
+    top-k already contains a target — the reference's loop reads exactly
+    these probabilities for such rows and never looks at positions 1..9
+    (run_base_vs_instruct_100q.py:349-364), so the two-phase engine skips
+    their decode entirely.
+
+    One convention, one implementation: this IS :func:`yes_no_from_scores`
+    on a single-position score tensor (``found`` ≡ position-0 top-k hit)."""
+    res = yes_no_from_scores(
+        logits[:, None, :], yes_id, no_id, max_look_ahead=1, top_k=top_k
+    )
+    return res.yes_prob, res.no_prob, res.relative_prob, res.odds_ratio, res.found
 
 
 @functools.partial(jax.jit, static_argnames=("top_filter",))

@@ -465,10 +465,26 @@ def test_extract_survey2_cli(tmp_path, capsys):
     out = str(tmp_path / "q2.txt")
     main(["extract-survey2-questions", "--survey-csv", REF2, "--output", out])
     printed = capsys.readouterr().out
-    lines = open(out).read().strip().splitlines()
+    lines = open(out, encoding="utf-8").read().strip().splitlines()
     assert len(lines) >= 50
     assert all(q.endswith("?") for q in lines)
     assert "wrote" in printed
+    # golden: byte-exact against the reference's committed extractor output
+    ref_txt = "/root/reference/data/question_list_part_2.txt"
+    if os.path.exists(ref_txt):
+        ref = open(ref_txt, encoding="utf-8").read().strip().splitlines()
+        assert lines == ref
+
+    # --ascii-quotes produces the straight-quote form the reference sweep
+    # actually ran (compare_instruct_models_survey2.py:298-355 hardcodes a
+    # straight-quote transcription of the extractor output)
+    out2 = str(tmp_path / "q2_ascii.txt")
+    main(["extract-survey2-questions", "--survey-csv", REF2,
+          "--output", out2, "--ascii-quotes"])
+    ascii_lines = open(out2, encoding="utf-8").read().strip().splitlines()
+    assert len(ascii_lines) == len(lines)
+    assert not any(ch in q for q in ascii_lines for ch in "“”‘’")
+    assert 'Is "biodegradable plastic" an "organic material"?' in ascii_lines
 
 
 def test_sample_statements_cli(tmp_path, capsys):

@@ -146,6 +146,54 @@ def test_instruct_sweep_cli_roundtrip(snapshot, tmp_path, capsys):
     assert "model pairs" in capsys.readouterr().out
 
 
+@pytest.mark.skipif(
+    not os.path.exists("/root/reference/data/word_meaning_survey_results_part_2.csv"),
+    reason="reference not mounted",
+)
+def test_survey2_instruct_sweep_chain(snapshot, tmp_path, capsys):
+    """The survey-2 leg end-to-end via the CLI, the reference's
+    compare_instruct_models_survey2.py flow: extract-survey2-questions on the
+    real Qualtrics export -> run-instruct-sweep --questions-file -> the
+    survey-2 results CSV with the §2.8 schema (one row per question x model,
+    filename instruct_model_comparison_results_survey2.csv, ibid.:543-546)."""
+    from llm_interpretation_replication_tpu.sweeps import instruct_sweep as sweep_mod
+    from llm_interpretation_replication_tpu.sweeps.writers import (
+        INSTRUCT_COMPARISON_COLUMNS,
+    )
+
+    ref2 = "/root/reference/data/word_meaning_survey_results_part_2.csv"
+    qfile = str(tmp_path / "question_list_part_2_actual.txt")
+    main(["extract-survey2-questions", "--survey-csv", ref2,
+          "--output", qfile, "--ascii-quotes"])
+    questions = open(qfile, encoding="utf-8").read().strip().splitlines()
+    assert len(questions) == 50          # the reference's survey-2 prompt count
+
+    out = tmp_path / "run_survey2"
+    csv = out / "instruct_model_comparison_results_survey2.csv"
+    orig = sweep_mod.instruct_sweep_models
+    sweep_mod.instruct_sweep_models = lambda: [snapshot]
+    try:
+        main([
+            "run-instruct-sweep", "--device", "cpu", "--dtype", "float32",
+            "--batch-size", "8", "--output-dir", str(out),
+            "--checkpoint-dir", str(tmp_path / "ckpt_s2"),
+            "--questions-file", qfile, "--results-csv", str(csv),
+        ])
+    finally:
+        sweep_mod.instruct_sweep_models = orig
+    printed = capsys.readouterr().out
+    assert "50 questions" in printed
+    df = pd.read_csv(csv)
+    assert list(df.columns) == INSTRUCT_COMPARISON_COLUMNS
+    assert len(df) == 50                 # 50 questions x 1 model
+    assert set(df["prompt"]) == set(questions)
+    rel = pd.to_numeric(df["relative_prob"], errors="coerce")
+    assert rel.notna().all() and ((rel >= 0) & (rel <= 1)).all()
+    # the survey-2 checkpoint is derived from the CSV basename, so it can
+    # coexist with the 50q sweep's checkpoint in one output dir
+    assert (out / "instruct_model_comparison_results_survey2_checkpoint.json").exists()
+
+
 def test_api_perturbation_cli_full_batch_lifecycle(tmp_path, monkeypatch, capsys):
     """run-api-perturbation via the CLI against a faked OpenAI Batch service
     (upload -> create -> poll -> download), on the real 5 legal scenarios:

@@ -554,6 +554,103 @@ def test_chatglm_conversion_structure():
     assert np.isfinite(logits[mask.astype(bool)]).all()
 
 
+def test_chatglm_numeric_parity_handcrafted_oracle():
+    """ChatGLM2 numeric pin WITHOUT remote code: a handcrafted numpy oracle of
+    the ChatGLM2 block — RMSNorm, fused QKV with bias, multi-query groups,
+    interleaved RoPE over the first half of each head (RotaryEmbedding(dim =
+    kv_channels // 2) with inv_freq over arange(0, rot, 2)/rot, pairs
+    (x[2i], x[2i+1])), swiglu MLP chunked [gate; up], sequential residuals —
+    per the published THUDM modeling_chatglm.py equations that the reference
+    loads via trust_remote_code (compare_instruct_models.py:409-421).  Every
+    other family pins against an executable HF oracle; this closes the one
+    structural-only gap at the same <=1e-4 tolerance."""
+    import types
+
+    hf = types.SimpleNamespace(
+        model_type="chatglm", padded_vocab_size=VOCAB, hidden_size=32,
+        num_layers=2, num_attention_heads=4, kv_channels=8,
+        multi_query_attention=True, multi_query_group_num=2,
+        ffn_hidden_size=48, seq_length=64, layernorm_epsilon=1e-5,
+        rmsnorm=True, add_qkv_bias=True, add_bias_linear=False,
+    )
+    fam, cfg = mcfg.from_hf_config(hf)
+    assert fam == "chatglm"
+    L, h, n, d, g, f = 2, 32, 4, 8, 2, 48
+    nd, kvd = n * d, g * d
+    rng = np.random.default_rng(11)
+    sd = {}
+    for i in range(L):
+        pre = f"transformer.encoder.layers.{i}"
+        sd[f"{pre}.self_attention.query_key_value.weight"] = rng.standard_normal((nd + 2 * kvd, h)) * 0.05
+        sd[f"{pre}.self_attention.query_key_value.bias"] = rng.standard_normal(nd + 2 * kvd) * 0.02
+        sd[f"{pre}.self_attention.dense.weight"] = rng.standard_normal((h, nd)) * 0.05
+        sd[f"{pre}.mlp.dense_h_to_4h.weight"] = rng.standard_normal((2 * f, h)) * 0.05
+        sd[f"{pre}.mlp.dense_4h_to_h.weight"] = rng.standard_normal((h, f)) * 0.05
+        sd[f"{pre}.input_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
+        sd[f"{pre}.post_attention_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
+    sd["transformer.embedding.word_embeddings.weight"] = rng.standard_normal((VOCAB, h)) * 0.05
+    sd["transformer.encoder.final_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
+    sd["transformer.output_layer.weight"] = rng.standard_normal((VOCAB, h)) * 0.05
+
+    ids, mask = _batch(rng)
+    eps = 1e-5
+
+    # ---- the oracle: modeling_chatglm.py equations in plain numpy ---------
+    def rms(x, w):
+        return x / np.sqrt((x ** 2).mean(-1, keepdims=True) + eps) * w
+
+    def softmax(x):
+        x = x - x.max(-1, keepdims=True)
+        e = np.exp(x)
+        return e / e.sum(-1, keepdims=True)
+
+    b, s = ids.shape
+    rot = d // 2                               # RotaryEmbedding(kv_channels // 2)
+    inv_freq = 1.0 / (10000.0 ** (np.arange(0, rot, 2) / rot))
+    ang = np.outer(np.arange(s), inv_freq)     # [s, rot/2]
+    cos, sin = np.cos(ang), np.sin(ang)
+
+    def rope(t):                               # t: [b, s, heads, d]
+        tr, tp = t[..., :rot], t[..., rot:]
+        x0, x1 = tr[..., 0::2], tr[..., 1::2]
+        c, sn = cos[None, :, None, :], sin[None, :, None, :]
+        out = np.stack([x0 * c - x1 * sn, x1 * c + x0 * sn], axis=-1)
+        return np.concatenate([out.reshape(tr.shape), tp], axis=-1)
+
+    valid = mask.astype(bool)
+    causal = np.tril(np.ones((s, s), bool))
+    attend = causal[None] & valid[:, None, :]  # [b, s_q, s_k]
+
+    x = sd["transformer.embedding.word_embeddings.weight"][ids]
+    for i in range(L):
+        pre = f"transformer.encoder.layers.{i}"
+        hln = rms(x, sd[f"{pre}.input_layernorm.weight"])
+        qkv = hln @ sd[f"{pre}.self_attention.query_key_value.weight"].T \
+            + sd[f"{pre}.self_attention.query_key_value.bias"]
+        q = rope(qkv[..., :nd].reshape(b, s, n, d))
+        k = rope(qkv[..., nd:nd + kvd].reshape(b, s, g, d))
+        v = qkv[..., nd + kvd:].reshape(b, s, g, d)
+        k = np.repeat(k, n // g, axis=2)       # head j reads group j // (n/g)
+        v = np.repeat(v, n // g, axis=2)
+        scores = np.einsum("bsnd,btnd->bnst", q, k) / np.sqrt(d)
+        scores = np.where(attend[:, None], scores, -1e30)
+        attn = np.einsum("bnst,btnd->bsnd", softmax(scores), v).reshape(b, s, nd)
+        x = x + attn @ sd[f"{pre}.self_attention.dense.weight"].T
+        h2 = rms(x, sd[f"{pre}.post_attention_layernorm.weight"])
+        a = h2 @ sd[f"{pre}.mlp.dense_h_to_4h.weight"].T
+        gate, up = np.split(a, 2, axis=-1)     # swiglu chunks in half
+        x = x + (gate / (1.0 + np.exp(-gate)) * up) @ sd[f"{pre}.mlp.dense_4h_to_h.weight"].T
+    x = rms(x, sd["transformer.encoder.final_layernorm.weight"])
+    oracle = x @ sd["transformer.output_layer.weight"].T
+
+    get = mconvert.getter_from_torch_state_dict(
+        {kk: torch.tensor(vv) for kk, vv in sd.items()}
+    )
+    params = mconvert.convert("chatglm", get, cfg, dtype=jnp.float32)
+    ours = np.asarray(decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask)))
+    _assert_close(ours, oracle, mask, atol=1e-4)
+
+
 def test_mpt_biased_variant_and_unsupported_configs():
     """Original-Mosaic MPT checkpoints with ``no_bias: false`` carry bias
     tensors (HF's port drops them, so this leg is structurally tested against

@@ -398,8 +398,9 @@ class TestQuant:
         assert corr > 0.999, corr
 
     def test_quantized_greedy_decode_matches_fp32(self):
-        """The decode path (_attn_ragged / _block_ragged) must also apply the
-        dequant scales — greedy tokens should match fp32 on a tiny model."""
+        """The decode path (_attn_decode / _block_decode two-block attention)
+        must also apply the dequant scales — greedy tokens should match fp32
+        on a tiny model."""
         from llm_interpretation_replication_tpu.models.config import DecoderConfig
         from llm_interpretation_replication_tpu.models.decoder import greedy_decode
         from llm_interpretation_replication_tpu.ops import quant

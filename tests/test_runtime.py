@@ -142,6 +142,73 @@ class TestScoringEngine:
         fast_serial = eng.first_token_relative_prob(prompts)
         np.testing.assert_array_equal(fast_deep, fast_serial)
 
+    def test_completions_match_hf_generate_50_tokens(self):
+        """The completion column must be the reference's full
+        ``generate(max_new_tokens=50)`` text, truncated at 100 chars — not a
+        10-token prefix (run_base_vs_instruct_100q.py:337-346,379)."""
+        import torch
+
+        eng, model, tok = _tiny_engine()
+        assert eng.ecfg.max_new_tokens == 50
+        prompts = [
+            "Is a tweet a publication? Answer: Yes",
+            "Is soup a beverage?",
+            "The quick brown fox jumps over",
+        ]
+        rows = eng.score_prompts(prompts)
+        for prompt, row in zip(prompts, rows):
+            ids = tok(prompt, return_tensors="pt").input_ids
+            with torch.no_grad():
+                out = model.generate(
+                    ids, max_new_tokens=50, do_sample=False,
+                    pad_token_id=tok.pad_token_id or 0,
+                    eos_token_id=tok.eos_token_id,
+                )
+            ref = tok.decode(
+                out[0][ids.shape[1]:], skip_special_tokens=True
+            ).strip()[:100]
+            assert row["completion"] == ref, (prompt, row["completion"], ref)
+
+    def test_two_phase_matches_full_decode_probs(self):
+        """decode_completions=False takes the early-exit subset path; its
+        probabilities must equal the completions path (which scores every
+        row) and stay within the reference scan semantics."""
+        import dataclasses as dc
+
+        eng, _, _ = _tiny_engine()
+        prompts = [f"prompt {i} about soup, tweets and vehicles" for i in range(5)]
+        rows_full = eng.score_prompts(prompts)
+        eng.ecfg = dc.replace(eng.ecfg, decode_completions=False)
+        rows_fast = eng.score_prompts(prompts)
+        for a, b in zip(rows_full, rows_fast):
+            np.testing.assert_allclose(a["yes_prob"], b["yes_prob"], rtol=1e-5)
+            np.testing.assert_allclose(a["no_prob"], b["no_prob"], rtol=1e-5)
+            np.testing.assert_allclose(
+                a["relative_prob"], b["relative_prob"], rtol=1e-5
+            )
+            assert a["scan_found"] == b["scan_found"]
+            assert b["completion"] == ""
+
+    def test_chunked_scan_matches_single_chunk(self):
+        """scan_chunk must be invisible in the results: the early exit may
+        only fire when every real row is resolved (hit or actual EOS), so a
+        2-step chunking and a single 10-step chunk agree row-for-row."""
+        import dataclasses as dc
+
+        eng, _, _ = _tiny_engine()
+        eng.ecfg = dc.replace(eng.ecfg, decode_completions=False)
+        prompts = [f"prompt {i} about soup, tweets, Yes and No" for i in range(6)]
+        eng.ecfg = dc.replace(eng.ecfg, scan_chunk=10)
+        rows_one = eng.score_prompts(prompts)
+        eng.ecfg = dc.replace(eng.ecfg, scan_chunk=2)
+        rows_chunked = eng.score_prompts(prompts)
+        for a, b in zip(rows_one, rows_chunked):
+            assert a["scan_found"] == b["scan_found"]
+            np.testing.assert_allclose(
+                a["relative_prob"], b["relative_prob"], rtol=1e-5
+            )
+            np.testing.assert_allclose(a["yes_prob"], b["yes_prob"], rtol=1e-5)
+
     def test_first_token_fast_path_matches_scan_position0(self):
         eng, _, _ = _tiny_engine()
         prompts = ["Is soup a beverage?"]

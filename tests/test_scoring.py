@@ -78,6 +78,32 @@ class TestYesNoScan:
         res = yes_no_from_scores(jnp.asarray(scores), 2, 3, max_look_ahead=1)
         assert np.isinf(float(res.odds_ratio[0]))
 
+    def test_eos_truncates_scan_like_hf_generate(self):
+        """HF generate stops at EOS, so the reference's scores list ends at
+        the eos-emitting position; batched decode keeps forced-EOS positions
+        that must be invisible to the scan (valid_steps)."""
+        from llm_interpretation_replication_tpu.scoring import steps_until_eos
+
+        V, eos = 30, 7
+        # row 0: emits eos at step 1 -> 2 visible positions; a fat "yes" at
+        # position 3 must NOT be seen (reference would have fallen back to 0)
+        scores = np.full((2, 6, V), -10.0, np.float32)
+        scores[:, :, :6] = 3.0             # top-5 = tokens 0..5, no yes/no
+        scores[0, 3, 20] = 50.0            # invisible: after row-0's eos
+        scores[1, 3, 20] = 50.0            # visible: row 1 never hits eos
+        tokens = np.full((2, 6), 4, np.int32)
+        tokens[0, 1] = eos
+        tokens[0, 2:] = eos                # forced eos after done
+        vs = steps_until_eos(jnp.asarray(tokens), eos)
+        np.testing.assert_array_equal(np.asarray(vs), [2, 6])
+        res = yes_no_from_scores(jnp.asarray(scores), 20, 21,
+                                 valid_steps=vs)
+        assert not bool(res.found[0]) and int(res.position[0]) == 0
+        assert bool(res.found[1]) and int(res.position[1]) == 3
+        # without the cutoff the phantom position would (wrongly) hit
+        res_raw = yes_no_from_scores(jnp.asarray(scores), 20, 21)
+        assert bool(res_raw.found[0])
+
 
 class TestEndToEndAgainstTorchReference:
     """Tiny NeoX model: reference-style HF generate + python scan vs our
