@@ -249,6 +249,23 @@ def main():
                              "MXU-bound projections")
     args = parser.parse_args()
 
+    if args.quant == "none" and args.model == "falcon-7b":
+        # bf16 7B weights (~13 GB) leave no HBM for the dense S×T attention
+        # scores at ANY batch size on a 16 GB chip — the Pallas flash kernel
+        # streams them in blocks and is the only path that fits, and batch
+        # must drop to 64 for the activations (measured 2026-07: dense OOMs
+        # at batch 64-192; flash 21.2 p/s at batch 64, OOM above).
+        if args.attn == "xla":
+            print("# --quant none on falcon-7b: dense attention cannot fit "
+                  "beside bf16 weights; switching to --attn flash",
+                  file=sys.stderr)
+            args.attn = "flash"
+        if args.batch > 64:
+            print(f"# --quant none on falcon-7b: clamping --batch "
+                  f"{args.batch} -> 64 (bf16 activation headroom)",
+                  file=sys.stderr)
+            args.batch = 64
+
     import jax
     import jax.numpy as jnp
 

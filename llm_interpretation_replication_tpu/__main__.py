@@ -677,6 +677,51 @@ def cmd_analyze_3way(args):
         print(f"figure: {out['figure']}")
 
 
+def cmd_analyze_agreement(args):
+    """Point-estimate + question-bootstrap LLM/human agreement reports
+    (analyze_llm_human_agreement.py + analyze_llm_agreement_simple_bootstrap.py
+    as one subcommand; both reference JSON shapes)."""
+    import os
+
+    import pandas as pd
+
+    from .survey.variants import (
+        agreement_question_bootstrap,
+        human_agreement_means,
+        human_agreement_report,
+        save_agreement_json,
+    )
+
+    os.makedirs(args.output_dir, exist_ok=True)
+    instruct_df = _load_llm_csv(args.llm_csv)
+    base_df = pd.read_csv(args.base_csv) if args.base_csv else None
+    means = human_agreement_means([args.survey_csv], instruct_df)
+    print(f"Loaded human average ratings for {len(means)} questions")
+
+    point = human_agreement_report(instruct_df, base_df, means)
+    point_path = os.path.join(args.output_dir, "llm_human_agreement_analysis.json")
+    save_agreement_json({k: v for k, v in point.items() if k != "detailed"},
+                        point_path)
+    print(f"{'Model':<45} {'Type':<9} {'MAE':<8} {'RMSE':<8} {'Pearson r':<10}")
+    for r in point["model_results"]:
+        print(f"{r['model']:<45} {r['model_type']:<9} {r['mae']:<8.4f} "
+              f"{r['rmse']:<8.4f} {r['pearson_r']:<10.4f}")
+
+    boot = agreement_question_bootstrap(
+        instruct_df, base_df, means,
+        n_bootstrap=args.bootstrap, seed=args.seed,
+    )
+    boot_path = os.path.join(args.output_dir, "llm_human_agreement_bootstrap.json")
+    save_agreement_json(boot, boot_path)
+    for metric, rec in boot["overall_comparison"]["metrics"].items():
+        print(f"{metric.upper()}: base {rec['base_mean']:.4f} vs instruct "
+              f"{rec['instruct_mean']:.4f}, diff {rec['difference']:+.4f} "
+              f"[{rec['difference_ci'][0]:+.4f}, {rec['difference_ci'][1]:+.4f}], "
+              f"p = {rec['p_value']:.4f}")
+    print(f"wrote {point_path}")
+    print(f"wrote {boot_path}")
+
+
 def cmd_analyze_family_differences(args):
     """Respondent-level agreement bootstrap + per-family MAE/MSE/MAPE
     differences (analyze_llm_human_agreement_bootstrap.py +
@@ -1032,6 +1077,20 @@ def main(argv=None):
     p.add_argument("--output-dir", default="results/three_way")
     p.add_argument("--no-figures", action="store_true")
     p.set_defaults(fn=cmd_analyze_3way)
+
+    p = sub.add_parser("analyze-agreement",
+                       help="point-estimate + question-bootstrap LLM/human "
+                            "agreement (both reference JSON report shapes)")
+    p.add_argument("--llm-csv", required=True,
+                   help="instruct_model_comparison_results.csv")
+    p.add_argument("--base-csv", default=None,
+                   help="model_comparison_results.csv (base models)")
+    p.add_argument("--survey-csv", required=True,
+                   help="survey-1 Qualtrics export (the 50 mapped questions)")
+    p.add_argument("--output-dir", default="results/agreement")
+    p.add_argument("--bootstrap", type=int, default=1000)
+    p.add_argument("--seed", type=int, default=42)
+    p.set_defaults(fn=cmd_analyze_agreement)
 
     p = sub.add_parser("analyze-family-differences",
                        help="respondent-bootstrap agreement + per-family "

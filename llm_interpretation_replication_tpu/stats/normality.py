@@ -13,6 +13,49 @@ import numpy as np
 from scipy import stats as scipy_stats
 
 
+# Known AD critical-value tables for the 'norm' case.  scipy 1.17 revised
+# BOTH the asymptotic table (Stephens' values → recomputed ones) and the
+# finite-n correction divisor, so critical values (and hence the banded
+# p-values and normal/not-normal flags) shift between scipy eras.  The
+# reference's recorded analyses were produced on a legacy-table scipy;
+# pinning code detects the active era and compares bit-exactly against the
+# matching table instead of a loose tolerance (PARITY.md §6).
+AD_NORM_TABLES = {
+    # scipy < 1.17: Stephens (1974) via D'Agostino correction
+    "legacy": ((0.576, 0.656, 0.787, 0.918, 1.092),
+               lambda n: 1.0 + 4.0 / n - 25.0 / n ** 2),
+    # scipy >= 1.17: revised table + 1 + 0.75/n + 2.25/n^2 correction
+    "scipy117": ((0.561, 0.631, 0.752, 0.873, 1.035),
+                 lambda n: 1.0 + 0.75 / n + 2.25 / n ** 2),
+}
+
+
+def ad_critical_values(n: int, version: str) -> np.ndarray:
+    """The five AD critical values scipy's anderson(..., 'norm') returns for
+    a sample of size ``n`` under the given table era (3-decimal rounding
+    exactly as scipy applies it)."""
+    base, correction = AD_NORM_TABLES[version]
+    return np.around(np.asarray(base) / correction(n), 3)
+
+
+def active_ad_table_version(probe_n: int = 100) -> str:
+    """Which AD table era the INSTALLED scipy uses, detected empirically:
+    run anderson() on a fixed sample and match the returned critical values
+    against each known table.  Returns 'unknown' for a future scipy whose
+    table matches neither — callers should fail loudly and add the new era
+    to AD_NORM_TABLES."""
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("ignore")
+        res = scipy_stats.anderson(np.linspace(-2.0, 2.0, probe_n), "norm")
+    crit = np.asarray(res.critical_values, dtype=float)
+    for version in AD_NORM_TABLES:
+        if np.array_equal(crit, ad_critical_values(probe_n, version)):
+            return version
+    return "unknown"
+
+
 def ad_pvalue_from_bands(ad_statistic: float, critical_values) -> float:
     """Reference's banded approximation (index 2 = 5% level)."""
     if ad_statistic > 10:

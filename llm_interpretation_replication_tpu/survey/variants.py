@@ -14,6 +14,14 @@ equivalent in the main pipeline:
 - ``visualize_ground_truth_distribution.py:1-265`` — human ground-truth
   histogram with fitted normal + random-baseline panel, and the simplified
   single-panel variant.
+- ``analyze_llm_human_agreement.py:1-315`` — per-model point-estimate
+  agreement with the cleaned human means (MAE/RMSE/MAPE/Pearson/Spearman,
+  worst questions, cross-model question variance) →
+  ``llm_human_agreement_analysis.json``.
+- ``analyze_llm_agreement_simple_bootstrap.py:1-482`` — QUESTION-level
+  bootstrap of the same metrics (the respondent-level variant is
+  ``agreement_bootstrap`` above) plus a base-vs-instruct group comparison
+  with a permutation p-value → ``llm_human_agreement_bootstrap.json``.
 """
 
 from __future__ import annotations
@@ -182,6 +190,23 @@ def three_way_report(llm_df: pd.DataFrame, survey_df: pd.DataFrame,
 # Respondent-level agreement bootstrap + per-family differences
 # ---------------------------------------------------------------------------
 
+def _metric_summary(name: str, vals, alpha: float = 0.05) -> Dict:
+    """mean/std/percentile-CI record fields for one bootstrap metric —
+    shared by the respondent-level and question-level bootstraps."""
+    vals = np.asarray(vals, float)
+    vals = vals[np.isfinite(vals)]
+    if not vals.size:
+        nan = float("nan")
+        return {f"{name}_mean": nan, f"{name}_ci_lower": nan,
+                f"{name}_ci_upper": nan, f"{name}_std": nan}
+    return {
+        f"{name}_mean": float(np.mean(vals)),
+        f"{name}_ci_lower": float(np.percentile(vals, alpha / 2 * 100)),
+        f"{name}_ci_upper": float(np.percentile(vals, (1 - alpha / 2) * 100)),
+        f"{name}_std": float(np.std(vals)),
+    }
+
+
 def agreement_bootstrap(llm_df: pd.DataFrame, survey_df: pd.DataFrame,
                         question_cols: Sequence[str], mapping: Dict[str, str],
                         n_bootstrap: int = 100, seed: int = 42,
@@ -232,11 +257,7 @@ def agreement_bootstrap(llm_df: pd.DataFrame, survey_df: pd.DataFrame,
                "n_bootstrap": n_bootstrap}
         for name, vals in (("mae", mae), ("mse", mse), ("mape", mape),
                            ("pearson_r", pearson)):
-            vals = vals[np.isfinite(vals)]
-            rec[f"{name}_mean"] = float(np.mean(vals)) if vals.size else float("nan")
-            rec[f"{name}_std"] = float(np.std(vals)) if vals.size else float("nan")
-            rec[f"{name}_ci_lower"] = float(np.percentile(vals, 2.5)) if vals.size else float("nan")
-            rec[f"{name}_ci_upper"] = float(np.percentile(vals, 97.5)) if vals.size else float("nan")
+            rec.update(_metric_summary(name, vals))
         results.append(rec)
     return {
         "analysis_type": "llm_human_agreement_bootstrap",
@@ -442,3 +463,261 @@ def save_agreement_json(agreement: Dict, path: str) -> str:
     with open(path, "w", encoding="utf-8") as f:
         json.dump(agreement, f, indent=2, default=float)
     return path
+
+
+# ---------------------------------------------------------------------------
+# Point-estimate + question-bootstrap agreement reports
+# (analyze_llm_human_agreement.py, analyze_llm_agreement_simple_bootstrap.py)
+# ---------------------------------------------------------------------------
+
+def human_agreement_means(survey_filepaths, llm_df: pd.DataFrame) -> Dict[str, float]:
+    """prompt → cleaned human mean on the 0-1 scale.
+
+    Rebuilds the ``survey_analysis_detailed.json`` input both agreement
+    scripts consume (results.by_question.*.mean_response / 100) from the raw
+    Qualtrics export: preregistered exclusions, then per-question means for
+    every survey column whose question text matches an LLM prompt
+    (analyze_llm_human_agreement.py:14-96)."""
+    from .pipeline import (
+        apply_exclusion_criteria,
+        load_and_clean_survey_data,
+        match_survey_to_llm_questions,
+    )
+
+    df, cols = load_and_clean_survey_data(survey_filepaths)
+    clean, _ = apply_exclusion_criteria(df, cols)
+    matches, _ = match_survey_to_llm_questions(llm_df, survey_filepaths)
+    out: Dict[str, float] = {}
+    for prompt, qid in matches.items():
+        vals = pd.to_numeric(clean[qid], errors="coerce").dropna()
+        if len(vals):
+            out[prompt] = float(vals.mean()) / 100.0
+    return out
+
+
+def _matched_probs(model_df: pd.DataFrame, human_means: Dict[str, float]):
+    """(prompt, human, model) triples; relative_prob preferred, yes/no
+    fallback for CSVs without it (the base-model comparison CSV) — the
+    scripts' column handling (analyze_llm_human_agreement.py:100-118)."""
+    rows = []
+    for _, row in model_df.iterrows():
+        prompt = row["prompt"]
+        if prompt not in human_means:
+            continue
+        if "relative_prob" in row.index and pd.notna(row.get("relative_prob")):
+            p = float(row["relative_prob"])
+        elif pd.notna(row.get("yes_prob")) and pd.notna(row.get("no_prob")):
+            total = float(row["yes_prob"]) + float(row["no_prob"])
+            p = float(row["yes_prob"]) / total if total > 0 else 0.5
+        else:
+            continue
+        rows.append((prompt, human_means[prompt], p))
+    return rows
+
+
+def _model_frames(instruct_df, base_df):
+    frames = []
+    if base_df is not None:
+        frames.extend((m, "base", base_df[base_df["model"] == m])
+                      for m in base_df["model"].unique())
+    frames.extend((m, "instruct", instruct_df[instruct_df["model"] == m])
+                  for m in instruct_df["model"].unique())
+    return frames
+
+
+def human_agreement_report(
+    instruct_df: pd.DataFrame,
+    base_df: Optional[pd.DataFrame],
+    human_means: Dict[str, float],
+    min_questions: int = 10,
+) -> Dict:
+    """Point-estimate agreement per model (analyze_llm_human_agreement.py):
+    MAE, RMSE, MAPE, Pearson/Spearman vs the cleaned human means, ranked by
+    MAE, plus cross-model per-question variance — the exact
+    ``llm_human_agreement_analysis.json`` shape (ibid.:289-307).
+
+    The returned dict carries a non-serialized ``detailed`` list with each
+    model's matched rows and 5 worst-disagreement questions (printed, not
+    saved, by the reference)."""
+    from scipy.stats import pearsonr, spearmanr
+
+    results, detailed = [], []
+    for model, model_type, mdf in _model_frames(instruct_df, base_df):
+        rows = _matched_probs(mdf, human_means)
+        if len(rows) < min_questions:
+            continue
+        h = np.array([r[1] for r in rows])
+        p = np.array([r[2] for r in rows])
+        mae = float(np.mean(np.abs(h - p)))
+        rmse = float(np.sqrt(np.mean((h - p) ** 2)))
+        # near-zero human means are excluded from MAPE (same guard as
+        # agreement_bootstrap) so a degenerate question cannot make the JSON
+        # carry Infinity; no real survey-1 question has mean <= 0.01
+        with np.errstate(divide="ignore", invalid="ignore"):
+            ape = np.where(h > 0.01, np.abs((h - p) / h), np.nan)
+        mape = float(np.nanmean(ape) * 100)
+        pr, pp = pearsonr(h, p)
+        sr, sp = spearmanr(h, p)
+        order = np.argsort(-np.abs(h - p))
+        worst = [
+            {"prompt": rows[i][0], "human_avg": float(h[i]),
+             "model_prob": float(p[i]), "difference": float(abs(h[i] - p[i]))}
+            for i in order[:5]
+        ]
+        results.append({
+            "model": model, "model_type": model_type, "mae": mae,
+            "rmse": rmse, "mape": mape, "pearson_r": float(pr),
+            "n_questions": len(rows),
+        })
+        detailed.append({
+            "model": model, "model_type": model_type,
+            "pearson_p": float(pp), "spearman_r": float(sr),
+            "spearman_p": float(sp), "worst_questions": worst,
+            "matched": rows,
+        })
+    order = np.argsort([r["mae"] for r in results])
+    results = [results[i] for i in order]
+    detailed = [detailed[i] for i in order]
+
+    question_variance = {}
+    for prompt, human_avg in human_means.items():
+        probs = [p for d in detailed for (q, _, p) in d["matched"] if q == prompt]
+        if probs:
+            question_variance[prompt] = {
+                "human_avg": float(human_avg),
+                "model_mean": float(np.mean(probs)),
+                "model_std": float(np.std(probs)),
+                "n_models": len(probs),
+            }
+    return {
+        "analysis_type": "llm_human_agreement",
+        "description": "Comparison of LLM outputs to human average ratings "
+                       "per question",
+        "model_results": results,
+        "question_variance": question_variance,
+        "detailed": detailed,
+    }
+
+
+def agreement_question_bootstrap(
+    instruct_df: pd.DataFrame,
+    base_df: Optional[pd.DataFrame],
+    human_means: Dict[str, float],
+    n_bootstrap: int = 1000,
+    confidence_level: float = 0.95,
+    seed: int = 42,
+    min_questions: int = 10,
+    n_diff_bootstrap: int = 10000,
+) -> Dict:
+    """QUESTION-level bootstrap agreement
+    (analyze_llm_agreement_simple_bootstrap.py): resample question indices
+    with replacement, score each model on the sampled questions, report
+    mean/95% CI/std per metric, then compare base vs instruct model groups
+    with a bootstrap difference CI and a permutation p-value — the exact
+    ``llm_human_agreement_bootstrap.json`` shape (ibid.:440-478).
+
+    Faithfully reproduces the reference's membership-matching quirk: a
+    question drawn twice still contributes ONCE per iteration (`prompt in
+    sampled_questions`, ibid.:99-106), so each iteration is effectively a
+    ~63% unique-question subsample.  The reference runs numpy's global
+    unseeded RNG; ``seed`` makes ours reproducible."""
+    alpha = 1 - confidence_level
+    rng = np.random.default_rng(seed)
+    all_questions = list(human_means.keys())
+    n_q = len(all_questions)
+    qindex = {q: j for j, q in enumerate(all_questions)}
+
+    model_results = []
+    base_count = instruct_count = 0
+    for model, model_type, mdf in _model_frames(instruct_df, base_df):
+        rows = _matched_probs(mdf, human_means)
+        h_full = np.full(n_q, np.nan)
+        p_full = np.full(n_q, np.nan)
+        for prompt, h, p in rows:
+            h_full[qindex[prompt]] = h
+            p_full[qindex[prompt]] = p
+        per_iter = {"mae": [], "mse": [], "mape": [], "pearson_r": []}
+        ok = 0
+        for _ in range(n_bootstrap):
+            sampled = np.unique(rng.integers(0, n_q, size=n_q))
+            mask = np.zeros(n_q, bool)
+            mask[sampled] = True
+            mask &= np.isfinite(p_full)
+            if mask.sum() < min_questions:
+                continue
+            ok += 1
+            h = h_full[mask]
+            p = p_full[mask]
+            err = h - p
+            per_iter["mae"].append(np.mean(np.abs(err)))
+            per_iter["mse"].append(np.mean(err ** 2))
+            with np.errstate(divide="ignore", invalid="ignore"):
+                ape = np.abs(err / h)
+            ape = ape[np.isfinite(ape)]
+            per_iter["mape"].append(np.mean(ape) * 100 if ape.size else np.nan)
+            if h.std() > 0 and p.std() > 0:
+                per_iter["pearson_r"].append(float(np.corrcoef(h, p)[0, 1]))
+            else:
+                per_iter["pearson_r"].append(np.nan)
+        # the reference's "at least 100 successful bootstraps" floor, scaled
+        # down when the caller requests fewer iterations overall (otherwise a
+        # small --bootstrap run silently drops every model)
+        if ok < min(100, n_bootstrap):
+            continue
+        rec = {"model": model, "model_type": model_type, "n_bootstrap": ok}
+        for metric, vals in per_iter.items():
+            rec.update(_metric_summary(metric, vals, alpha))
+        model_results.append(rec)
+        if model_type == "base":
+            base_count += 1
+        else:
+            instruct_count += 1
+
+    model_results.sort(key=lambda r: r["mae_mean"])
+
+    overall = {"base_models_count": base_count,
+               "instruct_models_count": instruct_count, "metrics": {}}
+    base_recs = [r for r in model_results if r["model_type"] == "base"]
+    inst_recs = [r for r in model_results if r["model_type"] == "instruct"]
+    for metric in ("mae", "mse", "mape"):
+        bv = np.array([r[f"{metric}_mean"] for r in base_recs
+                       if np.isfinite(r[f"{metric}_mean"])])
+        iv = np.array([r[f"{metric}_mean"] for r in inst_recs
+                       if np.isfinite(r[f"{metric}_mean"])])
+        if not (bv.size and iv.size):
+            continue
+        diff = float(bv.mean() - iv.mean())
+        n1, n2 = len(bv), len(iv)
+        boot = np.empty(n_diff_bootstrap)
+        for b in range(n_diff_bootstrap):
+            boot[b] = (rng.choice(bv, n1, replace=True).mean()
+                       - rng.choice(iv, n2, replace=True).mean())
+        pooled = np.concatenate([bv, iv])
+        null = np.empty(n_diff_bootstrap)
+        for b in range(n_diff_bootstrap):
+            perm = rng.permutation(pooled)
+            null[b] = perm[:n1].mean() - perm[n1:].mean()
+        lo, hi = alpha / 2 * 100, (1 - alpha / 2) * 100
+        overall["metrics"][metric] = {
+            "base_mean": float(bv.mean()),
+            "base_ci": [float(np.percentile(bv, lo)), float(np.percentile(bv, hi))],
+            "instruct_mean": float(iv.mean()),
+            "instruct_ci": [float(np.percentile(iv, lo)), float(np.percentile(iv, hi))],
+            "difference": diff,
+            "difference_ci": [float(np.percentile(boot, lo)),
+                              float(np.percentile(boot, hi))],
+            "p_value": float(np.mean(np.abs(null) >= abs(diff))),
+        }
+    return {
+        "analysis_type": "llm_human_agreement_bootstrap_questions",
+        "description": "Comparison of LLM outputs to human average ratings "
+                       "with bootstrap confidence intervals (sampling "
+                       "questions)",
+        "bootstrap_parameters": {
+            "n_iterations": n_bootstrap,
+            "confidence_level": confidence_level,
+            "bootstrap_method": "questions_with_replacement",
+        },
+        "model_results": model_results,
+        "overall_comparison": overall,
+    }

@@ -522,3 +522,29 @@ def test_repair_batch_cli(tmp_path, capsys):
              for r in rows]
     assert texts == ["Answer 0", "Answer 1"]   # extraction actually recovered
     assert "repaired 2 rows" in capsys.readouterr().out
+
+
+@pytest.mark.skipif(not os.path.exists(REF2), reason="reference not mounted")
+def test_analyze_agreement_cli_real_data(tmp_path, capsys):
+    """analyze-agreement end-to-end on the real CSVs: both reference JSON
+    shapes written (llm_human_agreement_analysis.json +
+    llm_human_agreement_bootstrap.json), ranking printed."""
+    out = tmp_path / "agreement"
+    main([
+        "analyze-agreement",
+        "--llm-csv", REF_INSTRUCT,
+        "--base-csv", "/root/reference/data/model_comparison_results.csv",
+        "--survey-csv", REF1,
+        "--output-dir", str(out),
+        "--bootstrap", "120",
+    ])
+    printed = capsys.readouterr().out
+    assert "Loaded human average ratings for 50 questions" in printed
+    assert "p = " in printed
+    point = json.loads((out / "llm_human_agreement_analysis.json").read_text())
+    assert point["analysis_type"] == "llm_human_agreement"
+    assert len(point["question_variance"]) == 50
+    assert "detailed" not in point            # print-only detail not serialized
+    boot = json.loads((out / "llm_human_agreement_bootstrap.json").read_text())
+    assert boot["analysis_type"] == "llm_human_agreement_bootstrap_questions"
+    assert {"mae", "mse", "mape"} <= set(boot["overall_comparison"]["metrics"])

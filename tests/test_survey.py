@@ -184,3 +184,115 @@ class TestMae100q:
         b = paired_bootstrap_mae_difference(base, inst, n_bootstrap=2000, seed=42)
         assert a == b
         assert a["observed_diff"] > 0
+
+
+@needs_ref
+class TestAgreementReports:
+    """The two condensed agreement scripts' report shapes on REAL data:
+    analyze_llm_human_agreement.py (point estimates) and
+    analyze_llm_agreement_simple_bootstrap.py (question-level bootstrap)."""
+
+    @staticmethod
+    def _inputs():
+        import pandas as pd
+
+        from llm_interpretation_replication_tpu.survey.variants import (
+            human_agreement_means,
+        )
+
+        instruct_df = pd.read_csv(f"{REF}/instruct_model_comparison_results.csv")
+        base_df = pd.read_csv(f"{REF}/model_comparison_results.csv")
+        means = human_agreement_means(
+            [f"{REF}/word_meaning_survey_results.csv"], instruct_df
+        )
+        return instruct_df, base_df, means
+
+    def test_human_means_cover_the_50_mapped_questions(self):
+        _, _, means = self._inputs()
+        assert len(means) == 50
+        assert all(0.0 <= v <= 1.0 for v in means.values())
+
+    def test_point_estimates_match_independent_oracle(self):
+        """Per-model MAE/RMSE/Pearson recomputed in-test straight from the
+        CSVs + cleaned means (scipy, no shared code path) must agree to
+        1e-12; spot values pinned for regression."""
+        import pandas as pd
+        from scipy.stats import pearsonr
+
+        from llm_interpretation_replication_tpu.survey.variants import (
+            human_agreement_report,
+        )
+
+        instruct_df, base_df, means = self._inputs()
+        rep = human_agreement_report(instruct_df, base_df, means)
+        by_key = {(r["model"], r["model_type"]): r for r in rep["model_results"]}
+
+        sub = base_df[base_df["model"] == "tiiuae/falcon-7b"]
+        pairs = []
+        for _, row in sub.iterrows():
+            if row["prompt"] not in means:
+                continue
+            total = row["yes_prob"] + row["no_prob"]
+            if pd.isna(total):
+                continue
+            p = row["yes_prob"] / total if total > 0 else 0.5
+            pairs.append((means[row["prompt"]], p))
+        h = np.array([a for a, _ in pairs])
+        p = np.array([b for _, b in pairs])
+        rec = by_key[("tiiuae/falcon-7b", "base")]
+        np.testing.assert_allclose(rec["mae"], np.mean(np.abs(h - p)), rtol=1e-12)
+        np.testing.assert_allclose(
+            rec["rmse"], np.sqrt(np.mean((h - p) ** 2)), rtol=1e-12
+        )
+        np.testing.assert_allclose(rec["pearson_r"], pearsonr(h, p)[0], rtol=1e-10)
+        assert rec["n_questions"] == len(pairs) == 49
+
+        # regression pins (real-data values, round 3)
+        np.testing.assert_allclose(rec["mae"], 0.21272931615254154, rtol=1e-9)
+        inst = by_key[("tiiuae/falcon-7b-instruct", "instruct")]
+        np.testing.assert_allclose(inst["mae"], 0.20193314582237168, rtol=1e-9)
+        np.testing.assert_allclose(inst["pearson_r"], -0.045745630685306925,
+                                   rtol=1e-9)
+        assert inst["n_questions"] == 50
+
+        # ranked by MAE; question variance covers all 50 questions
+        maes = [r["mae"] for r in rep["model_results"]]
+        assert maes == sorted(maes)
+        assert len(rep["question_variance"]) == 50
+        qv = rep["question_variance"]['Is a "screenshot" a "photograph"?']
+        assert qv["n_models"] == len(rep["model_results"]) == 28
+
+    def test_question_bootstrap_schema_and_group_comparison(self):
+        from llm_interpretation_replication_tpu.survey.variants import (
+            agreement_question_bootstrap,
+        )
+
+        instruct_df, base_df, means = self._inputs()
+        boot = agreement_question_bootstrap(
+            instruct_df, base_df, means, n_bootstrap=150, seed=7,
+            n_diff_bootstrap=2000,
+        )
+        assert boot["analysis_type"] == "llm_human_agreement_bootstrap_questions"
+        assert boot["bootstrap_parameters"]["bootstrap_method"] == (
+            "questions_with_replacement"
+        )
+        assert boot["overall_comparison"]["base_models_count"] == 18
+        assert boot["overall_comparison"]["instruct_models_count"] == 10
+        for rec in boot["model_results"]:
+            for metric in ("mae", "mse", "mape"):
+                assert (rec[f"{metric}_ci_lower"] <= rec[f"{metric}_mean"]
+                        <= rec[f"{metric}_ci_upper"]), rec["model"]
+        maes = [r["mae_mean"] for r in boot["model_results"]]
+        assert maes == sorted(maes)
+        for metric in ("mae", "mse", "mape"):
+            rec = boot["overall_comparison"]["metrics"][metric]
+            assert 0.0 <= rec["p_value"] <= 1.0
+            assert rec["difference_ci"][0] <= rec["difference_ci"][1]
+        # seeded determinism (json text: NaN == NaN under repr, not ==)
+        import json
+
+        boot2 = agreement_question_bootstrap(
+            instruct_df, base_df, means, n_bootstrap=150, seed=7,
+            n_diff_bootstrap=2000,
+        )
+        assert json.dumps(boot, default=float) == json.dumps(boot2, default=float)
