@@ -193,6 +193,21 @@ def test_survey2_instruct_sweep_chain(snapshot, tmp_path, capsys):
     # coexist with the 50q sweep's checkpoint in one output dir
     assert (out / "instruct_model_comparison_results_survey2_checkpoint.json").exists()
 
+    # chain end: the sweep CSV feeds the consolidated survey pipeline (the
+    # reference concatenated its survey-2 run into the combined CSV that
+    # survey_analysis_consolidated.py consumes)
+    survey_out = tmp_path / "survey2_analysis"
+    main([
+        "analyze-survey",
+        "--survey1-csv", "/root/reference/data/word_meaning_survey_results.csv",
+        "--survey2-csv", ref2,
+        "--llm-csv", str(csv),
+        "--output-dir", str(survey_out),
+        "--bootstrap", "20", "--cross-prompt-bootstrap", "2",
+    ])
+    results = json.loads((survey_out / "results.json").read_text())
+    assert results, "analyze-survey produced no results on the survey-2 sweep"
+
 
 def test_api_perturbation_cli_full_batch_lifecycle(tmp_path, monkeypatch, capsys):
     """run-api-perturbation via the CLI against a faked OpenAI Batch service

@@ -189,6 +189,52 @@ class TestScoringEngine:
             assert a["scan_found"] == b["scan_found"]
             assert b["completion"] == ""
 
+    def test_two_phase_gather_path_on_dp_mesh(self, eight_cpu_devices):
+        """The phase-2 subset GATHER (undecided rows pulled out of a SHARDED
+        prefill cache, m < batch) must work across the data mesh and agree
+        with the single-device full-decode result.  batch 16 on dp=8 with
+        few prompts forces m=8 < 16, the gather branch."""
+        import dataclasses as dc
+
+        from llm_interpretation_replication_tpu.parallel import make_mesh
+
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+        from llm_interpretation_replication_tpu.runtime import batching
+        from llm_interpretation_replication_tpu.scoring import yes_no as yn
+
+        prompts = [f"prompt number {i} about soup" for i in range(5)]
+        eng_single, _, _ = _tiny_engine(mesh=None, batch_size=16)
+        rows_single = eng_single.score_prompts(prompts)
+
+        # guard against vacuity: at least one prompt must be UNDECIDED at
+        # position 0, otherwise phase 2 (the gather under test) never runs
+        yes_id, no_id = eng_single.target_ids(("Yes", "No"))[:2]
+        batch = next(batching.batches_for_prompts(
+            batching.encode_prompts(eng_single.tokenizer, prompts), 16,
+            eng_single.ecfg.buckets,
+            pad_id=eng_single.tokenizer.pad_token_id or 0,
+        ))
+        last = dmod.forward_last_logits(
+            eng_single.params, eng_single.cfg,
+            jnp.asarray(batch.token_ids), jnp.asarray(batch.attention_mask),
+        )
+        hit = np.asarray(yn.first_token_scan(
+            last, yes_id, no_id, top_k=eng_single.ecfg.top_k)[4])
+        n_undecided = int((~hit & (batch.indices >= 0)).sum())
+        assert n_undecided >= 1, "fixture decided every row at position 0"
+
+        mesh = make_mesh(data=8, model=1, seq=1)
+        eng_dp, _, _ = _tiny_engine(mesh=mesh, batch_size=16)
+        eng_dp.ecfg = dc.replace(eng_dp.ecfg, decode_completions=False)
+        rows_dp = eng_dp.score_prompts(prompts)
+        for a, b in zip(rows_single, rows_dp):
+            np.testing.assert_allclose(
+                a["relative_prob"], b["relative_prob"], atol=1e-5
+            )
+            assert a["scan_found"] == b["scan_found"]
+
     def test_chunked_scan_matches_single_chunk(self):
         """scan_chunk must be invisible in the results: the early exit may
         only fire when every real row is resolved (hit or actual EOS), so a
