@@ -147,7 +147,8 @@ def test_instruct_sweep_cli_roundtrip(snapshot, tmp_path, capsys):
 
 
 @pytest.mark.skipif(
-    not os.path.exists("/root/reference/data/word_meaning_survey_results_part_2.csv"),
+    not (os.path.exists("/root/reference/data/word_meaning_survey_results_part_2.csv")
+         and os.path.exists("/root/reference/data/word_meaning_survey_results.csv")),
     reason="reference not mounted",
 )
 def test_survey2_instruct_sweep_chain(snapshot, tmp_path, capsys):

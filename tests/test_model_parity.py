@@ -225,6 +225,112 @@ def test_greedy_decode_matches_hf_generate(rng):
     np.testing.assert_allclose(np.asarray(scores)[0], hf_scores, atol=2e-3, rtol=1e-3)
 
 
+def test_greedy_decode_alibi_and_learned_positions_match_hf(rng):
+    """Decode-path position machinery beyond rotary: the two-block decode
+    attention rebuilds ALiBi distances (BLOOM) and learned-position lookups
+    (OPT, +2 offset) from the cache's explicit positions — both must
+    reproduce HF generate token-for-token, not just the prompt forward."""
+    from transformers import (
+        BloomConfig,
+        BloomForCausalLM,
+        GPTJConfig,
+        GPTJForCausalLM,
+        OPTConfig,
+        OPTForCausalLM,
+    )
+
+    cases = [
+        ("bloom", BloomForCausalLM, BloomConfig(
+            vocab_size=VOCAB, hidden_size=32, n_layer=3, n_head=4), 3),
+        ("opt", OPTForCausalLM, OPTConfig(
+            vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, ffn_dim=64, max_position_embeddings=64,
+            do_layer_norm_before=True, word_embed_proj_dim=32), 6),
+        # interleaved partial rotary + shared-LN parallel block + lm_head bias
+        ("gptj", GPTJForCausalLM, GPTJConfig(
+            vocab_size=VOCAB, n_embd=32, n_layer=3, n_head=4, rotary_dim=4,
+            n_positions=64, activation_function="gelu_new"), 21),
+    ]
+    steps = 6
+    for fam_expect, cls, hf_config, seed in cases:
+        torch.manual_seed(seed)
+        model = cls(hf_config).eval()
+        ids = rng.integers(3, VOCAB, size=(1, 9)).astype(np.int32)
+        mask = np.ones_like(ids)
+        with torch.no_grad():
+            out = model.generate(
+                torch.tensor(ids), max_new_tokens=steps, do_sample=False,
+                output_scores=True, return_dict_in_generate=True,
+                pad_token_id=0,
+            )
+        hf_tokens = out.sequences[0, ids.shape[1]:].numpy()
+        hf_scores = np.stack([s[0].float().numpy() for s in out.scores])
+        fam, cfg = mcfg.from_hf_config(hf_config)
+        assert fam == fam_expect
+        params = mconvert.convert(
+            fam, mconvert.getter_from_torch_state_dict(model.state_dict()),
+            cfg, dtype=jnp.float32,
+        )
+        tokens, scores = decoder.greedy_decode(
+            params, cfg, jnp.asarray(ids), jnp.asarray(mask), num_steps=steps
+        )
+        np.testing.assert_array_equal(np.asarray(tokens)[0], hf_tokens,
+                                      err_msg=fam)
+        np.testing.assert_allclose(np.asarray(scores)[0], hf_scores,
+                                   atol=2e-3, rtol=1e-3, err_msg=fam)
+
+
+def test_greedy_decode_eos_stop_matches_hf():
+    """EOS semantics: HF generate stops after emitting eos_token_id; our
+    batched decode force-pads with EOS past that point.  Designating a token
+    the model ACTUALLY generates mid-continuation as EOS makes the stop
+    deterministic: tokens up to and including it must match HF, and
+    everything after must be the forced EOS pad.  Uses a private rng (not
+    the module fixture) and picks a step whose token has no earlier
+    occurrence, so the test is order-independent and cannot stop early."""
+    from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+    local_rng = np.random.default_rng(42)
+    hf_config = GPTNeoXConfig(
+        vocab_size=VOCAB, hidden_size=32, num_hidden_layers=2,
+        num_attention_heads=4, intermediate_size=64, rotary_pct=0.25,
+        max_position_embeddings=64,
+    )
+    torch.manual_seed(7)
+    model = GPTNeoXForCausalLM(hf_config).eval()
+    ids = local_rng.integers(3, VOCAB, size=(1, 8)).astype(np.int32)
+    mask = np.ones_like(ids)
+    fam, cfg = mcfg.from_hf_config(hf_config)
+    params = mconvert.convert(
+        fam, mconvert.getter_from_torch_state_dict(model.state_dict()), cfg,
+        dtype=jnp.float32,
+    )
+    free_toks, _ = decoder.greedy_decode(
+        params, cfg, jnp.asarray(ids), jnp.asarray(mask), num_steps=8
+    )
+    free = [int(t) for t in np.asarray(free_toks)[0]]
+    # first step >= 1 whose token never occurred earlier: HF must stop THERE
+    stop = next(j for j in range(1, len(free)) if free[j] not in free[:j])
+    eos = free[stop]
+
+    with torch.no_grad():
+        out = model.generate(
+            torch.tensor(ids), max_new_tokens=8, do_sample=False,
+            pad_token_id=0, eos_token_id=eos,
+        )
+    hf_tokens = out[0, ids.shape[1]:].numpy()
+    assert hf_tokens[-1] == eos and len(hf_tokens) == stop + 1
+
+    toks, _ = decoder.greedy_decode(
+        params, cfg, jnp.asarray(ids), jnp.asarray(mask), num_steps=8,
+        eos_token_id=eos,
+    )
+    toks = np.asarray(toks)[0]
+    np.testing.assert_array_equal(toks[: stop + 1], hf_tokens)
+    np.testing.assert_array_equal(toks[stop + 1:],
+                                  np.full(8 - stop - 1, eos))  # forced pad
+
+
 def test_greedy_decode_ragged_batch_matches_unpadded(rng):
     """Padding must not change a row's continuation: decode each row alone vs
     in a ragged batch."""

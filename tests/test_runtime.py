@@ -198,8 +198,6 @@ class TestScoringEngine:
 
         from llm_interpretation_replication_tpu.parallel import make_mesh
 
-        import jax.numpy as jnp
-
         from llm_interpretation_replication_tpu.models import decoder as dmod
         from llm_interpretation_replication_tpu.runtime import batching
         from llm_interpretation_replication_tpu.scoring import yes_no as yn
