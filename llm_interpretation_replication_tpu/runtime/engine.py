@@ -265,15 +265,7 @@ class ScoringEngine:
                 completion = ""
                 if ecfg.decode_completions:
                     completion = self._completion_text(tokens_np[r], eos_id)
-                row = {
-                    "yes_prob": float(vals[0]),
-                    "no_prob": float(vals[1]),
-                    "relative_prob": float(vals[2]),
-                    "odds_ratio": float(vals[3]),
-                    "scan_found": bool(vals[4]),
-                    "completion": completion,
-                    "success": True,
-                }
+                row = _result_row(*vals, completion)
                 if with_confidence:
                     k = r if sub_pos is None else sub_pos[r]
                     cands = top_candidates_from_scores(
@@ -380,15 +372,8 @@ class ScoringEngine:
                 completion = ""
                 if ecfg.decode_completions:
                     completion = self._completion_text(tokens_np[r], eos_id)
-                row = {
-                    "yes_prob": float(yes_np[r]),
-                    "no_prob": float(no_np[r]),
-                    "relative_prob": float(rel_np[r]),
-                    "odds_ratio": float(odds_np[r]),
-                    "scan_found": bool(found_np[r]),
-                    "completion": completion,
-                    "success": True,
-                }
+                row = _result_row(yes_np[r], no_np[r], rel_np[r],
+                                  odds_np[r], found_np[r], completion)
                 if with_confidence:
                     cands = top_candidates_from_scores(
                         scores_np[r], self.tokenizer, num_positions=3, top_k=19
@@ -462,6 +447,20 @@ def _gather_rows(cache, last, lengths, idx):
         length=cache.length,
     )
     return sub, last[idx], lengths[idx]
+
+
+def _result_row(yes, no, rel, odds, found, completion: str) -> Dict:
+    """One prompt's result dict — the ``get_yes_no_logprobs`` contract
+    (run_base_vs_instruct_100q.py:376-382)."""
+    return {
+        "yes_prob": float(yes),
+        "no_prob": float(no),
+        "relative_prob": float(rel),
+        "odds_ratio": float(odds),
+        "scan_found": bool(found),
+        "completion": completion,
+        "success": True,
+    }
 
 
 def _error_row(msg: str) -> Dict:

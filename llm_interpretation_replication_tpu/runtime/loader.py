@@ -188,6 +188,24 @@ def load_model(
             params = quantize_decoder_params_np(params)
     elif quant != "none":
         raise ValueError(f"unknown quant mode {quant!r}")
+    itemsize = jnp.dtype(dtype).itemsize
+    if (quant == "none" and family != "t5"
+            # 'auto' stays dense at sweep lengths too (it only flips to the
+            # flash kernel past its long-context threshold), so it OOMs the
+            # same way as explicit 'xla'
+            and cfg.attention_impl in ("xla", "auto")
+            and _param_bytes(params, itemsize) > DENSE_BF16_WARN_BYTES
+            and (mesh is None or mesh.devices.size == 1)):
+        import warnings
+
+        # measured on 16 GB v5e (PARITY.md bf16 note): ~13 GB of bf16 7B
+        # weights leave no HBM for the dense S×T attention scores at ANY
+        # sweep batch size — the run will OOM where int8 fits comfortably
+        warnings.warn(
+            f"{path}: unquantized weights at this scale typically cannot "
+            f"host dense attention scores on a single chip; use "
+            f"quant='int8' or attention_impl='flash' (block-streamed "
+            f"scores)")
     if mesh is not None:
         from ..parallel.sharding import param_specs
 
@@ -207,6 +225,19 @@ def load_model(
     else:
         params = _cast(params, dtype)
     return family, cfg, params
+
+
+# Unquantized-weight bytes above which single-chip dense attention is known
+# not to fit 16 GB HBM beside the weights (bf16 7B ≈ 13 GB measured).
+DENSE_BF16_WARN_BYTES = 10e9
+
+
+def _param_bytes(params, bytes_per_elem: int) -> float:
+    """Approximate device size of an unquantized param tree."""
+    import jax
+
+    return sum(np.prod(leaf.shape) for leaf in jax.tree_util.tree_leaves(params)
+               if hasattr(leaf, "shape")) * bytes_per_elem
 
 
 def _target_dtype(key, x, dtype):

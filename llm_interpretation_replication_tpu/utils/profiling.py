@@ -36,6 +36,49 @@ def annotate(name: str):
         yield
 
 
+def top_device_ops(trace_dir: str, top_n: int = 25):
+    """Per-op device-time totals out of a :func:`trace` capture — the
+    headless answer to TensorBoard's op profile (no TB in the image).
+
+    Parses the newest ``*.xplane.pb`` under ``trace_dir`` with the
+    TensorFlow tsl proto and sums event durations per XLA op on each device
+    plane.  Returns [(op_name, total_ms)] sorted descending.  This is the
+    analysis that located the round-3 decode relayout loop: look for
+    unexplained ``%while`` or ``%copy`` ops over large shapes between the
+    compute fusions (PARITY.md bench notes).
+    """
+    import glob
+    import os
+    from collections import defaultdict
+
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    paths = sorted(
+        glob.glob(os.path.join(trace_dir, "**", "*.xplane.pb"), recursive=True),
+        key=os.path.getmtime,
+    )
+    if not paths:
+        raise FileNotFoundError(f"no *.xplane.pb under {trace_dir}")
+    space = xplane_pb2.XSpace()
+    with open(paths[-1], "rb") as f:
+        space.ParseFromString(f.read())
+    totals: dict = defaultdict(float)
+    for plane in space.planes:
+        if "TPU" not in plane.name and "CPU" not in plane.name:
+            continue
+        # key on the authoritative map key — XEventMetadata.id is a
+        # by-convention duplicate some producers leave unset
+        names = {mid: m.name for mid, m in plane.event_metadata.items()}
+        for line in plane.lines:
+            # TPU device planes put XLA ops on "XLA Ops" lines; the CPU
+            # backend logs thunk executions on its PjRt client thread line
+            if "XLA Ops" not in line.name and "XLAPjRtCpuClient" not in line.name:
+                continue
+            for ev in line.events:
+                totals[names.get(ev.metadata_id, "?")] += ev.duration_ps / 1e9
+    return sorted(totals.items(), key=lambda kv: -kv[1])[:top_n]
+
+
 class ThroughputMeter:
     def __init__(self, n_chips: int = 1, clock=time.perf_counter):
         self.n_chips = max(n_chips, 1)

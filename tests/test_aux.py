@@ -43,6 +43,28 @@ class TestProfiling:
                     for _, _, fs in os.walk(tmp_path) for f in fs)
         assert found, "jax.profiler trace produced no artifacts"
 
+    def test_top_device_ops_finds_the_matmul(self, tmp_path):
+        """The headless op-profile reader (no TensorBoard in the image):
+        tracing a jit'd matmul must surface dot_general among the top ops —
+        the workflow that located the round-3 decode relayout loop."""
+        import jax
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.utils.profiling import (
+            top_device_ops,
+            trace,
+        )
+
+        f = jax.jit(lambda x: (x @ x.T).sum())
+        x = jnp.ones((256, 256))
+        f(x).block_until_ready()                    # compile outside the trace
+        with trace(str(tmp_path), enabled=True):
+            f(x).block_until_ready()
+        top = top_device_ops(str(tmp_path), top_n=10)
+        assert top, "no device ops parsed from the trace"
+        assert any("dot" in name for name, _ in top), top
+        assert all(ms >= 0 for _, ms in top)
+
     def test_trace_disabled_noop(self, tmp_path):
         from llm_interpretation_replication_tpu.utils.profiling import trace
 

@@ -355,6 +355,34 @@ class TestLoader:
         corr = np.corrcoef(ours.ravel(), theirs.ravel())[0, 1]
         assert corr > 0.999, corr
 
+    def test_unquantized_dense_scale_warning(self, tmp_path, monkeypatch):
+        """Loading unquantized weights past the single-chip dense-attention
+        budget warns (bf16 7B + dense S×T scores cannot share 16 GB HBM —
+        PARITY.md bf16 note); int8 or flash loads stay silent."""
+        import warnings
+
+        import torch
+        from transformers import GPTNeoXConfig, GPTNeoXForCausalLM
+
+        from llm_interpretation_replication_tpu.runtime import loader as loader_mod
+
+        hf_config = GPTNeoXConfig(
+            vocab_size=128, hidden_size=32, num_hidden_layers=2,
+            num_attention_heads=4, intermediate_size=64,
+            max_position_embeddings=64,
+        )
+        torch.manual_seed(41)
+        snap = tmp_path / "snap"
+        GPTNeoXForCausalLM(hf_config).save_pretrained(snap, safe_serialization=True)
+        monkeypatch.setattr(loader_mod, "DENSE_BF16_WARN_BYTES", 0)
+        with pytest.warns(UserWarning, match="dense attention"):
+            loader_mod.load_model(str(snap), dtype=jnp.float32)
+        with warnings.catch_warnings():
+            warnings.simplefilter("error")          # no warning allowed
+            loader_mod.load_model(str(snap), dtype=jnp.float32, quant="int8")
+            loader_mod.load_model(str(snap), dtype=jnp.float32,
+                                  attention_impl="flash")
+
     def test_load_int8_t5_falls_back_to_bf16(self, tmp_path):
         """A global --quant int8 must not abort mixed sweeps: T5 loads warn
         and fall back instead of raising."""
