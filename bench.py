@@ -17,7 +17,7 @@ a conservative A100 baseline, so vs_baseline = prompts_per_sec / 1.0.
 
 Default configuration (measured on TPU v5e, 2026-07): w8a8 int8 projections
 (the reference's own path is bitsandbytes int8; ours keeps 0.9997 logit
-correlation vs bf16, and <=0.0017 relative-prob drift across all 7 decoder
+correlation vs bf16, and <=0.0043 relative-prob drift across all 8 decoder
 families — ops/quant.py, tests/test_quant_audit.py, PARITY.md) at batch 192
 with the engine's 432-token length bucket (430-token prompts pad to 432 —
 runtime/batching.DEFAULT_BUCKETS), where the v5e int8 MXU path runs ~2.3x

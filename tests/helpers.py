@@ -44,3 +44,38 @@ def build_test_tokenizer(vocab_size: int = 300):
     )
 
     return build_inprocess_tokenizer(vocab_size)
+
+
+def chatglm_test_setup(vocab_size: int = 128, seed: int = 11):
+    """(hf_config_namespace, torch_state_dict) for the ChatGLM2 tiny geometry
+    — the remote-code family with no offline HF oracle; shared by the
+    handcrafted-oracle parity test and the int8 quantization audit."""
+    import types
+
+    import numpy as np
+    import torch
+
+    hf = types.SimpleNamespace(
+        model_type="chatglm", padded_vocab_size=vocab_size, hidden_size=32,
+        num_layers=3, num_attention_heads=4, kv_channels=8,
+        multi_query_attention=True, multi_query_group_num=2,
+        ffn_hidden_size=48, seq_length=64, layernorm_epsilon=1e-5,
+        rmsnorm=True, add_qkv_bias=True, add_bias_linear=False,
+    )
+    n, d, g, h, f = 4, 8, 2, 32, 48
+    nd, kvd = n * d, g * d
+    rng = np.random.default_rng(seed)
+    sd = {}
+    for i in range(hf.num_layers):
+        pre = f"transformer.encoder.layers.{i}"
+        sd[f"{pre}.self_attention.query_key_value.weight"] = rng.standard_normal((nd + 2 * kvd, h)) * 0.05
+        sd[f"{pre}.self_attention.query_key_value.bias"] = rng.standard_normal(nd + 2 * kvd) * 0.02
+        sd[f"{pre}.self_attention.dense.weight"] = rng.standard_normal((h, nd)) * 0.05
+        sd[f"{pre}.mlp.dense_h_to_4h.weight"] = rng.standard_normal((2 * f, h)) * 0.05
+        sd[f"{pre}.mlp.dense_4h_to_h.weight"] = rng.standard_normal((h, f)) * 0.05
+        sd[f"{pre}.input_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
+        sd[f"{pre}.post_attention_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
+    sd["transformer.embedding.word_embeddings.weight"] = rng.standard_normal((vocab_size, h)) * 0.05
+    sd["transformer.encoder.final_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
+    sd["transformer.output_layer.weight"] = rng.standard_normal((vocab_size, h)) * 0.05
+    return hf, {k: torch.tensor(v) for k, v in sd.items()}

@@ -615,43 +615,24 @@ def test_glm_parity(rng):
 
 def test_chatglm_conversion_structure():
     """ChatGLM2-6B geometry (remote-code family; no offline HF oracle):
-    config translation + weight conversion from a synthetic state dict +
-    jit forward must produce finite logits with the right shapes."""
-    import types
+    config translation + weight conversion from the shared synthetic state
+    dict + jit forward must produce finite logits with the right shapes."""
+    from helpers import chatglm_test_setup
 
-    hf = types.SimpleNamespace(
-        model_type="chatglm", padded_vocab_size=VOCAB, hidden_size=32,
-        num_layers=2, num_attention_heads=4, kv_channels=8,
-        multi_query_attention=True, multi_query_group_num=2,
-        ffn_hidden_size=48, seq_length=64, layernorm_epsilon=1e-5,
-        rmsnorm=True, add_qkv_bias=True, add_bias_linear=False,
-    )
+    hf, sd = chatglm_test_setup(VOCAB)
     fam, cfg = mcfg.from_hf_config(hf)
     assert fam == "chatglm"
     assert cfg.num_kv_heads == 2 and cfg.rotary_style == "interleaved"
     assert cfg.rotary_pct == 0.5 and cfg.intermediate_size == 48
 
-    rng2 = np.random.default_rng(7)
-    nd, kvd, h, f = 32, 16, 32, 48
-    sd = {}
-    for i in range(cfg.num_layers):
-        pre = f"transformer.encoder.layers.{i}"
-        sd[f"{pre}.self_attention.query_key_value.weight"] = rng2.standard_normal((nd + 2 * kvd, h)) * 0.05
-        sd[f"{pre}.self_attention.query_key_value.bias"] = rng2.standard_normal(nd + 2 * kvd) * 0.01
-        sd[f"{pre}.self_attention.dense.weight"] = rng2.standard_normal((h, nd)) * 0.05
-        sd[f"{pre}.mlp.dense_h_to_4h.weight"] = rng2.standard_normal((2 * f, h)) * 0.05
-        sd[f"{pre}.mlp.dense_4h_to_h.weight"] = rng2.standard_normal((h, f)) * 0.05
-        sd[f"{pre}.input_layernorm.weight"] = np.ones(h)
-        sd[f"{pre}.post_attention_layernorm.weight"] = np.ones(h)
-    sd["transformer.embedding.word_embeddings.weight"] = rng2.standard_normal((VOCAB, h)) * 0.05
-    sd["transformer.encoder.final_layernorm.weight"] = np.ones(h)
-    sd["transformer.output_layer.weight"] = rng2.standard_normal((VOCAB, h)) * 0.05
-
-    get = lambda name: sd[name]  # noqa: E731
-    params = mconvert.convert("chatglm", get, cfg, dtype=jnp.float32)
-    assert params["layers"]["attn"]["wq"].shape == (2, h, nd)
-    assert params["layers"]["attn"]["wk"].shape == (2, h, kvd)
-    assert params["layers"]["mlp"]["wg"].shape == (2, h, f)
+    L, h, nd, kvd, f = hf.num_layers, 32, 32, 16, 48
+    params = mconvert.convert(
+        "chatglm", mconvert.getter_from_torch_state_dict(sd), cfg,
+        dtype=jnp.float32,
+    )
+    assert params["layers"]["attn"]["wq"].shape == (L, h, nd)
+    assert params["layers"]["attn"]["wk"].shape == (L, h, kvd)
+    assert params["layers"]["mlp"]["wg"].shape == (L, h, f)
     ids = np.random.default_rng(8).integers(3, VOCAB, size=(2, 10)).astype(np.int32)
     mask = np.ones_like(ids)
     mask[1, 7:] = 0
@@ -670,34 +651,16 @@ def test_chatglm_numeric_parity_handcrafted_oracle():
     loads via trust_remote_code (compare_instruct_models.py:409-421).  Every
     other family pins against an executable HF oracle; this closes the one
     structural-only gap at the same <=1e-4 tolerance."""
-    import types
+    from helpers import chatglm_test_setup
 
-    hf = types.SimpleNamespace(
-        model_type="chatglm", padded_vocab_size=VOCAB, hidden_size=32,
-        num_layers=2, num_attention_heads=4, kv_channels=8,
-        multi_query_attention=True, multi_query_group_num=2,
-        ffn_hidden_size=48, seq_length=64, layernorm_epsilon=1e-5,
-        rmsnorm=True, add_qkv_bias=True, add_bias_linear=False,
-    )
+    hf, sd_torch = chatglm_test_setup(VOCAB)
     fam, cfg = mcfg.from_hf_config(hf)
     assert fam == "chatglm"
-    L, h, n, d, g, f = 2, 32, 4, 8, 2, 48
+    L, h, n, d, g, f = hf.num_layers, 32, 4, 8, 2, 48
     nd, kvd = n * d, g * d
-    rng = np.random.default_rng(11)
-    sd = {}
-    for i in range(L):
-        pre = f"transformer.encoder.layers.{i}"
-        sd[f"{pre}.self_attention.query_key_value.weight"] = rng.standard_normal((nd + 2 * kvd, h)) * 0.05
-        sd[f"{pre}.self_attention.query_key_value.bias"] = rng.standard_normal(nd + 2 * kvd) * 0.02
-        sd[f"{pre}.self_attention.dense.weight"] = rng.standard_normal((h, nd)) * 0.05
-        sd[f"{pre}.mlp.dense_h_to_4h.weight"] = rng.standard_normal((2 * f, h)) * 0.05
-        sd[f"{pre}.mlp.dense_4h_to_h.weight"] = rng.standard_normal((h, f)) * 0.05
-        sd[f"{pre}.input_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
-        sd[f"{pre}.post_attention_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
-    sd["transformer.embedding.word_embeddings.weight"] = rng.standard_normal((VOCAB, h)) * 0.05
-    sd["transformer.encoder.final_layernorm.weight"] = 1.0 + rng.standard_normal(h) * 0.05
-    sd["transformer.output_layer.weight"] = rng.standard_normal((VOCAB, h)) * 0.05
+    sd = {k: v.numpy() for k, v in sd_torch.items()}
 
+    rng = np.random.default_rng(11)
     ids, mask = _batch(rng)
     eps = 1e-5
 

@@ -89,14 +89,23 @@ def _families():
             num_attention_heads=4, num_key_value_heads=2,
             intermediate_size=64, max_position_embeddings=64,
         ),
+        # remote-code family: weights come from the shared synthetic state
+        # dict (helpers.chatglm_test_setup), not AutoModel
+        "chatglm2-mqa": "chatglm",
     }
 
 
-def _model_for(hf_config, seed):
+def _weights_for(hf_config, seed):
+    """(hf_config, state_dict) — AutoModel for HF families, the synthetic
+    ChatGLM2 setup for the remote-code one."""
+    if hf_config == "chatglm":
+        from helpers import chatglm_test_setup
+
+        return chatglm_test_setup(VOCAB, seed=seed + 11)
     from transformers import AutoModelForCausalLM
 
     torch.manual_seed(seed)
-    return AutoModelForCausalLM.from_config(hf_config).eval()
+    return hf_config, AutoModelForCausalLM.from_config(hf_config).eval().state_dict()
 
 
 def _prompt_batch(rng, n=N_PROMPTS, seq=24):
@@ -118,9 +127,9 @@ def _relative_probs(params, cfg, ids, mask):
 
 
 def _audit_family(name, hf_config, seed=0):
+    hf_config, state_dict = _weights_for(hf_config, seed)
     fam, cfg = mcfg.from_hf_config(hf_config)
-    model = _model_for(hf_config, seed)
-    get = mconvert.getter_from_torch_state_dict(model.state_dict())
+    get = mconvert.getter_from_torch_state_dict(state_dict)
     params = mconvert.convert(fam, get, cfg, dtype=jnp.bfloat16)
     qparams = quantize_decoder_params(params)
     rng = np.random.default_rng(seed + 1)
