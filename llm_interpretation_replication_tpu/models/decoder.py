@@ -169,29 +169,6 @@ def dot_product_attention(q, k, v, bias):
     return jnp.einsum("bnst,btnd->bsnd", probs, v)
 
 
-def grouped_dot_product_attention(q, k, v, bias):
-    """MQA/GQA attention on UNREPEATED K/V: q [B,S,N,D], k/v [B,T,G,D],
-    bias broadcastable to [B,N,S,T] with N either full heads or 1.
-
-    ``_repeat_kv`` + :func:`dot_product_attention` forces XLA to materialize
-    an [B,T,N,D] K/V copy (770 MB/layer at the sweep shape for Falcon's 71:1
-    MQA) — harmless amortized over a 432-token prompt forward, dominant at
-    decode steps where S=1.  The grouped einsum keeps K/V at [B,T,G,D]."""
-    b, s, n, d = q.shape
-    g = k.shape[2]
-    hpg = n // g
-    qg = q.reshape(b, s, g, hpg, d)
-    scores = jnp.einsum("bsghd,btgd->bghst", qg, k) / jnp.sqrt(d).astype(q.dtype)
-    bias = jnp.broadcast_to(bias, (b, bias.shape[1], s, k.shape[1]))
-    bias_g = (
-        bias.reshape(b, g, hpg, s, -1) if bias.shape[1] == n
-        else bias[:, :, None]                          # head-agnostic [B,1,1,S,T]
-    )
-    probs = jax.nn.softmax(scores.astype(jnp.float32) + bias_g, axis=-1)
-    out = jnp.einsum("bghst,btgd->bsghd", probs.astype(q.dtype), v)
-    return out.reshape(b, s, n, d)
-
-
 def _grouped_scores(q, k):
     """q [B,S,N,D] × unrepeated k [B,T,G,D] → scores [B,G,N/G,S,T]."""
     b, s, n, d = q.shape
@@ -272,14 +249,6 @@ class KVCache(NamedTuple):
     length: jnp.ndarray     # [] int32 — slots filled so far
 
 
-def init_cache(cfg: DecoderConfig, batch: int, max_len: int, dtype=jnp.bfloat16) -> KVCache:
-    shape = (cfg.num_layers, batch, max_len, cfg.num_kv_heads, cfg.head_dim)
-    return KVCache(
-        k=jnp.zeros(shape, dtype), v=jnp.zeros(shape, dtype),
-        positions=jnp.broadcast_to(jnp.arange(max_len)[None], (batch, max_len)),
-        valid=jnp.zeros((batch, max_len), bool),
-        length=jnp.zeros((), jnp.int32),
-    )
 
 
 # ---------------------------------------------------------------------------
@@ -479,24 +448,17 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
     return x, cache
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "return_cache", "cache_len"))
+@functools.partial(jax.jit, static_argnames=("cfg",))
 def forward(
     params,
     cfg: DecoderConfig,
     token_ids,                 # [B, S] int32, right-padded
     attention_mask,            # [B, S] 1 for real tokens
-    return_cache: bool = False,
-    cache_len: Optional[int] = None,
 ):
-    """Full-sequence forward.  Returns fp32 logits [B, S, V]; optionally also a
-    KV cache (padded to ``cache_len``) for subsequent greedy decode."""
-    s = token_ids.shape[1]
-    x, cache = _trunk(params, cfg, token_ids, attention_mask,
-                      (cache_len or s) if return_cache else None)
-    logits = _unembed(cfg, params, x)
-    if return_cache:
-        return logits, cache
-    return logits
+    """Full-sequence forward: fp32 logits [B, S, V].  (Decode flows start
+    from :func:`prefill`, which returns last-position logits + KV cache.)"""
+    x, _ = _trunk(params, cfg, token_ids, attention_mask, None)
+    return _unembed(cfg, params, x)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg",))

@@ -712,9 +712,7 @@ def test_chatglm_numeric_parity_handcrafted_oracle():
     x = rms(x, sd["transformer.encoder.final_layernorm.weight"])
     oracle = x @ sd["transformer.output_layer.weight"].T
 
-    get = mconvert.getter_from_torch_state_dict(
-        {kk: torch.tensor(vv) for kk, vv in sd.items()}
-    )
+    get = mconvert.getter_from_torch_state_dict(sd_torch)
     params = mconvert.convert("chatglm", get, cfg, dtype=jnp.float32)
     ours = np.asarray(decoder.forward(params, cfg, jnp.asarray(ids), jnp.asarray(mask)))
     _assert_close(ours, oracle, mask, atol=1e-4)
