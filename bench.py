@@ -206,7 +206,7 @@ def main():
                              "'xla' value) or the Pallas kernels "
                              "(ops/attention.py)")
     parser.add_argument("--mode", choices=["parity", "single", "decode"],
-                        default="parity",
+                        default=None,  # resolved to parity after --decode 0 compat
                         help="parity (default): the two-phase sweep — one "
                              "prefill settles every row whose position-0 "
                              "top-k contains a target (the reference reads "
@@ -248,6 +248,21 @@ def main():
                              "VPU-bound attention softmax with another's "
                              "MXU-bound projections")
     args = parser.parse_args()
+
+    if args.decode == 0:
+        # old CLI: --decode 0 was the single-forward fast path
+        if args.mode not in (None, "single"):
+            parser.error(f"--decode 0 selects the single-forward path and "
+                         f"contradicts --mode {args.mode}; drop one")
+        args.mode = "single"
+        args.decode = 10
+    if args.mode is None:
+        args.mode = "parity"
+    if not 0.0 <= args.decided_frac <= 1.0:
+        parser.error("--decided-frac must be within [0, 1]")
+    if args.mode == "parity" and args.microbatch > 1:
+        parser.error("--microbatch applies to the single/decode modes; the "
+                     "parity mode's decode slice is sized from the full batch")
 
     if args.quant == "none" and args.model == "falcon-7b":
         # bf16 7B weights (~13 GB) leave no HBM for the dense S×T attention
@@ -313,14 +328,6 @@ def main():
         first_token_scan,
         yes_no_from_scores,
     )
-
-    if args.decode == 0:
-        # old CLI: --decode 0 was the single-forward fast path
-        args.mode = "single"
-        args.decode = 10
-    if args.mode == "parity" and args.microbatch > 1:
-        parser.error("--microbatch applies to the single/decode modes; the "
-                     "parity mode's decode slice is sized from the full batch")
 
     # Undecided slice for the two-phase parity mode, padded to the engine's
     # power-of-two menu so the decode shape is one the engine also compiles.

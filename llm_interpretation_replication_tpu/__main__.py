@@ -116,6 +116,12 @@ def cmd_run_instruct_sweep(args):
         # survey-2 leg: the question list extracted from the Qualtrics
         # headers (extract-survey2-questions), the reference's
         # compare_instruct_models_survey2.py:298-355 prompts
+        if not args.results_csv:
+            raise SystemExit(
+                "--questions-file requires --results-csv: without it the "
+                "custom-question run would overwrite the default sweep's "
+                "instruct_model_comparison_results.csv"
+            )
         with open(args.questions_file, encoding="utf-8") as f:
             prompts = [line.strip() for line in f if line.strip()]
     else:

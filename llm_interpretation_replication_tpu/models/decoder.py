@@ -417,21 +417,19 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
         sin_cos = rotary_embedding(positions, rd, cfg.rope_theta, params["embed"]["tokens"].dtype)
 
     t = cache_len
+    # Slot index == position for right-padded rows: ONE definition of the
+    # cache's slot→position mapping, used by both the dense prompt bias and
+    # the returned KVCache (decode rebuilds biases from these fields).
+    kv_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
+    kv_valid = jnp.pad(mask, ((0, 0), (0, t - s)))
     # The prompt forward honors flash/auto here too — the dense cached path
     # materializes BOTH an S×T bias and S×T scores, exactly the HBM blowup
     # 'auto' exists to avoid on long prompts.  Decode steps (S=1) stay dense.
     use_flash = cfg.use_flash_attention(s)
     flash_lengths = (jnp.sum(attention_mask, axis=-1).astype(jnp.int32)
                      if use_flash else None)
-    if use_flash:
-        bias = None
-    else:
-        # Attention runs over the whole (zero-padded) cache: extend the
-        # key-side mask/positions from S to T.  Slot index == position for
-        # right-padded rows.
-        kv_positions = jnp.broadcast_to(jnp.arange(t)[None, :], (b, t))
-        kv_valid = jnp.pad(mask, ((0, 0), (0, t - s)))
-        bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
+    bias = (None if use_flash
+            else make_attention_bias(cfg, positions, kv_positions, kv_valid))
 
     def body(h, lp):
         h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, t, flash_lengths)
@@ -440,9 +438,7 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
     x, (ks, vs) = lax.scan(body, x, params["layers"])
     lengths = jnp.sum(attention_mask, axis=-1)  # [B] per-row prompt length
     cache = KVCache(
-        k=ks, v=vs,
-        positions=jnp.broadcast_to(jnp.arange(t)[None, :], (b, t)),
-        valid=jnp.pad(mask, ((0, 0), (0, t - s))),
+        k=ks, v=vs, positions=kv_positions, valid=kv_valid,
         length=jnp.max(lengths).astype(jnp.int32),
     )
     return x, cache

@@ -155,15 +155,20 @@ def forward(params, cfg: T5Config, enc_ids, enc_mask, dec_ids):
     return _unembed(params, cfg, x)
 
 
-@functools.partial(jax.jit, static_argnames=("cfg", "num_steps"))
+@functools.partial(jax.jit, static_argnames=("cfg", "num_steps", "score_steps"))
 def greedy_decode(params, cfg: T5Config, enc_ids, enc_mask, num_steps: int,
-                  eos_token_id: Optional[int] = None):
+                  eos_token_id: Optional[int] = None,
+                  score_steps: Optional[int] = None):
     """Greedy generation from ``decoder_start_token_id``.
 
-    Returns (tokens [B, num_steps], scores [B, num_steps, V]) — scores[i] is
-    the fp32 distribution from which token i was picked, mirroring HF
+    Returns (tokens [B, num_steps], scores [B, K, V]) — scores[i] is the fp32
+    distribution from which token i was picked, mirroring HF
     ``generate(output_scores=True)`` as consumed by the reference's
-    MAX_LOOK_AHEAD scan (run_base_vs_instruct_100q.py:310-320).
+    MAX_LOOK_AHEAD scan (run_base_vs_instruct_100q.py:310-320).  K is
+    ``score_steps`` (default: all steps): completion-only steps past the scan
+    window run in a second, score-free scan so the [B, steps, V] fp32 buffer
+    covers only the positions the scan can read (50-token completion decodes
+    would otherwise stack 5× the scores for nothing).
 
     The decoder re-runs over the (static-length) token prefix each step; for
     the ≤50-token generations of the reference this trades a tiny amount of
@@ -173,6 +178,7 @@ def greedy_decode(params, cfg: T5Config, enc_ids, enc_mask, num_steps: int,
     enc_hidden = encode(params, cfg, enc_ids, enc_mask)
     total = num_steps + 1
     tokens = jnp.full((b, total), cfg.decoder_start_token_id, jnp.int32)
+    k = num_steps if score_steps is None else min(score_steps, num_steps)
 
     pos = jnp.arange(total)
     self_bias_full = _position_bias(
@@ -199,7 +205,14 @@ def greedy_decode(params, cfg: T5Config, enc_ids, enc_mask, num_steps: int,
         tokens = lax.dynamic_update_slice(tokens, next_tok[:, None], (0, i + 1))
         return (tokens, done), (next_tok, step_logits)
 
-    (tokens, _), (out_toks, out_scores) = lax.scan(
-        step, (tokens, jnp.zeros((b,), bool)), jnp.arange(num_steps)
-    )
+    def step_tokens_only(carry, i):
+        carry, (next_tok, _) = step(carry, i)
+        return carry, next_tok
+
+    carry = (tokens, jnp.zeros((b,), bool))
+    carry, (out_toks, out_scores) = lax.scan(step, carry, jnp.arange(k))
+    if k < num_steps:
+        _, tail_toks = lax.scan(step_tokens_only, carry,
+                                jnp.arange(k, num_steps))
+        out_toks = jnp.concatenate([out_toks, tail_toks], axis=0)
     return jnp.swapaxes(out_toks, 0, 1), jnp.swapaxes(out_scores, 0, 1)

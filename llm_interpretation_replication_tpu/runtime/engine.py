@@ -346,16 +346,16 @@ class ScoringEngine:
             mask = self._put(batch.attention_mask)
             tokens, scores = t5mod.greedy_decode(
                 self.params, self.cfg, ids, mask, num_steps=gen_total,
-                eos_token_id=eos_id,
+                eos_token_id=eos_id, score_steps=steps,
             )
             res = yn.yes_no_from_scores(
-                scores[:, :steps], yes_id, no_id,
+                scores, yes_id, no_id,
                 max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
                 valid_steps=yn.steps_until_eos(tokens[:, :steps], eos_id),
             )
             # Only pin the [B, steps, V] scores buffer in the pending queue
             # when the confidence leg needs it — ~250 MB/batch at sweep sizes.
-            return tokens, scores[:, :steps] if with_confidence else None, res
+            return tokens, scores if with_confidence else None, res
 
         def consume(batch, out):
             tokens, scores, res = out

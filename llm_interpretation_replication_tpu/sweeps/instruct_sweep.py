@@ -38,6 +38,13 @@ def _score_model(engine, model_name: str, prompts: Sequence[str], is_base: bool)
     return {q: row for q, row in zip(prompts, rows)}
 
 
+def _prompts_fingerprint(prompts: Sequence[str]) -> str:
+    import hashlib
+
+    digest = hashlib.sha256("\n".join(prompts).encode("utf-8")).hexdigest()
+    return f"{len(prompts)}:{digest[:16]}"
+
+
 def run_instruct_sweep(
     engine_factory: EngineFactory,
     prompts: Sequence[str],
@@ -48,8 +55,17 @@ def run_instruct_sweep(
 ) -> pd.DataFrame:
     log = log or SessionLogger()
     models = list(models if models is not None else instruct_sweep_models())
-    ck = CheckpointFile(checkpoint_path, default={"outputs": {}})
+    fp = _prompts_fingerprint(prompts)
+    ck = CheckpointFile(checkpoint_path, default={"outputs": {}, "prompts": fp})
     state = ck.load()
+    # Checkpoints are keyed by model name; a checkpoint from a DIFFERENT
+    # question list (e.g. the 50q sweep's, when the survey-2 leg reuses its
+    # output dir) would silently skip every model and republish the old rows.
+    if state.get("prompts", fp) != fp:
+        log(f"Checkpoint {checkpoint_path} belongs to a different prompt set "
+            f"({state.get('prompts')} != {fp}); starting fresh")
+        state = {"outputs": {}, "prompts": fp}
+    state["prompts"] = fp
     outputs: Dict[str, Dict] = state["outputs"]
     for model_name in models:
         if model_name in outputs:
@@ -58,7 +74,7 @@ def run_instruct_sweep(
         log(f"Running instruct model: {model_name}")
         engine = engine_factory(model_name)
         outputs[model_name] = _score_model(engine, model_name, prompts, is_base=False)
-        ck.save({"outputs": outputs})
+        ck.save({"outputs": outputs, "prompts": fp})
     df = instruct_comparison_frame(outputs, models)
     os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
     df.to_csv(results_csv, index=False)

@@ -452,27 +452,36 @@ class TestDistributedBootstrap:
 
         script = tmp_path / "dist_worker.py"
         script.write_text(WORKER_SCRIPT)
-        s = socket.socket()
-        s.bind(("127.0.0.1", 0))
-        port = s.getsockname()[1]
-        s.close()
         repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-        procs = [
-            subprocess.Popen(
-                [sys.executable, str(script), str(i), str(port), repo],
-                stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-            )
-            for i in range(2)
-        ]
-        outs = []
-        try:
-            for p in procs:
-                out, _ = p.communicate(timeout=240)
-                outs.append((p.returncode, out))
-        finally:
-            for p in procs:
-                if p.poll() is None:
-                    p.kill()
+
+        def run_once():
+            s = socket.socket()
+            s.bind(("127.0.0.1", 0))
+            port = s.getsockname()[1]
+            s.close()
+            procs = [
+                subprocess.Popen(
+                    [sys.executable, str(script), str(i), str(port), repo],
+                    stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+                )
+                for i in range(2)
+            ]
+            outs = []
+            try:
+                for p in procs:
+                    out, _ = p.communicate(timeout=240)
+                    outs.append((p.returncode, out))
+            finally:
+                for p in procs:
+                    if p.poll() is None:
+                        p.kill()
+            return outs
+
+        outs = run_once()
+        if any(rc != 0 and "Failed to connect" in out for rc, out in outs):
+            # ephemeral-port TOCTOU: something else grabbed the port between
+            # the probe bind and the coordinator bind — retry on a fresh one
+            outs = run_once()
         for i, (rc, out) in enumerate(outs):
             assert rc == 0, f"worker {i} failed:\n{out[-2000:]}"
             assert f"WORKER{i} OK 22.0" in out

@@ -140,6 +140,38 @@ class TestInstructSweep:
         assert list(df.columns) == INSTRUCT_COMPARISON_COLUMNS
         assert set(df["model_family"]) == {"gamma", "delta"}
 
+    def test_checkpoint_rejects_different_prompt_set(self, tmp_path):
+        """The checkpoint is keyed by model name; a checkpoint from a
+        DIFFERENT question list (e.g. the 50q sweep's, reused by a survey-2
+        run) must be discarded, not silently replayed as the new sweep."""
+        ck = str(tmp_path / "ck.json")
+        models = ["fake/gamma-7b-instruct"]
+        df1 = run_instruct_sweep(
+            lambda name: FakeEngine(name), prompts=QUESTIONS, models=models,
+            checkpoint_path=ck, results_csv=str(tmp_path / "a.csv"),
+        )
+        # same prompts -> checkpoint honored (no rescoring)
+        factory_calls = []
+
+        def factory(name):
+            factory_calls.append(name)
+            return FakeEngine(name)
+
+        run_instruct_sweep(
+            factory, prompts=QUESTIONS, models=models,
+            checkpoint_path=ck, results_csv=str(tmp_path / "b.csv"),
+        )
+        assert factory_calls == []
+        # different prompts -> stale checkpoint discarded, models rescored
+        other = [q + " (survey 2)" for q in QUESTIONS]
+        df2 = run_instruct_sweep(
+            factory, prompts=other, models=models,
+            checkpoint_path=ck, results_csv=str(tmp_path / "c.csv"),
+        )
+        assert factory_calls == models
+        assert set(df2["prompt"]) == set(other)
+        assert set(df2["prompt"]) != set(df1["prompt"])
+
     def test_word_meaning_pairs_schema(self, tmp_path):
         df = run_base_vs_instruct_word_meaning(
             lambda name: FakeEngine(name),
