@@ -1,6 +1,7 @@
 from .batching import Batch, batches_for_prompts, bucket_for, encode_prompts
 from .engine import EngineConfig, ScoringEngine
 from .loader import CheckpointDir, load_hf_config, load_model, load_tokenizer
+from .plan import ScoringPlan, resolve_scoring_plan
 from .train import TrainState, causal_lm_loss, init_train_state, make_optimizer, make_train_step
 
 __all__ = [
@@ -14,6 +15,8 @@ __all__ = [
     "load_hf_config",
     "load_model",
     "load_tokenizer",
+    "ScoringPlan",
+    "resolve_scoring_plan",
     "TrainState",
     "causal_lm_loss",
     "init_train_state",

@@ -46,6 +46,21 @@ class EngineConfig:
     pipeline_depth: int = 2         # in-flight device batches; host post-
                                     # processing of batch k overlaps device
                                     # compute of batch k+1 (JAX async dispatch)
+    phase2_pool: bool = True        # pool undecided rows across prefill
+                                    # batches and run ONE scored decode per
+                                    # ~pool_target rows (decode is weight-
+                                    # streaming-bound: a 10-step decode costs
+                                    # nearly the same for 24 rows as for 192,
+                                    # so amortizing it across batches removes
+                                    # most of the two-phase overhead)
+    phase2_pool_target: int = 0     # rows per pooled decode; 0 → batch_size
+    phase2_pool_max_bytes: int = 512 << 20
+                                    # HBM cap on gathered K/V held by the
+                                    # pool ACROSS ALL buckets; a bucket
+                                    # flushes early when the next add would
+                                    # exceed it, so pooling can never push a
+                                    # budget-fitting sweep into OOM (long
+                                    # buckets hold ~3.5 MB/row at 7B)
 
 
 class ScoringEngine:
@@ -160,6 +175,14 @@ class ScoringEngine:
         results: List[Optional[Dict]] = [None] * len(prompts)
         steps, gen_total = self._gen_plan()
 
+        pool = None
+        if ecfg.phase2_pool and not with_confidence and not ecfg.decode_completions:
+            pool = _Phase2Pool(
+                self, steps, eos_id, yes_id, no_id,
+                target=ecfg.phase2_pool_target or ecfg.batch_size,
+                results=results, max_bytes=ecfg.phase2_pool_max_bytes,
+            )
+
         def launch(batch):
             ids = self._put(batch.token_ids)
             mask = self._put(batch.attention_mask)
@@ -191,8 +214,22 @@ class ScoringEngine:
                 # Completion chunks: every row generates (the reference's
                 # generate does, regardless of where the scan hit); the first
                 # chunk doubles as the scored look-ahead when any row needs it.
+                #
+                # COMPILE FAN-OUT (deliberate): each chunk concatenates its
+                # tail into the cache, so successive chunks see cache lengths
+                # T, T+10, T+20, ... and compile ~gen_total/steps (≈5)
+                # executables per length bucket, amortized by XLA's
+                # persistent compilation cache.  The alternative — pre-pad
+                # the cache once to T+max_new_tokens and write tails in with
+                # dynamic-update-slice for a single shared executable — is
+                # exactly the scatter-updated-cache design the profiler
+                # killed in round 3: the DUS made XLA pick a T-minor cache
+                # layout whose full-cache relayout loop cost 150-310 ms per
+                # batch (models/decoder.KVCache docstring).  Five cheap
+                # compiles beat a relayout per batch.
                 prev, done, offset = last, None, 0
                 chunk_toks, scores_dev = [], None
+                lag_flag = None  # all-done flag of the PREVIOUS chunk
                 while offset < gen_total:
                     n = min(steps, gen_total - offset)
                     ws = offset == 0 and need_scores
@@ -204,9 +241,25 @@ class ScoringEngine:
                         scores_dev = sc
                     chunk_toks.append(toks)
                     offset += n
-                    if (eos_id is not None and offset < gen_total
-                            and bool(np.asarray(done).all())):
-                        break  # every row has emitted EOS — HF generate stops
+                    if eos_id is not None and offset < gen_total:
+                        # EOS early exit with a ONE-CHUNK LAG: reading chunk
+                        # k's `done` flag synchronously would leave the device
+                        # idle for a host round-trip before chunk k+1 could
+                        # dispatch.  Instead the flag is reduced on device,
+                        # its host copy starts immediately, and the LOOP EXIT
+                        # decision for chunk k+2 reads chunk k's flag — by
+                        # then chunk k+1 is already queued, so the device
+                        # pipeline never drains.  Cost: at most one surplus
+                        # chunk whose tokens are EOS-frozen (done rows emit
+                        # eos_id, _completion_text cuts at the first EOS), so
+                        # semantics are unchanged.
+                        if lag_flag is not None and bool(np.asarray(lag_flag)):
+                            break  # every row had emitted EOS — generate stops
+                        lag_flag = done.all()
+                        try:
+                            lag_flag.copy_to_host_async()
+                        except AttributeError:
+                            pass  # non-jax array backends: plain fetch later
                 tokens_np = np.concatenate(
                     [np.asarray(t) for t in chunk_toks], axis=1
                 )
@@ -224,39 +277,58 @@ class ScoringEngine:
             elif need_scores:
                 # No completions wanted: scored decode only, and only for the
                 # undecided rows — gathered out of the prefill cache so the
-                # prompt forward never re-runs (when most of the batch is
-                # undecided the gather-copy is pointless; decode in place).
-                m = _pad_pow2(undecided.size, hit0.shape[0])
-                if m == hit0.shape[0]:
-                    sub_cache, last_s, len_s, sub_pos = cache, last, lengths, None
-                else:
+                # prompt forward never re-runs.  The gathered rows normally
+                # accumulate in the cross-batch pool (one decode per
+                # ~pool_target rows); when most of the batch is undecided the
+                # gather-copy is pointless and the batch decodes in place,
+                # and the confidence leg (which needs per-row score buffers
+                # at emission time) always decodes immediately.
+                m = _pad_slice(undecided.size, hit0.shape[0])
+                if pool is not None and m < hit0.shape[0]:
                     idx = np.zeros((m,), np.int32)
                     idx[: undecided.size] = undecided
                     sub_cache, last_s, len_s = _gather_rows(
                         cache, last, lengths, jnp.asarray(idx)
                     )
-                    sub_pos = {int(r): j for j, r in enumerate(undecided)}
-                sc, toks_s = self._scan_decode_chunked(
-                    sub_cache, last_s, len_s, steps, eos_id, yes_id, no_id,
-                    min_steps=3 if with_confidence else 0,
-                    n_real=None if sub_pos is None else undecided.size,
-                )
-                res = yn.yes_no_from_scores(
-                    sc, yes_id, no_id,
-                    max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                    valid_steps=yn.steps_until_eos(toks_s, eos_id),
-                )
-                res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
-                if with_confidence:
-                    scores_np = np.asarray(sc)
+                    pool.add(batch.bucket_len, sub_cache, last_s, len_s,
+                             undecided.size, batch.indices[undecided])
+                    # res_np stays None: pooled rows are emitted at flush time
+                else:
+                    if m == hit0.shape[0]:
+                        sub_cache, last_s, len_s = cache, last, lengths
+                        real, sub_pos = valid, None
+                    else:
+                        idx = np.zeros((m,), np.int32)
+                        idx[: undecided.size] = undecided
+                        sub_cache, last_s, len_s = _gather_rows(
+                            cache, last, lengths, jnp.asarray(idx)
+                        )
+                        sub_pos = {int(r): j for j, r in enumerate(undecided)}
+                        real = np.zeros((m,), bool)
+                        real[: undecided.size] = True
+                    sc, toks_s = self._scan_decode_chunked(
+                        sub_cache, last_s, len_s, steps, eos_id, yes_id, no_id,
+                        min_steps=3 if with_confidence else 0,
+                        real_mask=real,
+                    )
+                    res = yn.yes_no_from_scores(
+                        sc, yes_id, no_id,
+                        max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                        valid_steps=yn.steps_until_eos(toks_s, eos_id),
+                    )
+                    res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+                    if with_confidence:
+                        scores_np = np.asarray(sc)
 
             for r, orig in enumerate(batch.indices):
                 if orig < 0:
                     continue
-                j = r if sub_pos is None else sub_pos.get(r)
                 if hit0[r] and not with_confidence:
                     vals = (yes0[r], no0[r], rel0[r], odds0[r], True)
+                elif res_np is None:
+                    continue  # undecided row deferred to the pool flush
                 else:
+                    j = r if sub_pos is None else sub_pos.get(r)
                     vals = (
                         res_np["yes_prob"][j], res_np["no_prob"][j],
                         res_np["relative_prob"][j], res_np["odds_ratio"][j],
@@ -281,11 +353,13 @@ class ScoringEngine:
             ),
             launch, consume,
         )
+        if pool is not None:
+            pool.flush_all()
         return [r if r is not None else _error_row("missing") for r in results]
 
     def _scan_decode_chunked(self, sub_cache, last_s, len_s, steps, eos_id,
                              yes_id, no_id, min_steps: int = 0,
-                             n_real: Optional[int] = None):
+                             real_mask: Optional[np.ndarray] = None):
         """Scored look-ahead decode in ``scan_chunk``-step chunks with early
         exit: once every row has either a top-k hit or an EOS-terminated
         score list, later positions can never be read by the reference's scan
@@ -293,9 +367,10 @@ class ScoringEngine:
         decoding them is pure waste.  In real sweeps undecided rows usually
         hit at positions 1-3, so the 10-step tail is rarely decoded.
 
-        ``n_real``: rows past this index are padding (duplicates of batch
-        row 0) and must not hold the exit open.  Returns (scores [m, P, V],
-        tokens [m, P]) with P <= steps."""
+        ``real_mask`` ([m] bool): rows outside the mask are padding
+        (duplicates of other rows, or blank pool filler) and must not hold
+        the exit open.  Returns (scores [m, P, V], tokens [m, P]) with
+        P <= steps."""
         ecfg = self.ecfg
         chunk = max(1, ecfg.scan_chunk)
         sc_parts, tok_parts = [], []
@@ -321,8 +396,8 @@ class ScoringEngine:
             # resolved = scan hit so far, or EOS actually emitted (the `done`
             # mask from decode_steps) — no later position can change the row
             resolved = np.asarray(part.found) | np.asarray(done)
-            if n_real is not None:
-                resolved = resolved[:n_real]
+            if real_mask is not None:
+                resolved = resolved[real_mask]
             if offset >= min_steps and bool(resolved.all()):
                 break
         return (jnp.concatenate(sc_parts, axis=1),
@@ -426,13 +501,147 @@ class ScoringEngine:
         return out
 
 
-def _pad_pow2(n: int, cap: int) -> int:
-    """Pad a phase-2 subset to a small fixed menu of sizes (powers of two,
-    capped at the batch size) so XLA compiles at most log2(B) decode shapes."""
-    m = 8
-    while m < n:
-        m *= 2
-    return min(m, cap)
+#: Fixed menu of phase-2 decode slice sizes.  Finer than powers of two
+#: (each pow2 entry gets a 1.5x midpoint) so the padded slice wastes at most
+#: ~33% lanes instead of ~50% — at the sweep's own operating point (batch 192,
+#: ~90% rows decided at position 0 → 19 undecided) the pow2 menu decoded 32
+#: rows with 13 of them padding; the 24-row entry decodes 5 padding rows.
+#: Each entry costs at most one compile per length bucket, amortized by XLA's
+#: persistent compilation cache.
+_SLICE_MENU = (8, 12, 16, 24, 32, 48, 64, 96, 128, 192, 256, 384, 512)
+
+
+def _pad_slice(n: int, cap: int) -> int:
+    """Smallest menu size >= n, capped at the batch size."""
+    for m in _SLICE_MENU:
+        if m >= n:
+            return min(m, cap)
+    return cap
+
+
+class _Phase2Pool:
+    """Cross-batch pool of phase-2 (undecided) rows.
+
+    The scored look-ahead decode is weight-streaming-bound: every step
+    streams the full weight set from HBM regardless of how few rows decode,
+    so a 10-step decode costs nearly the same for 24 rows as for 192.
+    Running it once per prefill batch therefore pays the full ~100-300 ms
+    decode cost for a handful of rows, every batch.  Instead, each batch's
+    undecided rows are gathered out of its prefill cache (a few MB per row)
+    and accumulate here, keyed by bucket length; ONE pooled decode runs per
+    ``target`` accumulated rows (and at end of sweep), amortizing the
+    per-step weight streaming across ~target/undecided-per-batch batches.
+    Semantics are unchanged — the same rows decode the same tokens from the
+    same caches, just grouped into fewer device programs.
+    """
+
+    def __init__(self, engine, steps, eos_id, yes_id, no_id, target, results,
+                 max_bytes: int = 512 << 20):
+        self.engine = engine
+        self.steps = steps
+        self.eos_id = eos_id
+        self.yes_id = yes_id
+        self.no_id = no_id
+        self.target = max(1, int(target))
+        self.max_bytes = max(1, int(max_bytes))
+        self.results = results
+        self.entries: Dict[int, List] = {}
+        self.counts: Dict[int, int] = {}
+        self.bytes: Dict[int, int] = {}
+
+    @staticmethod
+    def _entry_bytes(cache) -> int:
+        return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
+
+    def add(self, bucket_len, sub_cache, last_s, len_s, n_real, orig_idx):
+        """Queue one batch's gathered undecided slice (rows past ``n_real``
+        are gather padding).  ``orig_idx``: original prompt index per real
+        row.  Flushes when the bucket reaches ``target`` rows or the pool's
+        TOTAL held K/V would exceed ``max_bytes`` (the largest bucket
+        flushes first, freeing the most per row)."""
+        nb = self._entry_bytes(sub_cache)
+        while self.entries and sum(self.bytes.values()) + nb > self.max_bytes:
+            self.flush(max(self.bytes, key=self.bytes.get))
+        self.entries.setdefault(bucket_len, []).append(
+            (sub_cache, last_s, len_s, int(n_real), np.asarray(orig_idx))
+        )
+        self.counts[bucket_len] = self.counts.get(bucket_len, 0) + int(
+            last_s.shape[0]
+        )
+        self.bytes[bucket_len] = self.bytes.get(bucket_len, 0) + nb
+        if self.counts[bucket_len] >= self.target:
+            self.flush(bucket_len)
+
+    def flush_all(self):
+        for bucket_len in list(self.entries):
+            self.flush(bucket_len)
+
+    def _blank_entry(self, template, rows: int):
+        """Numerically-inert filler rows that pad a pooled decode up to a
+        menu size: one valid zero-K cache slot per row (so the attention
+        softmax never reduces over an empty set) and zero logits."""
+        cache_t, last_t, len_t = template
+        L, _, T, G, D = cache_t.k.shape
+        kv = jnp.zeros((L, rows, T, G, D), cache_t.k.dtype)
+        valid = jnp.zeros((rows, T), bool).at[:, 0].set(True)
+        cache = dmod.KVCache(
+            k=kv, v=kv,
+            positions=jnp.zeros((rows, T), cache_t.positions.dtype),
+            valid=valid, length=cache_t.length,
+        )
+        last = jnp.zeros((rows, last_t.shape[1]), last_t.dtype)
+        lens = jnp.ones((rows,), len_t.dtype)
+        return cache, last, lens, 0, np.empty((0,), np.int64)
+
+    def flush(self, bucket_len):
+        entries = self.entries.pop(bucket_len, [])
+        self.counts.pop(bucket_len, None)
+        self.bytes.pop(bucket_len, None)
+        if not entries:
+            return
+        total = sum(e[1].shape[0] for e in entries)
+        m = _pad_slice(total, total if total > _SLICE_MENU[-1] else _SLICE_MENU[-1])
+        if m > total:
+            entries.append(self._blank_entry(entries[0][:3], m - total))
+        if len(entries) == 1:
+            cache, last, lens = entries[0][:3]
+        else:
+            cache = dmod.KVCache(
+                k=jnp.concatenate([e[0].k for e in entries], axis=1),
+                v=jnp.concatenate([e[0].v for e in entries], axis=1),
+                positions=jnp.concatenate([e[0].positions for e in entries], axis=0),
+                valid=jnp.concatenate([e[0].valid for e in entries], axis=0),
+                length=entries[0][0].length,
+            )
+            last = jnp.concatenate([e[1] for e in entries], axis=0)
+            lens = jnp.concatenate([e[2] for e in entries], axis=0)
+        mask_parts = []
+        for _, last_e, _, n_real, _ in entries:
+            part = np.zeros((last_e.shape[0],), bool)
+            part[:n_real] = True
+            mask_parts.append(part)
+        mask = np.concatenate(mask_parts)
+        ecfg = self.engine.ecfg
+        sc, toks = self.engine._scan_decode_chunked(
+            cache, last, lens, self.steps, self.eos_id, self.yes_id,
+            self.no_id, real_mask=mask,
+        )
+        res = yn.yes_no_from_scores(
+            sc, self.yes_id, self.no_id,
+            max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+            valid_steps=yn.steps_until_eos(toks, self.eos_id),
+        )
+        res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+        row = 0
+        for _, last_e, _, n_real, orig in entries:
+            for j in range(n_real):
+                g = row + j
+                self.results[int(orig[j])] = _result_row(
+                    res_np["yes_prob"][g], res_np["no_prob"][g],
+                    res_np["relative_prob"][g], res_np["odds_ratio"][g],
+                    res_np["found"][g], "",
+                )
+            row += last_e.shape[0]
 
 
 @jax.jit

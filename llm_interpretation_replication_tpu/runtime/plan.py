@@ -1,0 +1,129 @@
+"""Execution planning: will this (model, quant, batch, seq) fit the chip?
+
+The round-3 profiling pass (PARITY.md "bf16 fallback") found exactly ONE
+working bf16 configuration for a 7B on a 16 GB v5e — Pallas flash attention
+at batch <= 64 — because bf16 weights (~13 GB) leave no room for the dense
+S×T attention-score tensors at any sweep batch, while the flash kernel
+streams scores in blocks.  That routing lived as an inline special case in
+bench.py; this module makes it a library decision the sweeps, the bench,
+and a regression test share, so the only-working bf16 path cannot silently
+regress (round-4 verdict item 7).
+
+The budget model is CALIBRATED against the measured v5e anchor points
+rather than derived from first principles (XLA's fusion decides what
+actually coexists in HBM):
+
+- w8a8 int8, dense, batch 192, seq 432: fits (the 38 p/s headline config)
+- bf16, dense, batch 64-192: OOM (measured round 3)
+- bf16, flash, batch 64: fits (21.2 p/s); batch 128: OOM
+
+Terms reproducing all five observations: bf16 score tensor (XLA keeps the
+fused softmax in bf16 at sweep shapes — an fp32 [B,H,S,S] alone would
+exceed what the measured-fitting int8 config leaves free), a half-live-set
+activation estimate (fusion means the widest transients never fully
+coexist), an fp32 output-accumulator workspace for the flash kernel, and a
+fixed runtime reserve.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional
+
+HBM_BYTES_V5E = 16 << 30
+#: Head-room XLA/runtime needs beside our tensors (compiled program
+#: buffers, fragmentation, transfer staging).  0.75 GiB separates the
+#: measured-fitting configs from the measured-OOM ones.
+RESERVE_BYTES = 3 << 28
+
+
+def param_count(cfg) -> int:
+    """Decoder parameter count from the geometry (embeddings + L blocks)."""
+    h, f, v = cfg.hidden_size, cfg.intermediate_size, cfg.vocab_size
+    nd = cfg.num_heads * cfg.head_dim
+    kvd = cfg.num_kv_heads * cfg.head_dim
+    per_layer = h * nd + 2 * h * kvd + nd * h + 2 * h * f
+    total = v * h + cfg.num_layers * per_layer
+    if not getattr(cfg, "tie_word_embeddings", False):
+        total += v * h
+    return total
+
+
+def weight_bytes(cfg, quant: str) -> int:
+    """bf16 = 2 B/param; w8a8 int8 = 1 B/param + fp32 per-channel scales
+    (negligible next to the matrices, bounded here at 1%)."""
+    n = param_count(cfg)
+    return int(n * 1.01) if quant == "int8" else 2 * n
+
+
+def dense_attention_bytes(cfg, batch: int, seq: int) -> int:
+    """The bf16 [B, H, S, S] score tensor of one dense-attention layer."""
+    return batch * cfg.num_heads * seq * seq * 2
+
+
+def activation_bytes(cfg, batch: int, seq: int) -> int:
+    """Live activation set per layer step: residual stream + the widest
+    transient (MLP intermediate), at half weight for fusion overlap."""
+    h, f = cfg.hidden_size, cfg.intermediate_size
+    return batch * seq * (h + 2 * f)
+
+
+def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
+    """fp32 output accumulator of the Pallas flash kernel."""
+    return batch * seq * cfg.num_heads * cfg.head_dim * 4
+
+
+@dataclasses.dataclass
+class ScoringPlan:
+    attention_impl: str        # "xla" (dense) or "flash"
+    batch: int                 # possibly clamped from the request
+    fits_dense: bool           # dense attention fits at the REQUESTED batch
+    weight_bytes: int
+    reason: str
+
+
+def resolve_scoring_plan(cfg, quant: str, batch: int, seq: int,
+                         hbm_bytes: int = HBM_BYTES_V5E,
+                         requested_impl: Optional[str] = None) -> ScoringPlan:
+    """Route a scoring sweep onto the chip.
+
+    - dense (XLA) attention is the throughput default (bench.py's outcome
+      table: the flash kernel loses ~12% in situ as an opaque fusion
+      boundary) — kept whenever weights + dense scores + activations fit;
+    - otherwise the Pallas flash kernel (block-streamed scores), with the
+      batch clamped (to a power of two, largest that fits weights +
+      activations + kernel workspace) — the bf16-7B escape hatch
+      (PARITY.md, measured: flash batch 64 = 21.2 p/s, dense OOM).
+
+    ``requested_impl='flash'`` skips the dense feasibility check but still
+    clamps the batch.
+    """
+    wb = weight_bytes(cfg, quant)
+    budget = hbm_bytes - RESERVE_BYTES
+    dense_need = wb + dense_attention_bytes(cfg, batch, seq) \
+        + activation_bytes(cfg, batch, seq)
+    fits_dense = dense_need <= budget
+    if fits_dense and requested_impl != "flash":
+        return ScoringPlan("xla", batch, True, wb,
+                           f"dense fits: {dense_need / 2**30:.1f} GiB of "
+                           f"{budget / 2**30:.1f}")
+
+    def flash_need(b):
+        return wb + activation_bytes(cfg, b, seq) \
+            + flash_workspace_bytes(cfg, b, seq)
+
+    if flash_need(batch) <= budget:
+        clamped = batch            # requested batch fits: no clamp
+    else:
+        per_row = max(1, flash_need(1) - wb)
+        b_max = max(1, int((budget - wb) // per_row))
+        clamped = 1                # largest fitting power of two
+        while clamped * 2 <= min(batch, b_max):
+            clamped *= 2
+    impl = "flash" if not fits_dense or requested_impl == "flash" else "xla"
+    return ScoringPlan(
+        impl, clamped, fits_dense, wb,
+        f"dense needs {dense_need / 2**30:.1f} GiB > budget "
+        f"{budget / 2**30:.1f}; flash at batch {clamped}"
+        if not fits_dense else f"flash requested; batch {clamped}",
+    )

@@ -233,6 +233,82 @@ class TestScoringEngine:
             )
             assert a["scan_found"] == b["scan_found"]
 
+    def test_bf16_escape_hatch_routing(self):
+        """The only-working bf16 7B configuration (PARITY.md 'bf16
+        fallback') is a LIBRARY decision, not a bench special case, and
+        must not silently regress: on falcon-7b geometry with a 16 GB HBM
+        budget, quant='none' routes to the Pallas flash kernel with the
+        batch clamped to 64 (dense would exceed the budget at ANY sweep
+        batch), while the int8 default keeps dense attention at batch 192
+        (the measured 38 p/s headline config)."""
+        from llm_interpretation_replication_tpu.models.config import DecoderConfig
+        from llm_interpretation_replication_tpu.runtime import resolve_scoring_plan
+
+        falcon7b = DecoderConfig(
+            vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71,
+            num_kv_heads=1, intermediate_size=18176, parallel_residual=True,
+            shared_layernorm=True, qkv_bias=False, out_bias=False,
+            mlp_bias=False, position_embedding="rotary",
+            tie_word_embeddings=True, max_position_embeddings=2048,
+        )
+        # bf16: dense infeasible, flash escape hatch at batch 64
+        plan = resolve_scoring_plan(falcon7b, "none", 192, 432)
+        assert not plan.fits_dense
+        assert plan.attention_impl == "flash"
+        assert plan.batch == 64
+        # ... even when the caller asks for a batch dense couldn't hold
+        plan64 = resolve_scoring_plan(falcon7b, "none", 64, 432)
+        assert not plan64.fits_dense and plan64.attention_impl == "flash"
+        # int8 default: dense fits at the headline operating point
+        plan_i8 = resolve_scoring_plan(falcon7b, "int8", 192, 432)
+        assert plan_i8.fits_dense
+        assert plan_i8.attention_impl == "xla" and plan_i8.batch == 192
+        # weights dominate: the estimate must see ~13 GiB of bf16 weights
+        assert 12 * 2**30 < plan.weight_bytes < 15 * 2**30
+        # tiny models never trigger the hatch
+        small = DecoderConfig(
+            vocab_size=50304, hidden_size=2048, num_layers=16, num_heads=16,
+            intermediate_size=8192, parallel_residual=True, qkv_bias=True,
+            out_bias=True, mlp_bias=True, position_embedding="rotary",
+            rotary_pct=0.25, max_position_embeddings=2048,
+        )
+        plan_s = resolve_scoring_plan(small, "none", 192, 432)
+        assert plan_s.fits_dense and plan_s.attention_impl == "xla"
+
+    def test_phase2_pool_matches_per_batch_decode(self):
+        """Cross-batch pooling of undecided rows (one scored decode per
+        ~pool_target rows instead of one per prefill batch) must be invisible
+        in the results: same probabilities, same scan_found, every prompt
+        emitted — including a mid-sweep flush, the end-of-sweep flush_all,
+        and blank filler rows padding the pooled slice to a menu size."""
+        import dataclasses as dc
+
+        eng, _, _ = _tiny_engine(batch_size=16)
+        # 40 prompts -> 3 batches of 16; undecided rows pool across batches.
+        prompts = [f"prompt {i} about soup, tweets and vehicles" for i in range(40)]
+        eng.ecfg = dc.replace(
+            eng.ecfg, decode_completions=False, phase2_pool=False
+        )
+        rows_direct = eng.score_prompts(prompts)
+        # targets: flush every batch / mid-sweep / only at flush_all; the
+        # last case also squeezes phase2_pool_max_bytes so the HBM cap path
+        # (early flush of the biggest bucket) is exercised and identical
+        for target, max_bytes in ((1, 512 << 20), (8, 512 << 20),
+                                  (16, 512 << 20), (64, 512 << 20),
+                                  (64, 1)):
+            eng.ecfg = dc.replace(
+                eng.ecfg, phase2_pool=True, phase2_pool_target=target,
+                phase2_pool_max_bytes=max_bytes,
+            )
+            rows_pooled = eng.score_prompts(prompts)
+            assert all(r["success"] for r in rows_pooled)
+            for a, b in zip(rows_direct, rows_pooled):
+                np.testing.assert_allclose(
+                    a["relative_prob"], b["relative_prob"], rtol=1e-5
+                )
+                np.testing.assert_allclose(a["yes_prob"], b["yes_prob"], rtol=1e-5)
+                assert a["scan_found"] == b["scan_found"]
+
     def test_chunked_scan_matches_single_chunk(self):
         """scan_chunk must be invisible in the results: the early exit may
         only fire when every real row is resolved (hit or actual EOS), so a
