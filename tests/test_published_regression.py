@@ -391,3 +391,64 @@ def test_combined_analysis_per_prompt_stats():
             assert got["std"].iloc[0] == pytest.approx(want[f"{model} Std"], abs=1e-9)
             checked += 1
     assert checked == 10          # 5 prompts x 2 surviving models
+
+
+# ---------------------------------------------------------------------------
+# verify-replication: the one-command parity harness (round-4 verdict item 3)
+# ---------------------------------------------------------------------------
+
+class TestVerifyReplication:
+    def test_verdict_logic(self):
+        from llm_interpretation_replication_tpu.analysis.replication import (
+            _check,
+            significance_category,
+        )
+
+        # point inside published CI
+        assert _check("t", "m", 0.2, (0.1, 0.3), 0.25)["verdict"] == "PASS"
+        # CIs overlap even though points differ
+        assert _check("t", "m", 0.2, (0.1, 0.3), 0.35,
+                      (0.28, 0.4))["verdict"] == "PASS"
+        # disjoint CIs fail
+        assert _check("t", "m", 0.2, (0.1, 0.3), 0.5,
+                      (0.4, 0.6))["verdict"] == "FAIL"
+        # point-only targets need printed-precision equality
+        assert _check("t", "m", 0.051, None, 0.0512)["verdict"] == "PASS"
+        assert _check("t", "m", 0.051, None, 0.057)["verdict"] == "FAIL"
+        # missing value fails
+        assert _check("t", "m", 0.2, (0.1, 0.3), None)["verdict"] == "FAIL"
+        # stars follow the PRINTED p (Claude vs Equanimity: p=0.0098 -> 0.010)
+        assert significance_category(0.0098) == "**"
+        assert significance_category(0.0022) == "***"
+        assert significance_category(0.2416) == "ns"
+        assert significance_category(0.07) == "*"
+
+    def test_all_pass_on_reference_artifacts(self):
+        """The full verifier on the reference's recorded artifacts: every
+        runnable check PASSES, Table 5 SKIPs (raw reference CSV unpublished
+        - .MISSING_LARGE_BLOBS), nothing FAILS."""
+        from llm_interpretation_replication_tpu.analysis.replication import (
+            format_report,
+            verify_replication,
+        )
+
+        result = verify_replication(
+            reference_root=REF, n_bootstrap=10_000,
+            cross_prompt_bootstrap=100,
+        )
+        assert result["ok"], format_report(result)
+        assert result["n_fail"] == 0
+        assert result["n_skip"] == 3          # the three Table-5 families
+        assert result["n_pass"] == 17
+        report = format_report(result)
+        assert "REPLICATION OK" in report
+        assert report.count("[PASS]") == 17
+
+    def test_table5_skip_without_results(self):
+        from llm_interpretation_replication_tpu.analysis.replication import (
+            check_table5,
+        )
+
+        rows = check_table5(None, "s1.csv", "s2.csv")
+        assert [r["verdict"] for r in rows] == ["SKIP"] * 3
+        assert all("snapshots" in r["detail"] for r in rows)
