@@ -641,6 +641,84 @@ def test_chatglm_conversion_structure():
     assert np.isfinite(logits[mask.astype(bool)]).all()
 
 
+def test_chatglm_numeric_parity_hf_glm_oracle():
+    """SECOND, independent ChatGLM2 oracle: HuggingFace's own
+    ``GlmForCausalLM`` (transformers' GLM-4 implementation — written by the
+    THUDM/HF teams, not by this repo) configured to the ChatGLM2 geometry.
+    The GLM-4 decoder block is the ChatGLM2 block: RMSNorm, biased QKV,
+    multi-query groups, INTERLEAVED rotary over the first half of each head
+    (partial_rotary_factor=0.5 with repeat_interleave'd cos/sin — its
+    ``apply_rotary_pos_emb`` rotates pairs (x[2i], x[2i+1]) by
+    theta_i = 10000^(-2i/rot), exactly RotaryEmbedding(kv_channels//2)),
+    fused-chunked swiglu MLP, sequential residuals, untied output layer.
+
+    The handcrafted numpy oracle below re-derives those equations by hand —
+    if this repo misread the published modeling_chatglm.py, the numpy oracle
+    could share the misreading.  HF's executable cannot: it is a separate
+    codebase whose GLM-4 checkpoints depend on these exact semantics.  Both
+    oracles agreeing with models/decoder.py (<=1e-4) closes that gap
+    (round-3 verdict item 5).  Reference load site:
+    compare_instruct_models.py:409-421."""
+    torch = pytest.importorskip("torch")
+    try:
+        from transformers import GlmConfig, GlmForCausalLM
+    except ImportError:
+        pytest.skip("transformers build without Glm")
+    from helpers import chatglm_test_setup
+
+    hf, sd = chatglm_test_setup(VOCAB)
+    n, d, g = 4, 8, 2
+    nd, kvd = n * d, g * d
+    glm_cfg = GlmConfig(
+        vocab_size=VOCAB, hidden_size=32, intermediate_size=48,
+        num_hidden_layers=hf.num_layers, num_attention_heads=n,
+        num_key_value_heads=g, head_dim=d, partial_rotary_factor=0.5,
+        attention_bias=True, rms_norm_eps=hf.layernorm_epsilon,
+        rope_theta=10000.0, tie_word_embeddings=False,
+        attention_dropout=0.0, max_position_embeddings=hf.seq_length,
+        pad_token_id=0,
+    )
+    model = GlmForCausalLM(glm_cfg).eval()
+    mapped = {}
+    for i in range(hf.num_layers):
+        src, dst = f"transformer.encoder.layers.{i}", f"model.layers.{i}"
+        qkv_w = sd[f"{src}.self_attention.query_key_value.weight"]
+        qkv_b = sd[f"{src}.self_attention.query_key_value.bias"]
+        mapped[f"{dst}.self_attn.q_proj.weight"] = qkv_w[:nd]
+        mapped[f"{dst}.self_attn.q_proj.bias"] = qkv_b[:nd]
+        mapped[f"{dst}.self_attn.k_proj.weight"] = qkv_w[nd:nd + kvd]
+        mapped[f"{dst}.self_attn.k_proj.bias"] = qkv_b[nd:nd + kvd]
+        mapped[f"{dst}.self_attn.v_proj.weight"] = qkv_w[nd + kvd:]
+        mapped[f"{dst}.self_attn.v_proj.bias"] = qkv_b[nd + kvd:]
+        mapped[f"{dst}.self_attn.o_proj.weight"] = sd[f"{src}.self_attention.dense.weight"]
+        mapped[f"{dst}.mlp.gate_up_proj.weight"] = sd[f"{src}.mlp.dense_h_to_4h.weight"]
+        mapped[f"{dst}.mlp.down_proj.weight"] = sd[f"{src}.mlp.dense_4h_to_h.weight"]
+        mapped[f"{dst}.input_layernorm.weight"] = sd[f"{src}.input_layernorm.weight"]
+        mapped[f"{dst}.post_attention_layernorm.weight"] = sd[f"{src}.post_attention_layernorm.weight"]
+    mapped["model.embed_tokens.weight"] = sd["transformer.embedding.word_embeddings.weight"]
+    mapped["model.norm.weight"] = sd["transformer.encoder.final_layernorm.weight"]
+    mapped["lm_head.weight"] = sd["transformer.output_layer.weight"]
+    missing, unexpected = model.load_state_dict(
+        {k: v.float() for k, v in mapped.items()}, strict=False)
+    assert not missing and not unexpected, (missing, unexpected)
+
+    rng = np.random.default_rng(11)
+    ids, mask = _batch(rng)
+    with torch.no_grad():
+        oracle = model(
+            torch.tensor(ids.astype(np.int64)),
+            attention_mask=torch.tensor(mask.astype(np.int64)),
+        ).logits.numpy()
+
+    fam, cfg = mcfg.from_hf_config(hf)
+    assert fam == "chatglm"
+    params = mconvert.convert(
+        fam, mconvert.getter_from_torch_state_dict(sd), cfg, dtype=jnp.float32)
+    ours = np.asarray(decoder.forward(
+        params, cfg, jnp.asarray(ids), jnp.asarray(mask)))
+    _assert_close(ours, oracle, mask, atol=1e-4)
+
+
 def test_chatglm_numeric_parity_handcrafted_oracle():
     """ChatGLM2 numeric pin WITHOUT remote code: a handcrafted numpy oracle of
     the ChatGLM2 block — RMSNorm, fused QKV with bias, multi-query groups,
