@@ -550,12 +550,16 @@ def human_agreement_report(
         p = np.array([r[2] for r in rows])
         mae = float(np.mean(np.abs(h - p)))
         rmse = float(np.sqrt(np.mean((h - p) ** 2)))
-        # near-zero human means are excluded from MAPE (same guard as
-        # agreement_bootstrap) so a degenerate question cannot make the JSON
-        # carry Infinity; no real survey-1 question has mean <= 0.01
-        with np.errstate(divide="ignore", invalid="ignore"):
-            ape = np.where(h > 0.01, np.abs((h - p) / h), np.nan)
-        mape = float(np.nanmean(ape) * 100)
+        # The reference divides unconditionally
+        # (analyze_llm_human_agreement.py:130), so a near-zero human mean
+        # would blow its MAPE up to inf.  No real survey question has a mean
+        # <= 0.01; assert that so data violating it fails LOUDLY here rather
+        # than silently dropping terms the reference would have included.
+        if not (h > 0.01).all():
+            raise ValueError(
+                f"{model}: human mean <= 0.01 would make the reference's "
+                f"unconditional MAPE non-finite")
+        mape = float(np.mean(np.abs((h - p) / h)) * 100)
         pr, pp = pearsonr(h, p)
         sr, sp = spearmanr(h, p)
         order = np.argsort(-np.abs(h - p))
