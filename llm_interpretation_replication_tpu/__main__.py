@@ -274,6 +274,26 @@ def cmd_generate_rephrasings(args):
           + " rephrasings per scenario")
 
 
+def cmd_run_gpt_perturbation(args):
+    import os
+
+    from .api_backends.openai_client import OpenAIClient
+    from .config import legal_scenarios
+    from .gen.rephrase import load_perturbations
+    from .sweeps.api_perturbation import run_gpt_perturbation_sweep
+
+    key = os.environ.get("OPENAI_API_KEY")
+    if not key:
+        raise SystemExit("OPENAI_API_KEY not set")
+    scenarios = load_perturbations(args.perturbations,
+                                   expected_scenarios=legal_scenarios())
+    run_gpt_perturbation_sweep(
+        OpenAIClient(key), args.model, scenarios, args.output,
+        rate_limit_sleep=args.sleep,
+        max_rephrasings=args.max_rephrasings,
+    )
+
+
 def cmd_run_gemini_perturbation(args):
     import os
 
@@ -480,13 +500,20 @@ def cmd_analyze_perturbations(args):
 
 
 def cmd_similarity(args):
+    from .analysis import similarity_report
+    from .analysis.similarity_report import load_embedding_model
     from .config import legal_scenarios
     from .gen.rephrase import load_perturbations
-    from .analysis import similarity_report
 
+    embedding_model = None
+    if args.embeddings:
+        # gated exactly like the reference: absent package / unloadable
+        # model degrades to the lexical metrics with a warning
+        embedding_model = load_embedding_model(args.embedding_model)
     records = load_perturbations(args.perturbations, expected_scenarios=legal_scenarios())
     summary = similarity_report(records, args.output_dir,
-                                max_rephrasings=args.max_rephrasings)
+                                max_rephrasings=args.max_rephrasings,
+                                embedding_model=embedding_model)
     print(summary.to_string(index=False))
 
 
@@ -875,6 +902,39 @@ def cmd_power_analysis(args):
     print(f"wrote {tex}")
 
 
+def cmd_verify_replication(args):
+    """One-command replication verifier: recompute every headline table
+    through this framework's pipeline and diff against the published numbers
+    (BASELINE.md) with CI-overlap PASS/FAIL verdicts.  With --snapshots, the
+    Table-5 sweep first runs for real through the TPU engine
+    (run_base_vs_instruct_100q.py:514-599); otherwise the Table-5 rows
+    report SKIP (the reference never published its raw CSV)."""
+    from .analysis.replication import (
+        format_report,
+        run_snapshot_sweep,
+        verify_replication,
+    )
+
+    results_100q = args.results_100q
+    if args.snapshots:
+        import os
+
+        args.checkpoint_dir = args.snapshots
+        rc = _run_config(args)
+        results_100q = run_snapshot_sweep(rc, args.output_dir)
+    result = verify_replication(
+        reference_root=args.reference_root,
+        results_100q_csv=results_100q,
+        n_bootstrap=args.bootstrap,
+        cross_prompt_bootstrap=args.cross_prompt_bootstrap,
+    )
+    print(format_report(result))
+    if args.output_json:
+        _write_json(result, args.output_json)
+    if not result["ok"]:
+        raise SystemExit(1)
+
+
 def main(argv=None):
     parser = argparse.ArgumentParser(prog="llm_interpretation_replication_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
@@ -882,6 +942,28 @@ def main(argv=None):
     p = sub.add_parser("run-100q", help="base-vs-instruct 100-question sweep")
     _add_run_config_args(p)
     p.set_defaults(fn=cmd_run_100q)
+
+    p = sub.add_parser(
+        "verify-replication",
+        help="recompute Tables 3-5 + appendix numbers and PASS/FAIL each "
+             "against the published values (BASELINE.md) by CI overlap")
+    _add_run_config_args(p)
+    p.add_argument("--reference-root", default="/root/reference",
+                   help="mounted reference repo with the recorded artifacts")
+    p.add_argument("--snapshots", default=None, metavar="DIR",
+                   help="local HF checkpoint dir: run the real Table-5 sweep "
+                        "(run-100q) through the TPU engine first")
+    p.add_argument("--results-100q", default=None,
+                   help="existing base_vs_instruct_100q_results.csv from a "
+                        "finished run-100q sweep (alternative to --snapshots)")
+    p.add_argument("--bootstrap", type=int, default=10_000,
+                   help="MAE bootstrap resamples (paper value)")
+    p.add_argument("--cross-prompt-bootstrap", type=int, default=200,
+                   help="cross-prompt bootstrap resamples (the full "
+                        "pipeline's 1000 takes minutes; point estimates are "
+                        "deterministic either way)")
+    p.add_argument("--output-json", default=None)
+    p.set_defaults(fn=cmd_verify_replication)
 
     p = sub.add_parser("run-instruct-sweep", help="instruct-model roster sweep")
     _add_run_config_args(p)
@@ -946,6 +1028,17 @@ def main(argv=None):
     p.add_argument("--target", type=int, default=2000)
     p.add_argument("--output", default="data/perturbations.json")
     p.set_defaults(fn=cmd_generate_rephrasings)
+
+    p = sub.add_parser("run-gpt-perturbation",
+                       help="serial GPT sync perturbation sweep, no batch "
+                            "service (perturb_prompts_gpt.py; key via env)")
+    p.add_argument("--perturbations", required=True, help="perturbations.json")
+    p.add_argument("--model", default="gpt-4-0125-preview")
+    p.add_argument("--output", default="results/gpt4_perturbation_results.xlsx")
+    p.add_argument("--sleep", type=float, default=0.5,
+                   help="rate-limit sleep between rephrasings (reference: 0.5s)")
+    p.add_argument("--max-rephrasings", type=int, default=None)
+    p.set_defaults(fn=cmd_run_gpt_perturbation)
 
     p = sub.add_parser("run-gemini-perturbation",
                        help="threaded Gemini sync perturbation sweep (key via env)")
@@ -1035,6 +1128,13 @@ def main(argv=None):
     p.add_argument("--perturbations", required=True)
     p.add_argument("--output-dir", default="results/prompt_similarity")
     p.add_argument("--max-rephrasings", type=int, default=None)
+    p.add_argument("--embeddings", action="store_true",
+                   help="add the sentence-transformer embedding-cosine "
+                        "column (calculate_prompt_similarity.py:98-207); "
+                        "degrades with a warning when the package or model "
+                        "is unavailable")
+    p.add_argument("--embedding-model", default="all-MiniLM-L6-v2",
+                   help="sentence-transformers model name (reference default)")
     p.set_defaults(fn=cmd_similarity)
 
     p = sub.add_parser("analyze-100q", help="instruct-base bootstrap over 100q results")

@@ -16,6 +16,36 @@ from ..stats.similarity import calculate_all_similarities
 from ..utils.xlsx import write_xlsx
 
 
+def load_embedding_model(name: str = "all-MiniLM-L6-v2", log=print):
+    """Optional sentence-transformers embedding model, gated exactly like
+    the reference (calculate_prompt_similarity.py:26-32, 221-231): None
+    when the package is missing or the model cannot load (e.g. zero-egress
+    environments), with a warning — the report then runs without the
+    ``embedding_cosine_similarity`` column, never fails."""
+    try:
+        from sentence_transformers import SentenceTransformer
+    except ImportError:
+        log("Warning: sentence-transformers not available. "
+            "Embedding similarity will be skipped.")
+        return None
+    import socket
+
+    prev_timeout = socket.getdefaulttimeout()
+    try:
+        # Zero-egress environments HANG on the hub download rather than
+        # erroring; a socket timeout turns that into the reference's
+        # warn-and-continue path within seconds instead of minutes.
+        socket.setdefaulttimeout(10.0)
+        log(f"Loading embedding model: {name}")
+        return SentenceTransformer(name)
+    except Exception as err:
+        log(f"Warning: Could not load embedding model: {err}")
+        log("Continuing without embedding similarity...")
+        return None
+    finally:
+        socket.setdefaulttimeout(prev_timeout)
+
+
 def similarity_report(
     perturbation_records: Sequence[Dict],
     output_dir: str,

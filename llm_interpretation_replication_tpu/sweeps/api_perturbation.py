@@ -442,6 +442,126 @@ def run_claude_perturbation_sweep(
 
 
 # ---------------------------------------------------------------------------
+# GPT sync leg (perturb_prompts_gpt.py)
+# ---------------------------------------------------------------------------
+#
+# The reference's non-batch OpenAI sweep (:86-233): one binary + one
+# confidence chat completion per rephrasing, prompts joined with a BLANK
+# LINE ("{rephrasing}\n\n{format}", :156-157 — unlike the Gemini leg's
+# single space), first-token top-20 logprob scan for the target tokens,
+# single-token 3-position weighted confidence (:47-85), 0.5 s rate-limit
+# sleep between pairs (:190).  The reference script writes its workbook
+# only once at the end; this leg adds the checkpoint-append + resume-by-
+# (model, original, rephrased) discipline the Claude/Gemini legs have, and
+# records real Token_i_Prob values (the reference stubbed them to 0,
+# :181-185, because its extractor never parsed the binary logprobs).
+
+def _gpt_perturbation_row(client, model: str, scenario: Dict,
+                          rephrased: str) -> Dict:
+    import json as jsonlib
+    import math
+
+    from ..api_backends.evaluators import openai_content_and_logprobs
+
+    binary_prompt = f"{rephrased}\n\n{scenario['response_format']}"
+    confidence_prompt = f"{rephrased}\n\n{scenario['confidence_format']}"
+    t1, t2 = scenario["target_tokens"][0], scenario["target_tokens"][1]
+
+    binary = client.chat_completion(
+        model, [{"role": "user", "content": binary_prompt}])
+    text, content = openai_content_and_logprobs(binary)
+    p1 = p2 = 0.0
+    top0 = content[0].get("top_logprobs", []) if content else []
+    for item in top0:
+        tok = (item.get("token") or "").strip()
+        if tok == t1:
+            p1 = math.exp(item["logprob"])
+        elif tok == t2:
+            p2 = math.exp(item["logprob"])
+
+    conf = client.chat_completion(
+        model, [{"role": "user", "content": confidence_prompt}])
+    conf_text, conf_content = openai_content_and_logprobs(conf)
+    positions = [
+        [(i["token"], i["logprob"]) for i in tok.get("top_logprobs", [])]
+        for tok in conf_content
+    ]
+    return perturbation_row(
+        model, scenario, rephrased,
+        response_text=text,
+        confidence_text=conf_text,
+        logprobs_repr=jsonlib.dumps(
+            [{"token": i.get("token"), "logprob": i.get("logprob")}
+             for i in top0]),
+        token_1_prob=p1,
+        token_2_prob=p2,
+        odds_ratio=p1 / p2 if p2 > 0 else float("inf"),
+        confidence_value=extract_first_int(conf_text),
+        weighted_confidence=weighted_confidence_single_tokens(positions),
+    )
+
+
+def run_gpt_perturbation_sweep(
+    client,
+    model: str,
+    scenarios: Sequence[Dict],
+    output_xlsx: str,
+    checkpoint_every: int = 50,
+    rate_limit_sleep: float = 0.5,
+    max_rephrasings: Optional[int] = None,
+    sleep=time.sleep,
+    log: Optional[SessionLogger] = None,
+) -> pd.DataFrame:
+    """Serial checkpointed GPT sync sweep: the reference's per-rephrasing
+    loop with workbook append every ``checkpoint_every`` rows and resume by
+    (model, original, rephrased) triple — the 15-column schema shared with
+    the OpenAI-batch and Gemini legs."""
+    import os
+
+    log = log or SessionLogger()
+    processed = load_processed_triples(output_xlsx)
+    work: List[Tuple[Dict, str]] = []
+    for scenario in scenarios:
+        rephrasings = scenario["rephrasings"]
+        if max_rephrasings is not None:
+            rephrasings = rephrasings[:max_rephrasings]
+        for rephrased in rephrasings:
+            if (model, scenario["original_main"], rephrased) not in processed:
+                work.append((scenario, rephrased))
+    if not work:
+        log(f"{model}: nothing to do (all triples processed)")
+    else:
+        log(f"{model}: evaluating {len(work)} perturbations (sync)")
+        pending: List[Dict] = []
+        errors = 0
+        for scenario, rephrased in work:
+            try:
+                pending.append(
+                    _gpt_perturbation_row(client, model, scenario, rephrased))
+            except Exception as err:   # broken call: keep the sweep alive
+                errors += 1
+                log(f"{model}: evaluation failed — {err}")
+            if len(pending) >= checkpoint_every:
+                append_xlsx(perturbation_frame(pending), output_xlsx)
+                log(f"{model}: checkpointed {len(pending)} rows")
+                pending.clear()
+            if rate_limit_sleep:
+                sleep(rate_limit_sleep)
+        if pending:
+            append_xlsx(perturbation_frame(pending), output_xlsx)
+            log(f"{model}: checkpointed {len(pending)} rows")
+        if errors:
+            log(f"{model}: {errors} evaluations failed (will retry on resume)")
+            if errors == len(work):
+                raise RuntimeError(
+                    f"{model}: every evaluation failed ({errors}/{len(work)})"
+                )
+    return read_xlsx(output_xlsx) if os.path.exists(output_xlsx) else pd.DataFrame(
+        columns=PERTURBATION_COLUMNS
+    )
+
+
+# ---------------------------------------------------------------------------
 # Gemini sync/threaded leg (perturb_prompts_gemini.py / _parallel.py)
 # ---------------------------------------------------------------------------
 #

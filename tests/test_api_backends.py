@@ -858,3 +858,114 @@ class TestGeminiPerturbationSweep:
         run_gemini_perturbation_sweep(client, "gemini-2.0-flash", scenarios, out,
                                       max_workers=2)
         assert len(ft.calls) > calls_before
+
+
+class TestGptPerturbationSweep:
+    """Serial GPT sync sweep (perturb_prompts_gpt.py:86-233): blank-line
+    prompt join, first-token top-20 scan, single-token weighted confidence,
+    checkpointed workbook append with resume-by-triple (the discipline the
+    Claude/Gemini sync legs share)."""
+
+    def _scenarios(self, n=5):
+        return [{
+            "original_main": "Scenario text one.",
+            "response_format": "Answer 'Covered' or 'Not'.",
+            "target_tokens": ["Covered", "Not"],
+            "confidence_format": "Confidence 0-100?",
+            "rephrasings": [f"Rephrase {i}." for i in range(n)],
+        }]
+
+    def _client(self):
+        ft = FakeTransport()
+
+        def respond(call):
+            content = call["json"]["messages"][0]["content"]
+            if "Confidence" in content:
+                return 200, {"choices": [{
+                    "message": {"content": "85"},
+                    "logprobs": {"content": [
+                        {"token": "8", "top_logprobs": [
+                            {"token": "8", "logprob": math.log(0.6)},
+                            {"token": "9", "logprob": math.log(0.3)},
+                        ]},
+                        {"token": "5", "top_logprobs": [
+                            {"token": "5", "logprob": math.log(0.9)},
+                        ]},
+                    ]},
+                }], "usage": {"prompt_tokens": 50, "completion_tokens": 2}}
+            return 200, {"choices": [{
+                "message": {"content": "Covered"},
+                "logprobs": {"content": [
+                    {"token": "Covered", "top_logprobs": [
+                        {"token": "Covered", "logprob": math.log(0.7)},
+                        {"token": "Not", "logprob": math.log(0.2)},
+                    ]},
+                ]},
+            }], "usage": {"prompt_tokens": 50, "completion_tokens": 1}}
+
+        ft.add("POST", "/chat/completions", respond)
+        return OpenAIClient("k", transport=ft, retry_policy=fast_retry()), ft
+
+    def test_serial_sweep_checkpoints_and_resume(self, tmp_path):
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            run_gpt_perturbation_sweep,
+        )
+        from llm_interpretation_replication_tpu.sweeps.writers import (
+            PERTURBATION_COLUMNS,
+        )
+
+        client, ft = self._client()
+        out = str(tmp_path / "gpt.xlsx")
+        slept = []
+        df = run_gpt_perturbation_sweep(
+            client, "gpt-4-0125-preview", self._scenarios(), out,
+            checkpoint_every=2, sleep=slept.append,
+        )
+        assert list(df.columns) == PERTURBATION_COLUMNS
+        assert len(df) == 5
+        assert df["Token_1_Prob"].iloc[0] == pytest.approx(0.7)
+        assert df["Token_2_Prob"].iloc[0] == pytest.approx(0.2)
+        assert df["Odds_Ratio"].iloc[0] == pytest.approx(0.7 / 0.2)
+        assert df["Confidence Value"].iloc[0] == 85
+        # reference weighted confidence: single-token positions (:47-85)
+        from llm_interpretation_replication_tpu.scoring.confidence import (
+            weighted_confidence_single_tokens,
+        )
+
+        expected = weighted_confidence_single_tokens([
+            [("8", math.log(0.6)), ("9", math.log(0.3))],
+            [("5", math.log(0.9))],
+        ])
+        assert df["Weighted Confidence"].iloc[0] == pytest.approx(expected)
+        # blank-line prompt join (perturb_prompts_gpt.py:156-157)
+        first = ft.calls[0]["json"]["messages"][0]["content"]
+        assert first == "Rephrase 0.\n\nAnswer 'Covered' or 'Not'."
+        # reference rate-limit sleep between pairs (:190)
+        assert slept == [0.5] * 5
+
+        # resume: same model re-run makes NO new API calls
+        calls_before = len(ft.calls)
+        df2 = run_gpt_perturbation_sweep(
+            client, "gpt-4-0125-preview", self._scenarios(), out,
+            sleep=lambda _s: None,
+        )
+        assert len(ft.calls) == calls_before
+        assert len(df2) == 5
+        # a different model re-evaluates into the same workbook
+        run_gpt_perturbation_sweep(client, "gpt-4o", self._scenarios(), out,
+                                   sleep=lambda _s: None)
+        assert len(ft.calls) > calls_before
+
+    def test_all_failures_raise(self, tmp_path):
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            run_gpt_perturbation_sweep,
+        )
+
+        ft = FakeTransport()
+        ft.add("POST", "/chat/completions", lambda c: (500, {"error": "boom"}))
+        client = OpenAIClient("k", transport=ft, retry_policy=fast_retry())
+        with pytest.raises(RuntimeError, match="every evaluation failed"):
+            run_gpt_perturbation_sweep(
+                client, "gpt-4-0125-preview", self._scenarios(2),
+                str(tmp_path / "gpt.xlsx"), sleep=lambda _s: None,
+            )
