@@ -1,66 +1,71 @@
 #!/usr/bin/env python
 """Benchmark: prompts/sec/chip on the yes/no scoring sweep (BASELINE.json).
 
-Workload: the north-star op — batched, jit'd relative-probability extraction
-(forward to the last real position, softmax over the two target-token logits)
-over Falcon-7B geometry with ~430-token right-padded prompts (few-shot prefix
-+ question, bucketed at 512).  This is the TPU replacement for the reference's
-serial per-prompt ``model.generate`` loop (run_base_vs_instruct_100q.py:464-472).
+The DEFAULT metric is ``--mode sweep`` — the END-TO-END 10k-perturbation
+scoring sweep exactly as a user runs it: the REAL
+``data/perturbations.json`` rephrasing texts (real length histogram /
+bucket mix), host tokenization, length bucketing, ONE cross-scenario
+two-phase ScoringEngine call with per-prompt target pairs, cross-batch
+pooled phase-2 decodes, row building, and checkpointed xlsx writes, all
+inside the wall clock (best of ``--sweep-repeats``, so first-compile time
+is excluded from the reported number but visible in repeat 1).  This
+replaces the reference's serial per-prompt ``model.generate`` loop
+(run_base_vs_instruct_100q.py:464-472) and supersedes the r01-r03
+synthetic steady-state headline.
 
-Weights are randomly initialized on-device in bf16 (zero-egress image: no 7B
-download) — throughput is architecture-bound, not value-bound.
+The synthetic steady-state modes remain for device-rate comparison and
+round-over-round continuity at the 430-token operating point:
+
+- ``--mode parity``: two-phase with the engine's POOLED phase-2 — each
+  batch prefills, and one pooled ``sub``-row scored decode runs every
+  ~batch/undecided rows prefills (runtime/engine._Phase2Pool).
+- ``--mode single``: one forward, no decode (the fast-path ceiling).
+- ``--mode decode``: every row takes the scored 10-step decode (floor).
+
+Weights are randomly initialized on-device (zero-egress image: no 7B
+download) — throughput is architecture-bound, not value-bound.  For the
+sweep mode, the position-0 hit rate that drives phase 2 is CALIBRATED into
+the synthetic weights (boost target-token unembedding rows along the mean
+normalized-hidden direction until the rate measured through the engine's
+own scan is ~--decided-frac), so which rows are decided, pool sizes, and
+early-exit behavior all emerge per-row instead of being dialed.
 
 Baseline: the reference path on an A100 is a serial 50-token fp16/int8
-generate per prompt; public A100 7B decode rates (~30-40 tok/s at batch 1 with
-HF transformers + int8) put it at ≈0.7 prompts/sec.  We use 1.0 prompts/sec as
-a conservative A100 baseline, so vs_baseline = prompts_per_sec / 1.0.
+generate per prompt; public A100 7B decode rates (~30-40 tok/s at batch 1
+with HF transformers + int8) put it at ≈0.7 prompts/sec.  We use 1.0
+prompts/sec as a conservative A100 baseline, so vs_baseline =
+prompts_per_sec / 1.0.
 
 Default configuration (measured on TPU v5e, 2026-07): w8a8 int8 projections
 (the reference's own path is bitsandbytes int8; ours keeps 0.9997 logit
-correlation vs bf16, and <=0.0043 relative-prob drift across all 8 decoder
-families — ops/quant.py, tests/test_quant_audit.py, PARITY.md) at batch 192
-with the engine's 432-token length bucket (430-token prompts pad to 432 —
-runtime/batching.DEFAULT_BUCKETS), where the v5e int8 MXU path runs ~2.3x
-the bf16 ceiling.
+correlation vs bf16, and <=0.0043 relative-prob drift across the 9 audited
+decoder families — ops/quant.py, tests/test_quant_audit.py, PARITY.md).
+Sweep mode: batch 256 over the real ~107-token prompts (384 OOMs at the
+256-token worst bucket).  Parity/single/decode modes: batch 192 at the
+432-token bucket, where the v5e int8 MXU path runs ~2.3x the bf16 ceiling.
 
-The DEFAULT metric is ``--mode parity`` — the TWO-PHASE sweep (one prefill
-settles every row whose position-0 top-k contains a target, exactly the rows
-for which the reference reads position 0 and stops,
-run_base_vs_instruct_100q.py:349-364; only the undecided slice continues
-into the scored MAX_LOOK_AHEAD=10 decode, reusing the prefill KV cache).
-Measured on v5e (2026-07, round 3):
-
-    mode / --decided-frac          prompts/sec   decode slice
-    single forward (ceiling)          38.1           —
-    parity 1.0                        36.5           8 rows
-    parity 0.9 (default)              36.2          32 rows
-    parity 0.6                        35.2         128 rows
-    decode, all rows (floor)          35.9         192 rows
-
-Why parity cannot reach the single-forward ceiling: the scored decode is 10
-SEQUENTIAL single-token steps, and each step must stream the full ~7 GB of
-int8 weights from HBM regardless of how few rows decode — ≈8.5 ms/step at
-819 GB/s, so ≥85 ms/batch (-0.6 p/s) even at perfect efficiency; measured
-step cost is ~13-20 ms (attention + per-step fixed overheads), i.e. the
-two-phase ceiling is ≈37.4 and the slice size barely matters.  The round-3
-decode-path work that got it this close is in models/decoder.py: a
-read-only prompt cache + small per-chunk tail with a two-block joint
-softmax (grouped_attention_two_block) replaced the scatter-updated cache,
-whose XLA layout mismatch cost a 150-310 ms full-cache relayout loop every
-batch (found via jax.profiler trace, 2026-07).
+Two-phase economics: the scored decode is 10 SEQUENTIAL single-token
+steps, each streaming the full ~7 GB of int8 weights from HBM regardless
+of how few rows decode (≈8.5 ms/step at 819 GB/s; measured 13-20 ms with
+attention + fixed overheads).  Paying that once per batch capped r03's
+parity mode at 36.1 vs the 38.1 single-forward ceiling; POOLING the
+undecided rows across ~10 batches (decode cost is nearly flat in rows)
+amortizes it to ~1/10 per batch.  The r03 decode-path work that made steps
+cheap at all is in models/decoder.py: a read-only prompt cache + small
+per-chunk tail with a two-block joint softmax replaced the scatter-updated
+cache, whose XLA layout mismatch cost a 150-310 ms full-cache relayout
+loop every batch (found via jax.profiler trace, 2026-07).
 
 ``--decided-frac`` defaults to 0.9: in the reference's own committed sweep
 outputs, ~60% of completions BEGIN with Yes/No (top-1 at position 0, the
 floor for top-5 membership — data/instruct_model_comparison_results_combined
 .csv), and the prompts instruct a Yes/No answer, so top-5 decisiveness is
-higher still.  In real sweeps the engine additionally stops the scored
-decode early once every undecided row has hit (rows resolve at positions
-1-3 in practice; runtime/engine._scan_decode_chunked) — the synthetic bench
-cannot show that win because random-weight rows never hit.
+higher still.
 
-Single-forward history: 38.2 r01/r02, 37.7 at the 448 bucket; 31.5 int8 /
-16.5 bf16 at the old batch-128/512 config (``--batch 128 --seq 512
-[--quant none]``).  Batch 224+ OOMs 16 GB HBM.
+Steady-state history (430-token operating point): single forward 38.2
+r01/r02, 38.1 r03; parity (per-batch 32-row slice) 36.07 r03; decode-all
+35.82 r03; 31.5 int8 / 16.5 bf16 at the old batch-128/512 config.  Batch
+224+ OOMs 16 GB HBM at seq 432.
 
 Where the single-forward time goes (jax.profiler device trace): the two
 projection-matmul fusions take 92.6 ms/layer vs 87 ms theoretical at the
@@ -190,6 +195,223 @@ def init_params(cfg, key, dtype, quant=False):
     return params
 
 
+def _train_sweep_tokenizer(texts, vocab_size=900):
+    """Byte-level BPE trained on the sweep's own prompt texts (zero-egress
+    image: no hub tokenizer).  vocab_size=900 is calibrated so compression
+    matches a production English BPE: 4.13 chars/token measured on the
+    perturbation corpus (falcon/GPT-2-class tokenizers run ~4.0-4.3 on
+    English prose); larger vocabs overfit the 2.5 MB corpus (saturating at
+    5.1 chars/token by vocab 4k) and would undercount tokens, inflating
+    prompts/sec."""
+    from tokenizers import ByteLevelBPETokenizer
+    from transformers import PreTrainedTokenizerFast
+
+    tok = ByteLevelBPETokenizer()
+    tok.train_from_iterator(texts, vocab_size=vocab_size, min_frequency=2)
+    inner = tok._tokenizer if hasattr(tok, "_tokenizer") else tok
+    fast = PreTrainedTokenizerFast(tokenizer_object=inner)
+    fast.pad_token = fast.decode([0])
+    fast.pad_token_id = 0
+    return fast
+
+
+def _calibrate_decided_rate(params, cfg, engine, scenarios, prompts_by_scenario,
+                            target_rate, sample_rows=64):
+    """Boost the target tokens' unembedding rows until the measured
+    position-0 top-5 hit rate over a stratified sample is ~``target_rate``.
+
+    Random weights never place a target token in the top-5 of a 65k vocab,
+    so an unmodified synthetic model would send EVERY row into phase 2 — the
+    worst case, not the real sweep (real prompts end "Answer only 'X' or
+    'Y'" and instruct models put a target in the top-5 nearly always).
+    Rather than dialing the undecided slice directly (the r03 bench's
+    --decided-frac), this boosts each target row e_t by α·ĥ along the mean
+    normalized-hidden direction and bisects α until the rate measured
+    THROUGH the engine's own scan matches; which rows are decided, how many
+    per batch, and where undecided rows later hit all emerge per-row, so
+    pool sizes fluctuate and the chunked early exit operates like a real
+    sweep.  ĥ is recovered from mean logits: logits = LN(h)·Eᵀ with
+    E ~ iid N(0, 0.02²) ⇒ mean_rows LN(h) ≈ μ_logits·E / (V·0.02²).
+
+    Returns (params, measured_rate)."""
+    import jax.numpy as jnp
+
+    from llm_interpretation_replication_tpu.models.decoder import forward_last_logits
+    from llm_interpretation_replication_tpu.runtime import batching
+    from llm_interpretation_replication_tpu.scoring import yes_no as yn
+
+    tok = engine.tokenizer
+    samples = []  # (ids, mask, yes_id, no_id) per scenario
+    mean_logits = None
+    for scenario, prompts in zip(scenarios, prompts_by_scenario):
+        yes_id, no_id = engine.target_ids(list(scenario["target_tokens"]))[:2]
+        batch = next(batching.batches_for_prompts(
+            batching.encode_prompts(tok, prompts[:sample_rows]),
+            sample_rows, engine.ecfg.buckets, pad_id=tok.pad_token_id or 0,
+        ))
+        ids, mask = jnp.asarray(batch.token_ids), jnp.asarray(batch.attention_mask)
+        samples.append((ids, mask, yes_id, no_id,
+                        int((batch.indices >= 0).sum())))
+        logits = forward_last_logits(params, cfg, ids, mask)
+        s = jnp.mean(logits, axis=0)
+        mean_logits = s if mean_logits is None else mean_logits + s
+    # The unembedding actually producing logits: the tied token embedding
+    # ([V, h] rows) or the separate lm_head ([h, V] columns).
+    tied = bool(getattr(cfg, "tie_word_embeddings", False))
+    unembed = (params["embed"]["tokens"] if tied
+               else jnp.transpose(params["lm_head"]))           # [V, h]
+    h_dir = jnp.matmul(mean_logits[None, :].astype(jnp.float32),
+                       unembed.astype(jnp.float32))[0]
+    h_dir = h_dir / jnp.linalg.norm(h_dir)
+    tids = sorted({t for _, _, y, n, _ in samples for t in (int(y), int(n))})
+    base_rows = unembed[jnp.asarray(tids)].astype(jnp.float32)
+
+    def rate_at(alpha):
+        rows = (base_rows + alpha * h_dir[None, :]).astype(unembed.dtype)
+        p = dict(params)
+        if tied:
+            p["embed"] = dict(params["embed"])
+            p["embed"]["tokens"] = unembed.at[jnp.asarray(tids)].set(rows)
+        else:
+            p["lm_head"] = params["lm_head"].at[:, jnp.asarray(tids)].set(
+                jnp.transpose(rows))
+        hits = total = 0
+        for ids, mask, yes_id, no_id, n_real in samples:
+            last = forward_last_logits(p, cfg, ids, mask)
+            hit = np.asarray(yn.first_token_scan(
+                last, yes_id, no_id, top_k=engine.ecfg.top_k)[4])
+            hits += int(hit[:n_real].sum())   # pad rows duplicate row 0 and
+            total += n_real                   # must not weight the rate
+        return p, hits / total
+
+    lo, hi = 0.0, 1.0
+    while hi < 4096:
+        _, r = rate_at(hi)
+        if r >= target_rate:
+            break
+        lo, hi = hi, hi * 2
+    for _ in range(8):
+        mid = (lo + hi) / 2
+        _, r = rate_at(mid)
+        if r < target_rate:
+            lo = mid
+        else:
+            hi = mid
+    # The decided/undecided threshold can be SHARP across alphas when rows'
+    # projections onto the mean-hidden direction cluster; return whichever
+    # bracket end measures closer to the target, and report the measured
+    # rate rather than pretending the dial was hit.
+    lo_p, lo_r = rate_at(lo)
+    hi_p, hi_r = rate_at(hi)
+    boosted, measured = ((lo_p, lo_r)
+                         if abs(lo_r - target_rate) < abs(hi_r - target_rate)
+                         else (hi_p, hi_r))
+    if abs(measured - target_rate) > 0.15:
+        print(f"# WARNING: calibrated hit rate {measured:.2f} far from "
+              f"target {target_rate}; sweep runs at the measured rate",
+              file=sys.stderr)
+    return boosted, measured
+
+
+def run_sweep_mode(args, cfg, params):
+    """End-to-end 10k-row perturbation scoring sweep — the BASELINE.json
+    north-star workload as the USER runs it: real perturbations.json prompt
+    texts (real length histogram / bucket mix), host tokenization, length
+    bucketing, the two-phase ScoringEngine (prefill + pooled phase-2 decode
+    + chunked early exit + pipeline_depth overlap), row building, and
+    checkpointed xlsx writes, all inside the wall clock.  Replaces the
+    reference's serial per-prompt generate loop
+    (run_base_vs_instruct_100q.py:464-472) and the r03 bench's synthetic
+    steady-state bucket."""
+    import json as jsonlib
+    import os
+    import tempfile
+    import time as timemod
+
+    import pandas as pd
+
+    from llm_interpretation_replication_tpu.runtime.engine import (
+        EngineConfig,
+        ScoringEngine,
+    )
+    from llm_interpretation_replication_tpu.sweeps.writers import (
+        PERTURBATION_COLUMNS,
+        perturbation_row,
+    )
+    from llm_interpretation_replication_tpu.utils.xlsx import write_xlsx
+
+    with open(args.perturbations) as f:
+        scenarios = jsonlib.load(f)
+    if args.sweep_rows:
+        per = max(1, args.sweep_rows // len(scenarios))
+        scenarios = [dict(s, rephrasings=s["rephrasings"][:per]) for s in scenarios]
+    prompts_by_scenario = [
+        [f"{r} {s['response_format']}" for r in s["rephrasings"]]
+        for s in scenarios
+    ]
+    n_total = sum(len(p) for p in prompts_by_scenario)
+    tok = _train_sweep_tokenizer([p for ps in prompts_by_scenario for p in ps])
+
+    engine = ScoringEngine(
+        "falcon", cfg, params, tok,
+        engine_config=EngineConfig(
+            batch_size=args.sweep_batch, decode_completions=False,
+            phase2_pool_target=args.pool_target,
+        ),
+    )
+    lens = [len(ids) for ids in tok([p for ps in prompts_by_scenario for p in ps])["input_ids"]]
+    params, measured_rate = _calibrate_decided_rate(
+        params, cfg, engine, scenarios, prompts_by_scenario, args.decided_frac,
+    )
+    engine.params = params
+    print(f"# sweep: {n_total} prompts, token lengths mean "
+          f"{sum(lens)/len(lens):.0f} min {min(lens)} max {max(lens)}, "
+          f"calibrated position-0 hit rate {measured_rate:.2f} "
+          f"(target {args.decided_frac})", file=sys.stderr)
+
+    out_path = args.sweep_out or os.path.join(
+        tempfile.mkdtemp(prefix="bench_sweep_"), "results.xlsx")
+    all_rows, pending = [], []
+
+    def flush():
+        nonlocal pending
+        if not pending:
+            return
+        all_rows.extend(pending)
+        pending = []
+        write_xlsx(pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS), out_path)
+
+    # ONE cross-scenario scoring call with per-prompt target pairs — the
+    # sweep shell's own batching (sweeps/perturbation.py): per-scenario
+    # calls paid a partial tail batch per (scenario, bucket), ~40% of all
+    # prefill rows on this corpus.
+    items = [(s, r) for s in scenarios for r in s["rephrasings"]]
+    all_prompts = [p for ps in prompts_by_scenario for p in ps]
+    all_targets = [list(s["target_tokens"]) for s, _ in items]
+    best_dt = float("inf")
+    for _ in range(max(1, args.sweep_repeats)):
+        all_rows, pending = [], []
+        t0 = timemod.perf_counter()
+        rows = engine.score_prompts(all_prompts, targets=all_targets)
+        for (scenario, reph), row in zip(items, rows):
+            pending.append(perturbation_row(
+                args.model, scenario, reph,
+                response_text=row["completion"],
+                confidence_text="",
+                logprobs_repr="bench:two-phase",
+                token_1_prob=row["yes_prob"],
+                token_2_prob=row["no_prob"],
+                odds_ratio=row["odds_ratio"],
+                confidence_value=None, weighted_confidence=None,
+            ))
+            if len(pending) >= args.checkpoint_every:
+                flush()
+        flush()
+        best_dt = min(best_dt, timemod.perf_counter() - t0)
+    assert len(all_rows) == n_total, (len(all_rows), n_total)
+    return n_total / best_dt, measured_rate, out_path
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", choices=["falcon-7b", "small-1b"], default="falcon-7b")
@@ -205,9 +427,17 @@ def main():
                         help="attention impl: XLA dense (the DecoderConfig "
                              "'xla' value) or the Pallas kernels "
                              "(ops/attention.py)")
-    parser.add_argument("--mode", choices=["parity", "single", "decode"],
-                        default=None,  # resolved to parity after --decode 0 compat
-                        help="parity (default): the two-phase sweep — one "
+    parser.add_argument("--mode", choices=["sweep", "parity", "single", "decode"],
+                        default=None,  # resolved after --decode 0 compat:
+                                       # sweep when perturbations.json exists,
+                                       # else parity
+                        help="sweep (default): END-TO-END 10k-perturbation "
+                             "scoring sweep on the real perturbations.json "
+                             "texts — tokenize + bucketing + two-phase "
+                             "engine + row building + xlsx checkpoints all "
+                             "inside the wall clock (the BASELINE.json "
+                             "north-star workload); "
+                             "parity: the two-phase sweep — one "
                              "prefill settles every row whose position-0 "
                              "top-k contains a target (the reference reads "
                              "position 0 for those rows, "
@@ -242,6 +472,32 @@ def main():
                         help="timing repetitions; the best (minimum-time) "
                              "run is reported to reject chip-contention "
                              "noise on shared/tunneled devices")
+    parser.add_argument("--perturbations", metavar="PATH",
+                        default="/root/reference/data/perturbations.json",
+                        help="sweep mode: the real 5x2000-rephrasing corpus "
+                             "(real length histogram / bucket mix)")
+    parser.add_argument("--sweep-batch", type=int, default=256, metavar="N",
+                        help="sweep mode engine batch size (real prompts "
+                             "are ~107 tokens so a larger batch than the "
+                             "430-token parity mode fits; measured 2026-07: "
+                             "256 runs, 384 OOMs at the 256-token worst "
+                             "bucket)")
+    parser.add_argument("--sweep-rows", type=int, default=0, metavar="N",
+                        help="sweep mode: cap total rows (0 = full 10k)")
+    parser.add_argument("--sweep-repeats", type=int, default=2, metavar="N",
+                        help="sweep mode: full-sweep repetitions, best "
+                             "wall-clock reported (chip contention)")
+    parser.add_argument("--sweep-out", metavar="PATH", default=None,
+                        help="sweep mode: output workbook (default: temp dir)")
+    parser.add_argument("--pool-target", type=int, default=0, metavar="N",
+                        help="sweep mode: phase-2 cross-batch pool size "
+                             "(0 = engine default, one pooled decode per "
+                             "batch-size undecided rows)")
+    parser.add_argument("--checkpoint-every", type=int, default=2000,
+                        metavar="N",
+                        help="sweep mode: rewrite the output workbook every "
+                             "N rows (the sweep shells' resume checkpoint; "
+                             "10k rows rewrite in ~0.9 s)")
     parser.add_argument("--microbatch", type=int, default=1, metavar="N",
                         help="split the batch into N independent chunks "
                              "inside the jit so XLA can overlap one chunk's "
@@ -257,29 +513,14 @@ def main():
         args.mode = "single"
         args.decode = 10
     if args.mode is None:
-        args.mode = "parity"
+        import os
+        args.mode = ("sweep" if os.path.exists(args.perturbations)
+                     else "parity")
     if not 0.0 <= args.decided_frac <= 1.0:
         parser.error("--decided-frac must be within [0, 1]")
-    if args.mode == "parity" and args.microbatch > 1:
+    if args.mode in ("parity", "sweep") and args.microbatch > 1:
         parser.error("--microbatch applies to the single/decode modes; the "
-                     "parity mode's decode slice is sized from the full batch")
-
-    if args.quant == "none" and args.model == "falcon-7b":
-        # bf16 7B weights (~13 GB) leave no HBM for the dense S×T attention
-        # scores at ANY batch size on a 16 GB chip — the Pallas flash kernel
-        # streams them in blocks and is the only path that fits, and batch
-        # must drop to 64 for the activations (measured 2026-07: dense OOMs
-        # at batch 64-192; flash 21.2 p/s at batch 64, OOM above).
-        if args.attn == "xla":
-            print("# --quant none on falcon-7b: dense attention cannot fit "
-                  "beside bf16 weights; switching to --attn flash",
-                  file=sys.stderr)
-            args.attn = "flash"
-        if args.batch > 64:
-            print(f"# --quant none on falcon-7b: clamping --batch "
-                  f"{args.batch} -> 64 (bf16 activation headroom)",
-                  file=sys.stderr)
-            args.batch = 64
+                     "parity/sweep decode slice is sized from the full batch")
 
     import jax
     import jax.numpy as jnp
@@ -289,10 +530,33 @@ def main():
         forward_last_logits,
         greedy_decode,
     )
+    from llm_interpretation_replication_tpu.runtime.plan import resolve_scoring_plan
     from llm_interpretation_replication_tpu.scoring.yes_no import relative_prob_first_token
 
     geometry = FALCON_7B if args.model == "falcon-7b" else SMALL_1B
     cfg = DecoderConfig(**geometry, attention_impl=args.attn)
+
+    # bf16 7B weights (~13 GB) leave no HBM for the dense S×T attention
+    # scores at sweep batches on a 16 GB chip — the Pallas flash kernel
+    # streams them in blocks and is the only path that fits, with the batch
+    # clamped for activation headroom (measured 2026-07: dense OOMs at batch
+    # 64-192; flash 21.2 p/s at batch 64, OOM above).  The routing decision
+    # is the shared library one (runtime/plan.py), regression-pinned in
+    # tests/test_runtime.py.
+    plan = resolve_scoring_plan(
+        cfg, args.quant, args.batch, args.seq,
+        requested_impl="flash" if args.attn == "flash" else None,
+    )
+    if plan.attention_impl != args.attn:
+        print(f"# --quant {args.quant} on {args.model}: {plan.reason}; "
+              f"switching to --attn {plan.attention_impl}", file=sys.stderr)
+        args.attn = plan.attention_impl
+        cfg = DecoderConfig(**geometry, attention_impl=args.attn)
+    if plan.batch != args.batch:
+        print(f"# clamping --batch {args.batch} -> {plan.batch} "
+              f"({plan.reason})", file=sys.stderr)
+        args.batch = plan.batch
+
     dtype = jnp.bfloat16
 
     use_quant = args.quant == "int8"
@@ -309,66 +573,91 @@ def main():
         else:
             raise
 
-    rng = np.random.default_rng(0)
-    ids = rng.integers(10, cfg.vocab_size - 10, size=(args.batch, args.seq)).astype(np.int32)
-    mask = np.zeros((args.batch, args.seq), np.int32)
-    mask[:, : args.prompt_tokens] = 1
-    ids = jnp.asarray(ids)
-    mask = jnp.asarray(mask)
-    yes_id, no_id = 5, 9
-    look = max(1, args.decode)
-
     from llm_interpretation_replication_tpu.models.decoder import (
         KVCache,
         decode_steps,
         prefill,
     )
-    from llm_interpretation_replication_tpu.runtime.engine import _pad_pow2
+    from llm_interpretation_replication_tpu.runtime.engine import _pad_slice
     from llm_interpretation_replication_tpu.scoring.yes_no import (
         first_token_scan,
         yes_no_from_scores,
     )
 
-    # Undecided slice for the two-phase parity mode, padded to the engine's
-    # power-of-two menu so the decode shape is one the engine also compiles.
-    n_undec = max(1, round(args.batch * (1.0 - args.decided_frac)))
-    sub = _pad_pow2(n_undec, args.batch)
+    yes_id, no_id = 5, 9
+    look = max(1, args.decode)
 
-    def score_parity(params, ids, mask):
-        # Phase 1: one prompt forward; position-0 top-k settles decided rows.
-        last, cache = prefill(params, cfg, ids, mask,
-                              cache_len=ids.shape[1])
-        _, _, rel0, _, _ = first_token_scan(last, yes_id, no_id)
-        # Phase 2: only the undecided slice decodes, from the kept KV cache.
-        lengths = jnp.sum(mask, axis=-1)
-        sub_cache = KVCache(k=cache.k[:, :sub], v=cache.v[:, :sub],
-                            positions=cache.positions[:sub],
-                            valid=cache.valid[:sub], length=cache.length)
-        _, sc, _, _, _ = decode_steps(params, cfg, sub_cache, last[:sub],
-                                      lengths[:sub], jnp.int32(0), look,
-                                      None, None, with_scores=True)
-        res = yes_no_from_scores(sc, yes_id, no_id)
-        return rel0, res.relative_prob
+    def phase2_geometry(batch, decided_frac):
+        """(n_undec, pool_every, sub): undecided rows per batch, prefills
+        per pooled decode, and the menu-padded pooled slice size."""
+        n_undec = max(1, round(batch * (1.0 - decided_frac)))
+        pool_every = max(1, int(round(batch / n_undec)))
+        sub = _pad_slice(min(pool_every * n_undec, batch), batch)
+        return n_undec, pool_every, sub
 
-    def score_decode(params, ids, mask):
-        # worst case: every row takes the scored MAX_LOOK_AHEAD decode
-        _, logits = greedy_decode(params, cfg, ids, mask, look)
-        return relative_prob_first_token(logits[:, 0, :], yes_id, no_id)
+    def steady_setup(batch, seq, prompt_tokens, decided_frac):
+        """Inputs + score fns for the synthetic steady-state modes at a
+        given operating point (batch, bucket length, real-token count)."""
+        rng = np.random.default_rng(0)
+        ids = jnp.asarray(rng.integers(
+            10, cfg.vocab_size - 10, size=(batch, seq)).astype(np.int32))
+        m = np.zeros((batch, seq), np.int32)
+        m[:, :prompt_tokens] = 1
+        mask = jnp.asarray(m)
+        # Two-phase parity mode, POOLED like the engine (runtime/engine
+        # _Phase2Pool): each batch's undecided rows accumulate and ONE
+        # ``sub``-row scored decode runs every ``pool_every`` prefills —
+        # decode is weight-streaming-bound, so amortizing its 10 steps
+        # across ~pool_target/undecided-per-batch batches removes most of
+        # the two-phase overhead.  The decode slice is a menu size
+        # (engine._pad_slice) so the shape is one the engine also compiles.
+        _, pool_every, sub = phase2_geometry(batch, decided_frac)
 
-    def score_single(params, ids, mask):
-        logits = forward_last_logits(params, cfg, ids, mask)
-        return relative_prob_first_token(logits, yes_id, no_id)
+        def score_prefill(params, ids, mask):
+            # Phase 1: one prompt forward; position-0 top-k settles decided
+            # rows.  Returns the cache so phase 2 can run without re-running
+            # the prompt (exactly the engine's prefill contract).
+            last, cache = prefill(params, cfg, ids, mask,
+                                  cache_len=ids.shape[1])
+            _, _, rel0, _, _ = first_token_scan(last, yes_id, no_id)
+            lengths = jnp.sum(mask, axis=-1)
+            return rel0, last, cache, lengths
 
-    base_fns = {"parity": score_parity, "decode": score_decode,
-                "single": score_single}
+        def score_pooled_decode(params, last, cache, lengths):
+            # Phase 2: one pooled scored decode over the accumulated
+            # undecided rows (modeled as ``sub`` rows of the latest cache —
+            # identical shapes/FLOPs to the engine's concatenated pool).
+            sub_cache = KVCache(k=cache.k[:, :sub], v=cache.v[:, :sub],
+                                positions=cache.positions[:sub],
+                                valid=cache.valid[:sub], length=cache.length)
+            _, sc, _, _, _ = decode_steps(params, cfg, sub_cache, last[:sub],
+                                          lengths[:sub], jnp.int32(0), look,
+                                          None, None, with_scores=True)
+            res = yes_no_from_scores(sc, yes_id, no_id)
+            return res.relative_prob
 
-    def with_microbatch(score_one):
+        score_parity = (score_prefill, score_pooled_decode, pool_every)
+
+        def score_decode(params, ids, mask):
+            # worst case: every row takes the scored MAX_LOOK_AHEAD decode
+            _, logits = greedy_decode(params, cfg, ids, mask, look)
+            return relative_prob_first_token(logits[:, 0, :], yes_id, no_id)
+
+        def score_single(params, ids, mask):
+            logits = forward_last_logits(params, cfg, ids, mask)
+            return relative_prob_first_token(logits, yes_id, no_id)
+
+        return ids, mask, sub, {"parity": score_parity,
+                                "decode": score_decode,
+                                "single": score_single}
+
+    def with_microbatch(score_one, batch):
         if args.microbatch <= 1:
             return score_one
-        if args.batch % args.microbatch:
-            parser.error(f"--batch {args.batch} not divisible by "
+        if batch % args.microbatch:
+            parser.error(f"--batch {batch} not divisible by "
                          f"--microbatch {args.microbatch}")
-        chunk = args.batch // args.microbatch
+        chunk = batch // args.microbatch
 
         def score(params, ids, mask):
             outs = [
@@ -379,14 +668,40 @@ def main():
             return tuple(jnp.concatenate(parts) for parts in zip(*outs))
         return score
 
-    def measure(mode, iters, repeats):
+    def measure(mode, iters, repeats, batch=None, seq=None, prompt_tokens=None,
+                decided_frac=None):
         """Best-of-N repeats: the tunneled chip is occasionally contended
         (same code measured 13-36 p/s across runs); the minimum per-step time
         is the uncontended hardware number the sweep actually achieves."""
-        score_jit = jax.jit(with_microbatch(base_fns[mode]))
+        batch = batch or args.batch
+        ids, mask, _, fns = steady_setup(
+            batch, seq or args.seq, prompt_tokens or args.prompt_tokens,
+            args.decided_frac if decided_frac is None else decided_frac)
         # NOTE: on the axon-tunneled chip, block_until_ready does NOT
         # actually block; a host fetch does.  Sync via np.asarray of a
         # scalar slice.
+        if mode == "parity":
+            f_prefill, f_decode, pool_every = fns[mode]
+            f_prefill = jax.jit(f_prefill)
+            f_decode = jax.jit(f_decode)
+            # round iterations UP to whole pool windows so the timing
+            # carries exactly iters/pool_every pooled decodes
+            iters = max(pool_every, ((iters + pool_every - 1)
+                                     // pool_every) * pool_every)
+            out = f_prefill(params, ids, mask)
+            dec = f_decode(params, *out[1:])
+            np.asarray(out[0][0]), np.asarray(dec[0])  # compile + sync
+            dt = float("inf")
+            for _ in range(max(1, repeats)):
+                t0 = time.perf_counter()
+                for i in range(iters):
+                    out = f_prefill(params, ids, mask)
+                    if (i + 1) % pool_every == 0:
+                        dec = f_decode(params, *out[1:])
+                np.asarray(out[0][0]), np.asarray(dec[0])  # drain queue
+                dt = min(dt, (time.perf_counter() - t0) / iters)
+            return batch / dt
+        score_jit = jax.jit(with_microbatch(fns[mode], batch))
         out = score_jit(params, ids, mask)
         np.asarray(jax.tree_util.tree_leaves(out)[0][0])  # compile + sync
         dt = float("inf")
@@ -396,23 +711,86 @@ def main():
                 out = score_jit(params, ids, mask)
             np.asarray(jax.tree_util.tree_leaves(out)[0][0])  # drain queue
             dt = min(dt, (time.perf_counter() - t0) / iters)
-        return args.batch / dt
+        return batch / dt
 
-    def describe(mode):
+    def describe(mode, batch=None, seq=None, prompt_tokens=None,
+                 decided_frac=None, extra=""):
+        batch = batch or args.batch
+        frac = args.decided_frac if decided_frac is None else decided_frac
+        _, pool_every, sub = phase2_geometry(batch, frac)
         tags = {
             "parity": (f"two-phase {args.decode}-step look-ahead, "
-                       f"{int(round(args.decided_frac * 100))}% rows decided "
-                       f"at position 0, {sub}-row decode slice"),
+                       f"{int(round(frac * 100))}% rows decided "
+                       f"at position 0, pooled {sub}-row decode every "
+                       f"{pool_every} batches"),
             "decode": f"{args.decode}-token look-ahead decode, all rows",
             "single": "single forward",
         }
         return (f"prompts/sec/chip (yes-no scoring sweep, {args.model} geometry, "
                 f"{'w8a8 int8' if args.quant == 'int8' else 'bf16'}, "
-                f"batch {args.batch}, {args.prompt_tokens}-token prompts, "
-                + tags[mode]
+                f"batch {batch}, {prompt_tokens or args.prompt_tokens}-token prompts, "
+                + tags.get(mode, mode) + extra
                 + (f", attn={args.attn}" if args.attn != "xla" else "")
                 + (f", microbatch={args.microbatch}" if args.microbatch > 1 else "")
                 + ")")
+
+    if args.mode == "sweep":
+        # The sweep runs at --sweep-batch on the real ~107-token prompts
+        # (256-token worst bucket: the longest rephrasing is 203 tokens) —
+        # plan THAT operating point, not the parity mode's 432-token one.
+        sweep_plan = resolve_scoring_plan(
+            cfg, args.quant, args.sweep_batch, 256,
+            requested_impl="flash" if args.attn == "flash" else None,
+        )
+        if sweep_plan.batch != args.sweep_batch or (
+                sweep_plan.attention_impl != args.attn):
+            print(f"# sweep plan: {sweep_plan.reason}; batch "
+                  f"{args.sweep_batch} -> {sweep_plan.batch}, attn "
+                  f"{args.attn} -> {sweep_plan.attention_impl}",
+                  file=sys.stderr)
+            args.sweep_batch = sweep_plan.batch
+            if sweep_plan.attention_impl != args.attn:
+                args.attn = sweep_plan.attention_impl
+                cfg = DecoderConfig(**geometry, attention_impl=args.attn)
+        pps, rate, out_path = run_sweep_mode(args, cfg, params)
+        print(f"# sweep workbook: {out_path}", file=sys.stderr)
+        record = {
+            "metric": (
+                f"prompts/sec/chip (END-TO-END 10k-perturbation scoring "
+                f"sweep on real perturbations.json texts: tokenize + "
+                f"bucketing + two-phase engine + row building + xlsx "
+                f"checkpoints; {args.model} geometry, "
+                f"{'w8a8 int8' if args.quant == 'int8' else 'bf16'}, "
+                f"batch {args.sweep_batch}, measured position-0 hit rate "
+                f"{rate:.2f})"
+            ),
+            "value": round(pps, 2),
+            "unit": "prompts/sec",
+            "vs_baseline": round(pps / A100_BASELINE_PROMPTS_PER_SEC, 2),
+        }
+        if not args.no_secondary:
+            # (a) the steady-state device rate at the sweep's own dominant
+            # operating point — the e2e number should be >=90% of this, the
+            # rest is host-side cost the pipeline failed to overlap; (b) the
+            # r01-r03 430-token parity + single headlines for
+            # round-over-round continuity on the shared chip.
+            sweep_kw = dict(batch=args.sweep_batch, seq=128, prompt_tokens=104,
+                            decided_frac=rate)
+            record["secondary"] = [
+                {"metric": describe("parity", extra=", sweep operating point",
+                                    **sweep_kw),
+                 "value": round(measure("parity", max(4, args.iters // 2), 2,
+                                        **sweep_kw), 2),
+                 "unit": "prompts/sec"},
+                {"metric": describe("parity"),
+                 "value": round(measure("parity", max(4, args.iters // 2), 2), 2),
+                 "unit": "prompts/sec"},
+                {"metric": describe("single"),
+                 "value": round(measure("single", max(4, args.iters // 2), 2), 2),
+                 "unit": "prompts/sec"},
+            ]
+        print(json.dumps(record))
+        return
 
     primary = measure(args.mode, args.iters, args.repeats)
     record = {

@@ -917,8 +917,6 @@ def cmd_verify_replication(args):
 
     results_100q = args.results_100q
     if args.snapshots:
-        import os
-
         args.checkpoint_dir = args.snapshots
         rc = _run_config(args)
         results_100q = run_snapshot_sweep(rc, args.output_dir)

@@ -85,6 +85,41 @@ class ScoringEngine:
     def target_ids(self, targets: Sequence[str]) -> List[int]:
         return yn.target_token_ids(self.tokenizer, targets, self.is_encoder_decoder)
 
+    def _target_id_rows(self, prompts, targets) -> np.ndarray:
+        """Normalize ``targets`` to a per-prompt [(yes_id, no_id)] array.
+
+        ``targets`` is either one (yes, no) string pair applied to every
+        prompt, or a sequence of per-prompt pairs (len == len(prompts)).
+        Per-prompt pairs let ONE call score prompts from MIXED scenarios —
+        every scoring op already broadcasts [B] token-id operands — so the
+        sweep batches across scenarios instead of paying a partial tail
+        batch per (scenario, bucket): at the real perturbation corpus that
+        padding was ~40% of all prefill rows."""
+        if targets and not isinstance(targets[0], str):
+            if len(targets) != len(prompts):
+                raise ValueError(
+                    f"per-prompt targets: got {len(targets)} pairs for "
+                    f"{len(prompts)} prompts")
+            cache: Dict[tuple, tuple] = {}
+            rows = np.empty((len(prompts), 2), np.int32)
+            for i, pair in enumerate(targets):
+                key = tuple(pair)
+                if key not in cache:
+                    cache[key] = tuple(self.target_ids(list(pair))[:2])
+                rows[i] = cache[key]
+            return rows
+        yes_id, no_id = self.target_ids(list(targets))[:2]
+        return np.tile(np.asarray([[yes_id, no_id]], np.int32),
+                       (len(prompts), 1))
+
+    @staticmethod
+    def _batch_target_rows(ids_all: np.ndarray, batch) -> np.ndarray:
+        """[B, 2] target ids for one batch; pad rows (index -1) duplicate
+        row 0's content in the batcher, so they take row 0's ids too."""
+        first = int(batch.indices[0])
+        idx = np.where(batch.indices >= 0, batch.indices, first)
+        return ids_all[idx]
+
     def _put(self, arr):
         if self.mesh is None:
             return jnp.asarray(arr)
@@ -169,7 +204,7 @@ class ScoringEngine:
 
     def _score_decoder(self, prompts, targets, with_confidence) -> List[Dict]:
         ecfg = self.ecfg
-        yes_id, no_id = self.target_ids(targets)[:2]
+        ids_all = self._target_id_rows(prompts, targets)   # [N, 2]
         eos_id = getattr(self.tokenizer, "eos_token_id", None)
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
@@ -178,7 +213,7 @@ class ScoringEngine:
         pool = None
         if ecfg.phase2_pool and not with_confidence and not ecfg.decode_completions:
             pool = _Phase2Pool(
-                self, steps, eos_id, yes_id, no_id,
+                self, steps, eos_id,
                 target=ecfg.phase2_pool_target or ecfg.batch_size,
                 results=results, max_bytes=ecfg.phase2_pool_max_bytes,
             )
@@ -193,12 +228,15 @@ class ScoringEngine:
                 self.params, self.cfg, ids, mask, cache_len=batch.bucket_len,
             )
             lengths = jnp.sum(mask, axis=-1)
-            scan0 = yn.first_token_scan(last, yes_id, no_id, top_k=ecfg.top_k)
+            row_ids = self._batch_target_rows(ids_all, batch)
+            scan0 = yn.first_token_scan(
+                last, row_ids[:, 0], row_ids[:, 1], top_k=ecfg.top_k)
             return last, cache, lengths, scan0
 
         def consume(batch, out):
             last, cache, lengths, scan0 = out
             yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+            row_ids = self._batch_target_rows(ids_all, batch)
             valid = batch.indices >= 0
             undecided = np.flatnonzero(~hit0 & valid)
             if with_confidence:
@@ -265,7 +303,7 @@ class ScoringEngine:
                 )
                 if need_scores:
                     res = yn.yes_no_from_scores(
-                        scores_dev[:, :steps], yes_id, no_id,
+                        scores_dev[:, :steps], row_ids[:, 0], row_ids[:, 1],
                         max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
                         valid_steps=yn.steps_until_eos(
                             chunk_toks[0][:, :steps], eos_id
@@ -291,12 +329,13 @@ class ScoringEngine:
                         cache, last, lengths, jnp.asarray(idx)
                     )
                     pool.add(batch.bucket_len, sub_cache, last_s, len_s,
-                             undecided.size, batch.indices[undecided])
+                             undecided.size, batch.indices[undecided],
+                             row_ids[idx])
                     # res_np stays None: pooled rows are emitted at flush time
                 else:
                     if m == hit0.shape[0]:
                         sub_cache, last_s, len_s = cache, last, lengths
-                        real, sub_pos = valid, None
+                        real, sub_pos, ids_sub = valid, None, row_ids
                     else:
                         idx = np.zeros((m,), np.int32)
                         idx[: undecided.size] = undecided
@@ -306,13 +345,15 @@ class ScoringEngine:
                         sub_pos = {int(r): j for j, r in enumerate(undecided)}
                         real = np.zeros((m,), bool)
                         real[: undecided.size] = True
+                        ids_sub = row_ids[idx]
                     sc, toks_s = self._scan_decode_chunked(
-                        sub_cache, last_s, len_s, steps, eos_id, yes_id, no_id,
+                        sub_cache, last_s, len_s, steps, eos_id,
+                        ids_sub[:, 0], ids_sub[:, 1],
                         min_steps=3 if with_confidence else 0,
                         real_mask=real,
                     )
                     res = yn.yes_no_from_scores(
-                        sc, yes_id, no_id,
+                        sc, ids_sub[:, 0], ids_sub[:, 1],
                         max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
                         valid_steps=yn.steps_until_eos(toks_s, eos_id),
                     )
@@ -410,7 +451,7 @@ class ScoringEngine:
         the first MAX_LOOK_AHEAD positions, like the reference's
         encoder-decoder branch (run_base_vs_instruct_100q.py:291-326)."""
         ecfg = self.ecfg
-        yes_id, no_id = self.target_ids(targets)[:2]
+        ids_all = self._target_id_rows(prompts, targets)
         eos_id = getattr(self.tokenizer, "eos_token_id", None)
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
@@ -423,8 +464,9 @@ class ScoringEngine:
                 self.params, self.cfg, ids, mask, num_steps=gen_total,
                 eos_token_id=eos_id, score_steps=steps,
             )
+            row_ids = self._batch_target_rows(ids_all, batch)
             res = yn.yes_no_from_scores(
-                scores, yes_id, no_id,
+                scores, row_ids[:, 0], row_ids[:, 1],
                 max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
                 valid_steps=yn.steps_until_eos(tokens[:, :steps], eos_id),
             )
@@ -470,8 +512,9 @@ class ScoringEngine:
         top_filter: int = 0,
     ) -> np.ndarray:
         """Fast path: one forward per bucket, no generation — the pjit'd
-        perturbation-sweep hot op.  Returns [N, 3] (yes, no, relative)."""
-        yes_id, no_id = self.target_ids(targets)[:2]
+        perturbation-sweep hot op.  Returns [N, 3] (yes, no, relative).
+        ``targets`` may be per-prompt pairs (see ``_target_id_rows``)."""
+        ids_all = self._target_id_rows(prompts, targets)
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         out = np.zeros((len(prompts), 3), np.float64)
 
@@ -483,7 +526,9 @@ class ScoringEngine:
                 logits = t5mod.forward(self.params, self.cfg, ids, mask, dec)[:, 0, :]
             else:
                 logits = dmod.forward_last_logits(self.params, self.cfg, ids, mask)
-            return yn.relative_prob_first_token(logits, yes_id, no_id, top_filter)
+            row_ids = self._batch_target_rows(ids_all, batch)
+            return yn.relative_prob_first_token(
+                logits, row_ids[:, 0], row_ids[:, 1], top_filter)
 
         def consume(batch, res):
             yes, no, rel = (np.asarray(a) for a in res)
@@ -535,13 +580,11 @@ class _Phase2Pool:
     same caches, just grouped into fewer device programs.
     """
 
-    def __init__(self, engine, steps, eos_id, yes_id, no_id, target, results,
+    def __init__(self, engine, steps, eos_id, target, results,
                  max_bytes: int = 512 << 20):
         self.engine = engine
         self.steps = steps
         self.eos_id = eos_id
-        self.yes_id = yes_id
-        self.no_id = no_id
         self.target = max(1, int(target))
         self.max_bytes = max(1, int(max_bytes))
         self.results = results
@@ -553,17 +596,21 @@ class _Phase2Pool:
     def _entry_bytes(cache) -> int:
         return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
 
-    def add(self, bucket_len, sub_cache, last_s, len_s, n_real, orig_idx):
+    def add(self, bucket_len, sub_cache, last_s, len_s, n_real, orig_idx,
+            row_ids):
         """Queue one batch's gathered undecided slice (rows past ``n_real``
         are gather padding).  ``orig_idx``: original prompt index per real
-        row.  Flushes when the bucket reaches ``target`` rows or the pool's
-        TOTAL held K/V would exceed ``max_bytes`` (the largest bucket
-        flushes first, freeing the most per row)."""
+        row; ``row_ids``: [m, 2] per-row (yes, no) target ids — rows from
+        DIFFERENT scenarios pool together.  Flushes when the bucket reaches
+        ``target`` rows or the pool's TOTAL held K/V would exceed
+        ``max_bytes`` (the largest bucket flushes first, freeing the most
+        per row)."""
         nb = self._entry_bytes(sub_cache)
         while self.entries and sum(self.bytes.values()) + nb > self.max_bytes:
             self.flush(max(self.bytes, key=self.bytes.get))
         self.entries.setdefault(bucket_len, []).append(
-            (sub_cache, last_s, len_s, int(n_real), np.asarray(orig_idx))
+            (sub_cache, last_s, len_s, int(n_real), np.asarray(orig_idx),
+             np.asarray(row_ids, np.int32))
         )
         self.counts[bucket_len] = self.counts.get(bucket_len, 0) + int(
             last_s.shape[0]
@@ -591,7 +638,8 @@ class _Phase2Pool:
         )
         last = jnp.zeros((rows, last_t.shape[1]), last_t.dtype)
         lens = jnp.ones((rows,), len_t.dtype)
-        return cache, last, lens, 0, np.empty((0,), np.int64)
+        return (cache, last, lens, 0, np.empty((0,), np.int64),
+                np.zeros((rows, 2), np.int32))
 
     def flush(self, bucket_len):
         entries = self.entries.pop(bucket_len, [])
@@ -616,24 +664,25 @@ class _Phase2Pool:
             last = jnp.concatenate([e[1] for e in entries], axis=0)
             lens = jnp.concatenate([e[2] for e in entries], axis=0)
         mask_parts = []
-        for _, last_e, _, n_real, _ in entries:
+        for _, last_e, _, n_real, _, _ in entries:
             part = np.zeros((last_e.shape[0],), bool)
             part[:n_real] = True
             mask_parts.append(part)
         mask = np.concatenate(mask_parts)
+        ids = np.concatenate([e[5] for e in entries], axis=0)   # [m, 2]
         ecfg = self.engine.ecfg
         sc, toks = self.engine._scan_decode_chunked(
-            cache, last, lens, self.steps, self.eos_id, self.yes_id,
-            self.no_id, real_mask=mask,
+            cache, last, lens, self.steps, self.eos_id,
+            ids[:, 0], ids[:, 1], real_mask=mask,
         )
         res = yn.yes_no_from_scores(
-            sc, self.yes_id, self.no_id,
+            sc, ids[:, 0], ids[:, 1],
             max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
             valid_steps=yn.steps_until_eos(toks, self.eos_id),
         )
         res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
         row = 0
-        for _, last_e, _, n_real, orig in entries:
+        for _, last_e, _, n_real, orig, _ in entries:
             for j in range(n_real):
                 g = row + j
                 self.results[int(orig[j])] = _result_row(

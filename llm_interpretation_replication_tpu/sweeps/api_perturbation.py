@@ -518,6 +518,15 @@ def run_gpt_perturbation_sweep(
     the OpenAI-batch and Gemini legs."""
     import os
 
+    if is_reasoning_model(model):
+        # o*/gpt-5* return no logprobs, so every row would record
+        # Token_i_Prob=0 garbage; the batch pipeline has the
+        # reasoning-model modes (confidence-only / frequency repeats,
+        # perturb_prompts.py:46-48) — route there instead of writing junk.
+        raise ValueError(
+            f"{model} is a reasoning model (no logprobs on the sync API); "
+            f"use run-api-perturbation, whose batch pipeline handles "
+            f"reasoning models")
     log = log or SessionLogger()
     processed = load_processed_triples(output_xlsx)
     work: List[Tuple[Dict, str]] = []

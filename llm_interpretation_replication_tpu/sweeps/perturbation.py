@@ -53,6 +53,7 @@ def run_model_perturbation_sweep(
     checkpoint_every: int = 100,
     max_rephrasings: Optional[int] = None,
     confidence: bool = True,
+    score_chunk: int = 2000,
     log: Optional[SessionLogger] = None,
 ) -> pd.DataFrame:
     log = log or SessionLogger()
@@ -70,6 +71,18 @@ def run_model_perturbation_sweep(
         os.makedirs(os.path.dirname(os.path.abspath(output_xlsx)), exist_ok=True)
         write_xlsx(pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS), output_xlsx)
 
+    # Cross-scenario batching: the engine takes PER-PROMPT target pairs, so
+    # one scoring call mixes all scenarios' rephrasings.  Per-scenario calls
+    # paid a partial tail batch per (scenario, length-bucket) — ~40% of all
+    # prefill rows were padding at the real corpus; batched across scenarios
+    # the tails collapse to one per bucket per chunk.  ``score_chunk`` rows
+    # are scored per call — it bounds CRASH LOSS (a crash during a chunk's
+    # scoring calls loses that whole chunk; the workbook can only flush
+    # rows whose chunk finished), so the 2000 default keeps the old
+    # one-scenario durability while still merging tail batches whenever
+    # scenarios have fewer rephrasings.  Raise it for maximum throughput on
+    # reliable hardware.
+    todo_items: List[tuple] = []
     for scenario in scenarios:
         rephrasings = scenario["rephrasings"]
         if max_rephrasings:
@@ -83,18 +96,22 @@ def run_model_perturbation_sweep(
             continue
         log(f"{model_name}: scoring {len(todo)} rephrasings of scenario "
             f"{scenario['original_main'][:50]!r}...")
-        targets = list(scenario["target_tokens"])
-        binary_prompts = [f"{r} {scenario['response_format']}" for r in todo]
+        todo_items.extend((scenario, r) for r in todo)
+
+    for start in range(0, len(todo_items), score_chunk):
+        chunk = todo_items[start:start + score_chunk]
+        targets = [list(s["target_tokens"]) for s, _ in chunk]
+        binary_prompts = [f"{r} {s['response_format']}" for s, r in chunk]
         probs = engine.first_token_relative_prob(
             binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
         )
         responses = engine.score_prompts(binary_prompts, targets=targets)
 
-        conf_values: List[Optional[int]] = [None] * len(todo)
-        conf_texts = [""] * len(todo)
-        weighted: List[Optional[float]] = [None] * len(todo)
+        conf_values: List[Optional[int]] = [None] * len(chunk)
+        conf_texts = [""] * len(chunk)
+        weighted: List[Optional[float]] = [None] * len(chunk)
         if confidence:
-            conf_prompts = [f"{r} {scenario['confidence_format']}" for r in todo]
+            conf_prompts = [f"{r} {s['confidence_format']}" for s, r in chunk]
             conf_rows = engine.score_prompts(
                 conf_prompts, targets=targets, with_confidence=True
             )
@@ -103,7 +120,7 @@ def run_model_perturbation_sweep(
                 conf_values[i] = extract_first_int(row["completion"])
                 weighted[i] = row.get("weighted_confidence")
 
-        for i, reph in enumerate(todo):
+        for i, (scenario, reph) in enumerate(chunk):
             t1p, t2p = float(probs[i, 0]), float(probs[i, 1])
             odds = t1p / t2p if t2p > 0 else float("inf")
             pending.append(

@@ -956,6 +956,21 @@ class TestGptPerturbationSweep:
                                    sleep=lambda _s: None)
         assert len(ft.calls) > calls_before
 
+    def test_reasoning_model_rejected(self, tmp_path):
+        """o*/gpt-5* return no logprobs on the sync API — the sweep must
+        refuse instead of checkpointing Token_i_Prob=0 garbage (the batch
+        pipeline has the reasoning-model modes, perturb_prompts.py:46-48)."""
+        from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
+            run_gpt_perturbation_sweep,
+        )
+
+        client, _ = self._client()
+        with pytest.raises(ValueError, match="reasoning model"):
+            run_gpt_perturbation_sweep(
+                client, "o3", self._scenarios(1), str(tmp_path / "gpt.xlsx"),
+                sleep=lambda _s: None,
+            )
+
     def test_all_failures_raise(self, tmp_path):
         from llm_interpretation_replication_tpu.sweeps.api_perturbation import (
             run_gpt_perturbation_sweep,

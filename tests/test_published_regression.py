@@ -452,3 +452,67 @@ class TestVerifyReplication:
         rows = check_table5(None, "s1.csv", "s2.csv")
         assert [r["verdict"] for r in rows] == ["SKIP"] * 3
         assert all("snapshots" in r["detail"] for r in rows)
+
+    def test_table5_pass_path_on_engineered_sweep(self, tmp_path):
+        """PASS path for the Table-5 check: a synthetic run-100q results CSV
+        whose per-question error distributions are ENGINEERED to land every
+        family's base/instruct MAE, paired-bootstrap diff CI, and printed
+        significance category inside the published Table 5 values
+        (main.tex:432-446) — Falcon +0.135*** (constant positive diffs),
+        StableLM -0.030 ns (small mean, wide spread), RedPajama +0.122*
+        (borderline p via 16 high-mean questions with +/-0.268 spread).
+        Exercises the verdict logic end-to-end on data shaped like a real
+        sweep output, which the reference never published."""
+        from llm_interpretation_replication_tpu.analysis.replication import (
+            check_table5,
+        )
+        from llm_interpretation_replication_tpu.survey import (
+            apply_exclusion_criteria,
+            human_responses_by_question,
+            load_and_clean_survey_data,
+        )
+        from llm_interpretation_replication_tpu.survey.pipeline import (
+            extract_question_text,
+        )
+
+        df, cols = load_and_clean_survey_data([SURVEY1, SURVEY2])
+        df, _ = apply_exclusion_criteria(df, cols)
+        human = human_responses_by_question(df, cols)
+        texts = extract_question_text([SURVEY1, SURVEY2])
+        means = {c: human[c]["mean"] / 100.0 for c in human}
+        ordered = sorted(means, key=lambda c: means[c])
+
+        def rel(h, err):
+            # place the prediction err away from the human mean, inside [0,1]
+            return h + err if h + err <= 1.0 else h - err
+
+        rows = []
+
+        def add(model, columns, errors):
+            for col, err in zip(columns, errors):
+                r = rel(means[col], err)
+                assert 0.0 <= r <= 1.0, (model, col, means[col], err)
+                rows.append({"prompt": texts[col], "model": model,
+                             "relative_prob": r})
+
+        # Falcon: constant errors -> diff +0.135 exactly, p=0 -> ***
+        add("tiiuae/falcon-7b", ordered, [0.333] * len(ordered))
+        add("tiiuae/falcon-7b-instruct", ordered, [0.468] * len(ordered))
+        # StableLM: 50 questions, instruct errors 0.339 +/- 0.15 -> ns
+        fifty = ordered[:50]
+        add("stabilityai/stablelm-base-alpha-7b", fifty, [0.369] * 50)
+        add("stabilityai/stablelm-tuned-alpha-7b", fifty,
+            [0.339 + (0.15 if i % 2 else -0.15) for i in range(50)])
+        # RedPajama: 16 highest-mean questions, +/-0.268 spread -> p ~ 0.06 *
+        high = [c for c in ordered if means[c] >= 0.75][-16:]
+        assert len(high) == 16
+        add("togethercomputer/RedPajama-INCITE-7B-Base", high, [0.313] * 16)
+        add("togethercomputer/RedPajama-INCITE-7B-Instruct", high,
+            [0.437 + (0.268 if i % 2 else -0.268) for i in range(16)])
+
+        csv = tmp_path / "base_vs_instruct_100q_results.csv"
+        pd.DataFrame(rows).to_csv(csv, index=False)
+        verdicts = check_table5(str(csv), SURVEY1, SURVEY2)
+        assert len(verdicts) == 9          # 3 families x (base, instruct, diff)
+        for v in verdicts:
+            assert v["verdict"] == "PASS", v

@@ -309,6 +309,44 @@ class TestScoringEngine:
                 np.testing.assert_allclose(a["yes_prob"], b["yes_prob"], rtol=1e-5)
                 assert a["scan_found"] == b["scan_found"]
 
+    def test_per_row_targets_match_per_group_calls(self):
+        """One call with PER-PROMPT target pairs (cross-scenario batching)
+        must reproduce separate per-scenario calls exactly, across the fast
+        path, the two-phase path (incl. pooled flushes mixing scenarios),
+        and the completions path."""
+        import dataclasses as dc
+
+        eng, _, _ = _tiny_engine(batch_size=8)
+        prompts_a = [f"is item {i} a publication maybe" for i in range(6)]
+        prompts_b = [f"does thing {i} count as soup" for i in range(5)]
+        pairs = [("Yes", "No")] * len(prompts_a) + [("No", "Yes")] * len(prompts_b)
+        mixed = prompts_a + prompts_b
+
+        rows_a = eng.score_prompts(prompts_a, targets=("Yes", "No"))
+        rows_b = eng.score_prompts(prompts_b, targets=("No", "Yes"))
+        rows_mixed = eng.score_prompts(mixed, targets=pairs)
+        for a, b in zip(rows_a + rows_b, rows_mixed):
+            assert a["yes_prob"] == b["yes_prob"]
+            assert a["relative_prob"] == b["relative_prob"]
+            assert a["completion"] == b["completion"]
+
+        eng.ecfg = dc.replace(eng.ecfg, decode_completions=False,
+                              phase2_pool_target=16)
+        fast_a = eng.first_token_relative_prob(prompts_a, targets=("Yes", "No"))
+        fast_b = eng.first_token_relative_prob(prompts_b, targets=("No", "Yes"))
+        fast_mixed = eng.first_token_relative_prob(mixed, targets=pairs)
+        np.testing.assert_array_equal(np.vstack([fast_a, fast_b]), fast_mixed)
+
+        two_a = eng.score_prompts(prompts_a, targets=("Yes", "No"))
+        two_b = eng.score_prompts(prompts_b, targets=("No", "Yes"))
+        two_mixed = eng.score_prompts(mixed, targets=pairs)
+        for a, b in zip(two_a + two_b, two_mixed):
+            np.testing.assert_allclose(a["relative_prob"], b["relative_prob"],
+                                       rtol=1e-6)
+            assert a["scan_found"] == b["scan_found"]
+        with pytest.raises(ValueError, match="per-prompt targets"):
+            eng.score_prompts(mixed, targets=pairs[:-1])
+
     def test_chunked_scan_matches_single_chunk(self):
         """scan_chunk must be invisible in the results: the early exit may
         only fire when every real row is resolved (hit or actual EOS), so a
