@@ -44,16 +44,32 @@ def batches_for_prompts(
     buckets: Sequence[int] = DEFAULT_BUCKETS,
     pad_id: int = 0,
     keep_order_within_bucket: bool = True,
+    min_bucket_rows: Optional[int] = None,
 ) -> Iterator[Batch]:
     """Group encoded prompts by bucket, emit fixed-shape padded batches.
 
     Short final batches are padded with duplicate rows (index -1) so the
     compiled program shape never varies with sweep size.
+
+    Buckets holding fewer than ``min_bucket_rows`` prompts (default
+    batch_size // 8) merge UPWARD into the next occupied larger bucket: a
+    handful of stray lengths is never worth a fresh XLA compile (~1.5-4 min
+    per program on a remote-compile chip) when padding them into the
+    neighboring shape costs microseconds.  The largest occupied bucket
+    never merges (there is nowhere to go).
     """
+    if min_bucket_rows is None:
+        min_bucket_rows = max(1, batch_size // 8)
     by_bucket: dict = {}
     for idx, ids in enumerate(encoded):
         b = bucket_for(len(ids), buckets)
         by_bucket.setdefault(b, []).append((idx, list(ids)))
+    occupied = sorted(by_bucket)
+    for i, b in enumerate(occupied[:-1]):
+        if len(by_bucket[b]) < min_bucket_rows:
+            by_bucket[occupied[i + 1]] = (
+                by_bucket.pop(b) + by_bucket[occupied[i + 1]]
+            )
     for bucket_len in sorted(by_bucket):
         items = by_bucket[bucket_len]
         if not keep_order_within_bucket:

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import collections
 import dataclasses
+import functools
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -54,6 +55,14 @@ class EngineConfig:
                                     # so amortizing it across batches removes
                                     # most of the two-phase overhead)
     phase2_pool_target: int = 0     # rows per pooled decode; 0 → batch_size
+    phase2_select_slice: int = 0    # in-program phase-2 row selection: the
+                                    # prefill outputs only this many cache
+                                    # rows (undecided-first), so the full
+                                    # cache never materializes (~106 ms/batch
+                                    # at sweep shapes); 0 → batch_size // 4,
+                                    # menu-padded.  Batches with more
+                                    # undecided rows fall back to a full
+                                    # prefill.
     phase2_pool_max_bytes: int = 512 << 20
                                     # HBM cap on gathered K/V held by the
                                     # pool ACROSS ALL buckets; a bucket
@@ -210,13 +219,9 @@ class ScoringEngine:
         results: List[Optional[Dict]] = [None] * len(prompts)
         steps, gen_total = self._gen_plan()
 
-        pool = None
         if ecfg.phase2_pool and not with_confidence and not ecfg.decode_completions:
-            pool = _Phase2Pool(
-                self, steps, eos_id,
-                target=ecfg.phase2_pool_target or ecfg.batch_size,
-                results=results, max_bytes=ecfg.phase2_pool_max_bytes,
-            )
+            return self._score_decoder_pooled(
+                encoded, ids_all, results, eos_id, steps)
 
         def launch(batch):
             ids = self._put(batch.token_ids)
@@ -322,52 +327,39 @@ class ScoringEngine:
                 # and the confidence leg (which needs per-row score buffers
                 # at emission time) always decodes immediately.
                 m = _pad_slice(undecided.size, hit0.shape[0])
-                if pool is not None and m < hit0.shape[0]:
+                if m == hit0.shape[0]:
+                    sub_cache, last_s, len_s = cache, last, lengths
+                    real, sub_pos, ids_sub = valid, None, row_ids
+                else:
                     idx = np.zeros((m,), np.int32)
                     idx[: undecided.size] = undecided
                     sub_cache, last_s, len_s = _gather_rows(
                         cache, last, lengths, jnp.asarray(idx)
                     )
-                    pool.add(batch.bucket_len, sub_cache, last_s, len_s,
-                             undecided.size, batch.indices[undecided],
-                             row_ids[idx])
-                    # res_np stays None: pooled rows are emitted at flush time
-                else:
-                    if m == hit0.shape[0]:
-                        sub_cache, last_s, len_s = cache, last, lengths
-                        real, sub_pos, ids_sub = valid, None, row_ids
-                    else:
-                        idx = np.zeros((m,), np.int32)
-                        idx[: undecided.size] = undecided
-                        sub_cache, last_s, len_s = _gather_rows(
-                            cache, last, lengths, jnp.asarray(idx)
-                        )
-                        sub_pos = {int(r): j for j, r in enumerate(undecided)}
-                        real = np.zeros((m,), bool)
-                        real[: undecided.size] = True
-                        ids_sub = row_ids[idx]
-                    sc, toks_s = self._scan_decode_chunked(
-                        sub_cache, last_s, len_s, steps, eos_id,
-                        ids_sub[:, 0], ids_sub[:, 1],
-                        min_steps=3 if with_confidence else 0,
-                        real_mask=real,
-                    )
-                    res = yn.yes_no_from_scores(
-                        sc, ids_sub[:, 0], ids_sub[:, 1],
-                        max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                        valid_steps=yn.steps_until_eos(toks_s, eos_id),
-                    )
-                    res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
-                    if with_confidence:
-                        scores_np = np.asarray(sc)
+                    sub_pos = {int(r): j for j, r in enumerate(undecided)}
+                    real = np.zeros((m,), bool)
+                    real[: undecided.size] = True
+                    ids_sub = row_ids[idx]
+                sc, toks_s = self._scan_decode_chunked(
+                    sub_cache, last_s, len_s, steps, eos_id,
+                    ids_sub[:, 0], ids_sub[:, 1],
+                    min_steps=3 if with_confidence else 0,
+                    real_mask=real,
+                )
+                res = yn.yes_no_from_scores(
+                    sc, ids_sub[:, 0], ids_sub[:, 1],
+                    max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                    valid_steps=yn.steps_until_eos(toks_s, eos_id),
+                )
+                res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+                if with_confidence:
+                    scores_np = np.asarray(sc)
 
             for r, orig in enumerate(batch.indices):
                 if orig < 0:
                     continue
                 if hit0[r] and not with_confidence:
                     vals = (yes0[r], no0[r], rel0[r], odds0[r], True)
-                elif res_np is None:
-                    continue  # undecided row deferred to the pool flush
                 else:
                     j = r if sub_pos is None else sub_pos.get(r)
                     vals = (
@@ -394,8 +386,110 @@ class ScoringEngine:
             ),
             launch, consume,
         )
-        if pool is not None:
-            pool.flush_all()
+        return [r if r is not None else _error_row("missing") for r in results]
+
+    def _score_decoder_pooled(self, encoded, ids_all, results, eos_id,
+                              steps) -> List[Dict]:
+        """Two-phase path with the cross-batch pool AND in-program phase-2
+        row selection: the prefill program outputs only a
+        ``phase2_select_slice``-row cache slice (undecided rows first), a
+        ~4x smaller output than the full cache — an HBM win (two pipelined
+        batches stay in flight), not a throughput win (the layer scan still
+        stacks the full K/V internally; see _prefill_select).  Batches
+        where more rows are undecided than the slice holds fall back to a
+        full prefill + in-place decode (they were going to decode
+        near-full-lane anyway)."""
+        ecfg = self.ecfg
+        pool = _Phase2Pool(
+            self, steps, eos_id,
+            target=ecfg.phase2_pool_target or ecfg.batch_size,
+            results=results, max_bytes=ecfg.phase2_pool_max_bytes,
+        )
+        select_m = _pad_slice(
+            ecfg.phase2_select_slice or max(8, ecfg.batch_size // 4),
+            ecfg.batch_size)
+
+        def launch(batch):
+            ids = self._put(batch.token_ids)
+            mask = self._put(batch.attention_mask)
+            row_ids = self._batch_target_rows(ids_all, batch)
+            return _prefill_select(
+                self.params, self.cfg, ids, mask,
+                jnp.asarray(batch.indices >= 0),
+                row_ids[:, 0], row_ids[:, 1],
+                cache_len=batch.bucket_len, slice_m=select_m,
+                top_k=ecfg.top_k,
+            )
+
+        def consume(batch, out):
+            scan0, sel, sub_cache, last_s, len_s = out
+            yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+            row_ids = self._batch_target_rows(ids_all, batch)
+            valid = batch.indices >= 0
+            undecided = np.flatnonzero(~hit0 & valid)
+            count = undecided.size
+            if count > select_m:
+                # Overflow fallback: re-run the prompt forward with the full
+                # cache and decode in place.
+                ids = self._put(batch.token_ids)
+                mask = self._put(batch.attention_mask)
+                last_f, cache = dmod.prefill(
+                    self.params, self.cfg, ids, mask,
+                    cache_len=batch.bucket_len)
+                sc, toks_s = self._scan_decode_chunked(
+                    cache, last_f, jnp.sum(mask, axis=-1), steps, eos_id,
+                    row_ids[:, 0], row_ids[:, 1], real_mask=valid,
+                )
+                res = yn.yes_no_from_scores(
+                    sc, row_ids[:, 0], row_ids[:, 1],
+                    max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                    valid_steps=yn.steps_until_eos(toks_s, eos_id),
+                )
+                res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+                for r, orig in enumerate(batch.indices):
+                    if orig < 0:
+                        continue
+                    if hit0[r]:
+                        vals = (yes0[r], no0[r], rel0[r], odds0[r], True)
+                    else:
+                        vals = (res_np["yes_prob"][r], res_np["no_prob"][r],
+                                res_np["relative_prob"][r],
+                                res_np["odds_ratio"][r], res_np["found"][r])
+                    results[int(orig)] = _result_row(*vals, "")
+                return
+            if count:
+                # slice rows 0..count-1 ARE the undecided rows (the sort key
+                # is False for exactly those rows), though their order
+                # within the slice is the sort's business — every per-row
+                # association below therefore goes through sel, never
+                # through the ascending `undecided` list.  Shrink to the
+                # tight menu size before pooling so held bytes stay
+                # proportional to real rows.
+                sel_np = np.asarray(sel)
+                m = _pad_slice(count, select_m)
+                if m < select_m:
+                    idx = np.zeros((m,), np.int32)
+                    idx[:count] = np.arange(count)
+                    sub_cache, last_s, len_s = _gather_rows(
+                        sub_cache, last_s, len_s, jnp.asarray(idx))
+                    mapped = sel_np[idx]
+                else:
+                    mapped = sel_np[:select_m]
+                pool.add(batch.bucket_len, sub_cache, last_s, len_s, count,
+                         batch.indices[mapped[:count]], row_ids[mapped])
+            for r, orig in enumerate(batch.indices):
+                if orig >= 0 and hit0[r]:
+                    results[int(orig)] = _result_row(
+                        yes0[r], no0[r], rel0[r], odds0[r], True, "")
+
+        self._run_pipelined(
+            batching.batches_for_prompts(
+                encoded, ecfg.batch_size, ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+            ),
+            launch, consume,
+        )
+        pool.flush_all()
         return [r if r is not None else _error_row("missing") for r in results]
 
     def _scan_decode_chunked(self, sub_cache, last_s, len_s, steps, eos_id,
@@ -691,6 +785,44 @@ class _Phase2Pool:
                     res_np["found"][g], "",
                 )
             row += last_e.shape[0]
+
+
+@functools.partial(
+    jax.jit, static_argnames=("cfg", "cache_len", "slice_m", "top_k"))
+def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
+                    cache_len: int, slice_m: int, top_k: int):
+    """Prefill + position-0 scan + IN-PROGRAM phase-2 row selection.
+
+    Selecting the undecided rows INSIDE the program — undecided-first
+    stable sort of the scan's hit mask — outputs a ``slice_m``-row cache
+    slice instead of the full batch.  Measured effect (v5e, 2026-07): the
+    THROUGHPUT cost of producing a cache is unchanged (36.9 p/s either
+    way at the 430-token point; the cost is the layer scan's internal
+    ys-stacking of K/V, ~106 ms/batch, which the gather still reads —
+    prefill 37.32 p/s vs 38.11 pure forward), but the program OUTPUT
+    shrinks ~4x (e.g. 1.36 GB -> 340 MB at 192x432), freeing the HBM that
+    two in-flight pipelined batches would otherwise pin and enabling
+    larger sweep batches.  ``valid_rows`` masks batch padding rows
+    (treated as decided, sorted last).
+
+    Returns (scan0, sel [slice_m] original batch row per slice row,
+    sub_cache, last_sel, len_sel).  Callers must fall back to
+    :func:`models.decoder.prefill` when more than ``slice_m`` rows are
+    undecided."""
+    last, cache = dmod.prefill(params, cfg, ids, mask, cache_len=cache_len)
+    lengths = jnp.sum(mask, axis=-1)
+    scan0 = yn.first_token_scan(last, yes_ids, no_ids, top_k=top_k)
+    decided = scan0[4] | ~valid_rows
+    sel = jnp.argsort(decided, stable=True)[:slice_m]   # undecided first
+    sub = dmod.KVCache(
+        k=cache.k[:, sel], v=cache.v[:, sel],
+        positions=cache.positions[sel], valid=cache.valid[sel],
+        length=cache.length,
+    )
+    # Deliberately NOT returning the full-batch `last`/`lengths`: the
+    # pooled consumer never reads them, and at batch 256 the [B, V] logits
+    # alone would pin ~66 MB of dead output per in-flight pipelined batch.
+    return scan0, sel, sub, last[sel], lengths[sel]
 
 
 @jax.jit

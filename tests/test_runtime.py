@@ -23,14 +23,18 @@ class TestBucketing:
     def test_bucket_for(self):
         assert bucket_for(1) == 64
         assert bucket_for(64) == 64
-        assert bucket_for(65) == 128
+        assert bucket_for(65) == 96      # perturbation-corpus hot zone
+        assert bucket_for(100) == 112
+        assert bucket_for(130) == 144
+        assert bucket_for(430) == 432    # 100q few-shot hot zone
         with pytest.raises(ValueError):
             bucket_for(99999)
 
     def test_batches_fixed_shapes_and_padding(self):
         encoded = [[1] * n for n in (5, 70, 8, 100, 3, 200)]
         batches = list(batches_for_prompts(encoded, batch_size=2, pad_id=0))
-        # buckets: 64 -> [5,8,3] (2 batches), 128 -> [70,100], 256 -> [200]
+        # buckets: 64 -> [5,8,3] (2 batches), 96 -> [70], 112 -> [100],
+        # 256 -> [200]
         shapes = sorted({(b.token_ids.shape, b.bucket_len) for b in batches})
         assert ((2, 64), 64) in [(s, bl) for s, bl in shapes]
         covered = sorted(int(i) for b in batches for i in b.indices if i >= 0)
@@ -41,6 +45,29 @@ class TestBucketing:
             for r in range(len(b.indices)):
                 if b.indices[r] < 0:
                     np.testing.assert_array_equal(b.token_ids[r], b.token_ids[0])
+
+    def test_tiny_buckets_merge_upward(self):
+        """A near-empty bucket must not cost its own XLA compile: fewer than
+        min_bucket_rows prompts merge into the next occupied larger bucket
+        (cascading); the largest occupied bucket never merges."""
+        # 20 prompts at ~100 tokens (112 bucket), 1 stray at 70 (96), 1 at
+        # 130 (144): with batch_size 16, min rows = 2 -> 96 and 112?  96 has
+        # 1 < 2 -> merges into 112; 144 is largest occupied -> stays.
+        encoded = [[1] * 100] * 20 + [[1] * 70] + [[1] * 130]
+        batches = list(batches_for_prompts(encoded, batch_size=16, pad_id=0))
+        lens = sorted({b.bucket_len for b in batches})
+        assert lens == [112, 144]
+        covered = sorted(int(i) for b in batches for i in b.indices if i >= 0)
+        assert covered == list(range(22))
+        # cascade: two tiny buckets in a row both ride up (batch 32 ->
+        # min rows 4; the merged 96+112 pair is still under threshold)
+        encoded = [[1] * 70] + [[1] * 100] + [[1] * 130] * 20
+        batches = list(batches_for_prompts(encoded, batch_size=32, pad_id=0))
+        assert sorted({b.bucket_len for b in batches}) == [144]
+        # disable via min_bucket_rows=1: every occupied bucket kept
+        batches = list(batches_for_prompts(encoded, batch_size=32, pad_id=0,
+                                           min_bucket_rows=1))
+        assert sorted({b.bucket_len for b in batches}) == [96, 112, 144]
 
 
 def _tiny_engine(mesh=None, batch_size=4):
@@ -346,6 +373,57 @@ class TestScoringEngine:
             assert a["scan_found"] == b["scan_found"]
         with pytest.raises(ValueError, match="per-prompt targets"):
             eng.score_prompts(mixed, targets=pairs[:-1])
+
+    def test_prefill_select_slice_contract(self):
+        """_prefill_select's contract: slice rows 0..count-1 are EXACTLY the
+        undecided real rows (set equality — order is the sort's business),
+        batch padding rows sort as decided, and the slice caches agree with
+        a full prefill gather for those rows."""
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+        from llm_interpretation_replication_tpu.runtime import batching
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            _prefill_select,
+        )
+        from llm_interpretation_replication_tpu.scoring import yes_no as yn
+
+        eng, _, _ = _tiny_engine(batch_size=8)
+        prompts = [f"prompt {i} about soup and tweets" for i in range(5)]
+        batch = next(batching.batches_for_prompts(
+            batching.encode_prompts(eng.tokenizer, prompts), 8,
+            eng.ecfg.buckets, pad_id=eng.tokenizer.pad_token_id or 0,
+        ))
+        yes_id, no_id = eng.target_ids(("Yes", "No"))[:2]
+        ids = jnp.asarray(batch.token_ids)
+        mask = jnp.asarray(batch.attention_mask)
+        row_y = jnp.full((8,), yes_id, jnp.int32)
+        row_n = jnp.full((8,), no_id, jnp.int32)
+        scan0, sel, sub, last_s, len_s = _prefill_select(
+            eng.params, eng.cfg, ids, mask,
+            jnp.asarray(batch.indices >= 0), row_y, row_n,
+            cache_len=batch.bucket_len, slice_m=8, top_k=eng.ecfg.top_k,
+        )
+        hit = np.asarray(scan0[4])
+        valid = batch.indices >= 0
+        undecided = set(np.flatnonzero(~hit & valid).tolist())
+        sel_np = np.asarray(sel)
+        count = len(undecided)
+        assert set(sel_np[:count].tolist()) == undecided
+        # padding rows (invalid) never appear before real decided rows run out
+        assert all(valid[r] for r in sel_np[:int(valid.sum())])
+        # slice caches equal a gather of the same rows from a full prefill
+        last_full, cache = dmod.prefill(
+            eng.params, eng.cfg, ids, mask, cache_len=batch.bucket_len)
+        np.testing.assert_allclose(
+            np.asarray(sub.k), np.asarray(cache.k[:, sel_np]), rtol=1e-6)
+        np.testing.assert_allclose(
+            np.asarray(last_s), np.asarray(last_full[sel_np]), rtol=1e-6)
+        # the selected rows' scan values equal the full-batch scan's
+        full_scan = yn.first_token_scan(last_full, yes_id, no_id,
+                                        top_k=eng.ecfg.top_k)
+        np.testing.assert_allclose(np.asarray(scan0[2]),
+                                   np.asarray(full_scan[2]), rtol=1e-6)
 
     def test_chunked_scan_matches_single_chunk(self):
         """scan_chunk must be invisible in the results: the early exit may
