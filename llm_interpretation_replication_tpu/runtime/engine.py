@@ -41,6 +41,16 @@ class EngineConfig:
                                     # never decoded — semantics unchanged, the
                                     # reference stops reading at the first hit)
     top_k: int = 5
+    first_token_top_filter: int = 20
+                                    # every scored row also carries
+                                    # first_token_{yes,no,relative}_prob:
+                                    # position-0 probabilities zeroed
+                                    # outside the top-N, the API
+                                    # extractor's top-20-logprobs view
+                                    # (perturb_prompts.py:480-498) — free
+                                    # at scoring time, and the perturbation
+                                    # sweep's binary leg reads them instead
+                                    # of paying a second full forward
     buckets: Sequence[int] = batching.DEFAULT_BUCKETS
     decode_completions: bool = True
     completion_chars: int = 100     # reference truncation (":379")
@@ -236,11 +246,15 @@ class ScoringEngine:
             row_ids = self._batch_target_rows(ids_all, batch)
             scan0 = yn.first_token_scan(
                 last, row_ids[:, 0], row_ids[:, 1], top_k=ecfg.top_k)
-            return last, cache, lengths, scan0
+            first3 = yn.relative_prob_first_token(
+                last, row_ids[:, 0], row_ids[:, 1],
+                ecfg.first_token_top_filter)
+            return last, cache, lengths, scan0, first3
 
         def consume(batch, out):
-            last, cache, lengths, scan0 = out
+            last, cache, lengths, scan0, first3 = out
             yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+            first3 = tuple(np.asarray(a) for a in first3)
             row_ids = self._batch_target_rows(ids_all, batch)
             valid = batch.indices >= 0
             undecided = np.flatnonzero(~hit0 & valid)
@@ -370,7 +384,8 @@ class ScoringEngine:
                 completion = ""
                 if ecfg.decode_completions:
                     completion = self._completion_text(tokens_np[r], eos_id)
-                row = _result_row(*vals, completion)
+                row = _attach_first_token(_result_row(*vals, completion),
+                                          first3, r)
                 if with_confidence:
                     k = r if sub_pos is None else sub_pos[r]
                     cands = top_candidates_from_scores(
@@ -418,12 +433,13 @@ class ScoringEngine:
                 jnp.asarray(batch.indices >= 0),
                 row_ids[:, 0], row_ids[:, 1],
                 cache_len=batch.bucket_len, slice_m=select_m,
-                top_k=ecfg.top_k,
+                top_k=ecfg.top_k, top_filter=ecfg.first_token_top_filter,
             )
 
         def consume(batch, out):
-            scan0, sel, sub_cache, last_s, len_s = out
+            scan0, first3, sel, sub_cache, last_s, len_s = out
             yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+            first3 = tuple(np.asarray(a) for a in first3)
             row_ids = self._batch_target_rows(ids_all, batch)
             valid = batch.indices >= 0
             undecided = np.flatnonzero(~hit0 & valid)
@@ -455,7 +471,8 @@ class ScoringEngine:
                         vals = (res_np["yes_prob"][r], res_np["no_prob"][r],
                                 res_np["relative_prob"][r],
                                 res_np["odds_ratio"][r], res_np["found"][r])
-                    results[int(orig)] = _result_row(*vals, "")
+                    results[int(orig)] = _attach_first_token(
+                        _result_row(*vals, ""), first3, r)
                 return
             if count:
                 # slice rows 0..count-1 ARE the undecided rows (the sort key
@@ -476,11 +493,13 @@ class ScoringEngine:
                 else:
                     mapped = sel_np[:select_m]
                 pool.add(batch.bucket_len, sub_cache, last_s, len_s, count,
-                         batch.indices[mapped[:count]], row_ids[mapped])
+                         batch.indices[mapped[:count]], row_ids[mapped],
+                         first3=np.stack([a[mapped] for a in first3], axis=1))
             for r, orig in enumerate(batch.indices):
                 if orig >= 0 and hit0[r]:
-                    results[int(orig)] = _result_row(
-                        yes0[r], no0[r], rel0[r], odds0[r], True, "")
+                    results[int(orig)] = _attach_first_token(_result_row(
+                        yes0[r], no0[r], rel0[r], odds0[r], True, ""),
+                        first3, r)
 
         self._run_pipelined(
             batching.batches_for_prompts(
@@ -564,12 +583,16 @@ class ScoringEngine:
                 max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
                 valid_steps=yn.steps_until_eos(tokens[:, :steps], eos_id),
             )
+            first3 = yn.relative_prob_first_token(
+                scores[:, 0, :], row_ids[:, 0], row_ids[:, 1],
+                ecfg.first_token_top_filter)
             # Only pin the [B, steps, V] scores buffer in the pending queue
             # when the confidence leg needs it — ~250 MB/batch at sweep sizes.
-            return tokens, scores if with_confidence else None, res
+            return tokens, scores if with_confidence else None, res, first3
 
         def consume(batch, out):
-            tokens, scores, res = out
+            tokens, scores, res, first3 = out
+            first3 = tuple(np.asarray(a) for a in first3)
             tokens_np = np.asarray(tokens)
             scores_np = np.asarray(scores) if with_confidence else None
             yes_np = np.asarray(res.yes_prob)
@@ -583,8 +606,10 @@ class ScoringEngine:
                 completion = ""
                 if ecfg.decode_completions:
                     completion = self._completion_text(tokens_np[r], eos_id)
-                row = _result_row(yes_np[r], no_np[r], rel_np[r],
-                                  odds_np[r], found_np[r], completion)
+                row = _attach_first_token(
+                    _result_row(yes_np[r], no_np[r], rel_np[r],
+                                odds_np[r], found_np[r], completion),
+                    first3, r)
                 if with_confidence:
                     cands = top_candidates_from_scores(
                         scores_np[r], self.tokenizer, num_positions=3, top_k=19
@@ -691,7 +716,7 @@ class _Phase2Pool:
         return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
 
     def add(self, bucket_len, sub_cache, last_s, len_s, n_real, orig_idx,
-            row_ids):
+            row_ids, first3):
         """Queue one batch's gathered undecided slice (rows past ``n_real``
         are gather padding).  ``orig_idx``: original prompt index per real
         row; ``row_ids``: [m, 2] per-row (yes, no) target ids — rows from
@@ -704,7 +729,7 @@ class _Phase2Pool:
             self.flush(max(self.bytes, key=self.bytes.get))
         self.entries.setdefault(bucket_len, []).append(
             (sub_cache, last_s, len_s, int(n_real), np.asarray(orig_idx),
-             np.asarray(row_ids, np.int32))
+             np.asarray(row_ids, np.int32), np.asarray(first3))
         )
         self.counts[bucket_len] = self.counts.get(bucket_len, 0) + int(
             last_s.shape[0]
@@ -733,7 +758,7 @@ class _Phase2Pool:
         last = jnp.zeros((rows, last_t.shape[1]), last_t.dtype)
         lens = jnp.ones((rows,), len_t.dtype)
         return (cache, last, lens, 0, np.empty((0,), np.int64),
-                np.zeros((rows, 2), np.int32))
+                np.zeros((rows, 2), np.int32), np.full((rows, 3), np.nan))
 
     def flush(self, bucket_len):
         entries = self.entries.pop(bucket_len, [])
@@ -758,12 +783,13 @@ class _Phase2Pool:
             last = jnp.concatenate([e[1] for e in entries], axis=0)
             lens = jnp.concatenate([e[2] for e in entries], axis=0)
         mask_parts = []
-        for _, last_e, _, n_real, _, _ in entries:
+        for _, last_e, _, n_real, _, _, _ in entries:
             part = np.zeros((last_e.shape[0],), bool)
             part[:n_real] = True
             mask_parts.append(part)
         mask = np.concatenate(mask_parts)
         ids = np.concatenate([e[5] for e in entries], axis=0)   # [m, 2]
+        first3 = np.concatenate([e[6] for e in entries], axis=0)  # [m, 3]
         ecfg = self.engine.ecfg
         sc, toks = self.engine._scan_decode_chunked(
             cache, last, lens, self.steps, self.eos_id,
@@ -776,21 +802,23 @@ class _Phase2Pool:
         )
         res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
         row = 0
-        for _, last_e, _, n_real, orig, _ in entries:
+        for _, last_e, _, n_real, orig, _, _ in entries:
             for j in range(n_real):
                 g = row + j
-                self.results[int(orig[j])] = _result_row(
+                self.results[int(orig[j])] = _attach_first_token(_result_row(
                     res_np["yes_prob"][g], res_np["no_prob"][g],
                     res_np["relative_prob"][g], res_np["odds_ratio"][g],
                     res_np["found"][g], "",
-                )
+                ), (first3[:, 0], first3[:, 1], first3[:, 2]), g)
             row += last_e.shape[0]
 
 
 @functools.partial(
-    jax.jit, static_argnames=("cfg", "cache_len", "slice_m", "top_k"))
+    jax.jit,
+    static_argnames=("cfg", "cache_len", "slice_m", "top_k", "top_filter"))
 def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
-                    cache_len: int, slice_m: int, top_k: int):
+                    cache_len: int, slice_m: int, top_k: int,
+                    top_filter: int = 20):
     """Prefill + position-0 scan + IN-PROGRAM phase-2 row selection.
 
     Selecting the undecided rows INSIDE the program — undecided-first
@@ -805,10 +833,10 @@ def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
     larger sweep batches.  ``valid_rows`` masks batch padding rows
     (treated as decided, sorted last).
 
-    Returns (scan0, sel [slice_m] original batch row per slice row,
-    sub_cache, last_sel, len_sel).  Callers must fall back to
-    :func:`models.decoder.prefill` when more than ``slice_m`` rows are
-    undecided."""
+    Returns (scan0, first3 [top-filtered position-0 (yes, no, relative)],
+    sel [slice_m] original batch row per slice row, sub_cache, last_sel,
+    len_sel).  Callers must fall back to :func:`models.decoder.prefill`
+    when more than ``slice_m`` rows are undecided."""
     last, cache = dmod.prefill(params, cfg, ids, mask, cache_len=cache_len)
     lengths = jnp.sum(mask, axis=-1)
     scan0 = yn.first_token_scan(last, yes_ids, no_ids, top_k=top_k)
@@ -819,10 +847,11 @@ def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
         positions=cache.positions[sel], valid=cache.valid[sel],
         length=cache.length,
     )
+    first3 = yn.relative_prob_first_token(last, yes_ids, no_ids, top_filter)
     # Deliberately NOT returning the full-batch `last`/`lengths`: the
     # pooled consumer never reads them, and at batch 256 the [B, V] logits
     # alone would pin ~66 MB of dead output per in-flight pipelined batch.
-    return scan0, sel, sub, last[sel], lengths[sel]
+    return scan0, first3, sel, sub, last[sel], lengths[sel]
 
 
 @jax.jit
@@ -837,6 +866,17 @@ def _gather_rows(cache, last, lengths, idx):
         length=cache.length,
     )
     return sub, last[idx], lengths[idx]
+
+
+def _attach_first_token(row: Dict, first3, i: int) -> Dict:
+    """Attach the top-filtered position-0 probabilities (the API
+    extractor's top-20-logprobs view, perturb_prompts.py:480-498) that
+    every scoring pass computes for free from its prefill logits —
+    ``first3`` is a (yes, no, relative) triple of [B] arrays."""
+    row["first_token_yes_prob"] = float(first3[0][i])
+    row["first_token_no_prob"] = float(first3[1][i])
+    row["first_token_relative_prob"] = float(first3[2][i])
+    return row
 
 
 def _result_row(yes, no, rel, odds, found, completion: str) -> Dict:

@@ -102,10 +102,25 @@ def run_model_perturbation_sweep(
         chunk = todo_items[start:start + score_chunk]
         targets = [list(s["target_tokens"]) for s, _ in chunk]
         binary_prompts = [f"{r} {s['response_format']}" for s, r in chunk]
-        probs = engine.first_token_relative_prob(
-            binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
-        )
         responses = engine.score_prompts(binary_prompts, targets=targets)
+        ecfg = getattr(engine, "ecfg", None)
+        if (ecfg is not None
+                and getattr(ecfg, "first_token_top_filter", None) == TOP_LOGPROBS
+                and responses
+                and all("first_token_yes_prob" in row for row in responses)):
+            # the scoring pass already computed the top-20-filtered
+            # position-0 probabilities from its own prefill logits — no
+            # second full forward for the binary leg.  Guarded on the
+            # engine's filter matching the API extractor's top-20 contract
+            # and on EVERY row carrying the fields (error rows don't).
+            probs = np.asarray([
+                [row["first_token_yes_prob"], row["first_token_no_prob"],
+                 row["first_token_relative_prob"]] for row in responses
+            ])
+        else:   # foreign/fake engines, custom filters, or error rows
+            probs = engine.first_token_relative_prob(
+                binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
+            )
 
         conf_values: List[Optional[int]] = [None] * len(chunk)
         conf_texts = [""] * len(chunk)

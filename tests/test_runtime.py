@@ -374,6 +374,29 @@ class TestScoringEngine:
         with pytest.raises(ValueError, match="per-prompt targets"):
             eng.score_prompts(mixed, targets=pairs[:-1])
 
+    def test_rows_carry_fused_first_token_probs(self):
+        """Every score_prompts row carries first_token_{yes,no,relative}_prob
+        — the top-20-filtered position-0 view the perturbation sweep's
+        binary leg previously paid a second full forward for — and the
+        values equal first_token_relative_prob's, on the completions path
+        AND the pooled two-phase path (incl. flush-emitted rows)."""
+        import dataclasses as dc
+
+        eng, _, _ = _tiny_engine(batch_size=16)
+        prompts = [f"prompt {i} about soup, tweets and vehicles" for i in range(20)]
+        fast = eng.first_token_relative_prob(prompts, top_filter=20)
+        for pooled in (False, True):
+            eng.ecfg = dc.replace(eng.ecfg, decode_completions=not pooled,
+                                  phase2_pool_target=16)
+            rows = eng.score_prompts(prompts)
+            for i, row in enumerate(rows):
+                np.testing.assert_allclose(
+                    row["first_token_yes_prob"], fast[i, 0], rtol=1e-6)
+                np.testing.assert_allclose(
+                    row["first_token_no_prob"], fast[i, 1], rtol=1e-6)
+                np.testing.assert_allclose(
+                    row["first_token_relative_prob"], fast[i, 2], rtol=1e-6)
+
     def test_prefill_select_slice_contract(self):
         """_prefill_select's contract: slice rows 0..count-1 are EXACTLY the
         undecided real rows (set equality — order is the sort's business),
@@ -399,7 +422,7 @@ class TestScoringEngine:
         mask = jnp.asarray(batch.attention_mask)
         row_y = jnp.full((8,), yes_id, jnp.int32)
         row_n = jnp.full((8,), no_id, jnp.int32)
-        scan0, sel, sub, last_s, len_s = _prefill_select(
+        scan0, first3, sel, sub, last_s, len_s = _prefill_select(
             eng.params, eng.cfg, ids, mask,
             jnp.asarray(batch.indices >= 0), row_y, row_n,
             cache_len=batch.bucket_len, slice_m=8, top_k=eng.ecfg.top_k,
