@@ -639,7 +639,7 @@ def main():
         no_arr = jnp.full((batch,), no_id, jnp.int32)
 
         def score_prefill(params, ids, mask):
-            scan0, _, sub_cache, last_s, len_s = _prefill_select(
+            scan0, _first3, _sel, sub_cache, last_s, len_s = _prefill_select(
                 params, cfg, ids, mask, valid_rows, yes_arr, no_arr,
                 cache_len=ids.shape[1], slice_m=sel_m, top_k=5,
             )
