@@ -396,10 +396,11 @@ def run_sweep_mode(args, cfg, params):
     all_prompts = [p for ps in prompts_by_scenario for p in ps]
     all_targets = [list(s["target_tokens"]) for s, _ in items]
     best_dt = float("inf")
-    for _ in range(max(1, args.sweep_repeats)):
+    for rep in range(max(1, args.sweep_repeats)):
         all_rows, pending = [], []
         t0 = timemod.perf_counter()
         rows = engine.score_prompts(all_prompts, targets=all_targets)
+        t_score = timemod.perf_counter() - t0
         for (scenario, reph), row in zip(items, rows):
             pending.append(perturbation_row(
                 args.model, scenario, reph,
@@ -414,7 +415,14 @@ def run_sweep_mode(args, cfg, params):
             if len(pending) >= args.checkpoint_every:
                 flush()
         flush()
-        best_dt = min(best_dt, timemod.perf_counter() - t0)
+        dt = timemod.perf_counter() - t0
+        # e2e-vs-steady-state gap decomposition, measured per repeat: the
+        # scoring call (device + overlapped host consume, incl. tokenize)
+        # vs the serial row-building + workbook-rewrite tail
+        print(f"# sweep repeat {rep}: total {dt:.1f}s = scoring "
+              f"{t_score:.1f}s + rows/writes {dt - t_score:.1f}s",
+              file=sys.stderr)
+        best_dt = min(best_dt, dt)
     assert len(all_rows) == n_total, (len(all_rows), n_total)
     return n_total / best_dt, measured_rate, out_path
 
