@@ -62,8 +62,9 @@ floor for top-5 membership — data/instruct_model_comparison_results_combined
 .csv), and the prompts instruct a Yes/No answer, so top-5 decisiveness is
 higher still.
 
-History: e2e sweep 92.2 r04 final (87.7 before the 96/112/144 hot-zone
-buckets; 68.2 with per-scenario calls).  Steady state at the 430-token
+History: e2e sweep 93.2 r04 final at pipeline depth 4 (91.5-92.2 at
+depth 2, 67.6 at depth 1 — the async-dispatch overlap measured; 87.7
+before the 96/112/144 hot-zone buckets; 68.2 with per-scenario calls).  Steady state at the 430-token
 operating point: single forward 38.1-38.2 r01-r04; parity 36.8-36.9 r04
 pooled+selected (36.07 r03 per-batch 32-row slice; the measured ceiling
 for any cache-carrying two-phase design is 37.3 — the layer scan's K/V
@@ -364,6 +365,7 @@ def run_sweep_mode(args, cfg, params):
         engine_config=EngineConfig(
             batch_size=args.sweep_batch, decode_completions=False,
             phase2_pool_target=args.pool_target,
+            pipeline_depth=args.pipeline_depth,
         ),
     )
     lens = [len(ids) for ids in tok([p for ps in prompts_by_scenario for p in ps])["input_ids"]]
@@ -508,6 +510,17 @@ def main():
                         help="sweep mode: phase-2 cross-batch pool size "
                              "(0 = engine default, one pooled decode per "
                              "batch-size undecided rows)")
+    parser.add_argument("--pipeline-depth", type=int, default=4, metavar="N",
+                        help="sweep mode: in-flight device batches (host "
+                             "post-processing of batch k overlaps device "
+                             "compute of batch k+1).  Measured warm 10k "
+                             "sweeps (v5e 2026-07): depth 1 = 67.6 p/s, "
+                             "2 = 91.5, 4 = 93.2 — the pooled+selected "
+                             "path holds only small cache slices per "
+                             "in-flight batch so 4 is safe here; the "
+                             "ENGINE default stays 2 because the "
+                             "completions path pins a full KV cache per "
+                             "in-flight batch")
     parser.add_argument("--checkpoint-every", type=int, default=2000,
                         metavar="N",
                         help="sweep mode: rewrite the output workbook every "

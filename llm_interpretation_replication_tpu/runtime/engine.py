@@ -56,7 +56,15 @@ class EngineConfig:
     completion_chars: int = 100     # reference truncation (":379")
     pipeline_depth: int = 2         # in-flight device batches; host post-
                                     # processing of batch k overlaps device
-                                    # compute of batch k+1 (JAX async dispatch)
+                                    # compute of batch k+1 (JAX async
+                                    # dispatch).  Measured on the warm 10k
+                                    # sweep (v5e): 1 = 67.6 p/s, 2 = 91.5,
+                                    # 4 = 93.2.  Default stays 2 because the
+                                    # completions path pins one FULL KV
+                                    # cache per in-flight batch (~1.4 GB at
+                                    # 192x432); the pooled+selected path
+                                    # holds only small slices, so sweeps
+                                    # without completions can raise it
     phase2_pool: bool = True        # pool undecided rows across prefill
                                     # batches and run ONE scored decode per
                                     # ~pool_target rows (decode is weight-
