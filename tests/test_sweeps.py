@@ -234,3 +234,54 @@ class TestPerturbationSweep:
         # no duplicated rows after resume
         keys = df["Rephrased Main Part"].tolist()
         assert len(keys) == len(set(keys))
+
+
+class TestPerturbationSweepRealEngine:
+    def test_end_to_end_with_real_engine_and_mixed_targets(self, tmp_path):
+        """The full local sweep against a REAL tiny ScoringEngine: two
+        scenarios with different (and swapped) target pairs score in one
+        cross-scenario pass, the binary leg's Token_i_Prob comes from the
+        FUSED first-token fields (verified equal to a standalone
+        first_token_relative_prob call), and the confidence leg fills
+        Confidence Value / Weighted Confidence from real decodes."""
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine(batch_size=8)
+        scenarios = [
+            {
+                "original_main": "Scenario one text.",
+                "response_format": "Answer only 'Yes' or 'No'.",
+                "target_tokens": ["Yes", "No"],
+                "confidence_format": "How confident, 0-100?",
+                "rephrasings": [f"Is thing {i} a stuff?" for i in range(3)],
+            },
+            {
+                "original_main": "Scenario two text.",
+                "response_format": "Answer only 'No' or 'Yes'.",
+                "target_tokens": ["No", "Yes"],
+                "confidence_format": "Confidence from 0 to 100?",
+                "rephrasings": [f"Does item {i} count?" for i in range(3)],
+            },
+        ]
+        out = str(tmp_path / "results.xlsx")
+        df = run_model_perturbation_sweep(
+            eng, "tiny/real-engine", scenarios, out, checkpoint_every=2,
+        )
+        assert list(df.columns) == PERTURBATION_COLUMNS
+        assert len(df) == 6
+        # fused binary leg == the standalone fast path, per scenario targets
+        for scenario in scenarios:
+            prompts = [f"{r} {scenario['response_format']}"
+                       for r in scenario["rephrasings"]]
+            fast = eng.first_token_relative_prob(
+                prompts, targets=list(scenario["target_tokens"]),
+                top_filter=20)
+            sub = df[df["Original Main Part"] == scenario["original_main"]]
+            np.testing.assert_allclose(
+                sub["Token_1_Prob"].to_numpy(dtype=float), fast[:, 0],
+                rtol=1e-6)
+            np.testing.assert_allclose(
+                sub["Token_2_Prob"].to_numpy(dtype=float), fast[:, 1],
+                rtol=1e-6)
+        # confidence leg ran real decodes
+        assert (df["Model Confidence Response"].astype(str).str.len() > 0).any()
