@@ -194,6 +194,7 @@ def cmd_run_perturbation(args):
         engine, args.model, scenarios,
         output_xlsx=os.path.join(rc.output_dir, "perturbation_results.xlsx"),
         max_rephrasings=args.max_rephrasings,
+        score_chunk=args.score_chunk,
     )
     print(f"{len(df)} rows")
 
@@ -994,6 +995,10 @@ def main(argv=None):
     p.add_argument("--model", required=True)
     p.add_argument("--perturbations", required=True)
     p.add_argument("--max-rephrasings", type=int, default=None)
+    p.add_argument("--score-chunk", type=int, default=2000,
+                   help="rows per cross-scenario scoring call: bounds crash "
+                        "loss (a crash loses the in-flight chunk); raise on "
+                        "reliable hardware to merge more tail batches")
     p.set_defaults(fn=cmd_run_perturbation)
 
     p = sub.add_parser("run-api-perturbation",
