@@ -70,7 +70,7 @@ pooled+selected (36.07 r03 per-batch 32-row slice; the measured ceiling
 for any cache-carrying two-phase design is 37.3 — the layer scan's K/V
 stacking, see PARITY.md); decode-all 35.8-35.9; 31.5 int8 / 16.5 bf16 at
 the old batch-128/512 config.  Batch 224+ OOMs 16 GB HBM at seq 432;
-sweep batch 384 OOMs at the 256-token bucket.  NEVER run the e2e sweep
+sweep batches 320+ OOM (the pooled-decode score buffer scales with batch).  NEVER run the e2e sweep
 beside other CPU-heavy processes: a concurrent pytest run measured 24 p/s
 on identical code (the steady-state modes are device-bound and immune).
 
@@ -497,8 +497,9 @@ def main():
                         help="sweep mode engine batch size (real prompts "
                              "are ~107 tokens so a larger batch than the "
                              "430-token parity mode fits; measured 2026-07: "
-                             "256 runs, 384 OOMs at the 256-token worst "
-                             "bucket)")
+                             "256 runs, 320 and 384 both OOM — the pooled "
+                             "decode's [batch, 10, V] fp32 score buffer "
+                             "scales with batch)")
     parser.add_argument("--sweep-rows", type=int, default=0, metavar="N",
                         help="sweep mode: cap total rows (0 = full 10k)")
     parser.add_argument("--sweep-repeats", type=int, default=2, metavar="N",
