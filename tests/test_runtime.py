@@ -301,6 +301,14 @@ class TestScoringEngine:
         )
         plan_s = resolve_scoring_plan(small, "none", 192, 432)
         assert plan_s.fits_dense and plan_s.attention_impl == "xla"
+        # explicit flash request keeps a batch that fits (no pow2 clamp)
+        plan_f = resolve_scoring_plan(small, "int8", 192, 432,
+                                      requested_impl="flash")
+        assert plan_f.attention_impl == "flash" and plan_f.batch == 192
+        # a chip too small for even the weights clamps to the floor batch
+        plan_t = resolve_scoring_plan(falcon7b, "none", 192, 432,
+                                      hbm_bytes=8 << 30)
+        assert not plan_t.fits_dense and plan_t.batch == 1
 
     def test_phase2_pool_matches_per_batch_decode(self):
         """Cross-batch pooling of undecided rows (one scored decode per
