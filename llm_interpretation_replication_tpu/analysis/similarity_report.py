@@ -33,8 +33,16 @@ def load_embedding_model(name: str = "all-MiniLM-L6-v2", log=print):
     prev_timeout = socket.getdefaulttimeout()
     try:
         # Zero-egress environments HANG on the hub download rather than
-        # erroring; a socket timeout turns that into the reference's
-        # warn-and-continue path within seconds instead of minutes.
+        # erroring (even under huggingface_hub's own 10 s request
+        # timeouts, which cover the HTTP layer but not every socket the
+        # load opens); a socket-level timeout turns that into the
+        # reference's warn-and-continue path within seconds instead of
+        # minutes.  setdefaulttimeout is PROCESS-GLOBAL: for the duration
+        # of this load, sockets opened by other threads inherit the 10 s
+        # timeout too.  Every caller of this loader (the `similarity
+        # --embeddings` CLI leg and similarity_report) is single-threaded,
+        # so nothing else opens sockets while it runs; the previous value
+        # is restored on exit either way.
         socket.setdefaulttimeout(10.0)
         log(f"Loading embedding model: {name}")
         return SentenceTransformer(name)

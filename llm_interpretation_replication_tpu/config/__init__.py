@@ -16,16 +16,20 @@ from typing import List, Optional, Sequence
 
 # Single source of truth for the sweep's length buckets; runtime/batching
 # re-exports it.  Lives here (stdlib-only module) so importing config never
-# pulls in the jax-heavy runtime package.  Fine-grained (step 16) in the
-# Two hot zones: 96-144 covers the 10k-perturbation corpus (real rephrasing
-# prompts tokenize to 60-203, mean ~107 — the finer 96/112/144 steps cut
-# padded tokens 12% vs a lone 128 bucket at that histogram), and 400-448
-# covers the 100q few-shot shape (~430 tokens pads to 432 — measured +1.2%
-# over the 448 bucket and +13% over 512 on v5e; see runtime/batching.py).
-# Every bucket is a multiple of 16 so VPU/MXU sublane tiling stays aligned;
-# near-empty buckets merge upward at batch time (batches_for_prompts
-# min_bucket_rows) so a stray length never costs a compile.
-DEFAULT_BUCKETS = (64, 96, 112, 128, 144, 192, 256, 320, 384, 416, 432, 448,
+# pulls in the jax-heavy runtime package.  Two hot zones, each step 16:
+# 64-256 covers the 10k-perturbation corpus (real rephrasing prompts
+# tokenize to 60-203, mean ~107 — on that histogram the full step-16 menu
+# with length-sorted batch formation pads x1.13 vs x1.23 for the coarser
+# r04 menu, ~8% of all device FLOPs), and 400-448 covers the 100q few-shot
+# shape (~430 tokens pads to 432 — measured +1.2% over the 448 bucket and
+# +13% over 512 on v5e; see runtime/batching.py).  Every bucket is a
+# multiple of 16 so VPU/MXU sublane tiling stays aligned; with grouped
+# batching, near-empty buckets merge upward at batch time
+# (batches_for_prompts min_bucket_rows) so a stray length never costs a
+# compile; with length-sorted batching a bucket is only compiled when a
+# whole batch's quantized max lands on it.
+DEFAULT_BUCKETS = (64, 80, 96, 112, 128, 144, 160, 176, 192, 208, 224, 240,
+                   256, 320, 384, 416, 432, 448,
                    512, 640, 768, 1024, 1536, 2048)
 
 _ASSETS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data_assets")

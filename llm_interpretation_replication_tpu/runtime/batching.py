@@ -38,6 +38,21 @@ def bucket_for(length: int, buckets: Sequence[int] = DEFAULT_BUCKETS) -> int:
     raise ValueError(f"prompt length {length} exceeds the largest bucket {buckets[-1]}")
 
 
+def _emit_batch(chunk, batch_size: int, bucket_len: int, pad_id: int) -> Batch:
+    token_ids = np.full((batch_size, bucket_len), pad_id, np.int32)
+    mask = np.zeros((batch_size, bucket_len), np.int32)
+    indices = np.full((batch_size,), -1, np.int64)
+    for r, (idx, ids) in enumerate(chunk):
+        token_ids[r, : len(ids)] = ids
+        mask[r, : len(ids)] = 1
+        indices[r] = idx
+    # fill pad rows with the first row so the model sees valid tokens
+    for r in range(len(chunk), batch_size):
+        token_ids[r] = token_ids[0]
+        mask[r] = mask[0]
+    return Batch(token_ids, mask, indices, bucket_len)
+
+
 def batches_for_prompts(
     encoded: Sequence[Sequence[int]],
     batch_size: int,
@@ -45,19 +60,41 @@ def batches_for_prompts(
     pad_id: int = 0,
     keep_order_within_bucket: bool = True,
     min_bucket_rows: Optional[int] = None,
+    length_sorted: bool = False,
 ) -> Iterator[Batch]:
-    """Group encoded prompts by bucket, emit fixed-shape padded batches.
+    """Emit fixed-shape padded batches for a ragged prompt list.
 
     Short final batches are padded with duplicate rows (index -1) so the
     compiled program shape never varies with sweep size.
 
-    Buckets holding fewer than ``min_bucket_rows`` prompts (default
-    batch_size // 8) merge UPWARD into the next occupied larger bucket: a
-    handful of stray lengths is never worth a fresh XLA compile (~1.5-4 min
-    per program on a remote-compile chip) when padding them into the
-    neighboring shape costs microseconds.  The largest occupied bucket
-    never merges (there is nowhere to go).
+    Two batch-formation strategies:
+
+    ``length_sorted=True`` (the engine default): ALL prompts sort by token
+    length and consecutive runs of ``batch_size`` form each batch, padded to
+    the bucket of the batch's own longest prompt.  Each prompt then pays
+    only the quantization gap to the next menu entry above its batch's max
+    — on the real 10k-perturbation corpus (60-203 tokens) this pads x1.13
+    vs x1.23 for bucket-grouping with the same menu — and exactly ONE
+    partial batch exists per sweep instead of one per occupied bucket.
+    Results are keyed by ``indices`` so emission order never affects
+    callers' output order.
+
+    ``length_sorted=False``: prompts group by their own bucket and batches
+    form within each bucket (preserving input order unless
+    ``keep_order_within_bucket=False``).  Buckets holding fewer than
+    ``min_bucket_rows`` prompts (default batch_size // 8) merge UPWARD into
+    the next occupied larger bucket: a handful of stray lengths is never
+    worth a fresh XLA compile (~1.5-4 min per program on a remote-compile
+    chip) when padding them into the neighboring shape costs microseconds.
+    The largest occupied bucket never merges (there is nowhere to go).
     """
+    if length_sorted:
+        order = sorted(enumerate(encoded), key=lambda it: len(it[1]))
+        for start in range(0, len(order), batch_size):
+            chunk = [(idx, list(ids)) for idx, ids in order[start : start + batch_size]]
+            bucket_len = bucket_for(len(chunk[-1][1]), buckets)
+            yield _emit_batch(chunk, batch_size, bucket_len, pad_id)
+        return
     if min_bucket_rows is None:
         min_bucket_rows = max(1, batch_size // 8)
     by_bucket: dict = {}
@@ -75,20 +112,8 @@ def batches_for_prompts(
         if not keep_order_within_bucket:
             items.sort(key=lambda it: len(it[1]))
         for start in range(0, len(items), batch_size):
-            chunk = items[start : start + batch_size]
-            rows = len(chunk)
-            token_ids = np.full((batch_size, bucket_len), pad_id, np.int32)
-            mask = np.zeros((batch_size, bucket_len), np.int32)
-            indices = np.full((batch_size,), -1, np.int64)
-            for r, (idx, ids) in enumerate(chunk):
-                token_ids[r, : len(ids)] = ids
-                mask[r, : len(ids)] = 1
-                indices[r] = idx
-            # fill pad rows with the first row so the model sees valid tokens
-            for r in range(rows, batch_size):
-                token_ids[r] = token_ids[0]
-                mask[r] = mask[0]
-            yield Batch(token_ids, mask, indices, bucket_len)
+            yield _emit_batch(items[start : start + batch_size], batch_size,
+                              bucket_len, pad_id)
 
 
 def encode_prompts(tokenizer, prompts: Sequence[str], add_special_tokens: bool = True) -> List[List[int]]:

@@ -23,8 +23,22 @@ import jax.numpy as jnp
 from ..models import decoder as dmod
 from ..models import t5 as t5mod
 from ..scoring import yes_no as yn
-from ..scoring.confidence import top_candidates_from_scores, weighted_confidence_digits
+from ..scoring.confidence import weighted_confidence_digits
 from . import batching
+
+
+@functools.partial(jax.jit, static_argnames=("num_positions", "k"))
+def _confidence_topk(scores, num_positions: int = 3, k: int = 19):
+    """Device-side replacement for fetching the full [m, steps, V] score
+    tensor just to read 3x19 candidates per row
+    (scoring/confidence.top_candidates_from_scores): top-k + logsumexp run
+    on device and the host fetches [m, P, k] logprobs + token ids — ~3000x
+    less host traffic than the fp32 scores (a measured 200-330 MB per
+    batch at sweep shapes over the tunneled chip)."""
+    sub = scores[:, :num_positions, :].astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(sub, axis=-1)        # [m, P]
+    vals, idx = jax.lax.top_k(sub, k)                       # [m, P, k]
+    return vals - logz[..., None], idx
 
 
 @dataclasses.dataclass
@@ -52,6 +66,17 @@ class EngineConfig:
                                     # sweep's binary leg reads them instead
                                     # of paying a second full forward
     buckets: Sequence[int] = batching.DEFAULT_BUCKETS
+    length_sorted_batches: bool = True
+                                    # form batches from globally length-
+                                    # sorted prompts so each batch pads to
+                                    # ITS OWN longest prompt's bucket
+                                    # (x1.13 padded tokens on the real
+                                    # perturbation corpus vs x1.23 for
+                                    # bucket-grouping) and only one partial
+                                    # batch exists per sweep.  Output order
+                                    # is unaffected (results key on prompt
+                                    # indices).  Off = group by bucket in
+                                    # input order (runtime/batching.py)
     decode_completions: bool = True
     completion_chars: int = 100     # reference truncation (":379")
     pipeline_depth: int = 2         # in-flight device batches; host post-
@@ -229,6 +254,26 @@ class ScoringEngine:
             : self.ecfg.completion_chars
         ]
 
+    def _candidates_from_topk(self, lp_row, idx_row):
+        """API-style (token text, logprob) candidate lists from one row's
+        device top-k ([P, k] logprobs + token ids, _confidence_topk) — the
+        inputs weighted_confidence_digits expects.  Token texts memoize in
+        an id->text cache: a sweep re-decodes the same few thousand ids."""
+        cache = getattr(self, "_tok_text_cache", None)
+        if cache is None:
+            cache = self._tok_text_cache = {}
+        positions = []
+        for p in range(lp_row.shape[0]):
+            cands = []
+            for lp, i in zip(lp_row[p], idx_row[p]):
+                i = int(i)
+                text = cache.get(i)
+                if text is None:
+                    text = cache[i] = self.tokenizer.decode([i])
+                cands.append((text, float(lp)))
+            positions.append(cands)
+        return positions
+
     def _score_decoder(self, prompts, targets, with_confidence) -> List[Dict]:
         ecfg = self.ecfg
         ids_all = self._target_id_rows(prompts, targets)   # [N, 2]
@@ -271,7 +316,8 @@ class ScoringEngine:
             need_scores = undecided.size > 0
 
             tokens_np = None      # [B, n_generated] when completions decoded
-            scores_np = None      # [B|m, steps, V] fp32 when confidence needs it
+            conf_lp = conf_idx = None  # [B|m, P, 19] device top-k when
+                                       # the confidence leg needs it
             res_np = None         # scan over positions 0..steps-1
             sub_pos = None        # batch row -> row in the subset arrays
 
@@ -338,7 +384,8 @@ class ScoringEngine:
                     )
                     res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
                     if with_confidence:
-                        scores_np = np.asarray(scores_dev)
+                        conf_lp, conf_idx = (np.asarray(a) for a in
+                                             _confidence_topk(scores_dev))
             elif need_scores:
                 # No completions wanted: scored decode only, and only for the
                 # undecided rows — gathered out of the prefill cache so the
@@ -375,7 +422,8 @@ class ScoringEngine:
                 )
                 res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
                 if with_confidence:
-                    scores_np = np.asarray(sc)
+                    conf_lp, conf_idx = (np.asarray(a) for a in
+                                         _confidence_topk(sc))
 
             for r, orig in enumerate(batch.indices):
                 if orig < 0:
@@ -396,9 +444,7 @@ class ScoringEngine:
                                           first3, r)
                 if with_confidence:
                     k = r if sub_pos is None else sub_pos[r]
-                    cands = top_candidates_from_scores(
-                        scores_np[k], self.tokenizer, num_positions=3, top_k=19
-                    )
+                    cands = self._candidates_from_topk(conf_lp[k], conf_idx[k])
                     row["weighted_confidence"] = weighted_confidence_digits(cands)
                 results[int(orig)] = row
 
@@ -406,6 +452,7 @@ class ScoringEngine:
             batching.batches_for_prompts(
                 encoded, ecfg.batch_size, ecfg.buckets,
                 pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=ecfg.length_sorted_batches,
             ),
             launch, consume,
         )
@@ -442,6 +489,7 @@ class ScoringEngine:
                 row_ids[:, 0], row_ids[:, 1],
                 cache_len=batch.bucket_len, slice_m=select_m,
                 top_k=ecfg.top_k, top_filter=ecfg.first_token_top_filter,
+                out_len=_pool_len(batch.bucket_len),
             )
 
         def consume(batch, out):
@@ -500,7 +548,8 @@ class ScoringEngine:
                     mapped = sel_np[idx]
                 else:
                     mapped = sel_np[:select_m]
-                pool.add(batch.bucket_len, sub_cache, last_s, len_s, count,
+                pool.add(_pool_len(batch.bucket_len), sub_cache, last_s,
+                         len_s, count,
                          batch.indices[mapped[:count]], row_ids[mapped],
                          first3=np.stack([a[mapped] for a in first3], axis=1))
             for r, orig in enumerate(batch.indices):
@@ -513,6 +562,7 @@ class ScoringEngine:
             batching.batches_for_prompts(
                 encoded, ecfg.batch_size, ecfg.buckets,
                 pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=ecfg.length_sorted_batches,
             ),
             launch, consume,
         )
@@ -594,15 +644,18 @@ class ScoringEngine:
             first3 = yn.relative_prob_first_token(
                 scores[:, 0, :], row_ids[:, 0], row_ids[:, 1],
                 ecfg.first_token_top_filter)
-            # Only pin the [B, steps, V] scores buffer in the pending queue
-            # when the confidence leg needs it — ~250 MB/batch at sweep sizes.
-            return tokens, scores if with_confidence else None, res, first3
+            # The confidence leg needs only 3x19 candidates per row: reduce
+            # on device (_confidence_topk) instead of pinning + fetching the
+            # [B, steps, V] scores buffer (~250 MB/batch at sweep sizes).
+            conf = _confidence_topk(scores) if with_confidence else None
+            return tokens, conf, res, first3
 
         def consume(batch, out):
-            tokens, scores, res, first3 = out
+            tokens, conf, res, first3 = out
             first3 = tuple(np.asarray(a) for a in first3)
             tokens_np = np.asarray(tokens)
-            scores_np = np.asarray(scores) if with_confidence else None
+            if with_confidence:
+                conf_lp, conf_idx = (np.asarray(a) for a in conf)
             yes_np = np.asarray(res.yes_prob)
             no_np = np.asarray(res.no_prob)
             rel_np = np.asarray(res.relative_prob)
@@ -619,9 +672,7 @@ class ScoringEngine:
                                 odds_np[r], found_np[r], completion),
                     first3, r)
                 if with_confidence:
-                    cands = top_candidates_from_scores(
-                        scores_np[r], self.tokenizer, num_positions=3, top_k=19
-                    )
+                    cands = self._candidates_from_topk(conf_lp[r], conf_idx[r])
                     row["weighted_confidence"] = weighted_confidence_digits(cands)
                 results[int(orig)] = row
 
@@ -629,6 +680,7 @@ class ScoringEngine:
             batching.batches_for_prompts(
                 encoded, ecfg.batch_size, ecfg.buckets,
                 pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=ecfg.length_sorted_batches,
             ),
             launch, consume,
         )
@@ -667,6 +719,7 @@ class ScoringEngine:
             batching.batches_for_prompts(
                 encoded, self.ecfg.batch_size, self.ecfg.buckets,
                 pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=self.ecfg.length_sorted_batches,
             ),
             launch, consume,
         )
@@ -689,6 +742,25 @@ def _pad_slice(n: int, cap: int) -> int:
         if m >= n:
             return min(m, cap)
     return cap
+
+
+#: Quantized cache lengths for the phase-2 pool: every prefill's undecided
+#: slice is padded (inert invalid slots) up to the menu entry covering its
+#: bucket, so slices from DIFFERENT length buckets pool and decode together.
+#: Without this the pool fragments per bucket — the step-16 length-sorted
+#: menu touches ~9 buckets on the real perturbation corpus, each holding a
+#: sub-target remnant that flushes padded at end of sweep — and every bucket
+#: costs its own family of decode compiles.  Attention over the extra
+#: invalid slots is negligible: the pooled decode is weight-streaming-bound
+#: (~8.5 ms/step at 7B int8 for ANY slice under a few hundred rows).
+_POOL_LEN_MENU = (256, 512, 1024, 2048)
+
+
+def _pool_len(bucket_len: int) -> int:
+    for t in _POOL_LEN_MENU:
+        if bucket_len <= t:
+            return t
+    return bucket_len
 
 
 class _Phase2Pool:
@@ -723,28 +795,36 @@ class _Phase2Pool:
     def _entry_bytes(cache) -> int:
         return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
 
-    def add(self, bucket_len, sub_cache, last_s, len_s, n_real, orig_idx,
+    def add(self, pool_len, sub_cache, last_s, len_s, n_real, orig_idx,
             row_ids, first3):
         """Queue one batch's gathered undecided slice (rows past ``n_real``
-        are gather padding).  ``orig_idx``: original prompt index per real
-        row; ``row_ids``: [m, 2] per-row (yes, no) target ids — rows from
-        DIFFERENT scenarios pool together.  Flushes when the bucket reaches
+        are gather padding).  ``pool_len`` is the slice's QUANTIZED cache
+        length (_pool_len of its bucket — slices from different buckets
+        arrive pre-padded by _prefill_select and pool together under one
+        key).  ``orig_idx``: original prompt index per real row;
+        ``row_ids``: [m, 2] per-row (yes, no) target ids — rows from
+        DIFFERENT scenarios pool together.  Flushes when the key reaches
         ``target`` rows or the pool's TOTAL held K/V would exceed
-        ``max_bytes`` (the largest bucket flushes first, freeing the most
-        per row)."""
+        ``max_bytes`` (the largest key flushes first, freeing the most per
+        row); an add that would push the key past _SLICE_MENU's largest
+        entry flushes FIRST, so a padded flush total never exceeds the menu
+        and never compiles a bespoke decode shape (user-set targets above
+        ~450 used to)."""
         nb = self._entry_bytes(sub_cache)
         while self.entries and sum(self.bytes.values()) + nb > self.max_bytes:
             self.flush(max(self.bytes, key=self.bytes.get))
-        self.entries.setdefault(bucket_len, []).append(
+        rows = int(last_s.shape[0])
+        if self.counts.get(pool_len, 0) and (
+                self.counts[pool_len] + rows > _SLICE_MENU[-1]):
+            self.flush(pool_len)
+        self.entries.setdefault(pool_len, []).append(
             (sub_cache, last_s, len_s, int(n_real), np.asarray(orig_idx),
              np.asarray(row_ids, np.int32), np.asarray(first3))
         )
-        self.counts[bucket_len] = self.counts.get(bucket_len, 0) + int(
-            last_s.shape[0]
-        )
-        self.bytes[bucket_len] = self.bytes.get(bucket_len, 0) + nb
-        if self.counts[bucket_len] >= self.target:
-            self.flush(bucket_len)
+        self.counts[pool_len] = self.counts.get(pool_len, 0) + rows
+        self.bytes[pool_len] = self.bytes.get(pool_len, 0) + nb
+        if self.counts[pool_len] >= self.target:
+            self.flush(pool_len)
 
     def flush_all(self):
         for bucket_len in list(self.entries):
@@ -823,10 +903,11 @@ class _Phase2Pool:
 
 @functools.partial(
     jax.jit,
-    static_argnames=("cfg", "cache_len", "slice_m", "top_k", "top_filter"))
+    static_argnames=("cfg", "cache_len", "slice_m", "top_k", "top_filter",
+                     "out_len"))
 def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
                     cache_len: int, slice_m: int, top_k: int,
-                    top_filter: int = 20):
+                    top_filter: int = 20, out_len: int = 0):
     """Prefill + position-0 scan + IN-PROGRAM phase-2 row selection.
 
     Selecting the undecided rows INSIDE the program — undecided-first
@@ -855,6 +936,18 @@ def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
         positions=cache.positions[sel], valid=cache.valid[sel],
         length=cache.length,
     )
+    if out_len and out_len > cache_len:
+        # Pad the slice to the pool's quantized cache length (_POOL_LEN_MENU)
+        # INSIDE the prefill program — invalid zero slots the attention bias
+        # masks out — so cross-bucket pooling costs zero extra programs.
+        pad_t = out_len - cache_len
+        sub = dmod.KVCache(
+            k=jnp.pad(sub.k, ((0, 0), (0, 0), (0, pad_t), (0, 0), (0, 0))),
+            v=jnp.pad(sub.v, ((0, 0), (0, 0), (0, pad_t), (0, 0), (0, 0))),
+            positions=jnp.pad(sub.positions, ((0, 0), (0, pad_t))),
+            valid=jnp.pad(sub.valid, ((0, 0), (0, pad_t))),
+            length=sub.length,
+        )
     first3 = yn.relative_prob_first_token(last, yes_ids, no_ids, top_filter)
     # Deliberately NOT returning the full-batch `last`/`lengths`: the
     # pooled consumer never reads them, and at batch 256 the [B, V] logits

@@ -246,9 +246,20 @@ def agreement_bootstrap(llm_df: pd.DataFrame, survey_df: pd.DataFrame,
         err = h - preds[None, :]
         mae = np.abs(err).mean(axis=1)
         mse = (err ** 2).mean(axis=1)
+        # MAPE mirrors the reference's finite-filter semantics
+        # (analyze_llm_human_agreement_bootstrap.py:179-182): every FINITE
+        # |err|/h term is kept — including tiny-but-nonzero human means,
+        # whose terms are huge but finite — and only inf (h == 0) and nan
+        # terms drop; a resample with no finite terms reports nan.
         with np.errstate(divide="ignore", invalid="ignore"):
-            ape = np.where(h > 0.01, np.abs(err) / h, np.nan)
-        mape = np.nanmean(ape, axis=1) * 100
+            ape = np.abs(err) / h
+        finite = np.isfinite(ape)
+        n_fin = finite.sum(axis=1)
+        mape = np.where(
+            n_fin > 0,
+            np.where(finite, ape, 0.0).sum(axis=1) / np.maximum(n_fin, 1),
+            np.nan,
+        ) * 100
         hc = h - h.mean(axis=1, keepdims=True)
         pc = preds - preds.mean()
         denom = np.sqrt((hc ** 2).sum(axis=1) * (pc ** 2).sum())

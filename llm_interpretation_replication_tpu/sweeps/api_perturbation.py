@@ -450,11 +450,17 @@ def run_claude_perturbation_sweep(
 # LINE ("{rephrasing}\n\n{format}", :156-157 — unlike the Gemini leg's
 # single space), first-token top-20 logprob scan for the target tokens,
 # single-token 3-position weighted confidence (:47-85), 0.5 s rate-limit
-# sleep between pairs (:190).  The reference script writes its workbook
-# only once at the end; this leg adds the checkpoint-append + resume-by-
-# (model, original, rephrased) discipline the Claude/Gemini legs have, and
-# records real Token_i_Prob values (the reference stubbed them to 0,
-# :181-185, because its extractor never parsed the binary logprobs).
+# sleep between pairs (:190), max_tokens=10 on both calls (:118,:143).
+# The reference script writes its workbook only once at the end; this leg
+# adds the checkpoint-append + resume-by-(model, original, rephrased)
+# discipline the Claude/Gemini legs have.  Two DELIBERATE column-content
+# deviations from perturb_prompts_gpt.py: (1) Token_i_Prob records the real
+# first-position probabilities of the target tokens (the reference stubbed
+# them to 0, :181-185, because its extractor never parsed the binary
+# logprobs); (2) 'Log Probabilities' records the BINARY response's
+# top-20 first-position logprobs — the data Token_i_Prob is derived from,
+# auditable per row — where the reference stored the CONFIDENCE response's
+# full logprobs dict (:170) that its analysis never read.
 
 def _gpt_perturbation_row(client, model: str, scenario: Dict,
                           rephrased: str) -> Dict:
@@ -468,7 +474,8 @@ def _gpt_perturbation_row(client, model: str, scenario: Dict,
     t1, t2 = scenario["target_tokens"][0], scenario["target_tokens"][1]
 
     binary = client.chat_completion(
-        model, [{"role": "user", "content": binary_prompt}])
+        model, [{"role": "user", "content": binary_prompt}],
+        max_tokens=10)  # perturb_prompts_gpt.py:118
     text, content = openai_content_and_logprobs(binary)
     p1 = p2 = 0.0
     top0 = content[0].get("top_logprobs", []) if content else []
@@ -480,7 +487,8 @@ def _gpt_perturbation_row(client, model: str, scenario: Dict,
             p2 = math.exp(item["logprob"])
 
     conf = client.chat_completion(
-        model, [{"role": "user", "content": confidence_prompt}])
+        model, [{"role": "user", "content": confidence_prompt}],
+        max_tokens=10)  # perturb_prompts_gpt.py:143
     conf_text, conf_content = openai_content_and_logprobs(conf)
     positions = [
         [(i["token"], i["logprob"]) for i in tok.get("top_logprobs", [])]

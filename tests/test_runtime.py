@@ -23,9 +23,10 @@ class TestBucketing:
     def test_bucket_for(self):
         assert bucket_for(1) == 64
         assert bucket_for(64) == 64
-        assert bucket_for(65) == 96      # perturbation-corpus hot zone
+        assert bucket_for(65) == 80      # perturbation-corpus hot zone
         assert bucket_for(100) == 112
         assert bucket_for(130) == 144
+        assert bucket_for(150) == 160    # step-16 through the whole hot zone
         assert bucket_for(430) == 432    # 100q few-shot hot zone
         with pytest.raises(ValueError):
             bucket_for(99999)
@@ -67,7 +68,37 @@ class TestBucketing:
         # disable via min_bucket_rows=1: every occupied bucket kept
         batches = list(batches_for_prompts(encoded, batch_size=32, pad_id=0,
                                            min_bucket_rows=1))
-        assert sorted({b.bucket_len for b in batches}) == [96, 112, 144]
+        assert sorted({b.bucket_len for b in batches}) == [80, 112, 144]
+
+    def test_length_sorted_batches(self):
+        """Global length-sorted mode: batches are consecutive runs of the
+        sorted lengths, each padded to ITS OWN max's bucket, one partial
+        batch total, and every prompt index covered exactly once."""
+        rng = np.random.default_rng(0)
+        lens = rng.integers(60, 204, size=37)
+        encoded = [[1] * int(n) for n in lens]
+        batches = list(batches_for_prompts(encoded, batch_size=8, pad_id=0,
+                                           length_sorted=True))
+        assert len(batches) == 5  # ceil(37/8): exactly one partial batch
+        covered = sorted(int(i) for b in batches for i in b.indices if i >= 0)
+        assert covered == list(range(37))
+        prev_max = 0
+        for b in batches:
+            real = b.indices >= 0
+            row_lens = b.attention_mask.sum(axis=1)[real]
+            # each batch pads to the bucket of its own longest prompt...
+            assert b.bucket_len == bucket_for(int(row_lens.max()))
+            # ...and batches come out in ascending length order
+            assert int(row_lens.max()) >= prev_max
+            prev_max = int(row_lens.max())
+            assert b.token_ids.shape == (8, b.bucket_len)
+        # padding is never worse than bucket-grouped for the same menu
+        sorted_tokens = sum(8 * b.bucket_len for b in batches)
+        grouped_tokens = sum(
+            b.token_ids.shape[0] * b.bucket_len
+            for b in batches_for_prompts(encoded, batch_size=8, pad_id=0,
+                                         min_bucket_rows=1))
+        assert sorted_tokens <= grouped_tokens
 
 
 def _tiny_engine(mesh=None, batch_size=4):

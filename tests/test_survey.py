@@ -262,6 +262,42 @@ class TestAgreementReports:
         qv = rep["question_variance"]['Is a "screenshot" a "photograph"?']
         assert qv["n_models"] == len(rep["model_results"]) == 28
 
+    def test_bootstrap_mape_keeps_tiny_but_nonzero_means(self):
+        """The respondent bootstrap's MAPE mirrors the reference's
+        finite-filter (analyze_llm_human_agreement_bootstrap.py:179-182):
+        a question with a TINY but nonzero human mean (0 < h <= 0.01)
+        contributes its huge-but-finite |err|/h term; only h == 0 (inf)
+        drops.  The r04 code silently NaN'd the tiny-mean term, diverging
+        from the reference on exactly this input."""
+        import pandas as pd
+
+        from llm_interpretation_replication_tpu.survey.variants import (
+            agreement_bootstrap,
+        )
+
+        # 4 identical respondents -> every bootstrap resample has the same
+        # means, so the expected MAPE is exact: Q_tiny mean = 0.5% = 0.005,
+        # Q_mid = 0.5, Q_zero = 0 (inf term, dropped)
+        survey_df = pd.DataFrame({
+            "Q_tiny": [0.5] * 4, "Q_mid": [50.0] * 4, "Q_zero": [0.0] * 4,
+        })
+        mapping = {"Q_tiny": "p_tiny", "Q_mid": "p_mid", "Q_zero": "p_zero"}
+        llm_df = pd.DataFrame({
+            "model": ["m"] * 3,
+            "prompt": ["p_tiny", "p_mid", "p_zero"],
+            "relative_prob": [0.105, 0.25, 0.4],
+        })
+        rep = agreement_bootstrap(
+            llm_df, survey_df, list(mapping), mapping,
+            n_bootstrap=8, seed=0, min_questions=1,
+        )
+        (rec,) = rep["model_results"]
+        ape_tiny = abs(0.005 - 0.105) / 0.005   # kept: finite (= 20.0)
+        ape_mid = abs(0.5 - 0.25) / 0.5         # kept (= 0.5)
+        expected = (ape_tiny + ape_mid) / 2 * 100   # inf term dropped
+        np.testing.assert_allclose(rec["mape_mean"], expected, rtol=1e-12)
+        np.testing.assert_allclose(rec["mape_std"], 0.0, atol=1e-12)
+
     def test_question_bootstrap_schema_and_group_comparison(self):
         from llm_interpretation_replication_tpu.survey.variants import (
             agreement_question_bootstrap,

@@ -235,6 +235,44 @@ class TestPerturbationSweep:
         keys = df["Rephrased Main Part"].tolist()
         assert len(keys) == len(set(keys))
 
+    def test_sidelog_crash_resume(self, tmp_path):
+        """Checkpoint flushes append to the O(new-rows) side-log; a crash
+        before the final xlsx render loses nothing — resume reads the
+        side-log, skips its rows, and the final workbook folds them in
+        (then deletes the side-log)."""
+        import json as jsonlib
+        import os
+
+        from llm_interpretation_replication_tpu.sweeps.perturbation import (
+            _sidelog_path,
+        )
+
+        out = str(tmp_path / "results.xlsx")
+        run_model_perturbation_sweep(
+            FakeEngine("fake/model-7b"), "fake/model-7b",
+            [dict(self.SCENARIOS[0],
+                  rephrasings=self.SCENARIOS[0]["rephrasings"][:3])],
+            out,
+        )
+        assert not os.path.exists(_sidelog_path(out))  # clean finish
+        # simulate a crash mid-run: the 3 finished rows live ONLY in the
+        # side-log (no rendered workbook yet)
+        done = read_xlsx(out).to_dict("records")
+        os.remove(out)
+        with open(_sidelog_path(out), "w") as f:
+            for row in done:
+                f.write(jsonlib.dumps(row) + "\n")
+        eng = FakeEngine("fake/model-7b")
+        df = run_model_perturbation_sweep(
+            eng, "fake/model-7b", self.SCENARIOS, out
+        )
+        assert len(df) == 10
+        keys = df["Rephrased Main Part"].tolist()
+        assert len(keys) == len(set(keys))           # crash rows not redone
+        back = read_xlsx(out)
+        assert len(back) == 10                       # final render has all
+        assert not os.path.exists(_sidelog_path(out))  # consumed
+
 
 class TestPerturbationSweepRealEngine:
     def test_end_to_end_with_real_engine_and_mixed_targets(self, tmp_path):
