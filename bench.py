@@ -378,9 +378,13 @@ def run_sweep_mode(args, cfg, params):
           f"calibrated position-0 hit rate {measured_rate:.2f} "
           f"(target {args.decided_frac})", file=sys.stderr)
 
+    from llm_interpretation_replication_tpu.sweeps.perturbation import (
+        _sidelog_path,
+    )
+
     out_path = args.sweep_out or os.path.join(
         tempfile.mkdtemp(prefix="bench_sweep_"), "results.xlsx")
-    sidelog = out_path + ".rows.jsonl"
+    sidelog = _sidelog_path(out_path)
     all_rows, pending = [], []
 
     def flush(final=False):
@@ -510,7 +514,11 @@ def run_sweep_full_mode(args, cfg, params):
             tempfile.mkdtemp(prefix="bench_sweep_full_"), "results.xlsx")
         # each repeat sweeps from scratch: a leftover workbook/side-log
         # would resume-skip every row and time nothing
-        for stale in (out_path, out_path + ".rows.jsonl"):
+        from llm_interpretation_replication_tpu.sweeps.perturbation import (
+            _sidelog_path,
+        )
+
+        for stale in (out_path, _sidelog_path(out_path)):
             if os.path.exists(stale):
                 os.remove(stale)
         t0 = timemod.perf_counter()
@@ -906,10 +914,22 @@ def main():
         # The sweep runs at --sweep-batch on the real ~107-token prompts
         # (256-token worst bucket: the longest rephrasing is 203 tokens) —
         # plan THAT operating point, not the parity mode's 432-token one.
-        sweep_plan = resolve_scoring_plan(
-            cfg, args.quant, args.sweep_batch, 256,
-            requested_impl="flash" if args.attn == "flash" else None,
-        )
+        # The full-study mode plans with the completion path's pinned
+        # caches/score buffers included (measured: batch 256 OOMs there).
+        if args.mode == "sweep-full":
+            from llm_interpretation_replication_tpu.runtime.plan import (
+                resolve_full_sweep_plan,
+            )
+            sweep_plan = resolve_full_sweep_plan(
+                cfg, args.quant, args.sweep_batch, 256,
+                pipeline_depth=args.pipeline_depth,
+                requested_impl="flash" if args.attn == "flash" else None,
+            )
+        else:
+            sweep_plan = resolve_scoring_plan(
+                cfg, args.quant, args.sweep_batch, 256,
+                requested_impl="flash" if args.attn == "flash" else None,
+            )
         if sweep_plan.batch != args.sweep_batch or (
                 sweep_plan.attention_impl != args.attn):
             print(f"# sweep plan: {sweep_plan.reason}; batch "
@@ -980,6 +1000,42 @@ def main():
                  "value": round(measure("single", max(4, args.iters // 2), 2), 2),
                  "unit": "prompts/sec"},
             ]
+            # (c) the FULL-STUDY row contract (binary leg with 50-token
+            # completions + confidence leg, all 15 columns via the real
+            # sweep shell) — one repeat, own HBM plan; guarded so a
+            # full-study failure can never sink the headline record.
+            try:
+                import copy
+
+                from llm_interpretation_replication_tpu.runtime.plan import (
+                    resolve_full_sweep_plan,
+                )
+
+                fargs = copy.copy(args)
+                fargs.sweep_repeats = 1
+                fargs.pipeline_depth = 2
+                fargs.sweep_out = None
+                fplan = resolve_full_sweep_plan(
+                    cfg, args.quant, args.sweep_batch, 256, pipeline_depth=2,
+                    requested_impl="flash" if args.attn == "flash" else None)
+                fargs.sweep_batch = fplan.batch
+                rps, frate, _ = run_sweep_full_mode(fargs, cfg, params)
+                record["secondary"].append({
+                    "metric": (
+                        f"full-study rows/sec/chip (END-TO-END sweep, FULL "
+                        f"row contract: binary leg with 50-token "
+                        f"completions + confidence leg, all 15 workbook "
+                        f"columns via the real sweep shell; {args.model} "
+                        f"geometry, "
+                        f"{'w8a8 int8' if args.quant == 'int8' else 'bf16'}, "
+                        f"batch {fargs.sweep_batch}, hit rate "
+                        f"{frate:.2f}, no-EOS worst case)"),
+                    "value": round(rps, 2),
+                    "unit": "rows/sec",
+                })
+            except Exception as err:
+                print(f"# full-study secondary failed ({err}); headline "
+                      f"record unaffected", file=sys.stderr)
         print(json.dumps(record))
         return
 

@@ -73,6 +73,24 @@ def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
     return batch * seq * cfg.num_heads * cfg.head_dim * 4
 
 
+def completions_extra_bytes(cfg, batch: int, seq: int,
+                            gen_tokens: int = 50, score_steps: int = 10,
+                            pipeline_depth: int = 2) -> int:
+    """Extra live set of the FULL-STUDY row contract (decode_completions +
+    confidence): each in-flight pipelined batch pins one full bf16 KV cache
+    grown to seq+gen_tokens slots plus the fp32 [B, steps, V] score buffer;
+    the chunked generate's cache concat makes old+new cache coexist
+    transiently (one extra cache); and the confidence leg's in-place
+    full-batch scored decode holds its own cache + score buffer besides the
+    in-flight binary-leg batches.  Calibrated against the measured v5e
+    anchors: int8 falcon-7b sweep-full at batch 256 / 256-token bucket /
+    depth 2 OOMs mid-sweep; batch 192 fits."""
+    cache = (cfg.num_layers * batch * (seq + gen_tokens)
+             * cfg.num_kv_heads * cfg.head_dim * 2 * 2)      # bf16, k+v
+    scores = batch * score_steps * cfg.vocab_size * 4        # fp32
+    return pipeline_depth * (cache + scores) + 2 * cache + scores
+
+
 @dataclasses.dataclass
 class ScoringPlan:
     attention_impl: str        # "xla" (dense) or "flash"
@@ -126,4 +144,42 @@ def resolve_scoring_plan(cfg, quant: str, batch: int, seq: int,
         f"dense needs {dense_need / 2**30:.1f} GiB > budget "
         f"{budget / 2**30:.1f}; flash at batch {clamped}"
         if not fits_dense else f"flash requested; batch {clamped}",
+    )
+
+
+def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
+                            gen_tokens: int = 50, score_steps: int = 10,
+                            pipeline_depth: int = 2,
+                            hbm_bytes: int = HBM_BYTES_V5E,
+                            requested_impl: Optional[str] = None
+                            ) -> ScoringPlan:
+    """Route the FULL-STUDY sweep (binary leg with completions + confidence
+    leg): resolve the attention impl like a binary sweep, then shrink the
+    batch (steps of 32) until the live set INCLUDING the completion path's
+    pinned caches and score buffers (completions_extra_bytes) fits."""
+    base = resolve_scoring_plan(cfg, quant, batch, seq, hbm_bytes,
+                                requested_impl)
+    wb = base.weight_bytes
+    budget = hbm_bytes - RESERVE_BYTES
+
+    def need(b):
+        attn = (flash_workspace_bytes(cfg, b, seq)
+                if base.attention_impl == "flash"
+                else dense_attention_bytes(cfg, b, seq))
+        return (wb + attn + activation_bytes(cfg, b, seq)
+                + completions_extra_bytes(cfg, b, seq, gen_tokens,
+                                          score_steps, pipeline_depth))
+
+    b = min(batch, base.batch)
+    if need(b) > budget:
+        b = max(32, (b // 32) * 32)     # step through multiples of 32:
+        while b > 32 and need(b) > budget:  # batches stay sublane-aligned
+            b -= 32
+    if b == base.batch:
+        return base
+    return ScoringPlan(
+        base.attention_impl, b, base.fits_dense, wb,
+        f"full-study row contract pins {completions_extra_bytes(cfg, b, seq, gen_tokens, score_steps, pipeline_depth) / 2**30:.1f} GiB "
+        f"of completion caches/scores at depth {pipeline_depth}; "
+        f"batch {batch} -> {b} to fit {budget / 2**30:.1f} GiB",
     )

@@ -341,6 +341,28 @@ class TestScoringEngine:
                                       hbm_bytes=8 << 30)
         assert not plan_t.fits_dense and plan_t.batch == 1
 
+        # FULL-STUDY planning (completions + confidence): the pinned KV
+        # caches and score buffers shrink the sweep batch — v5e anchors:
+        # int8 falcon-7b at the 256-token sweep bucket OOMs at batch 256
+        # (measured mid-sweep, r5) and must clamp below it; 192 fits and
+        # must NOT clamp; the binary-leg plan at 256 stays unclamped.
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            resolve_full_sweep_plan,
+        )
+
+        full = resolve_full_sweep_plan(falcon7b, "int8", 256, 256,
+                                       pipeline_depth=2)
+        assert full.batch < 256 and full.attention_impl == "xla"
+        full192 = resolve_full_sweep_plan(falcon7b, "int8", 192, 256,
+                                          pipeline_depth=2)
+        assert full192.batch == 192
+        binary = resolve_scoring_plan(falcon7b, "int8", 256, 256)
+        assert binary.batch == 256
+        # bf16 full-study: still routed to the flash escape hatch
+        full_bf = resolve_full_sweep_plan(falcon7b, "none", 256, 256,
+                                          pipeline_depth=2)
+        assert full_bf.attention_impl == "flash" and full_bf.batch <= 64
+
     def test_phase2_pool_matches_per_batch_decode(self):
         """Cross-batch pooling of undecided rows (one scored decode per
         ~pool_target rows instead of one per prefill batch) must be invisible
