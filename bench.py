@@ -70,14 +70,19 @@ pooled+selected (36.07 r03 per-batch 32-row slice; the measured ceiling
 for any cache-carrying two-phase design is 37.3 — the layer scan's K/V
 stacking, see PARITY.md); decode-all 35.8-35.9; 31.5 int8 / 16.5 bf16 at
 the old batch-128/512 config.  Batch 224+ OOMs 16 GB HBM at seq 432;
-sweep batches 320+ OOM (the pooled-decode score buffer scales with batch).  NEVER run the e2e sweep
+sweep batches 320+ OOM (retried under the r5 menu-capped
+pool: batch 320 survives one 10k repeat then ResourceExhausts on the
+next — fragmentation-level, so 256 stays the ceiling).  NEVER run the e2e sweep
 beside other CPU-heavy processes: a concurrent pytest run measured 24 p/s
 on identical code (the steady-state modes are device-bound and immune).
 
 Where the single-forward time goes (jax.profiler device trace): the two
 projection-matmul fusions take 92.6 ms/layer vs 87 ms theoretical at the
 v5e's 394 TOPS int8 — ~94% of MXU peak — so the matmul side is essentially
-optimal.  The remaining ~40% of the step is VPU-bound elementwise that XLA
+optimal.  (At the SWEEP's short 104-token operating point the same
+fusions run at 54-91% of peak because the fused quant-scale epilogue
+amortizes over fewer rows — whole-step MFU ~58%; trace-backed table in
+PARITY.md "Where the 104-token sweep step's time goes".)  The remaining ~40% of the step is VPU-bound elementwise that XLA
 already fuses (attention softmax ~14%, activation quantization ~3%, rotary
 ~2%, layernorm/residual/dequant the rest).  The round-2 attempts to claw
 that back are all measured in ops/attention.py's outcome table: the causal
