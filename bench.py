@@ -62,9 +62,11 @@ floor for top-5 membership — data/instruct_model_comparison_results_combined
 .csv), and the prompts instruct a Yes/No answer, so top-5 decisiveness is
 higher still.
 
-History: e2e sweep 93.2 r04 final at pipeline depth 4 (91.5-92.2 at
-depth 2, 67.6 at depth 1 — the async-dispatch overlap measured; 87.7
-before the 96/112/144 hot-zone buckets; 68.2 with per-scenario calls).  Steady state at the 430-token
+History: e2e sweep 111.8-112.1 r05 (async pool flushes; 105.8 with
+length-sorted batches + step-16 menu but blocking flushes); 93.2 r04
+final at pipeline depth 4 (91.5-92.2 at depth 2, 67.6 at depth 1 — the
+async-dispatch overlap measured; 87.7 before the 96/112/144 hot-zone
+buckets; 68.2 with per-scenario calls).  Steady state at the 430-token
 operating point: single forward 38.1-38.2 r01-r04; parity 36.8-36.9 r04
 pooled+selected (36.07 r03 per-batch 32-row slice; the measured ceiling
 for any cache-carrying two-phase design is 37.3 — the layer scan's K/V

@@ -790,6 +790,12 @@ class _Phase2Pool:
         self.entries: Dict[int, List] = {}
         self.counts: Dict[int, int] = {}
         self.bytes: Dict[int, int] = {}
+        self.deferred: List = []   # [(layout, fields, first3, fb)] —
+                                   # dispatched flushes awaiting host fetch;
+                                   # fb = K/V bytes the flush pins in HBM
+                                   # until its queued decode EXECUTES
+                                   # (counted against max_bytes, zeroed once
+                                   # the outputs report ready)
 
     @staticmethod
     def _entry_bytes(cache) -> int:
@@ -811,8 +817,15 @@ class _Phase2Pool:
         and never compiles a bespoke decode shape (user-set targets above
         ~450 used to)."""
         nb = self._entry_bytes(sub_cache)
-        while self.entries and sum(self.bytes.values()) + nb > self.max_bytes:
+        while self.entries and (sum(self.bytes.values())
+                                + self._inflight_bytes() + nb > self.max_bytes):
             self.flush(max(self.bytes, key=self.bytes.get))
+        if self.deferred and self._inflight_bytes() + nb > self.max_bytes:
+            # flushing only MOVED bytes to the dispatched-but-undrained set;
+            # draining blocks until those queued decodes have executed and
+            # their caches are freed — the one place the async pool trades
+            # throughput back for the HBM guarantee
+            self.drain()
         rows = int(last_s.shape[0])
         if self.counts.get(pool_len, 0) and (
                 self.counts[pool_len] + rows > _SLICE_MENU[-1]):
@@ -829,6 +842,7 @@ class _Phase2Pool:
     def flush_all(self):
         for bucket_len in list(self.entries):
             self.flush(bucket_len)
+        self.drain()
 
     def _blank_entry(self, template, rows: int):
         """Numerically-inert filler rows that pad a pooled decode up to a
@@ -870,35 +884,78 @@ class _Phase2Pool:
             )
             last = jnp.concatenate([e[1] for e in entries], axis=0)
             lens = jnp.concatenate([e[2] for e in entries], axis=0)
-        mask_parts = []
-        for _, last_e, _, n_real, _, _, _ in entries:
-            part = np.zeros((last_e.shape[0],), bool)
-            part[:n_real] = True
-            mask_parts.append(part)
-        mask = np.concatenate(mask_parts)
         ids = np.concatenate([e[5] for e in entries], axis=0)   # [m, 2]
         first3 = np.concatenate([e[6] for e in entries], axis=0)  # [m, 3]
         ecfg = self.engine.ecfg
-        sc, toks = self.engine._scan_decode_chunked(
-            cache, last, lens, self.steps, self.eos_id,
-            ids[:, 0], ids[:, 1], real_mask=mask,
+        # ASYNC flush: dispatch the full scored decode and the on-device
+        # yes/no reduction, then return — only the small [m] result arrays
+        # are fetched, later, in drain().  The r4 flush ran the CHUNKED
+        # early-exit decode here, whose mid-decode host reads blocked
+        # consume() until the device drained every in-flight prefill ahead
+        # of the decode — a measured 19.5 s of the 93 s warm 10k repeat
+        # (cProfile, r5) — and then restarted the pipeline empty.  Decoding
+        # all ``steps`` positions costs ~100 ms more device time per flush
+        # (weight-streaming-bound) but never reads the early-exit flag, so
+        # the launch loop keeps feeding the device.  The [m, steps, V]
+        # score tensor is consumed on device by yes_no_from_scores and
+        # freed; only [m]-sized outputs wait in the deferred list.
+        toks, sc, _, _, _ = dmod.decode_steps(
+            self.engine.params, self.engine.cfg, cache, last, lens,
+            np.int32(0), self.steps, self.eos_id, None, with_scores=True,
         )
         res = yn.yes_no_from_scores(
             sc, ids[:, 0], ids[:, 1],
             max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
             valid_steps=yn.steps_until_eos(toks, self.eos_id),
         )
-        res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
-        row = 0
-        for _, last_e, _, n_real, orig, _, _ in entries:
-            for j in range(n_real):
-                g = row + j
-                self.results[int(orig[j])] = _attach_first_token(_result_row(
-                    res_np["yes_prob"][g], res_np["no_prob"][g],
-                    res_np["relative_prob"][g], res_np["odds_ratio"][g],
-                    res_np["found"][g], "",
-                ), (first3[:, 0], first3[:, 1], first3[:, 2]), g)
-            row += last_e.shape[0]
+        fields = res._asdict()
+        for v in fields.values():
+            try:
+                v.copy_to_host_async()
+            except AttributeError:
+                pass
+        # keep only the row layout — NOT the entries themselves, whose
+        # device cache slices would otherwise stay pinned until drain()
+        layout = [(int(e[1].shape[0]), e[3], e[4]) for e in entries]
+        fb = sum(self._entry_bytes(e[0]) for e in entries)
+        self.deferred.append((layout, fields, first3, fb))
+
+    def _inflight_bytes(self) -> int:
+        """K/V bytes pinned by dispatched-but-unexecuted flush decodes.
+
+        A deferred flush whose outputs report ready has executed — its
+        concatenated caches are already freed on device — so its bytes stop
+        counting (checked NON-blockingly via jax.Array.is_ready, keeping
+        the common case async; only genuinely queued flushes force the
+        drain above)."""
+        total = 0
+        for i, (layout, fields, first3, fb) in enumerate(self.deferred):
+            if not fb:
+                continue
+            if all(getattr(v, "is_ready", lambda: True)()
+                   for v in fields.values()):
+                self.deferred[i] = (layout, fields, first3, 0)
+            else:
+                total += fb
+        return total
+
+    def drain(self):
+        """Resolve every dispatched flush into result rows (host fetches)."""
+        for layout, fields, first3, _fb in self.deferred:
+            res_np = {k: np.asarray(v) for k, v in fields.items()}
+            row = 0
+            for rows, n_real, orig in layout:
+                for j in range(n_real):
+                    g = row + j
+                    self.results[int(orig[j])] = _attach_first_token(
+                        _result_row(
+                            res_np["yes_prob"][g], res_np["no_prob"][g],
+                            res_np["relative_prob"][g],
+                            res_np["odds_ratio"][g],
+                            res_np["found"][g], "",
+                        ), (first3[:, 0], first3[:, 1], first3[:, 2]), g)
+                row += rows
+        self.deferred = []
 
 
 @functools.partial(

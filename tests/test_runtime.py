@@ -363,6 +363,48 @@ class TestScoringEngine:
                                           pipeline_depth=2)
         assert full_bf.attention_impl == "flash" and full_bf.batch <= 64
 
+    def test_pool_crosses_buckets_via_quantized_cache_len(self):
+        """Undecided slices from DIFFERENT length buckets pool together
+        under one quantized cache length (_pool_len): the prefill pads the
+        slice with inert invalid slots (engine._prefill_select out_len), so
+        a mixed-bucket sweep produces the same per-prompt numbers as the
+        per-batch decode — and the pool really does hold ONE key."""
+        import dataclasses as dc
+
+        from llm_interpretation_replication_tpu.runtime import engine as emod
+
+        eng, _, _ = _tiny_engine(batch_size=8)
+        # Two distinct buckets (32 and 64) with length-sorted batching OFF,
+        # so batches from both buckets are emitted and pool separately-keyed
+        # slices unless the quantized key merges them.
+        prompts = ([f"short {i}?" for i in range(8)]
+                   + [f"longer prompt {i} crossing the bucket line {i}"
+                      for i in range(8)])
+        eng.ecfg = dc.replace(eng.ecfg, decode_completions=False,
+                              phase2_pool=False, length_sorted_batches=False)
+        rows_direct = eng.score_prompts(prompts)
+        keys_seen = []
+        orig_add = emod._Phase2Pool.add
+
+        def spy_add(self, pool_len, *a, **k):
+            keys_seen.append(pool_len)
+            return orig_add(self, pool_len, *a, **k)
+
+        emod._Phase2Pool.add = spy_add
+        try:
+            eng.ecfg = dc.replace(eng.ecfg, phase2_pool=True,
+                                  phase2_pool_target=64)  # only flush_all
+            rows_pooled = eng.score_prompts(prompts)
+        finally:
+            emod._Phase2Pool.add = orig_add
+        assert all(r["success"] for r in rows_pooled)
+        for a, b in zip(rows_direct, rows_pooled):
+            np.testing.assert_allclose(a["relative_prob"], b["relative_prob"],
+                                       rtol=1e-5)
+        # both buckets' slices arrived under the SAME quantized pool key
+        assert keys_seen and len(set(keys_seen)) == 1, keys_seen
+        assert set(keys_seen) == {emod._pool_len(64)}
+
     def test_phase2_pool_matches_per_batch_decode(self):
         """Cross-batch pooling of undecided rows (one scored decode per
         ~pool_target rows instead of one per prefill batch) must be invisible
