@@ -195,6 +195,7 @@ def cmd_run_perturbation(args):
         output_xlsx=os.path.join(rc.output_dir, "perturbation_results.xlsx"),
         max_rephrasings=args.max_rephrasings,
         score_chunk=args.score_chunk,
+        confidence_max_new_tokens=args.confidence_max_new_tokens,
     )
     print(f"{len(df)} rows")
 
@@ -999,6 +1000,13 @@ def main(argv=None):
                    help="rows per cross-scenario scoring call: bounds crash "
                         "loss (a crash loses the in-flight chunk); raise on "
                         "reliable hardware to merge more tail batches")
+    p.add_argument("--confidence-max-new-tokens", type=int, default=10,
+                   metavar="N",
+                   help="generation cap for the confidence leg (the API "
+                        "legs' max_tokens=10 contract; the parse reads only "
+                        "the first integer).  0 = the engine's full "
+                        "max_new_tokens (50-token confidence completions "
+                        "in the workbook)")
     p.set_defaults(fn=cmd_run_perturbation)
 
     p = sub.add_parser("run-api-perturbation",

@@ -211,6 +211,7 @@ class ScoringEngine:
         prompts: Sequence[str],
         targets: Sequence[str] = ("Yes", "No"),
         with_confidence: bool = False,
+        max_new_tokens: Optional[int] = None,
     ) -> List[Dict]:
         """Yes/No-style scoring for a list of formatted prompts.
 
@@ -227,16 +228,25 @@ class ScoringEngine:
         greedy-generate up to ``max_new_tokens=50`` score-free tokens in
         EOS-early-exit chunks so the ``completion`` column matches the
         reference's ``generate(max_new_tokens=50)`` text (ibid.:337-346,379).
+
+        ``max_new_tokens`` overrides the engine config's generation cap for
+        THIS call only (never below the scored-scan steps) — e.g. the
+        perturbation sweep's confidence leg caps at the API legs' 10-token
+        contract while the binary leg keeps the full 50.
         """
         if self.is_encoder_decoder:
-            return self._score_encdec(prompts, targets, with_confidence)
-        return self._score_decoder(prompts, targets, with_confidence)
+            return self._score_encdec(prompts, targets, with_confidence,
+                                      max_new_tokens)
+        return self._score_decoder(prompts, targets, with_confidence,
+                                   max_new_tokens)
 
-    def _gen_plan(self):
-        """(scan_steps, total_new_tokens) for the current engine config."""
+    def _gen_plan(self, max_new_tokens: Optional[int] = None):
+        """(scan_steps, total_new_tokens) for the current engine config;
+        ``max_new_tokens`` is a per-call override of the config cap."""
         ecfg = self.ecfg
         steps = max(ecfg.score_steps, ecfg.max_look_ahead)
-        total = max(steps, ecfg.max_new_tokens) if ecfg.decode_completions else steps
+        cap = ecfg.max_new_tokens if max_new_tokens is None else max_new_tokens
+        total = max(steps, cap) if ecfg.decode_completions else steps
         return steps, total
 
     def _completion_text(self, row_tokens, eos_id) -> str:
@@ -274,13 +284,14 @@ class ScoringEngine:
             positions.append(cands)
         return positions
 
-    def _score_decoder(self, prompts, targets, with_confidence) -> List[Dict]:
+    def _score_decoder(self, prompts, targets, with_confidence,
+                   max_new_tokens=None) -> List[Dict]:
         ecfg = self.ecfg
         ids_all = self._target_id_rows(prompts, targets)   # [N, 2]
         eos_id = getattr(self.tokenizer, "eos_token_id", None)
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
-        steps, gen_total = self._gen_plan()
+        steps, gen_total = self._gen_plan(max_new_tokens)
 
         if ecfg.phase2_pool and not with_confidence and not ecfg.decode_completions:
             return self._score_decoder_pooled(
@@ -615,7 +626,8 @@ class ScoringEngine:
         return (jnp.concatenate(sc_parts, axis=1),
                 jnp.concatenate(tok_parts, axis=1))
 
-    def _score_encdec(self, prompts, targets, with_confidence) -> List[Dict]:
+    def _score_encdec(self, prompts, targets, with_confidence,
+                  max_new_tokens=None) -> List[Dict]:
         """T5 path: one scanned decode per batch (the decoder re-runs its
         short prefix each step — models/t5.py greedy_decode), generating
         ``max_new_tokens`` when completions are recorded and scanning only
@@ -626,7 +638,7 @@ class ScoringEngine:
         eos_id = getattr(self.tokenizer, "eos_token_id", None)
         encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
-        steps, gen_total = self._gen_plan()
+        steps, gen_total = self._gen_plan(max_new_tokens)
 
         def launch(batch):
             ids = self._put(batch.token_ids)
@@ -817,14 +829,16 @@ class _Phase2Pool:
         and never compiles a bespoke decode shape (user-set targets above
         ~450 used to)."""
         nb = self._entry_bytes(sub_cache)
-        while self.entries and (sum(self.bytes.values())
-                                + self._inflight_bytes() + nb > self.max_bytes):
+        # Evict from the POOL (largest key first, as before — flushing moves
+        # its bytes to the dispatched set, so this loop terminates)...
+        while self.entries and sum(self.bytes.values()) + nb > self.max_bytes:
             self.flush(max(self.bytes, key=self.bytes.get))
-        if self.deferred and self._inflight_bytes() + nb > self.max_bytes:
-            # flushing only MOVED bytes to the dispatched-but-undrained set;
-            # draining blocks until those queued decodes have executed and
-            # their caches are freed — the one place the async pool trades
-            # throughput back for the HBM guarantee
+        # ...and only when flush caches still QUEUED behind prefills (not
+        # yet executed — _inflight_bytes reaps finished ones first) push the
+        # TOTAL past the cap, block until the queue has consumed them — the
+        # one place the async pool trades throughput back for the HBM bound.
+        if self.deferred and (self._inflight_bytes()
+                              + sum(self.bytes.values()) + nb > self.max_bytes):
             self.drain()
         rows = int(last_s.shape[0])
         if self.counts.get(pool_len, 0) and (
@@ -915,9 +929,12 @@ class _Phase2Pool:
             except AttributeError:
                 pass
         # keep only the row layout — NOT the entries themselves, whose
-        # device cache slices would otherwise stay pinned until drain()
+        # device cache slices would otherwise stay pinned until drain().
+        # Until the queued decode executes, BOTH the source slices (held by
+        # the pending concatenate) and the concatenated copy (held by the
+        # decode) are resident, so the pinned accounting is 2x the slices.
         layout = [(int(e[1].shape[0]), e[3], e[4]) for e in entries]
-        fb = sum(self._entry_bytes(e[0]) for e in entries)
+        fb = 2 * sum(self._entry_bytes(e[0]) for e in entries)
         self.deferred.append((layout, fields, first3, fb))
 
     def _inflight_bytes(self) -> int:

@@ -363,6 +363,36 @@ class TestScoringEngine:
                                           pipeline_depth=2)
         assert full_bf.attention_impl == "flash" and full_bf.batch <= 64
 
+    def test_per_call_max_new_tokens_override(self):
+        """score_prompts(max_new_tokens=N) caps generation for ONE call (the
+        sweep's confidence leg uses the API legs' 10-token contract) without
+        touching the engine config or the scored scan: same probabilities,
+        the capped completion is a prefix of the full one, and the floor is
+        the scan steps."""
+        import torch
+
+        eng, model, tok = _tiny_engine()
+        assert eng._gen_plan() == (10, 50)
+        assert eng._gen_plan(10) == (10, 10)
+        assert eng._gen_plan(1) == (10, 10)   # never below the scored scan
+        prompts = ["The quick brown fox jumps over", "Is soup a beverage?"]
+        full = eng.score_prompts(prompts)
+        capped = eng.score_prompts(prompts, max_new_tokens=10)
+        assert eng.ecfg.max_new_tokens == 50  # config untouched
+        for prompt, f, c in zip(prompts, full, capped):
+            np.testing.assert_allclose(c["relative_prob"],
+                                       f["relative_prob"], rtol=1e-6)
+            ids = tok(prompt, return_tensors="pt").input_ids
+            with torch.no_grad():
+                out = model.generate(
+                    ids, max_new_tokens=10, do_sample=False,
+                    pad_token_id=tok.pad_token_id or 0,
+                    eos_token_id=tok.eos_token_id,
+                )
+            ref = tok.decode(out[0][ids.shape[1]:],
+                             skip_special_tokens=True).strip()[:100]
+            assert c["completion"] == ref, (prompt, c["completion"], ref)
+
     def test_pool_crosses_buckets_via_quantized_cache_len(self):
         """Undecided slices from DIFFERENT length buckets pool together
         under one quantized cache length (_pool_len): the prefill pads the

@@ -32,7 +32,8 @@ class FakeEngine:
         h = hashlib.sha256(f"{self.model_name}|{prompt}".encode()).digest()
         return h[0] / 255.0, h[1] / 255.0
 
-    def score_prompts(self, prompts, targets=("Yes", "No"), with_confidence=False):
+    def score_prompts(self, prompts, targets=("Yes", "No"),
+                      with_confidence=False, max_new_tokens=None):
         if self.fail:
             raise RuntimeError("simulated OOM")
         self.calls += 1
@@ -234,6 +235,25 @@ class TestPerturbationSweep:
         # no duplicated rows after resume
         keys = df["Rephrased Main Part"].tolist()
         assert len(keys) == len(set(keys))
+
+    def test_foreign_engine_old_signature_still_works(self, tmp_path):
+        """Duck-typed engines predating the per-call max_new_tokens kwarg
+        (score_prompts(prompts, targets, with_confidence)) keep working —
+        the confidence cap is passed only to engines that accept it."""
+
+        class OldEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False):
+                return FakeEngine.score_prompts(
+                    self, prompts, targets, with_confidence)
+
+        out = str(tmp_path / "results.xlsx")
+        df = run_model_perturbation_sweep(
+            OldEngine("fake/old-7b"), "fake/old-7b",
+            [self.SCENARIOS[0]], out,
+        )
+        assert len(df) == 6
+        assert df["Confidence Value"].notna().all()
 
     def test_sidelog_crash_resume(self, tmp_path):
         """Checkpoint flushes append to the O(new-rows) side-log; a crash
