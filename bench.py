@@ -613,13 +613,14 @@ def main():
                         default="/root/reference/data/perturbations.json",
                         help="sweep mode: the real 5x2000-rephrasing corpus "
                              "(real length histogram / bucket mix)")
-    parser.add_argument("--sweep-batch", type=int, default=256, metavar="N",
+    parser.add_argument("--sweep-batch", type=int, default=320, metavar="N",
                         help="sweep mode engine batch size (real prompts "
                              "are ~107 tokens so a larger batch than the "
-                             "430-token parity mode fits; measured 2026-07: "
-                             "256 runs, 320 and 384 both OOM — the pooled "
-                             "decode's [batch, 10, V] fp32 score buffer "
-                             "scales with batch)")
+                             "430-token parity mode fits; measured 2026-07 "
+                             "r5: 320 runs at 120.5 p/s warm — the pooled "
+                             "decode's ReducedScores statistics replaced "
+                             "the [batch, 10, V] fp32 score buffer that "
+                             "used to OOM 320 — and 384 still OOMs)")
     parser.add_argument("--sweep-rows", type=int, default=0, metavar="N",
                         help="sweep mode: cap total rows (0 = full 10k)")
     parser.add_argument("--sweep-repeats", type=int, default=2, metavar="N",

@@ -496,8 +496,39 @@ def prefill(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len: in
     return _prefill_impl(params, cfg, token_ids, attention_mask, cache_len)
 
 
+#: Candidates kept per step by the REDUCED score mode — the confidence leg's
+#: 19-candidate contract (runtime.engine._confidence_topk k=19, itself the
+#: API extractors' top-20-logprobs view minus the sampled token).  Any yes/no
+#: scan with top_k <= this reads its threshold from the kept candidates.
+REDUCED_TOPK = 19
+
+
+class ReducedScores(NamedTuple):
+    """Per-step score statistics that replace the stacked [B, P, V] fp32
+    logits when the caller only ever reads (a) target-token probabilities,
+    (b) top-k membership, and (c) top-19 candidates — i.e. everything
+    scoring.yes_no and the confidence leg consume.  ~1600x smaller than the
+    full score tensor (a measured ~580 MB per in-flight batch at the
+    full-study sweep's shapes), which is what capped the sweep's batch size.
+    """
+    topk_vals: jnp.ndarray      # [B, P, REDUCED_TOPK] fp32 logits, descending
+    topk_ids: jnp.ndarray       # [B, P, REDUCED_TOPK] int32 token ids
+    logz: jnp.ndarray           # [B, P] fp32 logsumexp over the vocab
+    target_logits: jnp.ndarray  # [B, P, 2] fp32 logits at (yes_id, no_id)
+
+
+def _reduce_step_scores(logits, target_ids):
+    """One step's [B, V] logits -> (vals, ids, logz, tgt) for ReducedScores."""
+    sub = logits.astype(jnp.float32)
+    logz = jax.scipy.special.logsumexp(sub, axis=-1)
+    vals, ids = lax.top_k(sub, REDUCED_TOPK)
+    tgt = jnp.take_along_axis(sub, target_ids, axis=-1)
+    return vals, ids, logz, tgt
+
+
 def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
-                       offset, num_steps, eos_token_id, done, with_scores):
+                       offset, num_steps, eos_token_id, done, with_scores,
+                       target_ids=None):
     b = prev_logits.shape[0]
     n = num_steps
     cdt = cache.k.dtype
@@ -539,7 +570,12 @@ def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
         step_logits = _unembed(cfg, params, x)[:, 0, :]                 # [B,V]
         if eos_token_id is not None:
             done = done | (next_tok == eos_token_id)
-        out = (next_tok, prev_logits) if with_scores else next_tok
+        if with_scores == "reduced":
+            out = (next_tok, _reduce_step_scores(prev_logits, target_ids))
+        elif with_scores:
+            out = (next_tok, prev_logits)
+        else:
+            out = next_tok
         return (tail_k, tail_v, step_logits, done), out
 
     (tail_k, tail_v, last_logits, done), out = lax.scan(
@@ -555,7 +591,13 @@ def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
         valid=jnp.concatenate([cache.valid, jnp.ones((b, n), bool)], axis=1),
         length=cache.length + n,
     )
-    if with_scores:
+    if with_scores == "reduced":
+        tokens, (s_vals, s_ids, s_logz, s_tgt) = out
+        scores = ReducedScores(
+            jnp.swapaxes(s_vals, 0, 1), jnp.swapaxes(s_ids, 0, 1),
+            jnp.swapaxes(s_logz, 0, 1), jnp.swapaxes(s_tgt, 0, 1),
+        )
+    elif with_scores:
         tokens, step_scores = out
         scores = jnp.swapaxes(step_scores, 0, 1)
     else:
@@ -574,7 +616,8 @@ def decode_steps(
     num_steps: int,
     eos_token_id: Optional[int] = None,
     done=None,          # [B] bool — rows already finished (EOS seen)
-    with_scores: bool = True,
+    with_scores=True,   # True | False | "reduced"
+    target_ids=None,    # [B, 2] int32 (yes, no) ids — required by "reduced"
 ):
     """Continue a batched greedy decode from an existing KV cache.
 
@@ -585,16 +628,24 @@ def decode_steps(
     stops between chunks once every row has emitted EOS, the batched
     equivalent of HF generate's per-sequence EOS stop.  ``with_scores=False``
     skips stacking the [B, n, V] fp32 score buffer (~500 MB at sweep shapes),
-    which completion chunks never need.
+    which completion chunks never need; ``with_scores="reduced"`` stacks only
+    :class:`ReducedScores` per-step statistics (top-19 + logsumexp + the two
+    ``target_ids`` logits — everything the yes/no scan and the confidence leg
+    read), trading the ~500 MB buffer for ~300 KB so the full-study sweep's
+    batch is no longer score-buffer-bound.
 
-    Returns (tokens [B, n], scores [B, n, V] | None, cache, last_logits, done);
-    ``scores[:, 0]`` is exactly ``prev_logits``, so a chunk started from
-    :func:`prefill`'s output reproduces the reference's position-0 read.
+    Returns (tokens [B, n], scores [B, n, V] | ReducedScores | None, cache,
+    last_logits, done); ``scores[:, 0]`` is exactly ``prev_logits`` (reduced:
+    its statistics), so a chunk started from :func:`prefill`'s output
+    reproduces the reference's position-0 read.
     """
     if done is None:
         done = jnp.zeros((prev_logits.shape[0],), bool)
+    if with_scores == "reduced" and target_ids is None:
+        raise ValueError("with_scores='reduced' needs target_ids [B, 2]")
     return _decode_steps_impl(params, cfg, cache, prev_logits, lengths,
-                              offset, num_steps, eos_token_id, done, with_scores)
+                              offset, num_steps, eos_token_id, done,
+                              with_scores, target_ids)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_steps"))

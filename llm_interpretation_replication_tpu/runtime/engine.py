@@ -337,6 +337,15 @@ class ScoringEngine:
                 # generate does, regardless of where the scan hit); the first
                 # chunk doubles as the scored look-ahead when any row needs it.
                 #
+                # REDUCED scores: the scored chunk stacks per-step
+                # ReducedScores statistics (top-19 + logsumexp + target
+                # logits) instead of [B, steps, V] fp32 logits — everything
+                # the yes/no scan and the confidence leg read, ~1600x
+                # smaller.  The fp32 buffer (~580 MB at full-study sweep
+                # shapes) was what HBM-capped the sweep's batch at 224
+                # (runtime/plan.resolve_full_sweep_plan).  Falls back to
+                # full scores only for top_k beyond the kept candidates.
+                #
                 # COMPILE FAN-OUT (deliberate): each chunk concatenates its
                 # tail into the cache, so successive chunks see cache lengths
                 # T, T+10, T+20, ... and compile ~gen_total/steps (≈5)
@@ -349,6 +358,7 @@ class ScoringEngine:
                 # layout whose full-cache relayout loop cost 150-310 ms per
                 # batch (models/decoder.KVCache docstring).  Five cheap
                 # compiles beat a relayout per batch.
+                reduced = ecfg.top_k <= dmod.REDUCED_TOPK
                 prev, done, offset = last, None, 0
                 chunk_toks, scores_dev = [], None
                 lag_flag = None  # all-done flag of the PREVIOUS chunk
@@ -357,7 +367,9 @@ class ScoringEngine:
                     ws = offset == 0 and need_scores
                     toks, sc, cache, prev, done = dmod.decode_steps(
                         self.params, self.cfg, cache, prev, lengths,
-                        np.int32(offset), n, eos_id, done, with_scores=ws,
+                        np.int32(offset), n, eos_id, done,
+                        with_scores=("reduced" if reduced else True) if ws else False,
+                        target_ids=jnp.asarray(row_ids) if ws and reduced else None,
                     )
                     if ws:
                         scores_dev = sc
@@ -386,17 +398,26 @@ class ScoringEngine:
                     [np.asarray(t) for t in chunk_toks], axis=1
                 )
                 if need_scores:
-                    res = yn.yes_no_from_scores(
-                        scores_dev[:, :steps], row_ids[:, 0], row_ids[:, 1],
-                        max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                        valid_steps=yn.steps_until_eos(
-                            chunk_toks[0][:, :steps], eos_id
-                        ),
-                    )
+                    vsteps = yn.steps_until_eos(chunk_toks[0][:, :steps],
+                                                eos_id)
+                    if reduced:
+                        res = yn.yes_no_from_reduced(
+                            scores_dev.topk_vals[:, :steps],
+                            scores_dev.logz[:, :steps],
+                            scores_dev.target_logits[:, :steps],
+                            max_look_ahead=ecfg.max_look_ahead,
+                            top_k=ecfg.top_k, valid_steps=vsteps,
+                        )
+                    else:
+                        res = yn.yes_no_from_scores(
+                            scores_dev[:, :steps], row_ids[:, 0],
+                            row_ids[:, 1],
+                            max_look_ahead=ecfg.max_look_ahead,
+                            top_k=ecfg.top_k, valid_steps=vsteps,
+                        )
                     res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
                     if with_confidence:
-                        conf_lp, conf_idx = (np.asarray(a) for a in
-                                             _confidence_topk(scores_dev))
+                        conf_lp, conf_idx = self._conf_topk_np(scores_dev)
             elif need_scores:
                 # No completions wanted: scored decode only, and only for the
                 # undecided rows — gathered out of the prefill cache so the
@@ -426,15 +447,11 @@ class ScoringEngine:
                     min_steps=3 if with_confidence else 0,
                     real_mask=real,
                 )
-                res = yn.yes_no_from_scores(
-                    sc, ids_sub[:, 0], ids_sub[:, 1],
-                    max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                    valid_steps=yn.steps_until_eos(toks_s, eos_id),
-                )
+                res = self._scan_results(sc, ids_sub[:, 0], ids_sub[:, 1],
+                                         toks_s, eos_id)
                 res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
                 if with_confidence:
-                    conf_lp, conf_idx = (np.asarray(a) for a in
-                                         _confidence_topk(sc))
+                    conf_lp, conf_idx = self._conf_topk_np(sc)
 
             for r, orig in enumerate(batch.indices):
                 if orig < 0:
@@ -523,11 +540,8 @@ class ScoringEngine:
                     cache, last_f, jnp.sum(mask, axis=-1), steps, eos_id,
                     row_ids[:, 0], row_ids[:, 1], real_mask=valid,
                 )
-                res = yn.yes_no_from_scores(
-                    sc, row_ids[:, 0], row_ids[:, 1],
-                    max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                    valid_steps=yn.steps_until_eos(toks_s, eos_id),
-                )
+                res = self._scan_results(sc, row_ids[:, 0], row_ids[:, 1],
+                                         toks_s, eos_id)
                 res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
                 for r, orig in enumerate(batch.indices):
                     if orig < 0:
@@ -580,6 +594,26 @@ class ScoringEngine:
         pool.flush_all()
         return [r if r is not None else _error_row("missing") for r in results]
 
+    def _scan_results(self, sc, yes_ids, no_ids, toks, eos_id):
+        """Yes/no scan over a chunked decode's scores — full [m, P, V]
+        tensor or ReducedScores, whichever the decode produced."""
+        vsteps = yn.steps_until_eos(toks, eos_id)
+        if isinstance(sc, dmod.ReducedScores):
+            return yn.yes_no_from_reduced(
+                sc.topk_vals, sc.logz, sc.target_logits,
+                max_look_ahead=self.ecfg.max_look_ahead,
+                top_k=self.ecfg.top_k, valid_steps=vsteps)
+        return yn.yes_no_from_scores(
+            sc, yes_ids, no_ids, max_look_ahead=self.ecfg.max_look_ahead,
+            top_k=self.ecfg.top_k, valid_steps=vsteps)
+
+    def _conf_topk_np(self, sc):
+        """[m, 3, 19] (logprobs, ids) for the confidence leg, as numpy."""
+        if isinstance(sc, dmod.ReducedScores):
+            return (np.asarray(sc.topk_vals[:, :3] - sc.logz[:, :3, None]),
+                    np.asarray(sc.topk_ids[:, :3]))
+        return tuple(np.asarray(a) for a in _confidence_topk(sc))
+
     def _scan_decode_chunked(self, sub_cache, last_s, len_s, steps, eos_id,
                              yes_id, no_id, min_steps: int = 0,
                              real_mask: Optional[np.ndarray] = None):
@@ -592,10 +626,28 @@ class ScoringEngine:
 
         ``real_mask`` ([m] bool): rows outside the mask are padding
         (duplicates of other rows, or blank pool filler) and must not hold
-        the exit open.  Returns (scores [m, P, V], tokens [m, P]) with
-        P <= steps."""
+        the exit open.  Returns (scores, tokens [m, P]) with P <= steps;
+        ``scores`` is ReducedScores (the default — the [m, P, V] fp32
+        tensor never materializes) or the full tensor when ``top_k``
+        exceeds the kept candidates."""
         ecfg = self.ecfg
         chunk = max(1, ecfg.scan_chunk)
+        reduced = ecfg.top_k <= dmod.REDUCED_TOPK
+        target_ids = None
+        if reduced:
+            m = int(last_s.shape[0])
+            target_ids = jnp.stack(
+                [jnp.broadcast_to(jnp.asarray(yes_id), (m,)),
+                 jnp.broadcast_to(jnp.asarray(no_id), (m,))], axis=1
+            ).astype(jnp.int32)
+
+        def cat(parts):
+            if not reduced:
+                return jnp.concatenate(parts, axis=1)
+            return dmod.ReducedScores(*(
+                jnp.concatenate([getattr(p, f) for p in parts], axis=1)
+                for f in dmod.ReducedScores._fields))
+
         sc_parts, tok_parts = [], []
         cur_cache, prev, done = sub_cache, last_s, None
         offset = 0
@@ -603,7 +655,9 @@ class ScoringEngine:
             n = min(chunk, steps - offset)
             toks_c, sc_c, cur_cache, prev, done = dmod.decode_steps(
                 self.params, self.cfg, cur_cache, prev, len_s,
-                np.int32(offset), n, eos_id, done, with_scores=True,
+                np.int32(offset), n, eos_id, done,
+                with_scores="reduced" if reduced else True,
+                target_ids=target_ids,
             )
             sc_parts.append(sc_c)
             tok_parts.append(toks_c)
@@ -611,11 +665,20 @@ class ScoringEngine:
             if offset >= steps:
                 break
             toks_sofar = jnp.concatenate(tok_parts, axis=1)
-            part = yn.yes_no_from_scores(
-                jnp.concatenate(sc_parts, axis=1), yes_id, no_id,
-                max_look_ahead=offset, top_k=ecfg.top_k,
-                valid_steps=yn.steps_until_eos(toks_sofar, eos_id),
-            )
+            vsteps = yn.steps_until_eos(toks_sofar, eos_id)
+            if reduced:
+                sofar = cat(sc_parts)
+                part = yn.yes_no_from_reduced(
+                    sofar.topk_vals, sofar.logz, sofar.target_logits,
+                    max_look_ahead=offset, top_k=ecfg.top_k,
+                    valid_steps=vsteps,
+                )
+            else:
+                part = yn.yes_no_from_scores(
+                    jnp.concatenate(sc_parts, axis=1), yes_id, no_id,
+                    max_look_ahead=offset, top_k=ecfg.top_k,
+                    valid_steps=vsteps,
+                )
             # resolved = scan hit so far, or EOS actually emitted (the `done`
             # mask from decode_steps) — no later position can change the row
             resolved = np.asarray(part.found) | np.asarray(done)
@@ -623,8 +686,7 @@ class ScoringEngine:
                 resolved = resolved[real_mask]
             if offset >= min_steps and bool(resolved.all()):
                 break
-        return (jnp.concatenate(sc_parts, axis=1),
-                jnp.concatenate(tok_parts, axis=1))
+        return cat(sc_parts), jnp.concatenate(tok_parts, axis=1)
 
     def _score_encdec(self, prompts, targets, with_confidence,
                   max_new_tokens=None) -> List[Dict]:
@@ -910,18 +972,37 @@ class _Phase2Pool:
         # (cProfile, r5) — and then restarted the pipeline empty.  Decoding
         # all ``steps`` positions costs ~100 ms more device time per flush
         # (weight-streaming-bound) but never reads the early-exit flag, so
-        # the launch loop keeps feeding the device.  The [m, steps, V]
-        # score tensor is consumed on device by yes_no_from_scores and
-        # freed; only [m]-sized outputs wait in the deferred list.
-        toks, sc, _, _, _ = dmod.decode_steps(
-            self.engine.params, self.engine.cfg, cache, last, lens,
-            np.int32(0), self.steps, self.eos_id, None, with_scores=True,
-        )
-        res = yn.yes_no_from_scores(
-            sc, ids[:, 0], ids[:, 1],
-            max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-            valid_steps=yn.steps_until_eos(toks, self.eos_id),
-        )
+        # the launch loop keeps feeding the device.  The decode stacks
+        # ReducedScores statistics in-scan (top-19 + logsumexp + target
+        # logits) — the [m, steps, V] fp32 tensor this path used to
+        # materialize between the decode and the reduction (~1.3 GB at the
+        # 512-row menu cap) is what OOM'd sweep batches 320/384 in r4;
+        # only [m]-sized outputs wait in the deferred list.
+        if ecfg.top_k <= dmod.REDUCED_TOPK:
+            # ReducedScores: the decode stacks per-step top-19 + logsumexp +
+            # target-logit statistics instead of the [m, steps, V] fp32
+            # tensor (~1.3 GB at the 512-row menu cap) that used to live
+            # between the decode and the reduction programs.
+            toks, sc, _, _, _ = dmod.decode_steps(
+                self.engine.params, self.engine.cfg, cache, last, lens,
+                np.int32(0), self.steps, self.eos_id, None,
+                with_scores="reduced", target_ids=jnp.asarray(ids),
+            )
+            res = yn.yes_no_from_reduced(
+                sc.topk_vals, sc.logz, sc.target_logits,
+                max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                valid_steps=yn.steps_until_eos(toks, self.eos_id),
+            )
+        else:
+            toks, sc, _, _, _ = dmod.decode_steps(
+                self.engine.params, self.engine.cfg, cache, last, lens,
+                np.int32(0), self.steps, self.eos_id, None, with_scores=True,
+            )
+            res = yn.yes_no_from_scores(
+                sc, ids[:, 0], ids[:, 1],
+                max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                valid_steps=yn.steps_until_eos(toks, self.eos_id),
+            )
         fields = res._asdict()
         for v in fields.values():
             try:

@@ -35,6 +35,9 @@ HBM_BYTES_V5E = 16 << 30
 #: buffers, fragmentation, transfer staging).  0.75 GiB separates the
 #: measured-fitting configs from the measured-OOM ones.
 RESERVE_BYTES = 3 << 28
+#: Extra head-room the FULL-STUDY (completions) path needs beyond the
+#: reserve before allocator thrash sets in — see resolve_full_sweep_plan.
+THRASH_HEADROOM_BYTES = 1 << 28
 
 
 def param_count(cfg) -> int:
@@ -75,20 +78,38 @@ def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
 
 def completions_extra_bytes(cfg, batch: int, seq: int,
                             gen_tokens: int = 50, score_steps: int = 10,
-                            pipeline_depth: int = 2) -> int:
+                            pipeline_depth: int = 2,
+                            reduced_scores: bool = True) -> int:
     """Extra live set of the FULL-STUDY row contract (decode_completions +
-    confidence): each in-flight pipelined batch pins one full bf16 KV cache
-    grown to seq+gen_tokens slots plus the fp32 [B, steps, V] score buffer;
-    the chunked generate's cache concat makes old+new cache coexist
-    transiently (one extra cache); and the confidence leg's in-place
-    full-batch scored decode holds its own cache + score buffer besides the
-    in-flight binary-leg batches.  Calibrated against the measured v5e
-    anchors: int8 falcon-7b sweep-full at batch 256 / 256-token bucket /
-    depth 2 OOMs mid-sweep; batch 192 fits."""
-    cache = (cfg.num_layers * batch * (seq + gen_tokens)
-             * cfg.num_kv_heads * cfg.head_dim * 2 * 2)      # bf16, k+v
-    scores = batch * score_steps * cfg.vocab_size * 4        # fp32
-    return pipeline_depth * (cache + scores) + 2 * cache + scores
+    confidence), per in-flight pipelined batch: the prefill-output bf16 KV
+    cache at the bucket length, the cache grown to seq+gen_tokens by the
+    completion chunks' concats (old + new coexist transiently, so BOTH
+    count twice), and the fp32 [B, V] next-token logits.  The scored chunk
+    stacks only ``models.decoder.ReducedScores`` statistics (~B*steps*41
+    floats — a rounding error here), NOT the fp32 [B, steps, V] buffer the
+    r4 engine pinned (~580 MB per in-flight batch at sweep shapes).
+
+    Calibrated against the measured v5e 10k-corpus anchors (reduced-score
+    engine, int8 falcon-7b, 256-token worst bucket, depth 2): batch 224
+    fits and is the measured optimum (31.4 rows/s warm); 240 still runs
+    but thrashes near the HBM edge (14.1 rows/s warm — allocator
+    pressure); 256 OOMs mid-sweep.  The terms put 240 just past the
+    budget, so requests above the boundary clamp to 224."""
+    cache_b = (cfg.num_layers * batch * seq
+               * cfg.num_kv_heads * cfg.head_dim * 2 * 2)    # bf16, k+v
+    cache_g = (cfg.num_layers * batch * (seq + gen_tokens)
+               * cfg.num_kv_heads * cfg.head_dim * 2 * 2)
+    logits = batch * cfg.vocab_size * 4                      # fp32 [B, V]
+    if reduced_scores:
+        scores = batch * score_steps * 41 * 4                # ReducedScores
+    else:
+        # Engines configured with top_k beyond ReducedScores' kept
+        # candidates (models.decoder.REDUCED_TOPK) fall back to stacking
+        # the full fp32 [B, steps, V] tensor per in-flight batch — the r4
+        # live set.  Callers must pass reduced_scores=False for that
+        # configuration or the plan under-reserves by ~580 MB per batch.
+        scores = batch * score_steps * cfg.vocab_size * 4
+    return pipeline_depth * (2 * (cache_b + cache_g) + logits + scores)
 
 
 @dataclasses.dataclass
@@ -151,16 +172,30 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
                             gen_tokens: int = 50, score_steps: int = 10,
                             pipeline_depth: int = 2,
                             hbm_bytes: int = HBM_BYTES_V5E,
-                            requested_impl: Optional[str] = None
-                            ) -> ScoringPlan:
+                            requested_impl: Optional[str] = None,
+                            top_k: Optional[int] = None) -> ScoringPlan:
     """Route the FULL-STUDY sweep (binary leg with completions + confidence
     leg): resolve the attention impl like a binary sweep, then shrink the
     batch (steps of 32) until the live set INCLUDING the completion path's
-    pinned caches and score buffers (completions_extra_bytes) fits."""
+    pinned caches and score buffers (completions_extra_bytes) fits.
+
+    ``top_k``: the engine's scan top-k, when known — a value beyond
+    ReducedScores' kept candidates makes the engine stack full fp32
+    score tensors, which this plan must budget for (None assumes the
+    default reduced path)."""
+    from ..models.decoder import REDUCED_TOPK
+
+    reduced_scores = top_k is None or top_k <= REDUCED_TOPK
     base = resolve_scoring_plan(cfg, quant, batch, seq, hbm_bytes,
                                 requested_impl)
     wb = base.weight_bytes
-    budget = hbm_bytes - RESERVE_BYTES
+    # The completions path churns large short-lived buffers (chunk concats,
+    # per-chunk caches), so running AT the budget edge thrashes the
+    # allocator instead of OOMing cleanly: batch 240 at the 256-token
+    # bucket measured 14.1 rows/s warm vs 224's 31.4 on identical code —
+    # slower than the smaller batch it would replace.  Keep a quarter-GiB
+    # of allocator working space beyond the ordinary reserve.
+    budget = hbm_bytes - RESERVE_BYTES - THRASH_HEADROOM_BYTES
 
     def need(b):
         attn = (flash_workspace_bytes(cfg, b, seq)
@@ -168,7 +203,8 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
                 else dense_attention_bytes(cfg, b, seq))
         return (wb + attn + activation_bytes(cfg, b, seq)
                 + completions_extra_bytes(cfg, b, seq, gen_tokens,
-                                          score_steps, pipeline_depth))
+                                          score_steps, pipeline_depth,
+                                          reduced_scores))
 
     b = min(batch, base.batch)
     if need(b) > budget:
@@ -179,7 +215,7 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
         return base
     return ScoringPlan(
         base.attention_impl, b, base.fits_dense, wb,
-        f"full-study row contract pins {completions_extra_bytes(cfg, b, seq, gen_tokens, score_steps, pipeline_depth) / 2**30:.1f} GiB "
+        f"full-study row contract pins {completions_extra_bytes(cfg, b, seq, gen_tokens, score_steps, pipeline_depth, reduced_scores) / 2**30:.1f} GiB "
         f"of completion caches/scores at depth {pipeline_depth}; "
         f"batch {batch} -> {b} to fit {budget / 2**30:.1f} GiB",
     )

@@ -20,6 +20,7 @@ from .yes_no import (
     relative_prob_first_token,
     steps_until_eos,
     target_token_ids,
+    yes_no_from_reduced,
     yes_no_from_scores,
 )
 
@@ -41,5 +42,6 @@ __all__ = [
     "relative_prob_first_token",
     "steps_until_eos",
     "target_token_ids",
+    "yes_no_from_reduced",
     "yes_no_from_scores",
 ]

@@ -65,6 +65,46 @@ def yes_no_from_scores(
     return YesNoResult(yes, no, relative, odds, found, sel)
 
 
+@functools.partial(jax.jit, static_argnames=("max_look_ahead", "top_k"))
+def yes_no_from_reduced(
+    topk_vals: jnp.ndarray,      # [B, P, K] fp32 top-K logits, descending
+    logz: jnp.ndarray,           # [B, P] fp32 logsumexp over the vocab
+    target_logits: jnp.ndarray,  # [B, P, 2] fp32 logits at (yes_id, no_id)
+    max_look_ahead: int = 10,
+    top_k: int = 5,
+    valid_steps=None,
+) -> YesNoResult:
+    """:func:`yes_no_from_scores` on ``models.decoder.ReducedScores``
+    statistics instead of the full [B, P, V] score tensor.
+
+    Same scan semantics: top-k membership compares raw logits against the
+    k-th largest logit (softmax is strictly monotone per row, so the
+    membership set is identical to the probability comparison), and the
+    probabilities are ``exp(logit - logsumexp)`` — the same quantity
+    ``softmax`` computes, differing only in float summation order.
+    Requires ``top_k <= K``.
+    """
+    b, p, k = topk_vals.shape
+    if top_k > k:
+        raise ValueError(f"top_k={top_k} > {k} kept candidates")
+    p_yes = jnp.exp(target_logits[..., 0] - logz)   # [B,P]
+    p_no = jnp.exp(target_logits[..., 1] - logz)
+    kth = topk_vals[..., top_k - 1]                 # [B,P]
+    look = min(max_look_ahead, p)
+    hit = ((target_logits[..., 0] >= kth) | (target_logits[..., 1] >= kth))[:, :look]
+    if valid_steps is not None:
+        hit = hit & (jnp.arange(look)[None, :] < valid_steps[:, None])
+    found = jnp.any(hit, axis=1)
+    first = jnp.argmax(hit, axis=1).astype(jnp.int32)
+    sel = jnp.where(found, first, 0)
+    yes = jnp.take_along_axis(p_yes, sel[:, None], axis=1)[:, 0]
+    no = jnp.take_along_axis(p_no, sel[:, None], axis=1)[:, 0]
+    total = yes + no
+    relative = jnp.where(total > 0, yes / jnp.where(total > 0, total, 1.0), 0.5)
+    odds = jnp.where(no > 0, yes / jnp.where(no > 0, no, 1.0), jnp.inf)
+    return YesNoResult(yes, no, relative, odds, found, sel)
+
+
 def steps_until_eos(tokens: jnp.ndarray, eos_id) -> jnp.ndarray:
     """[B, P] greedy tokens → [B] scan-visible position count.
 

@@ -139,6 +139,18 @@ def run_model_perturbation_sweep(
             f"{scenario['original_main'][:50]!r}...")
         todo_items.extend((scenario, r) for r in todo)
 
+    # Foreign engines with the older score_prompts signature keep working:
+    # the confidence cap kwarg is only passed when the signature names it
+    # or accepts **kwargs (probed once, outside the chunk loop).
+    import inspect
+
+    try:
+        params = inspect.signature(engine.score_prompts).parameters
+        takes_cap = ("max_new_tokens" in params
+                     or any(p.kind == p.VAR_KEYWORD for p in params.values()))
+    except (TypeError, ValueError):
+        takes_cap = True
+
     for start in range(0, len(todo_items), score_chunk):
         chunk = todo_items[start:start + score_chunk]
         targets = [list(s["target_tokens"]) for s, _ in chunk]
@@ -176,15 +188,7 @@ def run_model_perturbation_sweep(
             # confidence reads only the first 3 positions — while a 50-token
             # generate would spend 5x the decode on text nothing consumes.
             # (Measured: 26.6 -> 29.0 full-study rows/s on the 10k corpus.)
-            # Foreign engines with the older score_prompts signature keep
-            # working: the kwarg is only passed when accepted (0 disables).
-            import inspect
-
-            try:
-                takes_cap = ("max_new_tokens" in
-                             inspect.signature(engine.score_prompts).parameters)
-            except (TypeError, ValueError):
-                takes_cap = True
+            # 0 disables the cap; takes_cap is the signature probe above.
             cap_kw = ({"max_new_tokens": confidence_max_new_tokens}
                       if confidence_max_new_tokens and takes_cap else {})
             conf_rows = engine.score_prompts(

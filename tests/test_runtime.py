@@ -227,6 +227,27 @@ class TestScoringEngine:
             ).strip()[:100]
             assert row["completion"] == ref, (prompt, row["completion"], ref)
 
+    def test_reduced_scores_match_full_score_branch(self, monkeypatch):
+        """The completions path defaults to ReducedScores (top-19 + logsumexp
+        + target logits stacked in-scan) instead of the [B, steps, V] fp32
+        buffer; forcing the full-score branch (top_k above the kept
+        candidates) must yield identical rows — probabilities, completions,
+        and the confidence leg."""
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"prompt {i} about soup and tweets" for i in range(6)]
+        rows_reduced = eng.score_prompts(prompts, with_confidence=True)
+        monkeypatch.setattr(dmod, "REDUCED_TOPK", 0)  # force full scores
+        rows_full = eng.score_prompts(prompts, with_confidence=True)
+        for a, b in zip(rows_reduced, rows_full):
+            assert a["completion"] == b["completion"]
+            assert a["success"] == b["success"]
+            for f in ("yes_prob", "no_prob", "relative_prob",
+                      "weighted_confidence"):
+                np.testing.assert_allclose(a[f], b[f], rtol=1e-5, atol=1e-7,
+                                           err_msg=f)
+
     def test_two_phase_matches_full_decode_probs(self):
         """decode_completions=False takes the early-exit subset path; its
         probabilities must equal the completions path (which scores every
@@ -342,17 +363,25 @@ class TestScoringEngine:
         assert not plan_t.fits_dense and plan_t.batch == 1
 
         # FULL-STUDY planning (completions + confidence): the pinned KV
-        # caches and score buffers shrink the sweep batch — v5e anchors:
-        # int8 falcon-7b at the 256-token sweep bucket OOMs at batch 256
-        # (measured mid-sweep, r5) and must clamp below it; 192 fits and
-        # must NOT clamp; the binary-leg plan at 256 stays unclamped.
+        # caches shrink the sweep batch.  v5e 10k-corpus anchors with the
+        # ReducedScores engine (r5): int8 falcon-7b at the 256-token worst
+        # bucket fits at batch 224 (31.4 rows/s warm, the measured
+        # optimum); 240 thrashes the allocator (14.1 rows/s warm) and 256
+        # OOMs mid-sweep, so both clamp to 224; 192 fits and must NOT
+        # clamp; the binary-leg plan at 256 stays unclamped.
         from llm_interpretation_replication_tpu.runtime.plan import (
             resolve_full_sweep_plan,
         )
 
         full = resolve_full_sweep_plan(falcon7b, "int8", 256, 256,
                                        pipeline_depth=2)
-        assert full.batch < 256 and full.attention_impl == "xla"
+        assert full.batch == 224 and full.attention_impl == "xla"
+        full240 = resolve_full_sweep_plan(falcon7b, "int8", 240, 256,
+                                          pipeline_depth=2)
+        assert full240.batch == 224
+        full224 = resolve_full_sweep_plan(falcon7b, "int8", 224, 256,
+                                          pipeline_depth=2)
+        assert full224.batch == 224
         full192 = resolve_full_sweep_plan(falcon7b, "int8", 192, 256,
                                           pipeline_depth=2)
         assert full192.batch == 192

@@ -78,6 +78,54 @@ class TestYesNoScan:
         res = yes_no_from_scores(jnp.asarray(scores), 2, 3, max_look_ahead=1)
         assert np.isinf(float(res.odds_ratio[0]))
 
+    def test_reduced_statistics_match_full_scores(self):
+        """yes_no_from_reduced on decoder._reduce_step_scores statistics must
+        reproduce yes_no_from_scores on the full [B, P, V] tensor — same
+        found/position bits exactly, same probabilities to float tolerance —
+        including per-row target ids and the EOS valid-steps cutoff."""
+        import jax
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+        from llm_interpretation_replication_tpu.scoring import (
+            steps_until_eos, yes_no_from_reduced)
+
+        rng = np.random.default_rng(7)
+        B, P, V = 16, 10, 80
+        scores = rng.standard_normal((B, P, V)).astype(np.float32) * 4
+        yes_ids = rng.integers(0, V, B).astype(np.int32)
+        no_ids = rng.integers(0, V, B).astype(np.int32)
+        tokens = rng.integers(0, V, (B, P)).astype(np.int32)
+        vs = steps_until_eos(jnp.asarray(tokens), eos_id=3)
+
+        tgt = np.stack([yes_ids, no_ids], axis=1)
+        red = jax.vmap(dmod._reduce_step_scores, in_axes=(1, None),
+                       out_axes=(1, 1, 1, 1))(jnp.asarray(scores),
+                                              jnp.asarray(tgt))
+        vals, ids, logz, tlog = red
+        for top_k in (2, 5):
+            full = yes_no_from_scores(
+                jnp.asarray(scores), yes_ids, no_ids, top_k=top_k,
+                valid_steps=vs)
+            reduced = yes_no_from_reduced(
+                vals, logz, tlog, top_k=top_k, valid_steps=vs)
+            np.testing.assert_array_equal(np.asarray(full.found),
+                                          np.asarray(reduced.found))
+            np.testing.assert_array_equal(np.asarray(full.position),
+                                          np.asarray(reduced.position))
+            for f in ("yes_prob", "no_prob", "relative_prob"):
+                np.testing.assert_allclose(
+                    np.asarray(getattr(full, f)),
+                    np.asarray(getattr(reduced, f)), rtol=1e-5)
+        # the kept candidates also ARE the confidence leg's top-19 contract
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            _confidence_topk)
+        clp, cidx = _confidence_topk(jnp.asarray(scores))
+        np.testing.assert_array_equal(np.asarray(cidx),
+                                      np.asarray(ids[:, :3, :]))
+        np.testing.assert_allclose(
+            np.asarray(clp),
+            np.asarray(vals[:, :3, :] - logz[:, :3, None]), rtol=1e-5,
+            atol=1e-6)
+
     def test_eos_truncates_scan_like_hf_generate(self):
         """HF generate stops at EOS, so the reference's scores list ends at
         the eos-emitting position; batched decode keeps forced-EOS positions
