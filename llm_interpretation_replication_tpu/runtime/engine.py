@@ -398,23 +398,12 @@ class ScoringEngine:
                     [np.asarray(t) for t in chunk_toks], axis=1
                 )
                 if need_scores:
-                    vsteps = yn.steps_until_eos(chunk_toks[0][:, :steps],
-                                                eos_id)
-                    if reduced:
-                        res = yn.yes_no_from_reduced(
-                            scores_dev.topk_vals[:, :steps],
-                            scores_dev.logz[:, :steps],
-                            scores_dev.target_logits[:, :steps],
-                            max_look_ahead=ecfg.max_look_ahead,
-                            top_k=ecfg.top_k, valid_steps=vsteps,
-                        )
-                    else:
-                        res = yn.yes_no_from_scores(
-                            scores_dev[:, :steps], row_ids[:, 0],
-                            row_ids[:, 1],
-                            max_look_ahead=ecfg.max_look_ahead,
-                            top_k=ecfg.top_k, valid_steps=vsteps,
-                        )
+                    sc_steps = (
+                        dmod.ReducedScores(*(f[:, :steps] for f in scores_dev))
+                        if reduced else scores_dev[:, :steps])
+                    res = self._scan_results(
+                        sc_steps, row_ids[:, 0], row_ids[:, 1],
+                        chunk_toks[0][:, :steps], eos_id)
                     res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
                     if with_confidence:
                         conf_lp, conf_idx = self._conf_topk_np(scores_dev)
@@ -978,31 +967,19 @@ class _Phase2Pool:
         # materialize between the decode and the reduction (~1.3 GB at the
         # 512-row menu cap) is what OOM'd sweep batches 320/384 in r4;
         # only [m]-sized outputs wait in the deferred list.
-        if ecfg.top_k <= dmod.REDUCED_TOPK:
-            # ReducedScores: the decode stacks per-step top-19 + logsumexp +
-            # target-logit statistics instead of the [m, steps, V] fp32
-            # tensor (~1.3 GB at the 512-row menu cap) that used to live
-            # between the decode and the reduction programs.
-            toks, sc, _, _, _ = dmod.decode_steps(
-                self.engine.params, self.engine.cfg, cache, last, lens,
-                np.int32(0), self.steps, self.eos_id, None,
-                with_scores="reduced", target_ids=jnp.asarray(ids),
-            )
-            res = yn.yes_no_from_reduced(
-                sc.topk_vals, sc.logz, sc.target_logits,
-                max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                valid_steps=yn.steps_until_eos(toks, self.eos_id),
-            )
-        else:
-            toks, sc, _, _, _ = dmod.decode_steps(
-                self.engine.params, self.engine.cfg, cache, last, lens,
-                np.int32(0), self.steps, self.eos_id, None, with_scores=True,
-            )
-            res = yn.yes_no_from_scores(
-                sc, ids[:, 0], ids[:, 1],
-                max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
-                valid_steps=yn.steps_until_eos(toks, self.eos_id),
-            )
+        # ReducedScores (default): the decode stacks per-step top-19 +
+        # logsumexp + target-logit statistics instead of the [m, steps, V]
+        # fp32 tensor (~1.3 GB at the 512-row menu cap) that used to live
+        # between the decode and the reduction programs.
+        reduced = ecfg.top_k <= dmod.REDUCED_TOPK
+        toks, sc, _, _, _ = dmod.decode_steps(
+            self.engine.params, self.engine.cfg, cache, last, lens,
+            np.int32(0), self.steps, self.eos_id, None,
+            with_scores="reduced" if reduced else True,
+            target_ids=jnp.asarray(ids) if reduced else None,
+        )
+        res = self.engine._scan_results(sc, ids[:, 0], ids[:, 1], toks,
+                                        self.eos_id)
         fields = res._asdict()
         for v in fields.values():
             try:
