@@ -617,10 +617,11 @@ def main():
                         help="sweep mode engine batch size (real prompts "
                              "are ~107 tokens so a larger batch than the "
                              "430-token parity mode fits; measured 2026-07 "
-                             "r5: 320 runs at 120.5 p/s warm — the pooled "
-                             "decode's ReducedScores statistics replaced "
-                             "the [batch, 10, V] fp32 score buffer that "
-                             "used to OOM 320 — and 384 still OOMs)")
+                             "r5: 320 runs at 120.5-120.9 p/s warm — the "
+                             "pooled decode's ReducedScores statistics "
+                             "replaced the [batch, 10, V] fp32 score "
+                             "buffer that used to OOM 320 — while 352 and "
+                             "384 still OOM)")
     parser.add_argument("--sweep-rows", type=int, default=0, metavar="N",
                         help="sweep mode: cap total rows (0 = full 10k)")
     parser.add_argument("--sweep-repeats", type=int, default=2, metavar="N",
