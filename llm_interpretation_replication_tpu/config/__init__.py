@@ -31,6 +31,16 @@ from typing import List, Optional, Sequence
 DEFAULT_BUCKETS = (64, 80, 96, 112, 128, 144, 160, 176, 192, 208, 224, 240,
                    256, 320, 384, 416, 432, 448,
                    512, 640, 768, 1024, 1536, 2048)
+# Step 16 is the FINEST menu every attention path accepts: the Pallas
+# grouped/flash kernels require S % 16 == 0 (ops/attention.py dispatch),
+# so a step-8 hot zone would silently drop the bf16 flash escape hatch
+# (the ONLY working bf16-7B path) to dense attention and OOM.  Step 8 was
+# measured anyway on the int8/dense sweep (r5): padding x1.093 vs x1.129,
+# but only ~+0.5-1% e2e (121.4 vs 120.5-120.9 p/s warm at batch 320) —
+# saved padding converts sublinearly because shorter buckets also lower
+# per-token device efficiency in the short-seq regime (PARITY.md MFU
+# table: the MLP fusion epilogue amortizes over rows-per-tile).  Tested
+# and rejected: the invariant is worth more than the half-percent.
 
 _ASSETS = os.path.join(os.path.dirname(os.path.dirname(os.path.abspath(__file__))), "data_assets")
 
