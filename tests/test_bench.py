@@ -1,0 +1,153 @@
+"""Control-flow tests for bench.py's shared-chip OOM resilience.
+
+The e2e sweep's batch-320 operating point sits near the HBM edge and the
+real chip is shared: a co-tenant's allocation can RESOURCE_EXHAUST a
+repeat that ran clean three times (observed 2026-07).  The driver records
+the bench's single JSON line every round, so a mid-repeat OOM must never
+sink the whole record: with an earlier successful repeat the failed one
+is skipped (best-of over successes); with none, the batch steps down once
+and the repeat retries.  These tests drive run_sweep_mode on a tiny CPU
+model with a fault-injected engine to pin both branches.
+"""
+
+import argparse
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from llm_interpretation_replication_tpu.models.decoder import (  # noqa: E402
+    DecoderConfig,
+)
+from llm_interpretation_replication_tpu.runtime.engine import (  # noqa: E402
+    ScoringEngine,
+)
+
+TINY = dict(
+    vocab_size=300, hidden_size=32, num_layers=2, num_heads=4,
+    intermediate_size=64, parallel_residual=True, qkv_bias=True,
+    out_bias=True, mlp_bias=True, position_embedding="rotary",
+    rotary_pct=0.25, max_position_embeddings=512,
+)
+
+
+def _scenarios_file(tmp_path, rephrasings=6):
+    scenarios = [{
+        "original_main": "Is soup a beverage?",
+        "response_format": "Answer only 'Yes' or 'No'.",
+        "confidence_format": "How confident are you (0-100)?",
+        "target_tokens": ["Yes", "No"],
+        "rephrasings": [f"Is soup number {i} a beverage?"
+                        for i in range(rephrasings)],
+    }]
+    path = tmp_path / "perturbations.json"
+    path.write_text(json.dumps(scenarios))
+    return str(path)
+
+
+def _args(tmp_path, batch):
+    return argparse.Namespace(
+        model="tiny", quant="none", sweep_batch=batch, sweep_rows=0,
+        sweep_repeats=2, pool_target=0, pipeline_depth=2,
+        checkpoint_every=100, sweep_out=str(tmp_path / "out.xlsx"),
+        decided_frac=0.9, perturbations=_scenarios_file(tmp_path),
+    )
+
+
+def _fault_injector(monkeypatch, fail_on_calls):
+    """Make ScoringEngine.score_prompts raise a fake RESOURCE_EXHAUSTED on
+    the given full-sweep call numbers (1-based), delegating otherwise."""
+    real = ScoringEngine.score_prompts
+    state = {"calls": 0}
+
+    def wrapper(self, prompts, **kw):
+        state["calls"] += 1
+        if state["calls"] in fail_on_calls:
+            raise RuntimeError("RESOURCE_EXHAUSTED: TPU backend error (fake)")
+        return real(self, prompts, **kw)
+
+    monkeypatch.setattr(ScoringEngine, "score_prompts", wrapper)
+    return state
+
+
+def test_is_oom_matches_every_spelling():
+    for s in ("RESOURCE_EXHAUSTED: TPU backend error",
+              "jax.errors.JaxRuntimeError: ResourceExhausted",
+              "Resource exhausted: Out of memory allocating 1 bytes"):
+        assert bench._is_oom(RuntimeError(s)), s
+    assert not bench._is_oom(ValueError("shape mismatch"))
+
+
+def test_sweep_oom_with_prior_success_keeps_best(tmp_path, monkeypatch):
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=8)
+    state = _fault_injector(monkeypatch, fail_on_calls={2})
+    pps, rate, out = bench.run_sweep_mode(args, cfg, params)
+    assert state["calls"] == 2          # repeat 1 failed and was skipped
+    assert pps > 0 and np.isfinite(pps)
+    assert args.sweep_batch == 8        # no fallback: a repeat had succeeded
+    assert os.path.exists(out)
+
+
+def test_sweep_oom_without_success_steps_batch_down(tmp_path, monkeypatch):
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=320)
+    state = _fault_injector(monkeypatch, fail_on_calls={1})
+    pps, rate, out = bench.run_sweep_mode(args, cfg, params)
+    # first call OOM'd with no prior success -> batch fell back to 256 and
+    # the repeat retried; both budgeted repeats then completed
+    assert args.sweep_batch == 256
+    assert state["calls"] == 3
+    assert pps > 0 and np.isfinite(pps)
+
+
+def test_sweep_oom_at_floor_reraises(tmp_path, monkeypatch):
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=256)
+    args.sweep_repeats = 1
+    _fault_injector(monkeypatch, fail_on_calls={1})
+    with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+        bench.run_sweep_mode(args, cfg, params)
+
+
+def test_sweep_full_oom_steps_batch_down_and_keeps_workbook(tmp_path,
+                                                           monkeypatch):
+    """The full-study mode shares _sweep_oom_action (step -32, floor 192)
+    and must return the last SUCCESSFUL repeat's workbook path even though
+    every repeat re-measures from scratch."""
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=320)
+    args.sweep_out = None               # per-repeat tmpdirs: successes stay
+    state = _fault_injector(monkeypatch, fail_on_calls={1})
+    rps, rate, out = bench.run_sweep_full_mode(args, cfg, params)
+    assert args.sweep_batch == 288      # one -32 step, not a flat 256
+    # per repeat the shell calls score_prompts twice (binary + confidence):
+    # failed attempt (1) + retried repeat 0 (2,3) + repeat 1 (4,5)
+    assert state["calls"] == 5
+    assert rps > 0 and np.isfinite(rps)
+    assert out and os.path.exists(out)
+
+
+def test_non_oom_errors_propagate(tmp_path, monkeypatch):
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=320)
+
+    def boom(self, prompts, **kw):
+        raise ValueError("something unrelated")
+
+    monkeypatch.setattr(ScoringEngine, "score_prompts", boom)
+    with pytest.raises(ValueError, match="unrelated"):
+        bench.run_sweep_mode(args, cfg, params)
