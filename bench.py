@@ -329,44 +329,35 @@ def _calibrate_decided_rate(params, cfg, engine, scenarios, prompts_by_scenario,
 
 
 def _is_oom(err) -> bool:
-    """Device out-of-memory, across the spellings the stack produces:
-    'RESOURCE_EXHAUSTED' (status code), 'ResourceExhausted' (class name),
-    'Resource exhausted: Out of memory' (absl status text)."""
-    s = str(err).lower().replace("_", "").replace(" ", "")
-    return "resourceexhausted" in s
+    """Device out-of-memory — delegates to the shared fault-tolerance layer
+    (runtime/faults.is_oom), which this bench's r5 private copy grew into."""
+    from llm_interpretation_replication_tpu.runtime.faults import is_oom
+
+    return is_oom(err)
 
 
 def _sweep_oom_action(err, args, engine, rep, had_success, floor,
                       fallback, label):
-    """Shared skip-or-step-down policy for a mid-repeat device OOM.
-
-    The sweep operating points sit near the HBM edge and the chip is
-    SHARED: a co-tenant's allocation can RESOURCE_EXHAUST a repeat that
-    ran clean three times (observed 2026-07: repeat 0 at 110 s, repeat 1
-    ResourceExhausted).  The driver records this bench's single JSON line
-    every round, so a flaky OOM must never sink the whole record.
-
-    Returns "skip" (an earlier repeat succeeded: keep best-of) or
-    "retry" (no success yet: batch stepped down via ``fallback``);
-    re-raises for non-OOM errors or when already at ``floor``.
-    """
+    """Skip-or-step-down policy for a mid-repeat device OOM — the shared
+    policy in runtime/faults.sweep_oom_action (pure over the batch size),
+    with this bench's state application: on "retry" the stepped-down batch
+    lands in ``args.sweep_batch`` and the engine's batch_size.  Returns
+    "skip" (an earlier repeat succeeded: keep best-of) or "retry";
+    re-raises non-OOM errors and OOM at ``floor``.  Messages carry the
+    truncated error text so a misclassified RESOURCE_EXHAUSTED (RPC/quota
+    vs HBM) leaves a diagnostic trail."""
     import dataclasses as dc
 
-    if not _is_oom(err):
-        raise err
-    if had_success:
-        print(f"# {label} repeat {rep}: device OOM (shared chip); "
-              f"keeping earlier repeat(s)", file=sys.stderr)
-        return "skip"
-    if args.sweep_batch > floor:
-        new_batch = max(floor, fallback(args.sweep_batch))
-        print(f"# {label} repeat {rep}: device OOM at batch "
-              f"{args.sweep_batch}; falling back to {new_batch}",
-              file=sys.stderr)
+    from llm_interpretation_replication_tpu.runtime.faults import (
+        sweep_oom_action,
+    )
+
+    action, new_batch = sweep_oom_action(err, args.sweep_batch, rep,
+                                         had_success, floor, fallback, label)
+    if action == "retry":
         args.sweep_batch = new_batch
         engine.ecfg = dc.replace(engine.ecfg, batch_size=new_batch)
-        return "retry"
-    raise err
+    return action
 
 
 def run_sweep_mode(args, cfg, params):
@@ -414,6 +405,10 @@ def run_sweep_mode(args, cfg, params):
             batch_size=args.sweep_batch, decode_completions=False,
             phase2_pool_target=args.pool_target,
             pipeline_depth=args.pipeline_depth,
+            # the bench MEASURES an operating point: a mid-repeat OOM must
+            # step the whole repeat down the ladder visibly (below), never
+            # degrade single batches silently inside the engine
+            oom_backoff=False,
         ),
     )
     lens = [len(ids) for ids in tok([p for ps in prompts_by_scenario for p in ps])["input_ids"]]
@@ -437,14 +432,17 @@ def run_sweep_mode(args, cfg, params):
 
     def flush(final=False):
         # The sweep shells' append-only checkpoint (sweeps/perturbation.py):
-        # each flush APPENDS its rows to the side-log in O(new rows); the
+        # each flush APPENDS its rows to the side-log in O(new rows),
+        # fsync'd for crash consistency like the real sweep shell; the
         # xlsx renders once, at end of sweep.  The r04 rewrite-the-workbook
         # flush cost a measured 3.7-4.6 s tail over the 10k sweep.
         nonlocal pending
         if pending:
-            with open(sidelog, "a") as f:
-                for row in pending:
-                    f.write(jsonlib.dumps(row) + "\n")
+            from llm_interpretation_replication_tpu.utils.checkpoint import (
+                append_jsonl,
+            )
+
+            append_jsonl(sidelog, pending)
             all_rows.extend(pending)
             pending = []
         if final:
@@ -471,11 +469,22 @@ def run_sweep_mode(args, cfg, params):
         try:
             rows = engine.score_prompts(all_prompts, targets=all_targets)
         except Exception as err:
-            # flat fallback to 256, the other fully-measured operating
-            # point (112 p/s) — intermediate batches are unmeasured
+            # step through the MEASURED ladder (384/352 -> 320 -> 256,
+            # runtime/faults.MEASURED_SWEEP_LADDER): 320 is a fully-
+            # measured operating point (120.5-120.9 p/s warm), so a
+            # user-requested 352/384 that OOMs lands there before
+            # falling to 256 (111.8-112.1 p/s)
+            from llm_interpretation_replication_tpu.runtime.faults import (
+                MEASURED_SWEEP_LADDER,
+                next_batch_down,
+            )
+
             action = _sweep_oom_action(
                 err, args, engine, rep, best_dt < float("inf"),
-                floor=256, fallback=lambda b: 256, label="sweep")
+                floor=256,
+                fallback=lambda b: next_batch_down(
+                    b, ladder=MEASURED_SWEEP_LADDER, floor=256) or 256,
+                label="sweep")
             if action == "skip":
                 rep += 1
             continue
@@ -560,6 +569,9 @@ def run_sweep_full_mode(args, cfg, params):
             batch_size=args.sweep_batch, decode_completions=True,
             phase2_pool_target=args.pool_target,
             pipeline_depth=args.pipeline_depth,
+            # measured operating point: repeat-level step-down only (the
+            # engine's silent per-batch degradation would skew the record)
+            oom_backoff=False,
         ),
     )
     params, measured_rate = _calibrate_decided_rate(
@@ -612,8 +624,13 @@ def run_sweep_full_mode(args, cfg, params):
         last_ok_path = out_path
         rep += 1
     if last_ok_path and not os.path.exists(last_ok_path):
+        # with a fixed --sweep-out, a later failed repeat deleted the
+        # successful repeat's workbook at loop start — never hand the
+        # caller a path that no longer exists
         print(f"# note: workbook of the successful repeat was removed by a "
-              f"later failed repeat (fixed --sweep-out)", file=sys.stderr)
+              f"later failed repeat (fixed --sweep-out); no workbook to "
+              f"report", file=sys.stderr)
+        last_ok_path = None
     return n_total / best_dt, measured_rate, last_ok_path
 
 
@@ -1033,7 +1050,9 @@ def main():
                 cfg = DecoderConfig(**geometry, attention_impl=args.attn)
         if args.mode == "sweep-full":
             rps, rate, out_path = run_sweep_full_mode(args, cfg, params)
-            print(f"# sweep-full workbook: {out_path}", file=sys.stderr)
+            print(f"# sweep-full workbook: "
+                  f"{out_path or 'unavailable (removed by a failed repeat)'}",
+                  file=sys.stderr)
             record = {
                 "metric": (
                     f"full-study rows/sec/chip (END-TO-END perturbation "
@@ -1114,7 +1133,12 @@ def main():
                 cmd = [
                     sys.executable, os.path.abspath(__file__),
                     "--mode", "sweep-full",
-                    "--sweep-repeats", str(max(1, args.sweep_repeats)),
+                    # ONE full-study repeat: SKILL.md/PARITY.md document the
+                    # secondary as a single repeat, and a second warm repeat
+                    # costs ~5 minutes for no extra information (best-of
+                    # noise rejection matters for the headline, not the
+                    # companion row)
+                    "--sweep-repeats", "1",
                     "--sweep-batch", str(args.sweep_batch),
                     "--sweep-rows", str(args.sweep_rows),
                     "--pool-target", str(args.pool_target),

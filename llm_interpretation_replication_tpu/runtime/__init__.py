@@ -1,5 +1,16 @@
-from .batching import Batch, batches_for_prompts, bucket_for, encode_prompts
+from .batching import Batch, batches_for_prompts, bucket_for, encode_prompts, rebatch
 from .engine import EngineConfig, ScoringEngine
+from .faults import (
+    MEASURED_SWEEP_LADDER,
+    Preempted,
+    PreemptionGuard,
+    TransientError,
+    is_oom,
+    is_transient,
+    next_batch_down,
+    oom_detail,
+    retry_transient,
+)
 from .loader import CheckpointDir, load_hf_config, load_model, load_tokenizer
 from .plan import ScoringPlan, resolve_scoring_plan
 from .train import TrainState, causal_lm_loss, init_train_state, make_optimizer, make_train_step
@@ -9,6 +20,16 @@ __all__ = [
     "batches_for_prompts",
     "bucket_for",
     "encode_prompts",
+    "rebatch",
+    "MEASURED_SWEEP_LADDER",
+    "Preempted",
+    "PreemptionGuard",
+    "TransientError",
+    "is_oom",
+    "is_transient",
+    "next_batch_down",
+    "oom_detail",
+    "retry_transient",
     "EngineConfig",
     "ScoringEngine",
     "CheckpointDir",

@@ -116,6 +116,35 @@ def batches_for_prompts(
                               bucket_len, pad_id)
 
 
+def rebatch(
+    batch: Batch,
+    encoded: Sequence[Sequence[int]],
+    batch_size: int,
+    buckets: Sequence[int] = DEFAULT_BUCKETS,
+    pad_id: int = 0,
+    length_sorted: bool = True,
+) -> List[Batch]:
+    """Re-bucket one emitted batch's REAL rows at a smaller batch size.
+
+    The engine's OOM back-off path (runtime/faults.py): when a batch's
+    device program RESOURCE_EXHAUSTs, its real rows (``indices >= 0``) are
+    re-encoded from the original ``encoded`` prompt list and re-emitted as
+    fixed-shape batches of ``batch_size`` rows through the ordinary
+    :func:`batches_for_prompts` machinery — same buckets, same padding
+    discipline — with ``indices`` remapped to the ORIGINAL prompt indices,
+    so consumers key results exactly as before and no row is lost or
+    duplicated by the retry."""
+    rows = batch.indices[batch.indices >= 0]
+    sub_encoded = [encoded[int(i)] for i in rows]
+    out = []
+    for sb in batches_for_prompts(sub_encoded, batch_size, buckets,
+                                  pad_id=pad_id, length_sorted=length_sorted):
+        sb.indices = np.where(sb.indices >= 0,
+                              rows[np.clip(sb.indices, 0, None)], -1)
+        out.append(sb)
+    return out
+
+
 def encode_prompts(tokenizer, prompts: Sequence[str], add_special_tokens: bool = True) -> List[List[int]]:
     out = tokenizer(list(prompts), add_special_tokens=add_special_tokens)["input_ids"]
     return [list(ids) for ids in out]

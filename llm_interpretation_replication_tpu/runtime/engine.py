@@ -13,6 +13,7 @@ from __future__ import annotations
 import collections
 import dataclasses
 import functools
+import sys
 from typing import Callable, Dict, Iterable, List, Optional, Sequence
 
 import numpy as np
@@ -24,7 +25,8 @@ from ..models import decoder as dmod
 from ..models import t5 as t5mod
 from ..scoring import yes_no as yn
 from ..scoring.confidence import weighted_confidence_digits
-from . import batching
+from ..utils.telemetry import record_fault
+from . import batching, faults
 
 
 @functools.partial(jax.jit, static_argnames=("num_positions", "k"))
@@ -113,6 +115,23 @@ class EngineConfig:
                                     # exceed it, so pooling can never push a
                                     # budget-fitting sweep into OOM (long
                                     # buckets hold ~3.5 MB/row at 7B)
+    # -- adaptive OOM back-off (runtime/faults.py) ----------------------
+    # The chip is shared: a co-tenant allocation can RESOURCE_EXHAUST one
+    # batch of a sweep that ran clean for hours.  With oom_backoff on, a
+    # batch whose launch/fetch OOMs is re-bucketed at the next ladder size
+    # down (halving when the ladder is empty, never below oom_batch_floor)
+    # and retried IN PLACE — other batches keep the configured size, the
+    # degraded batch is recorded in telemetry (fault_events) so operating
+    # points stay auditable, and results are keyed by prompt index so no
+    # row is lost or duplicated.  At the floor the OOM propagates.
+    # Benchmarks that MEASURE an operating point should set
+    # oom_backoff=False so degradation is never silent (bench.py does).
+    oom_backoff: bool = dataclasses.field(
+        default_factory=faults.default_engine_backoff)
+    oom_batch_floor: int = dataclasses.field(
+        default_factory=faults.default_engine_floor)
+    oom_batch_ladder: Sequence[int] = dataclasses.field(
+        default_factory=faults.default_engine_ladder)
 
 
 class ScoringEngine:
@@ -127,6 +146,9 @@ class ScoringEngine:
         self.tokenizer = tokenizer
         self.mesh = mesh
         self.ecfg = engine_config or EngineConfig()
+        # per-engine mirror of the telemetry fault log: every OOM back-off
+        # this engine performed (degraded batches are auditable per run)
+        self.fault_events: List[Dict] = []
 
     # -- helpers ---------------------------------------------------------
 
@@ -183,7 +205,8 @@ class ScoringEngine:
             jnp.asarray(arr), NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (arr.ndim - 1))))
         )
 
-    def _run_pipelined(self, batches: Iterable, launch: Callable, consume: Callable):
+    def _run_pipelined(self, batches: Iterable, launch: Callable,
+                       consume: Callable, rebatch: Optional[Callable] = None):
         """Launch device programs up to ``pipeline_depth`` ahead of host-side
         result consumption.
 
@@ -192,17 +215,88 @@ class ScoringEngine:
         fetches (np.asarray) block.  Keeping a short queue of in-flight
         batches means the host's tokenizer-decode / row-building work for
         batch k runs while the chip computes batch k+1 — the double-buffered
-        input feed of SURVEY.md §7 step 6, without threads."""
+        input feed of SURVEY.md §7 step 6, without threads.
+
+        ``rebatch(batch, err)`` is the adaptive OOM back-off hook
+        (:meth:`_oom_rebatch`): when a batch's launch or consume raises a
+        device OOM, the hook returns replacement sub-batches (the same real
+        rows re-bucketed at a stepped-down size) which are queued ahead of
+        the remaining input; anything the hook cannot absorb it re-raises.
+        Because async dispatch surfaces a failed program at the first host
+        fetch of ITS outputs, the (batch, outputs) pairing below attributes
+        the error to the right rows even mid-pipeline.  A consume that
+        fails part-way re-scores its whole batch; results are keyed by
+        prompt index, so the rewrite is idempotent."""
         depth = max(1, self.ecfg.pipeline_depth)
         pending: collections.deque = collections.deque()
-        for batch in batches:
-            pending.append((batch, launch(batch)))
-            if len(pending) >= depth:
+        retries: collections.deque = collections.deque()
+        it = iter(batches)
+
+        def handle(batch, err):
+            if rebatch is None:
+                raise err
+            retries.extend(rebatch(batch, err))  # re-raises non-OOM/at-floor
+
+        while True:
+            batch = retries.popleft() if retries else next(it, None)
+            if batch is not None:
+                try:
+                    pending.append((batch, launch(batch)))
+                except Exception as err:
+                    handle(batch, err)
+                    continue
+            elif not pending:
+                break
+            if len(pending) >= depth or batch is None:
                 done, out = pending.popleft()
-                consume(done, out)
-        while pending:
-            done, out = pending.popleft()
-            consume(done, out)
+                try:
+                    consume(done, out)
+                except Exception as err:
+                    handle(done, err)
+
+    def _oom_rebatch(self, encoded) -> Optional[Callable]:
+        """Per-call OOM back-off hook for :meth:`_run_pipelined`.
+
+        Returns ``rebatch(batch, err)``: for a device OOM, step the failed
+        batch's size down the configured ladder (halving between ladder
+        points, never below ``oom_batch_floor`` — runtime/faults.py) and
+        re-bucket its real rows via :func:`batching.rebatch`; the degraded
+        batch is recorded in telemetry AND on ``self.fault_events`` so the
+        run's true operating points stay auditable.  Non-OOM errors and
+        OOMs at the floor re-raise.  None when back-off is disabled."""
+        ecfg = self.ecfg
+        if not ecfg.oom_backoff:
+            return None
+
+        def rebatch(batch, err):
+            # _no_rebatch marks errors whose device program spans rows from
+            # OTHER batches (the phase-2 pool): stepping THIS batch down
+            # cannot shrink that program, and retrying would silently lose
+            # the popped pool entries as "missing" rows — propagate to the
+            # caller's repeat-level OOM policy instead.
+            if getattr(err, "_no_rebatch", False) or not faults.is_oom(err):
+                raise err
+            size = int(batch.token_ids.shape[0])
+            new_size = faults.next_batch_down(
+                size, ladder=ecfg.oom_batch_ladder, floor=ecfg.oom_batch_floor)
+            if new_size is None:
+                raise err
+            n_real = int((batch.indices >= 0).sum())
+            event = record_fault(
+                "engine_oom_backoff", batch=size, new_batch=new_size,
+                bucket_len=int(batch.bucket_len), rows=n_real,
+                error=faults.oom_detail(err))
+            self.fault_events.append(event)
+            print(f"# engine: device OOM at batch {size} "
+                  f"(bucket {batch.bucket_len}); retrying {n_real} rows at "
+                  f"batch {new_size} [{faults.oom_detail(err)}]",
+                  file=sys.stderr)
+            return batching.rebatch(
+                batch, encoded, new_size, ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=ecfg.length_sorted_batches)
+
+        return rebatch
 
     # -- core ------------------------------------------------------------
 
@@ -471,7 +565,7 @@ class ScoringEngine:
                 pad_id=self.tokenizer.pad_token_id or 0,
                 length_sorted=ecfg.length_sorted_batches,
             ),
-            launch, consume,
+            launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return [r if r is not None else _error_row("missing") for r in results]
 
@@ -517,7 +611,10 @@ class ScoringEngine:
             valid = batch.indices >= 0
             undecided = np.flatnonzero(~hit0 & valid)
             count = undecided.size
-            if count > select_m:
+            # the slice actually produced: select_m normally, but an OOM-
+            # rebatched sub-batch smaller than select_m yields its own size
+            slice_rows = int(sel.shape[0])  # static shape: no device fetch
+            if count > slice_rows:
                 # Overflow fallback: re-run the prompt forward with the full
                 # cache and decode in place.
                 ids = self._put(batch.token_ids)
@@ -553,19 +650,29 @@ class ScoringEngine:
                 # tight menu size before pooling so held bytes stay
                 # proportional to real rows.
                 sel_np = np.asarray(sel)
-                m = _pad_slice(count, select_m)
-                if m < select_m:
+                m = _pad_slice(count, slice_rows)
+                if m < slice_rows:
                     idx = np.zeros((m,), np.int32)
                     idx[:count] = np.arange(count)
                     sub_cache, last_s, len_s = _gather_rows(
                         sub_cache, last_s, len_s, jnp.asarray(idx))
                     mapped = sel_np[idx]
                 else:
-                    mapped = sel_np[:select_m]
-                pool.add(_pool_len(batch.bucket_len), sub_cache, last_s,
-                         len_s, count,
-                         batch.indices[mapped[:count]], row_ids[mapped],
-                         first3=np.stack([a[mapped] for a in first3], axis=1))
+                    mapped = sel_np[:slice_rows]
+                try:
+                    pool.add(_pool_len(batch.bucket_len), sub_cache, last_s,
+                             len_s, count,
+                             batch.indices[mapped[:count]], row_ids[mapped],
+                             first3=np.stack([a[mapped] for a in first3],
+                                             axis=1))
+                except Exception as err:
+                    # a pooled decode holds rows popped from MANY earlier
+                    # batches; if it OOMs, re-bucketing the batch that
+                    # happened to trigger the flush cannot help and the
+                    # popped rows would silently become "missing" error
+                    # rows after the retry — bypass the per-batch rebatch
+                    err._no_rebatch = True
+                    raise
             for r, orig in enumerate(batch.indices):
                 if orig >= 0 and hit0[r]:
                     results[int(orig)] = _attach_first_token(_result_row(
@@ -578,7 +685,7 @@ class ScoringEngine:
                 pad_id=self.tokenizer.pad_token_id or 0,
                 length_sorted=ecfg.length_sorted_batches,
             ),
-            launch, consume,
+            launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         pool.flush_all()
         return [r if r is not None else _error_row("missing") for r in results]
@@ -745,7 +852,7 @@ class ScoringEngine:
                 pad_id=self.tokenizer.pad_token_id or 0,
                 length_sorted=ecfg.length_sorted_batches,
             ),
-            launch, consume,
+            launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return [r if r is not None else _error_row("missing") for r in results]
 
@@ -784,7 +891,7 @@ class ScoringEngine:
                 pad_id=self.tokenizer.pad_token_id or 0,
                 length_sorted=self.ecfg.length_sorted_batches,
             ),
-            launch, consume,
+            launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return out
 

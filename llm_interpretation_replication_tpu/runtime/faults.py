@@ -1,0 +1,352 @@
+"""Shared fault-tolerance layer for the runtime and the sweep shells.
+
+The north-star workloads (study-3 local inference and the 10k-perturbation
+sweep) run for hours on shared/preemptible TPU slices, where co-tenant
+RESOURCE_EXHAUSTED and SIGTERM preemption are routine operating conditions,
+not exceptional ones — the TPUv4 pjit-training literature treats both as
+normal for long-running pod jobs (PAPERS.md, "Scalable Training of Language
+Models using JAX pjit and TPUv4").  This module centralizes the policies the
+r5 bench proved out in its private copy (`bench.py` "Shared-chip OOM
+resilience") so the engine and every sweep shell share one implementation:
+
+- :func:`is_oom` / :func:`oom_detail` — normalized device-OOM detection
+  across the spellings the stack produces, plus a truncated diagnostic
+  string so a misclassified RESOURCE_EXHAUSTED (RPC/quota vs HBM) leaves a
+  trail in stderr/telemetry.
+- :func:`next_batch_down` + :data:`MEASURED_SWEEP_LADDER` — the measured
+  batch back-off ladder (384/352 → 320 → 256 at the sweep's ~107-token
+  operating point), falling back to halving between ladder points.  The
+  engine's per-batch retry and the bench's per-repeat step-down both walk
+  this.
+- :func:`sweep_oom_action` — the bench's skip-or-step-down policy for a
+  mid-repeat OOM (kept best-of when an earlier repeat succeeded; one batch
+  step-down and retry otherwise).
+- :func:`is_transient` / :func:`retry_transient` — the RetryPolicy-based
+  transient-retry path shared with :mod:`..utils.retry`: wraps an engine
+  call so RPC hiccups and connection resets retry with backoff while real
+  errors (shape bugs, OOM — which has its own path) propagate immediately.
+- :class:`PreemptionGuard` — SIGTERM/SIGINT handler that flushes registered
+  checkpoint state (side-log rows, CheckpointFile/ProcessedSet saves)
+  before exiting, so a preempted 10k sweep resumes losing at most the
+  in-flight chunk.
+
+Deliberately jax-free: importable by `bench.py`, the sweep shells, and
+tests without touching the device runtime.
+
+Env knobs (documented in README.md "Fault tolerance"):
+
+- ``LLM_INTERP_OOM_BACKOFF=0``   disable the engine's per-batch OOM retry
+- ``LLM_INTERP_OOM_FLOOR=N``     smallest batch the engine steps down to
+- ``LLM_INTERP_OOM_LADDER=a,b``  explicit engine back-off ladder
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import sys
+import threading
+from typing import Callable, Optional, Sequence, Tuple
+
+from ..utils.retry import RetryPolicy, retry_with_exponential_backoff
+from ..utils.telemetry import record_fault
+
+__all__ = [
+    "MEASURED_SWEEP_LADDER",
+    "Preempted",
+    "PreemptionGuard",
+    "TransientError",
+    "is_oom",
+    "is_transient",
+    "next_batch_down",
+    "oom_detail",
+    "retry_transient",
+    "sweep_oom_action",
+]
+
+
+# ---------------------------------------------------------------------------
+# OOM classification
+# ---------------------------------------------------------------------------
+
+def is_oom(err: BaseException) -> bool:
+    """Device out-of-memory, across the spellings the stack produces:
+    'RESOURCE_EXHAUSTED' (status code), 'ResourceExhausted' (class name),
+    'Resource exhausted: Out of memory' (absl status text)."""
+    s = str(err).lower().replace("_", "").replace(" ", "")
+    return "resourceexhausted" in s
+
+
+def oom_detail(err: BaseException, limit: int = 160) -> str:
+    """One-line truncated error text for OOM skip/retry messages.
+
+    RESOURCE_EXHAUSTED is not always HBM: the tunneled runtime can surface
+    RPC/quota exhaustion under the same status code.  Including the raw
+    (truncated) text in every skip/retry message leaves a diagnostic trail
+    when a misclassification silently changes the recorded operating
+    point."""
+    text = " ".join(str(err).split())
+    return text[:limit] + ("..." if len(text) > limit else "")
+
+
+# ---------------------------------------------------------------------------
+# Batch back-off ladder
+# ---------------------------------------------------------------------------
+
+#: Measured e2e-sweep operating points at the real corpus' ~107-token shape
+#: (v5e, 2026-07): 320 runs 120.5-120.9 p/s warm, 256 runs 111.8-112.1;
+#: 384 and 352 OOM.  A sweep batch that OOMs therefore steps 384/352 → 320
+#: → 256 — each landing on a fully-measured point — instead of jumping flat
+#: to 256 and skipping the better 320 point.
+MEASURED_SWEEP_LADDER: Tuple[int, ...] = (320, 256)
+
+
+def next_batch_down(batch: int, ladder: Sequence[int] = (),
+                    floor: int = 1) -> Optional[int]:
+    """Next smaller batch size on the back-off ladder, or None at the floor.
+
+    Walks ``ladder`` (descending measured operating points) first: the
+    largest entry strictly below ``batch``.  Below the ladder (or with no
+    ladder) the batch halves.  Never returns a value below ``floor``;
+    returns None when ``batch`` is already at/below the floor, signalling
+    the caller to re-raise.  ``floor`` clamps to 1: a zero floor (e.g.
+    ``LLM_INTERP_OOM_FLOOR=0`` meaning "no floor") must step to batch 1,
+    never to an unlaunchable batch 0."""
+    floor = max(1, int(floor))
+    if batch <= floor:
+        return None
+    for step in sorted(ladder, reverse=True):
+        if step < batch:
+            return max(floor, int(step))
+    return max(floor, batch // 2)
+
+
+def _env_flag(name: str, default: bool) -> bool:
+    val = os.environ.get(name)
+    if val is None:
+        return default
+    return val.strip().lower() not in ("0", "false", "no", "off", "")
+
+
+def _env_int(name: str, default: int) -> int:
+    val = os.environ.get(name)
+    try:
+        return int(val) if val else default
+    except ValueError:
+        return default
+
+
+def _env_ladder(name: str, default: Tuple[int, ...]) -> Tuple[int, ...]:
+    val = os.environ.get(name)
+    if not val:
+        return default
+    try:
+        return tuple(int(p) for p in val.replace(";", ",").split(",") if p.strip())
+    except ValueError:
+        return default
+
+
+def default_engine_backoff() -> bool:
+    return _env_flag("LLM_INTERP_OOM_BACKOFF", True)
+
+
+def default_engine_floor() -> int:
+    return _env_int("LLM_INTERP_OOM_FLOOR", 8)
+
+
+def default_engine_ladder() -> Tuple[int, ...]:
+    return _env_ladder("LLM_INTERP_OOM_LADDER", ())
+
+
+# ---------------------------------------------------------------------------
+# Bench / repeat-level OOM policy (moved from bench.py's private copy)
+# ---------------------------------------------------------------------------
+
+def sweep_oom_action(err, batch: int, rep, had_success, floor,
+                     fallback: Callable[[int], int], label: str
+                     ) -> Tuple[str, Optional[int]]:
+    """Shared skip-or-step-down policy for a mid-repeat device OOM.
+
+    The sweep operating points sit near the HBM edge and the chip is
+    SHARED: a co-tenant's allocation can RESOURCE_EXHAUST a repeat that
+    ran clean three times (observed 2026-07: repeat 0 at 110 s, repeat 1
+    ResourceExhausted).  The driver records the bench's single JSON line
+    every round, so a flaky OOM must never sink the whole record.
+
+    Pure policy over ``batch``, the repeat's current batch size: returns
+    ``("skip", None)`` (an earlier repeat succeeded: keep best-of) or
+    ``("retry", new_batch)`` (no success yet: step down via ``fallback``
+    — the caller applies ``new_batch`` to its own config); re-raises for
+    non-OOM errors or when already at ``floor``.  Every path prints the
+    truncated error text so misclassified RESOURCE_EXHAUSTED (RPC/quota
+    vs HBM) is auditable, and records a telemetry fault event."""
+    if not is_oom(err):
+        raise err
+    detail = oom_detail(err)
+    if had_success:
+        print(f"# {label} repeat {rep}: device OOM (shared chip); "
+              f"keeping earlier repeat(s) [{detail}]", file=sys.stderr)
+        record_fault("sweep_oom_skip", label=label, repeat=rep, error=detail)
+        return "skip", None
+    if batch > floor:
+        new_batch = max(floor, fallback(batch))
+        print(f"# {label} repeat {rep}: device OOM at batch "
+              f"{batch}; falling back to {new_batch} [{detail}]",
+              file=sys.stderr)
+        record_fault("sweep_oom_backoff", label=label, repeat=rep,
+                     batch=batch, new_batch=new_batch, error=detail)
+        return "retry", new_batch
+    raise err
+
+
+# ---------------------------------------------------------------------------
+# Transient-error retry (shared with utils/retry.py)
+# ---------------------------------------------------------------------------
+
+class TransientError(RuntimeError):
+    """Marker for injected/known-transient failures (utils/testing.py)."""
+
+
+#: Exception classes retried as transient.  OOM is deliberately excluded —
+#: it has its own back-off path (the batch ladder); retrying an OOM at the
+#: same shape only reproduces it.
+TRANSIENT_ERROR_TYPES: Tuple[type, ...] = (
+    TransientError, ConnectionError, TimeoutError, BrokenPipeError,
+)
+
+#: Substrings marking a transient failure when the class is generic (the
+#: tunneled runtime wraps RPC errors in RuntimeError).
+_TRANSIENT_MARKERS = ("unavailable", "deadline exceeded", "connection reset",
+                      "transient", "temporarily")
+
+
+def is_transient(err: BaseException) -> bool:
+    """Worth retrying in place: RPC hiccups, resets, injected transients —
+    never OOM (which steps the batch down instead) and never ordinary
+    programming errors."""
+    if is_oom(err):
+        return False
+    if isinstance(err, TRANSIENT_ERROR_TYPES):
+        return True
+    text = str(err).lower()
+    return any(m in text for m in _TRANSIENT_MARKERS)
+
+
+def default_transient_policy() -> RetryPolicy:
+    """Local-engine transient policy: 3 quick retries (the reference's 60 s
+    API ladder is for rate limits; a local RPC hiccup clears in seconds)."""
+    return RetryPolicy(max_retries=3, initial_delay=2.0, max_delay=30.0,
+                       retry_predicate=is_transient)
+
+
+def retry_transient(fn: Callable, policy: Optional[RetryPolicy] = None,
+                    label: str = "") -> Callable:
+    """Wrap ``fn`` so transient errors retry per ``policy`` (default
+    :func:`default_transient_policy`), recording a telemetry fault event
+    per retried error.  Non-transient errors propagate immediately."""
+    import dataclasses as dc
+
+    policy = policy or default_transient_policy()
+    inner = policy.retry_predicate or is_transient
+
+    def recording_predicate(err: BaseException) -> bool:
+        if not inner(err):
+            return False
+        record_fault("transient_retry", label=label or getattr(fn, "__name__", ""),
+                     error=oom_detail(err))
+        return True
+
+    policy = dc.replace(policy, retry_predicate=recording_predicate)
+    return retry_with_exponential_backoff(policy)(fn)
+
+
+# ---------------------------------------------------------------------------
+# Preemption guard
+# ---------------------------------------------------------------------------
+
+class Preempted(SystemExit):
+    """Raised (from the signal handler) after checkpoint state is flushed.
+
+    Subclasses SystemExit so an unguarded production run exits with the
+    conventional 128+signum code, while tests catch it explicitly."""
+
+    def __init__(self, signum: int):
+        super().__init__(128 + int(signum))
+        self.signum = int(signum)
+
+
+class PreemptionGuard:
+    """Flush checkpoint state on SIGTERM/SIGINT, then exit.
+
+    Shared/preemptible slices deliver SIGTERM with a short grace window; a
+    sweep that dies mid-chunk without flushing loses every pending side-log
+    row since the last ``checkpoint_every`` threshold.  Installed around a
+    sweep's chunk loop::
+
+        with PreemptionGuard(flush, label="perturbation"):
+            for chunk in chunks: ...
+
+    On SIGTERM/SIGINT each registered flush callback runs once (exceptions
+    in one flush never block the next), a telemetry fault event records the
+    preemption, and :class:`Preempted` (SystemExit) / KeyboardInterrupt is
+    raised in the main thread — so the sweep resumes losing at most the
+    in-flight chunk.  Handlers are restored on exit; nesting composes (the
+    inner guard defers to the previously-installed handler's flushes by
+    restoring them).  Outside the main thread signal handlers cannot be
+    installed; the guard then degrades to a no-op rather than failing the
+    sweep."""
+
+    SIGNALS = (signal.SIGTERM, signal.SIGINT)
+
+    def __init__(self, *flushes: Callable[[], None], label: str = "",
+                 signals: Optional[Sequence[int]] = None):
+        self.flushes = list(flushes)
+        self.label = label
+        self.signals = tuple(signals) if signals is not None else self.SIGNALS
+        self.triggered: Optional[int] = None
+        self.active = False
+        self._previous = {}
+
+    def add_flush(self, fn: Callable[[], None]) -> None:
+        self.flushes.append(fn)
+
+    def _handler(self, signum, frame):
+        self.triggered = signum
+        self.flush(reason=f"signal {signum}")
+        record_fault("preempted", label=self.label, signum=int(signum))
+        if signum == signal.SIGINT:
+            raise KeyboardInterrupt
+        raise Preempted(signum)
+
+    def flush(self, reason: str = "") -> None:
+        """Run every registered flush once, guarding each: a failing flush
+        (e.g. a full disk) must not block the remaining checkpoint state
+        from landing inside the grace window."""
+        for fn in self.flushes:
+            try:
+                fn()
+            except Exception as err:  # pragma: no cover - best-effort path
+                print(f"# preemption flush failed ({reason}): {err}",
+                      file=sys.stderr)
+
+    def __enter__(self) -> "PreemptionGuard":
+        if threading.current_thread() is not threading.main_thread():
+            return self  # signals only deliverable to the main thread
+        try:
+            for sig in self.signals:
+                self._previous[sig] = signal.signal(sig, self._handler)
+            self.active = True
+        except (ValueError, OSError):  # non-main thread / exotic platform
+            for sig, prev in self._previous.items():
+                signal.signal(sig, prev)
+            self._previous.clear()
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        for sig, prev in self._previous.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError):  # pragma: no cover
+                pass
+        self._previous.clear()
+        self.active = False

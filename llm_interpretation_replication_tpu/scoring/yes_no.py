@@ -83,6 +83,16 @@ def yes_no_from_reduced(
     probabilities are ``exp(logit - logsumexp)`` — the same quantity
     ``softmax`` computes, differing only in float summation order.
     Requires ``top_k <= K``.
+
+    Tie caveat: like :func:`yes_no_from_scores`, exact ties with the k-th
+    candidate over-match (``>=``).  Additionally, DISTINCT logits whose
+    fp32 softmax probabilities round to the same value — deep-tail targets
+    where ``exp(logit - logz)`` underflows or collides at the 2^-24
+    resolution — compare as a tie on the probability path but not on this
+    raw-logit path, so the found bit can differ between the two
+    implementations for such degenerate rows.  Both target probabilities
+    are ~0 there, so the relative probability the sweep records is 0.5
+    either way; only the ``scan_found`` flag is affected.
     """
     b, p, k = topk_vals.shape
     if top_k > k:

@@ -661,6 +661,23 @@ def run_gemini_perturbation_sweep(
                 log(f"{model}: checkpointed {len(pending)} rows")
                 pending.clear()
 
+        def flush_with_lock():
+            with lock:
+                flush_locked()
+
+        def flush_for_preemption():
+            # Signal handlers run in the MAIN thread.  A blocking acquire
+            # on a lock the main thread itself holds (the final
+            # flush_with_lock below) would deadlock inside the preemption
+            # grace window.  The bounded wait covers worker-held locks
+            # (short appends); if the main thread is already mid-flush,
+            # those rows are being written anyway — skip.
+            if lock.acquire(timeout=5.0):
+                try:
+                    flush_locked()
+                finally:
+                    lock.release()
+
         def run_one(item):
             scenario, rephrased = item
             row = _gemini_perturbation_row(client, model, scenario, rephrased)
@@ -669,17 +686,33 @@ def run_gemini_perturbation_sweep(
                 if len(pending) >= checkpoint_every:
                     flush_locked()
 
-        with ThreadPoolExecutor(max_workers=max_workers) as pool:
-            futures = [pool.submit(run_one, item) for item in work]
-            errors = 0
-            for future in as_completed(futures):
-                try:
-                    future.result()
-                except Exception as err:   # broken call: keep the sweep alive
-                    errors += 1
-                    log(f"{model}: evaluation failed — {err}")
-        with lock:
-            flush_locked()
+        # Preemption safety (runtime/faults.py): a SIGTERM/SIGINT in the
+        # main thread checkpoints the completed-but-unflushed rows before
+        # exit; the resumed sweep's triple-keyed skip set redoes only the
+        # in-flight evaluations.
+        from ..runtime.faults import PreemptionGuard
+
+        errors = 0
+        with PreemptionGuard(flush_for_preemption, label="gemini_perturbation"):
+            pool = ThreadPoolExecutor(max_workers=max_workers)
+            try:
+                futures = [pool.submit(run_one, item) for item in work]
+                for future in as_completed(futures):
+                    try:
+                        future.result()
+                    except Exception as err:   # broken call: keep the sweep alive
+                        errors += 1
+                        log(f"{model}: evaluation failed — {err}")
+            except BaseException:
+                # preemption/Ctrl-C: drop the queued work instead of the
+                # context manager's shutdown(wait=True) — the grace window
+                # cannot absorb thousands of queued API calls.  Only the
+                # <= max_workers in-flight calls finish (joined by the
+                # executor's atexit hook); their rows re-run on resume.
+                pool.shutdown(wait=False, cancel_futures=True)
+                raise
+            pool.shutdown(wait=True)
+            flush_with_lock()
         if errors:
             log(f"{model}: {errors} evaluations failed (will retry on resume)")
             if errors == len(work):

@@ -15,18 +15,26 @@ from typing import Callable, Dict, List, Optional, Sequence
 import pandas as pd
 
 from ..config import model_pairs_100q, ordinary_meaning_questions
+from ..runtime import faults
 from ..scoring.prompts import format_prompt
 from ..utils.checkpoint import CheckpointFile
 from ..utils.logging import SessionLogger
+from ..utils.retry import RetryPolicy
 from .writers import base_vs_instruct_100q_frame
 
 EngineFactory = Callable[[str], object]  # model name -> ScoringEngine
 
 
-def run_model_on_prompts(engine, model_name: str, prompts: Sequence[str], is_base_model: bool) -> List[Dict]:
+def run_model_on_prompts(engine, model_name: str, prompts: Sequence[str],
+                         is_base_model: bool,
+                         retry_policy: Optional[RetryPolicy] = None) -> List[Dict]:
     formatted = [format_prompt(q, is_base_model, model_name) for q in prompts]
     try:
-        rows = engine.score_prompts(formatted)
+        # transient failures retry with backoff before the error-row
+        # fallback burns the model's rows (runtime/faults.py)
+        rows = faults.retry_transient(
+            engine.score_prompts, retry_policy,
+            label=f"100q.{model_name}")(formatted)
     except Exception as err:  # error rows keep the sweep moving (ref :484-496)
         return [
             {
@@ -64,6 +72,7 @@ def run_sweep(
     prompts: Optional[Sequence[str]] = None,
     checkpoint_path: str = "results/base_vs_instruct_100q_checkpoint.json",
     results_csv: str = "results/base_vs_instruct_100q_results.csv",
+    retry_policy: Optional[RetryPolicy] = None,
     log: Optional[SessionLogger] = None,
 ) -> pd.DataFrame:
     log = log or SessionLogger()
@@ -74,23 +83,37 @@ def run_sweep(
     completed = set(state["completed_models"])
     all_results: List[Dict] = list(state["results"])
 
-    for pair in model_pairs:
-        base, instruct, family = pair["base"], pair["instruct"], pair["family"]
-        for model_name, role, is_base in ((base, "base", True), (instruct, "instruct", False)):
-            if model_name in completed:
-                log(f"Skipping {model_name} (already completed)")
-                continue
-            log(f"Running {role.upper()} model: {model_name}")
-            engine = engine_factory(model_name)
-            results = run_model_on_prompts(engine, model_name, prompts, is_base)
-            for r in results:
-                r["model_family"] = family
-                r["base_or_instruct"] = role
-            all_results.extend(results)
-            completed.add(model_name)
-            state = {"completed_models": sorted(completed), "results": all_results}
-            ck.save(state)
-            log(f"Checkpoint saved after {model_name}")
+    def save_checkpoint():
+        # The guard below can fire this from the signal handler BETWEEN the
+        # loop's `all_results.extend(...)` and `completed.add(...)`: unlike
+        # the sibling sweeps (where the completion marker IS the stored
+        # result), rows and marker are separate state here.  Keep the
+        # checkpoint invariant — rows exactly for completed models — by
+        # filtering, so the in-flight model re-scores on resume instead of
+        # landing twice in the CSV.
+        done = [r for r in all_results if r.get("model") in completed]
+        ck.save({"completed_models": sorted(completed), "results": done})
+
+    # Preemption safety: a SIGTERM mid-sweep persists the completed models
+    # before exit; the resumed run redoes only the in-flight model.
+    with faults.PreemptionGuard(save_checkpoint, label="100q_sweep"):
+        for pair in model_pairs:
+            base, instruct, family = pair["base"], pair["instruct"], pair["family"]
+            for model_name, role, is_base in ((base, "base", True), (instruct, "instruct", False)):
+                if model_name in completed:
+                    log(f"Skipping {model_name} (already completed)")
+                    continue
+                log(f"Running {role.upper()} model: {model_name}")
+                engine = engine_factory(model_name)
+                results = run_model_on_prompts(engine, model_name, prompts,
+                                               is_base, retry_policy=retry_policy)
+                for r in results:
+                    r["model_family"] = family
+                    r["base_or_instruct"] = role
+                all_results.extend(results)
+                completed.add(model_name)
+                save_checkpoint()
+                log(f"Checkpoint saved after {model_name}")
 
     df = base_vs_instruct_100q_frame(all_results)
     import os

@@ -14,18 +14,26 @@ from typing import Callable, Dict, Optional, Sequence
 import pandas as pd
 
 from ..config import instruct_sweep_models, model_pairs_word_meaning
+from ..runtime import faults
 from ..scoring.prompts import format_instruct_prompt, format_prompt
 from ..utils.checkpoint import CheckpointFile
 from ..utils.logging import SessionLogger
+from ..utils.retry import RetryPolicy
 from .writers import instruct_comparison_frame, model_comparison_frame
 
 EngineFactory = Callable[[str], object]
 
 
-def _score_model(engine, model_name: str, prompts: Sequence[str], is_base: bool) -> Dict[str, Dict]:
+def _score_model(engine, model_name: str, prompts: Sequence[str], is_base: bool,
+                 retry_policy: Optional[RetryPolicy] = None) -> Dict[str, Dict]:
     formatted = [format_prompt(q, is_base, model_name) for q in prompts]
     try:
-        rows = engine.score_prompts(formatted)
+        # transient errors retry with backoff (runtime/faults.py) BEFORE the
+        # error-row fallback: a connection reset must not burn a whole
+        # model's rows when a second attempt would have scored them
+        rows = faults.retry_transient(
+            engine.score_prompts, retry_policy,
+            label=f"instruct.{model_name}")(formatted)
     except Exception as err:
         rows = [
             {
@@ -51,6 +59,7 @@ def run_instruct_sweep(
     models: Optional[Sequence[str]] = None,
     checkpoint_path: str = "results/instruct_sweep_checkpoint.json",
     results_csv: str = "results/instruct_model_comparison_results.csv",
+    retry_policy: Optional[RetryPolicy] = None,
     log: Optional[SessionLogger] = None,
 ) -> pd.DataFrame:
     log = log or SessionLogger()
@@ -67,14 +76,22 @@ def run_instruct_sweep(
         state = {"outputs": {}, "prompts": fp}
     state["prompts"] = fp
     outputs: Dict[str, Dict] = state["outputs"]
-    for model_name in models:
-        if model_name in outputs:
-            log(f"Skipping {model_name} (checkpointed)")
-            continue
-        log(f"Running instruct model: {model_name}")
-        engine = engine_factory(model_name)
-        outputs[model_name] = _score_model(engine, model_name, prompts, is_base=False)
-        ck.save({"outputs": outputs, "prompts": fp})
+    # Preemption safety: SIGTERM/SIGINT saves the completed models'
+    # checkpoint before exit, so the resumed sweep loses at most the
+    # in-flight model (outputs only gains a key once a model finishes).
+    with faults.PreemptionGuard(
+            lambda: ck.save({"outputs": outputs, "prompts": fp}),
+            label="instruct_sweep"):
+        for model_name in models:
+            if model_name in outputs:
+                log(f"Skipping {model_name} (checkpointed)")
+                continue
+            log(f"Running instruct model: {model_name}")
+            engine = engine_factory(model_name)
+            outputs[model_name] = _score_model(
+                engine, model_name, prompts, is_base=False,
+                retry_policy=retry_policy)
+            ck.save({"outputs": outputs, "prompts": fp})
     df = instruct_comparison_frame(outputs, models)
     os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
     df.to_csv(results_csv, index=False)
@@ -88,6 +105,7 @@ def run_base_vs_instruct_word_meaning(
     model_pairs: Optional[Sequence[Dict]] = None,
     checkpoint_path: str = "results/model_comparison_checkpoint.json",
     results_csv: str = "results/model_comparison_results.csv",
+    retry_policy: Optional[RetryPolicy] = None,
     log: Optional[SessionLogger] = None,
 ) -> pd.DataFrame:
     log = log or SessionLogger()
@@ -96,15 +114,19 @@ def run_base_vs_instruct_word_meaning(
     ck = CheckpointFile(checkpoint_path, default={"outputs": {}})
     state = ck.load()
     outputs: Dict[str, Dict] = state["outputs"]
-    for base, instruct in pair_tuples:
-        for model_name, is_base in ((base, True), (instruct, False)):
-            if model_name in outputs:
-                log(f"Skipping {model_name} (checkpointed)")
-                continue
-            log(f"Running {'base' if is_base else 'instruct'} model: {model_name}")
-            engine = engine_factory(model_name)
-            outputs[model_name] = _score_model(engine, model_name, prompts, is_base)
-            ck.save({"outputs": outputs})
+    with faults.PreemptionGuard(lambda: ck.save({"outputs": outputs}),
+                                label="base_vs_instruct_word_meaning"):
+        for base, instruct in pair_tuples:
+            for model_name, is_base in ((base, True), (instruct, False)):
+                if model_name in outputs:
+                    log(f"Skipping {model_name} (checkpointed)")
+                    continue
+                log(f"Running {'base' if is_base else 'instruct'} model: {model_name}")
+                engine = engine_factory(model_name)
+                outputs[model_name] = _score_model(
+                    engine, model_name, prompts, is_base,
+                    retry_policy=retry_policy)
+                ck.save({"outputs": outputs})
     df = model_comparison_frame(outputs, pair_tuples)
     os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
     df.to_csv(results_csv, index=False)

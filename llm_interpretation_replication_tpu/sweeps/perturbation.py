@@ -22,8 +22,12 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pandas as pd
 
+from ..runtime import faults
 from ..scoring.confidence import extract_first_int
+from ..utils.checkpoint import append_jsonl
 from ..utils.logging import SessionLogger
+from ..utils.retry import RetryPolicy
+from ..utils.telemetry import record_fault
 from ..utils.xlsx import read_xlsx, write_xlsx
 from .writers import PERTURBATION_COLUMNS, perturbation_row
 
@@ -63,7 +67,13 @@ def load_existing_rows(output_xlsx: str) -> Tuple[List[Dict], set]:
                 line = line.strip()
                 if not line:
                     continue
-                row = json.loads(line)
+                try:
+                    row = json.loads(line)
+                except ValueError:
+                    # a hard kill mid-append can tear the trailing line
+                    # (fsync covers completed flushes, not in-progress
+                    # ones); its chunk simply re-scores on resume
+                    continue
                 key = _row_key(row)
                 if key not in seen:
                     rows.append(row)
@@ -85,6 +95,7 @@ def run_model_perturbation_sweep(
     confidence: bool = True,
     confidence_max_new_tokens: int = 10,
     score_chunk: int = 2000,
+    retry_policy: Optional[RetryPolicy] = None,
     log: Optional[SessionLogger] = None,
 ) -> pd.DataFrame:
     log = log or SessionLogger()
@@ -93,24 +104,35 @@ def run_model_perturbation_sweep(
     os.makedirs(os.path.dirname(os.path.abspath(output_xlsx)), exist_ok=True)
     sidelog = _sidelog_path(output_xlsx)
 
+    in_flush = False
+
     def flush(final: bool = False):
-        # O(new rows): append the checkpoint to the side-log; the xlsx is
-        # rendered once, at end of sweep (resume reads workbook + side-log,
-        # so durability is unchanged — see load_existing_rows).
-        nonlocal pending, all_rows
-        if pending:
-            with open(sidelog, "a") as f:
-                for row in pending:
-                    f.write(json.dumps(
-                        row, default=lambda o: o.item()   # numpy scalars
-                        if hasattr(o, "item") else str(o)) + "\n")
-            all_rows.extend(pending)
-            pending = []
-        if final:
-            write_xlsx(pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS),
-                       output_xlsx)
-            if os.path.exists(sidelog):
-                os.remove(sidelog)
+        # O(new rows): append the checkpoint to the side-log, fsync'd so a
+        # hard kill right after the flush cannot lose the rows it claimed
+        # to checkpoint; the xlsx is rendered once, at end of sweep (resume
+        # reads workbook + side-log, so durability is unchanged — see
+        # load_existing_rows).  The in_flush latch makes the flush signal-
+        # reentrancy-safe: the PreemptionGuard handler runs in this same
+        # thread, and re-entering mid-append would write the pending rows
+        # twice and interleave torn JSONL lines; the interrupted append's
+        # buffer still lands when its file closes on unwind.
+        nonlocal pending, all_rows, in_flush
+        if in_flush:
+            return
+        in_flush = True
+        try:
+            if pending:
+                append_jsonl(sidelog, pending)
+                all_rows.extend(pending)
+                pending = []
+            if final:
+                write_xlsx(pd.DataFrame(all_rows,
+                                        columns=PERTURBATION_COLUMNS),
+                           output_xlsx)
+                if os.path.exists(sidelog):
+                    os.remove(sidelog)
+        finally:
+            in_flush = False
 
     # Cross-scenario batching: the engine takes PER-PROMPT target pairs, so
     # one scoring call mixes all scenarios' rephrasings.  Per-scenario calls
@@ -151,74 +173,102 @@ def run_model_perturbation_sweep(
     except (TypeError, ValueError):
         takes_cap = True
 
-    for start in range(0, len(todo_items), score_chunk):
-        chunk = todo_items[start:start + score_chunk]
-        targets = [list(s["target_tokens"]) for s, _ in chunk]
-        binary_prompts = [f"{r} {s['response_format']}" for s, r in chunk]
-        responses = engine.score_prompts(binary_prompts, targets=targets)
-        ecfg = getattr(engine, "ecfg", None)
-        if (ecfg is not None
-                and getattr(ecfg, "first_token_top_filter", None) == TOP_LOGPROBS
-                and responses
-                and all("first_token_yes_prob" in row for row in responses)):
-            # the scoring pass already computed the top-20-filtered
-            # position-0 probabilities from its own prefill logits — no
-            # second full forward for the binary leg.  Guarded on the
-            # engine's filter matching the API extractor's top-20 contract
-            # and on EVERY row carrying the fields (error rows don't).
-            probs = np.asarray([
-                [row["first_token_yes_prob"], row["first_token_no_prob"],
-                 row["first_token_relative_prob"]] for row in responses
-            ])
-        else:   # foreign/fake engines, custom filters, or error rows
-            probs = engine.first_token_relative_prob(
-                binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
-            )
+    # Transient-retry wrappers (runtime/faults.py): an RPC hiccup or
+    # connection reset from the tunneled runtime retries in place with
+    # backoff instead of losing the chunk.  OOM is deliberately NOT
+    # retried here — the engine's own batch-ladder back-off handles it at
+    # batch granularity — and real errors propagate immediately.
+    score_prompts = faults.retry_transient(
+        engine.score_prompts, retry_policy, label="perturbation.score")
+    first_token = faults.retry_transient(
+        engine.first_token_relative_prob, retry_policy,
+        label="perturbation.first_token")
 
-        conf_values: List[Optional[int]] = [None] * len(chunk)
-        conf_texts = [""] * len(chunk)
-        weighted: List[Optional[float]] = [None] * len(chunk)
-        if confidence:
-            conf_prompts = [f"{r} {s['confidence_format']}" for s, r in chunk]
-            # The confidence leg generates at most ``confidence_max_new_
-            # tokens`` (default 10): every reference confidence contract is
-            # an API leg capped at max_tokens=10 (perturb_prompts_gpt.py:
-            # 118,143 — there is no local confidence leg to mirror), the
-            # parse reads only the first integer, and the weighted
-            # confidence reads only the first 3 positions — while a 50-token
-            # generate would spend 5x the decode on text nothing consumes.
-            # (Measured: 26.6 -> 29.0 full-study rows/s on the 10k corpus.)
-            # 0 disables the cap; takes_cap is the signature probe above.
-            cap_kw = ({"max_new_tokens": confidence_max_new_tokens}
-                      if confidence_max_new_tokens and takes_cap else {})
-            conf_rows = engine.score_prompts(
-                conf_prompts, targets=targets, with_confidence=True, **cap_kw
-            )
-            for i, row in enumerate(conf_rows):
-                conf_texts[i] = row["completion"]
-                conf_values[i] = extract_first_int(row["completion"])
-                weighted[i] = row.get("weighted_confidence")
-
-        for i, (scenario, reph) in enumerate(chunk):
-            t1p, t2p = float(probs[i, 0]), float(probs[i, 1])
-            odds = t1p / t2p if t2p > 0 else float("inf")
-            pending.append(
-                perturbation_row(
-                    model_name,
-                    scenario,
-                    reph,
-                    response_text=responses[i]["completion"],
-                    confidence_text=conf_texts[i],
-                    logprobs_repr=f"local:first_token_top{TOP_LOGPROBS}",
-                    token_1_prob=t1p,
-                    token_2_prob=t2p,
-                    odds_ratio=odds,
-                    confidence_value=conf_values[i],
-                    weighted_confidence=weighted[i],
+    # Preemption safety: shared/preemptible slices SIGTERM with a short
+    # grace window.  The guard flushes the pending side-log rows before
+    # exiting, so a preempted 10k sweep resumes losing at most the
+    # in-flight score_chunk (the resume path skips every flushed row).
+    with faults.PreemptionGuard(flush, label="perturbation"):
+        for start in range(0, len(todo_items), score_chunk):
+            chunk = todo_items[start:start + score_chunk]
+            targets = [list(s["target_tokens"]) for s, _ in chunk]
+            binary_prompts = [f"{r} {s['response_format']}" for s, r in chunk]
+            responses = score_prompts(binary_prompts, targets=targets)
+            ecfg = getattr(engine, "ecfg", None)
+            if (ecfg is not None
+                    and getattr(ecfg, "first_token_top_filter", None) == TOP_LOGPROBS
+                    and responses
+                    and all("first_token_yes_prob" in row for row in responses)):
+                # the scoring pass already computed the top-20-filtered
+                # position-0 probabilities from its own prefill logits — no
+                # second full forward for the binary leg.  Guarded on the
+                # engine's filter matching the API extractor's top-20 contract
+                # and on EVERY row carrying the fields (error rows don't).
+                probs = np.asarray([
+                    [row["first_token_yes_prob"], row["first_token_no_prob"],
+                     row["first_token_relative_prob"]] for row in responses
+                ])
+            else:   # foreign/fake engines, custom filters, or error rows
+                probs = first_token(
+                    binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
                 )
-            )
-            processed.add((model_name, scenario["original_main"], reph))
-            if len(pending) >= checkpoint_every:
-                flush()
-    flush(final=True)
+            n_nan = int(np.isnan(np.asarray(probs[:, :2], dtype=float))
+                        .any(axis=1).sum())
+            if n_nan:
+                # NaN target probabilities (a numerically-broken checkpoint
+                # or an injected fault) must stay auditable: the rows are
+                # still written — the schema carries them and resume must
+                # not rescore silently — but the event is on record.
+                record_fault("nan_logits", model=model_name, rows=n_nan,
+                             chunk_start=start)
+                log(f"{model_name}: WARNING — {n_nan} rows carry NaN target "
+                    f"probabilities (recorded in telemetry)")
+
+            conf_values: List[Optional[int]] = [None] * len(chunk)
+            conf_texts = [""] * len(chunk)
+            weighted: List[Optional[float]] = [None] * len(chunk)
+            if confidence:
+                conf_prompts = [f"{r} {s['confidence_format']}" for s, r in chunk]
+                # The confidence leg generates at most ``confidence_max_new_
+                # tokens`` (default 10): every reference confidence contract is
+                # an API leg capped at max_tokens=10 (perturb_prompts_gpt.py:
+                # 118,143 — there is no local confidence leg to mirror), the
+                # parse reads only the first integer, and the weighted
+                # confidence reads only the first 3 positions — while a 50-token
+                # generate would spend 5x the decode on text nothing consumes.
+                # (Measured: 26.6 -> 29.0 full-study rows/s on the 10k corpus.)
+                # 0 disables the cap; takes_cap is the signature probe above.
+                cap_kw = ({"max_new_tokens": confidence_max_new_tokens}
+                          if confidence_max_new_tokens and takes_cap else {})
+                conf_rows = score_prompts(
+                    conf_prompts, targets=targets, with_confidence=True,
+                    **cap_kw
+                )
+                for i, row in enumerate(conf_rows):
+                    conf_texts[i] = row["completion"]
+                    conf_values[i] = extract_first_int(row["completion"])
+                    weighted[i] = row.get("weighted_confidence")
+
+            for i, (scenario, reph) in enumerate(chunk):
+                t1p, t2p = float(probs[i, 0]), float(probs[i, 1])
+                odds = t1p / t2p if t2p > 0 else float("inf")
+                pending.append(
+                    perturbation_row(
+                        model_name,
+                        scenario,
+                        reph,
+                        response_text=responses[i]["completion"],
+                        confidence_text=conf_texts[i],
+                        logprobs_repr=f"local:first_token_top{TOP_LOGPROBS}",
+                        token_1_prob=t1p,
+                        token_2_prob=t2p,
+                        odds_ratio=odds,
+                        confidence_value=conf_values[i],
+                        weighted_confidence=weighted[i],
+                    )
+                )
+                processed.add((model_name, scenario["original_main"], reph))
+                if len(pending) >= checkpoint_every:
+                    flush()
+        flush(final=True)
     return pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS)

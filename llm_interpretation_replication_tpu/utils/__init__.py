@@ -1,12 +1,23 @@
-from .checkpoint import CheckpointFile, ProcessedSet
+from .checkpoint import CheckpointFile, ProcessedSet, append_jsonl
 from .logging import Progress, SessionLogger
 from .retry import RateLimiter, RetryPolicy, retry_with_exponential_backoff
-from .telemetry import clear_host_memory, device_memory_summary, get_memory_usage
+from .telemetry import (
+    clear_fault_events,
+    clear_host_memory,
+    device_memory_summary,
+    fault_events,
+    get_memory_usage,
+    record_fault,
+)
 from .xlsx import append_xlsx, read_xlsx, write_xlsx
 
 __all__ = [
     "CheckpointFile",
     "ProcessedSet",
+    "append_jsonl",
+    "clear_fault_events",
+    "fault_events",
+    "record_fault",
     "Progress",
     "SessionLogger",
     "RateLimiter",

@@ -15,15 +15,49 @@ from __future__ import annotations
 
 import json
 import os
-from typing import Any, Iterable, Optional
+from typing import Any, Iterable, Optional, Sequence
+
+
+_TMP_SEQ = iter(range(1 << 62))
 
 
 def _atomic_write_json(path: str, obj: Any) -> None:
     os.makedirs(os.path.dirname(os.path.abspath(path)), exist_ok=True)
-    tmp = path + ".tmp"
+    # per-call unique tmp name: a PreemptionGuard handler saving the same
+    # checkpoint can interrupt an in-progress save IN THE SAME THREAD; with
+    # a shared tmp path the handler's open("w") would truncate the inode
+    # the interrupted writer still holds, whose buffered partial JSON then
+    # flushes on unwind into the freshly-replaced FINAL file.  Unique names
+    # keep the two writes on separate inodes (the interrupted tmp is
+    # orphaned, harmlessly).
+    tmp = f"{path}.{next(_TMP_SEQ)}.tmp"
     with open(tmp, "w") as f:
         json.dump(obj, f, indent=2, default=str)
+        # fsync before the rename: os.replace is atomic against concurrent
+        # readers but not against power/instance loss — an unsynced tmp can
+        # land as an empty/truncated checkpoint after a hard preemption.
+        f.flush()
+        os.fsync(f.fileno())
     os.replace(tmp, path)
+
+
+def append_jsonl(path: str, rows: Iterable[Any], fsync: bool = True) -> None:
+    """Append one JSON object per row to a side-log, crash-consistently.
+
+    The sweep shells' checkpoint flush is an O(new-rows) append to a
+    ``.rows.jsonl`` side-log; with ``fsync`` (the default) the data is
+    forced to disk before the call returns, so a SIGKILL/power loss right
+    after a ``checkpoint_every`` flush can no longer lose the rows the
+    flush claimed to checkpoint.  Numpy scalars serialize via ``.item()``
+    like the sweep writers."""
+    with open(path, "a") as f:
+        for row in rows:
+            f.write(json.dumps(
+                row, default=lambda o: o.item()
+                if hasattr(o, "item") else str(o)) + "\n")
+        if fsync:
+            f.flush()
+            os.fsync(f.fileno())
 
 
 class CheckpointFile:

@@ -13,7 +13,7 @@ import functools
 import random
 import time
 from dataclasses import dataclass, field
-from typing import Callable, Tuple, Type
+from typing import Callable, Optional, Tuple, Type
 
 
 @dataclass
@@ -24,6 +24,11 @@ class RetryPolicy:
     exponential_base: float = 2.0
     jitter: Tuple[float, float] = (0.8, 1.2)
     retry_on: Tuple[Type[BaseException], ...] = (Exception,)
+    # Optional value-level filter consulted AFTER the class check: the error
+    # retries only when isinstance(err, retry_on) AND retry_predicate(err).
+    # Lets one policy retry e.g. only transient errors (runtime/faults.py
+    # is_transient) without enumerating wrapper exception classes.
+    retry_predicate: Optional[Callable[[BaseException], bool]] = None
     sleep: Callable[[float], None] = field(default=time.sleep)
     rng: random.Random = field(default_factory=random.Random)
 
@@ -59,6 +64,12 @@ def retry_with_exponential_backoff(policy: RetryPolicy | None = None, **override
                     last_err = err
                     if attempt == policy.max_retries:
                         break
+                    # consulted only when a retry would actually happen, so
+                    # a recording predicate (faults.retry_transient) never
+                    # logs a retry for the final, propagating failure
+                    if (policy.retry_predicate is not None
+                            and not policy.retry_predicate(err)):
+                        raise
                     policy.sleep(policy.delay_for_attempt(attempt))
             raise last_err
 

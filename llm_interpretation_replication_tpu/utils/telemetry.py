@@ -12,7 +12,42 @@ from __future__ import annotations
 
 import gc
 import shutil
-from typing import Optional
+import time
+from typing import Dict, List, Optional
+
+# ---------------------------------------------------------------------------
+# Fault-event log (runtime/faults.py)
+#
+# Every recovery the fault-tolerance layer performs — an engine batch
+# stepped down after OOM, a transient error retried, a preemption flush —
+# degrades or perturbs the operating point the run reports, so it must stay
+# auditable: a sweep that silently completed at batch 160 instead of 320 is
+# a different measurement.  Events accumulate here (bounded ring) and are
+# readable/drainable by benchmarks, tests, and reports.
+# ---------------------------------------------------------------------------
+
+_FAULT_EVENTS: List[Dict] = []
+_FAULT_EVENTS_CAP = 1000
+
+
+def record_fault(kind: str, **info) -> Dict:
+    """Append one fault-recovery event ({kind, time, **info}); returns it."""
+    event = {"kind": str(kind), "time": time.time(), **info}
+    _FAULT_EVENTS.append(event)
+    if len(_FAULT_EVENTS) > _FAULT_EVENTS_CAP:
+        del _FAULT_EVENTS[: len(_FAULT_EVENTS) - _FAULT_EVENTS_CAP]
+    return event
+
+
+def fault_events(kind: Optional[str] = None) -> List[Dict]:
+    """Recorded fault events, newest last (optionally filtered by kind)."""
+    if kind is None:
+        return list(_FAULT_EVENTS)
+    return [e for e in _FAULT_EVENTS if e["kind"] == kind]
+
+
+def clear_fault_events() -> None:
+    _FAULT_EVENTS.clear()
 
 
 def get_memory_usage() -> str:

@@ -4,9 +4,23 @@ The image is zero-egress (no HF hub), so anything that needs a real tokenizer
 builds a tiny byte-level BPE in process.  Shared by ``tests/helpers.py`` and
 ``__graft_entry__.dryrun_multichip``'s scoring leg so the dryrun exercises the
 exact ScoringEngine path (tokenize → bucket → decode → scan) the sweeps use.
+
+Also home of the FAULT-INJECTION HARNESS (:class:`FaultyEngine`): a wrapper
+that injects device OOM, SIGTERM preemption, transient RPC errors, and NaN
+logits on a schedule, at either the sweep-call or the device-batch
+granularity, so the pytest fault matrix (tests/test_faults.py, ``-m
+faults``) pins every recovery path in runtime/faults.py against a tiny CPU
+model.
 """
 
 from __future__ import annotations
+
+import contextlib
+import dataclasses
+import os
+import signal
+import time
+from typing import List, Optional, Sequence
 
 
 def build_inprocess_tokenizer(vocab_size: int = 300):
@@ -31,3 +45,182 @@ def build_inprocess_tokenizer(vocab_size: int = 300):
     fast.pad_token = fast.decode([0])
     fast.pad_token_id = 0
     return fast
+
+
+# ---------------------------------------------------------------------------
+# Fault-injection harness
+# ---------------------------------------------------------------------------
+
+def injected_oom_error() -> RuntimeError:
+    """The RESOURCE_EXHAUSTED spelling the real stack produces, so the
+    injected fault exercises the same ``faults.is_oom`` classification."""
+    return RuntimeError(
+        "RESOURCE_EXHAUSTED: Out of memory allocating device buffer "
+        "(injected by FaultyEngine)")
+
+
+@dataclasses.dataclass
+class Fault:
+    """One scheduled fault.
+
+    ``kind``:
+      - ``"oom"``       raise a fake RESOURCE_EXHAUSTED
+      - ``"transient"`` raise a :class:`~..runtime.faults.TransientError`
+      - ``"preempt"``   deliver SIGTERM to this process (so installed
+                        :class:`~..runtime.faults.PreemptionGuard` handlers
+                        flush); raises ``Preempted`` directly when no
+                        handler is installed (never kills the test runner)
+      - ``"nan"``       delegate the call, then overwrite every probability
+                        field with NaN — the observable effect of NaN logits
+
+    Exactly one trigger: ``at_call`` (1-based index over the engine's
+    score_prompts / first_token_relative_prob calls — sweep-chunk
+    granularity) or ``at_batch`` (1-based device-batch launch inside the
+    engine — the granularity the engine's OOM back-off operates at).
+    ``times`` repeats the fault on consecutive matching triggers."""
+
+    kind: str
+    at_call: int = 0
+    at_batch: int = 0
+    times: int = 1
+    fired: int = 0
+
+    def __post_init__(self):
+        if self.kind not in ("oom", "transient", "preempt", "nan"):
+            raise ValueError(f"unknown fault kind {self.kind!r}")
+        if (self.at_call > 0) == (self.at_batch > 0):
+            raise ValueError("specify exactly one of at_call / at_batch")
+        if self.kind == "nan" and self.at_batch:
+            raise ValueError("nan faults operate at call granularity")
+
+
+class FaultyEngine:
+    """Duck-typed engine wrapper injecting faults on a schedule.
+
+    Wraps any engine the sweep shells accept (a real ScoringEngine or a
+    test fake) and delegates everything, counting ``calls`` (score_prompts
+    and first_token_relative_prob invocations, shared counter — the same
+    numbering bench's regression tests use) and ``batches`` (device-batch
+    launches, by hooking the engine's ``_run_pipelined`` chokepoint when it
+    has one — installed only for the duration of THIS wrapper's calls, so
+    discarding the wrapper leaves the engine clean and no stale unfired
+    ``at_batch`` fault can ambush a later direct use of the engine).
+    Faults fire per their schedule; everything injected is recorded on
+    ``self.injected`` for assertions."""
+
+    def __init__(self, engine, faults: Sequence[Fault] = ()):
+        self.engine = engine
+        self.faults = list(faults)
+        self.calls = 0
+        self.batches = 0
+        self.injected: List[dict] = []
+        self._hook_batches = any(f.at_batch for f in self.faults)
+
+    @contextlib.contextmanager
+    def _batch_hook(self):
+        """Shadow the engine's ``_run_pipelined`` with the batch-counting
+        hook for one delegated call, restoring the original on exit."""
+        if not self._hook_batches or not hasattr(self.engine,
+                                                 "_run_pipelined"):
+            yield
+            return
+        real_run = self.engine._run_pipelined
+
+        def run(batches, launch, consume, rebatch=None):
+            def counting_launch(batch):
+                self.batches += 1
+                self._maybe_fire(at_batch=self.batches)
+                return launch(batch)
+            return real_run(batches, counting_launch, consume,
+                            rebatch=rebatch)
+
+        self.engine._run_pipelined = run
+        try:
+            yield
+        finally:
+            self.engine.__dict__.pop("_run_pipelined", None)
+
+    # -- delegation ------------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def score_prompts(self, prompts, targets=("Yes", "No"),
+                      with_confidence=False, max_new_tokens=None, **kw):
+        self.calls += 1
+        nan = self._take(at_call=self.calls, kinds=("nan",))
+        self._maybe_fire(at_call=self.calls)
+        kwargs = dict(targets=targets, with_confidence=with_confidence, **kw)
+        if max_new_tokens is not None:  # old-signature engines keep working
+            kwargs["max_new_tokens"] = max_new_tokens
+        with self._batch_hook():
+            rows = self.engine.score_prompts(prompts, **kwargs)
+        if nan is not None:
+            self._record(nan, at_call=self.calls)
+            for row in rows:
+                for key in ("yes_prob", "no_prob", "relative_prob",
+                            "odds_ratio", "first_token_yes_prob",
+                            "first_token_no_prob",
+                            "first_token_relative_prob"):
+                    if key in row:
+                        row[key] = float("nan")
+        return rows
+
+    def first_token_relative_prob(self, prompts, targets=("Yes", "No"),
+                                  top_filter: int = 0):
+        self.calls += 1
+        nan = self._take(at_call=self.calls, kinds=("nan",))
+        self._maybe_fire(at_call=self.calls)
+        with self._batch_hook():
+            out = self.engine.first_token_relative_prob(
+                prompts, targets=targets, top_filter=top_filter)
+        if nan is not None:
+            self._record(nan, at_call=self.calls)
+            out = out * float("nan")
+        return out
+
+    # -- scheduling ------------------------------------------------------
+
+    def _take(self, at_call: int = 0, at_batch: int = 0,
+              kinds: Sequence[str] = ("oom", "transient", "preempt")
+              ) -> Optional[Fault]:
+        for f in self.faults:
+            if f.fired >= f.times or f.kind not in kinds:
+                continue
+            if at_call and f.at_call == at_call:
+                f.fired += 1
+                return f
+            if at_batch and f.at_batch == at_batch:
+                f.fired += 1
+                return f
+        return None
+
+    def _record(self, fault: Fault, **where):
+        self.injected.append({"kind": fault.kind, **where})
+
+    def _maybe_fire(self, at_call: int = 0, at_batch: int = 0) -> None:
+        fault = self._take(at_call=at_call, at_batch=at_batch)
+        if fault is None:
+            return
+        self._record(fault, at_call=at_call, at_batch=at_batch)
+        if fault.kind == "oom":
+            raise injected_oom_error()
+        if fault.kind == "transient":
+            from ..runtime.faults import TransientError
+
+            raise TransientError("injected transient fault (FaultyEngine)")
+        if fault.kind == "preempt":
+            from ..runtime.faults import Preempted
+
+            handler = signal.getsignal(signal.SIGTERM)
+            if callable(handler):
+                # a real handler is installed (e.g. PreemptionGuard): deliver
+                # the actual signal so its flush path runs; the handler's
+                # raise surfaces out of the sleep below
+                os.kill(os.getpid(), signal.SIGTERM)
+                deadline = time.monotonic() + 2.0
+                while time.monotonic() < deadline:
+                    time.sleep(0.01)  # handler raises from in here
+            # SIG_DFL/SIG_IGN would kill (or ignore in) the test runner;
+            # simulate the preemption exit instead
+            raise Preempted(signal.SIGTERM)

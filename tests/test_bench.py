@@ -111,6 +111,28 @@ def test_sweep_oom_without_success_steps_batch_down(tmp_path, monkeypatch):
     assert pps > 0 and np.isfinite(pps)
 
 
+@pytest.mark.faults
+def test_sweep_oom_steps_through_measured_ladder(tmp_path, monkeypatch,
+                                                capsys):
+    """A 384 sweep that OOMs lands on 320 (a fully-measured operating
+    point) before falling to 256 — the shared MEASURED_SWEEP_LADDER in
+    runtime/faults.py, not a flat jump — and every skip/retry message
+    carries the truncated error text as a diagnostic trail."""
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=384)
+    state = _fault_injector(monkeypatch, fail_on_calls={1, 2})
+    pps, rate, out = bench.run_sweep_mode(args, cfg, params)
+    # 384 -> 320 (call 1 OOM) -> 256 (call 2 OOM); both repeats then ran
+    assert args.sweep_batch == 256
+    assert state["calls"] == 4
+    assert pps > 0 and np.isfinite(pps)
+    err = capsys.readouterr().err
+    assert "falling back to 320" in err
+    assert "falling back to 256" in err
+    assert "TPU backend error (fake)" in err  # misclassification stays auditable
+
+
 def test_sweep_oom_at_floor_reraises(tmp_path, monkeypatch):
     cfg = DecoderConfig(**TINY)
     params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)

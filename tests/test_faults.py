@@ -1,0 +1,583 @@
+"""Fault-injection matrix for the runtime fault-tolerance layer.
+
+The north-star sweeps run for hours on shared/preemptible TPU slices where
+co-tenant RESOURCE_EXHAUSTED and SIGTERM preemption are routine, so every
+recovery path in ``runtime/faults.py`` is pinned here against a tiny CPU
+model and the deterministic fake engine, via the ``utils.testing``
+fault-injection harness (:class:`FaultyEngine`):
+
+- OOM at batch launch / mid-chunk → the engine re-buckets the failed batch
+  down the ladder and completes without losing or duplicating a row
+- SIGTERM mid-sweep → the PreemptionGuard flushes checkpoint state and the
+  resumed sweep loses at most the in-flight chunk / model
+- transient RPC error → retried in place with backoff, then success
+- NaN logits → rows still land, the event is recorded in telemetry
+
+All tests are CPU-only and fast; the ``faults`` marker keeps them
+selectable (``-m faults``) and they run inside the tier-1 ``-m 'not slow'``
+PR gate.
+"""
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from llm_interpretation_replication_tpu.runtime import batching
+from llm_interpretation_replication_tpu.runtime.faults import (
+    MEASURED_SWEEP_LADDER,
+    Preempted,
+    PreemptionGuard,
+    TransientError,
+    is_oom,
+    is_transient,
+    next_batch_down,
+    oom_detail,
+    retry_transient,
+)
+from llm_interpretation_replication_tpu.sweeps import (
+    run_instruct_sweep,
+    run_model_perturbation_sweep,
+    run_sweep,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+from llm_interpretation_replication_tpu.utils.retry import RetryPolicy
+from llm_interpretation_replication_tpu.utils.testing import (
+    Fault,
+    FaultyEngine,
+    injected_oom_error,
+)
+
+from test_sweeps import FakeEngine
+
+pytestmark = pytest.mark.faults
+
+#: retry policy with sub-millisecond sleeps so the matrix stays fast
+FAST_RETRY = RetryPolicy(max_retries=3, initial_delay=0.001, max_delay=0.002)
+
+
+@pytest.fixture(autouse=True)
+def _clean_fault_log():
+    telemetry.clear_fault_events()
+    yield
+    telemetry.clear_fault_events()
+
+
+def _scenarios(n_scenarios=2, rephrasings=6):
+    return [
+        {
+            "original_main": f"Is thing {s} a stuff?",
+            "response_format": "Answer only 'Yes' or 'No'.",
+            "confidence_format": "How confident are you (0-100)?",
+            "target_tokens": ["Yes", "No"],
+            "rephrasings": [f"Is thing {s} variant {i} a stuff?"
+                            for i in range(rephrasings)],
+        }
+        for s in range(n_scenarios)
+    ]
+
+
+def _row_keys(df):
+    return list(zip(df["Model"], df["Original Main Part"],
+                    df["Rephrased Main Part"]))
+
+
+# ---------------------------------------------------------------------------
+# Classification + ladder unit behavior
+# ---------------------------------------------------------------------------
+
+class TestClassification:
+    def test_is_oom_matches_every_spelling(self):
+        for s in ("RESOURCE_EXHAUSTED: TPU backend error",
+                  "jax.errors.JaxRuntimeError: ResourceExhausted",
+                  "Resource exhausted: Out of memory allocating 1 bytes"):
+            assert is_oom(RuntimeError(s)), s
+        assert not is_oom(ValueError("shape mismatch"))
+        assert is_oom(injected_oom_error())
+
+    def test_oom_detail_truncates_and_flattens(self):
+        err = RuntimeError("RESOURCE_EXHAUSTED:\n  " + "x" * 400)
+        detail = oom_detail(err)
+        assert len(detail) <= 163 and detail.endswith("...")
+        assert "\n" not in detail
+
+    def test_is_transient_excludes_oom_and_bugs(self):
+        assert is_transient(TransientError("injected"))
+        assert is_transient(ConnectionError("reset"))
+        assert is_transient(RuntimeError("UNAVAILABLE: channel dropped"))
+        assert not is_transient(injected_oom_error())
+        assert not is_transient(ValueError("shape mismatch"))
+
+    def test_next_batch_down_walks_measured_ladder(self):
+        assert next_batch_down(384, MEASURED_SWEEP_LADDER, floor=256) == 320
+        assert next_batch_down(352, MEASURED_SWEEP_LADDER, floor=256) == 320
+        assert next_batch_down(320, MEASURED_SWEEP_LADDER, floor=256) == 256
+        assert next_batch_down(256, MEASURED_SWEEP_LADDER, floor=256) is None
+
+    def test_next_batch_down_halves_without_ladder(self):
+        assert next_batch_down(8) == 4
+        assert next_batch_down(4, floor=3) == 3
+        assert next_batch_down(1) is None
+
+    def test_next_batch_down_floor_zero_never_yields_batch_zero(self):
+        # LLM_INTERP_OOM_FLOOR=0 ("no floor") clamps to 1: batch 0 is
+        # unlaunchable and would crash mid-OOM-recovery
+        assert next_batch_down(2, floor=0) == 1
+        assert next_batch_down(1, floor=0) is None
+
+    def test_env_knobs(self, monkeypatch):
+        from llm_interpretation_replication_tpu.runtime import faults
+
+        monkeypatch.setenv("LLM_INTERP_OOM_BACKOFF", "0")
+        monkeypatch.setenv("LLM_INTERP_OOM_FLOOR", "16")
+        monkeypatch.setenv("LLM_INTERP_OOM_LADDER", "320,256")
+        assert faults.default_engine_backoff() is False
+        assert faults.default_engine_floor() == 16
+        assert faults.default_engine_ladder() == (320, 256)
+
+
+# ---------------------------------------------------------------------------
+# Transient retry
+# ---------------------------------------------------------------------------
+
+class TestRetryTransient:
+    def test_retry_then_success(self):
+        calls = {"n": 0}
+
+        def flaky():
+            calls["n"] += 1
+            if calls["n"] < 3:
+                raise TransientError("injected hiccup")
+            return "ok"
+
+        assert retry_transient(flaky, FAST_RETRY, label="t")() == "ok"
+        assert calls["n"] == 3
+        assert len(telemetry.fault_events("transient_retry")) == 2
+
+    def test_non_transient_propagates_immediately(self):
+        calls = {"n": 0}
+
+        def bug():
+            calls["n"] += 1
+            raise ValueError("shape mismatch")
+
+        with pytest.raises(ValueError):
+            retry_transient(bug, FAST_RETRY)()
+        assert calls["n"] == 1
+
+    def test_exhausted_retries_record_only_actual_retries(self):
+        def always():
+            raise TransientError("injected hiccup")
+
+        with pytest.raises(TransientError):
+            retry_transient(always, FAST_RETRY)()
+        # the final, propagating failure is not a retry and must not be
+        # logged as one — the audit trail counts what actually happened
+        events = telemetry.fault_events("transient_retry")
+        assert len(events) == FAST_RETRY.max_retries
+
+    def test_oom_is_never_retried_in_place(self):
+        calls = {"n": 0}
+
+        def oom():
+            calls["n"] += 1
+            raise injected_oom_error()
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            retry_transient(oom, FAST_RETRY)()
+        assert calls["n"] == 1  # the batch ladder owns OOM, not the retry
+
+
+# ---------------------------------------------------------------------------
+# Preemption guard
+# ---------------------------------------------------------------------------
+
+class TestPreemptionGuard:
+    def test_sigterm_flushes_then_exits_with_143(self):
+        before = signal.getsignal(signal.SIGTERM)
+        flushed = []
+        with pytest.raises(Preempted) as excinfo:
+            with PreemptionGuard(lambda: flushed.append(1), label="t"):
+                os.kill(os.getpid(), signal.SIGTERM)
+                time.sleep(1)  # handler raises out of here at the latest
+        assert flushed == [1]
+        assert excinfo.value.code == 128 + signal.SIGTERM
+        assert signal.getsignal(signal.SIGTERM) is before  # restored
+        assert telemetry.fault_events("preempted")
+
+    def test_sigint_raises_keyboardinterrupt(self):
+        flushed = []
+        with pytest.raises(KeyboardInterrupt):
+            with PreemptionGuard(lambda: flushed.append(1)):
+                os.kill(os.getpid(), signal.SIGINT)
+                time.sleep(1)
+        assert flushed == [1]
+
+    def test_failing_flush_does_not_block_the_next(self, capsys):
+        order = []
+
+        def bad():
+            order.append("bad")
+            raise OSError("disk full")
+
+        guard = PreemptionGuard(bad, lambda: order.append("good"))
+        guard.flush(reason="test")
+        assert order == ["bad", "good"]
+        assert "flush failed" in capsys.readouterr().err
+
+    def test_non_main_thread_degrades_to_noop(self):
+        result = {}
+
+        def worker():
+            with PreemptionGuard(lambda: None) as guard:
+                result["active"] = guard.active
+
+        t = threading.Thread(target=worker)
+        t.start()
+        t.join()
+        assert result["active"] is False
+
+
+# ---------------------------------------------------------------------------
+# Engine back-off mechanics (no model needed)
+# ---------------------------------------------------------------------------
+
+class _PadTok:
+    pad_token_id = 0
+
+
+def _bare_engine(**ecfg_kw):
+    from llm_interpretation_replication_tpu.runtime.engine import (
+        EngineConfig,
+        ScoringEngine,
+    )
+
+    ecfg = EngineConfig(batch_size=4, buckets=(8, 16), **ecfg_kw)
+    return ScoringEngine(None, None, None, _PadTok(), engine_config=ecfg)
+
+
+class TestEngineBackoffMechanics:
+    ENCODED = [[1] * 5 for _ in range(8)]
+
+    def _batches(self, eng):
+        return list(batching.batches_for_prompts(
+            self.ENCODED, eng.ecfg.batch_size, eng.ecfg.buckets, pad_id=0,
+            length_sorted=True))
+
+    def test_rebatch_remaps_indices_exactly_once(self):
+        batches = self._batches(_bare_engine())
+        original = sorted(int(i) for i in batches[0].indices if i >= 0)
+        subs = batching.rebatch(batches[0], self.ENCODED, 2, buckets=(8, 16))
+        covered = sorted(int(i) for b in subs for i in b.indices if i >= 0)
+        assert covered == original        # no row lost, none duplicated
+        assert all(b.token_ids.shape[0] == 2 for b in subs)
+
+    @pytest.mark.parametrize("fail_side", ["launch", "consume"])
+    def test_oom_steps_down_and_covers_every_row(self, fail_side):
+        eng = _bare_engine(oom_backoff=True, oom_batch_floor=1)
+        state = {"launches": 0, "failed": False}
+        consumed = []
+
+        def launch(batch):
+            state["launches"] += 1
+            if fail_side == "launch" and not state["failed"]:
+                state["failed"] = True
+                raise injected_oom_error()
+            return batch
+
+        def consume(batch, out):
+            if fail_side == "consume" and not state["failed"]:
+                state["failed"] = True
+                raise injected_oom_error()
+            consumed.extend(int(i) for i in batch.indices if i >= 0)
+
+        eng._run_pipelined(self._batches(eng), launch, consume,
+                           rebatch=eng._oom_rebatch(self.ENCODED))
+        assert sorted(consumed) == list(range(8))
+        assert state["launches"] > 2      # the failed batch relaunched smaller
+        assert [e["kind"] for e in eng.fault_events] == ["engine_oom_backoff"]
+        assert telemetry.fault_events("engine_oom_backoff")
+
+    def test_oom_at_floor_propagates(self):
+        eng = _bare_engine(oom_backoff=True, oom_batch_floor=4)
+
+        def launch(batch):
+            raise injected_oom_error()
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            eng._run_pipelined(self._batches(eng), launch, lambda b, o: None,
+                               rebatch=eng._oom_rebatch(self.ENCODED))
+
+    def test_backoff_disabled_propagates(self):
+        eng = _bare_engine(oom_backoff=False)
+        assert eng._oom_rebatch(self.ENCODED) is None
+
+    def test_non_oom_errors_propagate(self):
+        eng = _bare_engine(oom_backoff=True, oom_batch_floor=1)
+
+        def launch(batch):
+            raise ValueError("shape mismatch")
+
+        with pytest.raises(ValueError):
+            eng._run_pipelined(self._batches(eng), launch, lambda b, o: None,
+                               rebatch=eng._oom_rebatch(self.ENCODED))
+
+    def test_faulty_engine_hook_detaches_after_each_call(self):
+        """Discarding a FaultyEngine must leave the wrapped engine clean:
+        the batch hook shadows ``_run_pipelined`` only for the duration of
+        the wrapper's own calls, so a stale unfired ``at_batch`` fault can
+        never ambush a later direct use of the engine."""
+        eng = _bare_engine(oom_backoff=True, oom_batch_floor=1)
+        faulty = FaultyEngine(eng, [Fault("oom", at_batch=5)])  # never fires
+        assert "_run_pipelined" not in eng.__dict__
+        with faulty._batch_hook():
+            assert "_run_pipelined" in eng.__dict__
+        assert "_run_pipelined" not in eng.__dict__
+
+    def test_marked_pool_oom_bypasses_rebatch(self):
+        """An OOM flagged ``_no_rebatch`` (a phase-2 pooled decode spanning
+        rows from many batches) must propagate: stepping down the batch
+        that triggered the pool flush cannot shrink the pooled program."""
+        eng = _bare_engine(oom_backoff=True, oom_batch_floor=1)
+
+        def consume(batch, out):
+            err = injected_oom_error()
+            err._no_rebatch = True
+            raise err
+
+        with pytest.raises(RuntimeError, match="RESOURCE_EXHAUSTED"):
+            eng._run_pipelined(self._batches(eng), lambda b: b, consume,
+                               rebatch=eng._oom_rebatch(self.ENCODED))
+
+
+# ---------------------------------------------------------------------------
+# Perturbation sweep fault matrix (fake engine: 2 scenarios x 6 rephrasings,
+# score_chunk=4 -> 3 chunks, confidence off -> 2 engine calls per chunk)
+# ---------------------------------------------------------------------------
+
+def _run_perturbation(tmp_path, engine, name="fake/model-7b", **kw):
+    kw.setdefault("checkpoint_every", 100)
+    kw.setdefault("confidence", False)
+    kw.setdefault("score_chunk", 4)
+    kw.setdefault("retry_policy", FAST_RETRY)
+    return run_model_perturbation_sweep(
+        engine, name, _scenarios(), str(tmp_path / "out.xlsx"), **kw)
+
+
+class TestPerturbationFaultMatrix:
+    def test_transient_error_retries_then_succeeds(self, tmp_path):
+        faulty = FaultyEngine(FakeEngine("fake/model-7b"),
+                              [Fault("transient", at_call=1)])
+        df = _run_perturbation(tmp_path, faulty)
+        clean = _run_perturbation(tmp_path / "clean", FakeEngine("fake/model-7b"))
+        assert len(df) == 12
+        assert sorted(_row_keys(df)) == sorted(_row_keys(clean))
+        np.testing.assert_allclose(
+            df.sort_values("Rephrased Main Part")["Token_1_Prob"].values,
+            clean.sort_values("Rephrased Main Part")["Token_1_Prob"].values)
+        assert faulty.injected == [{"kind": "transient", "at_call": 1,
+                                    "at_batch": 0}]
+        assert telemetry.fault_events("transient_retry")
+
+    def test_nan_logits_recorded_not_silent(self, tmp_path):
+        # call 2 is chunk 1's first_token leg: its 4 rows go NaN
+        faulty = FaultyEngine(FakeEngine("fake/model-7b"),
+                              [Fault("nan", at_call=2)])
+        df = _run_perturbation(tmp_path, faulty)
+        assert len(df) == 12
+        assert len(set(_row_keys(df))) == 12
+        assert int(np.isnan(df["Token_1_Prob"].astype(float)).sum()) == 4
+        events = telemetry.fault_events("nan_logits")
+        assert len(events) == 1 and events[0]["rows"] == 4
+
+    def test_sigterm_mid_sweep_resumes_losing_at_most_one_chunk(self, tmp_path):
+        """Acceptance: a 10k-style sweep interrupted by injected SIGTERM
+        resumes losing <= the in-flight score_chunk."""
+        from llm_interpretation_replication_tpu.sweeps.perturbation import (
+            load_existing_rows,
+        )
+
+        # call 3 = chunk 2's binary leg: chunk 1 done (4 rows pending,
+        # checkpoint_every=100 so unflushed), chunk 2 in flight
+        faulty = FaultyEngine(FakeEngine("fake/model-7b"),
+                              [Fault("preempt", at_call=3)])
+        with pytest.raises(Preempted):
+            _run_perturbation(tmp_path, faulty)
+        # the guard flushed the pending rows inside the grace window
+        rows, keys = load_existing_rows(str(tmp_path / "out.xlsx"))
+        assert len(rows) == 4             # every completed chunk, no more
+        assert telemetry.fault_events("preempted")
+
+        # resume: only the 2 unfinished chunks are rescored, and the final
+        # workbook carries every (model, scenario, rephrasing) exactly once
+        clean = FakeEngine("fake/model-7b")
+        resumed = FaultyEngine(clean, [])
+        df = _run_perturbation(tmp_path, resumed)
+        assert resumed.calls == 4         # 2 chunks x (binary + first_token)
+        assert len(df) == 12
+        assert len(set(_row_keys(df))) == 12
+        assert not os.path.exists(str(tmp_path / "out.xlsx") + ".rows.jsonl")
+
+    def test_torn_sidelog_line_is_skipped_on_resume(self, tmp_path):
+        """A hard kill mid-append can leave a torn trailing JSONL line;
+        resume must skip it (re-scoring its chunk) instead of crashing."""
+        from llm_interpretation_replication_tpu.sweeps.perturbation import (
+            load_existing_rows,
+        )
+
+        out = tmp_path / "out.xlsx"
+        sidelog = str(out) + ".rows.jsonl"
+        good = {"Model": "m", "Original Main Part": "o",
+                "Rephrased Main Part": "r", "Token_1_Prob": 0.5}
+        with open(sidelog, "w") as f:
+            f.write(__import__("json").dumps(good) + "\n")
+            f.write('{"Model": "m", "Original Main Part": "o", "Reph')
+        rows, keys = load_existing_rows(str(out))
+        assert len(rows) == 1
+        assert keys == {("m", "o", "r")}
+
+
+# ---------------------------------------------------------------------------
+# Perturbation sweep on the real tiny engine: injected device OOM at batch
+# granularity steps the batch down inside the engine and the sweep completes
+# with every row intact (acceptance criterion)
+# ---------------------------------------------------------------------------
+
+class TestPerturbationEngineOOM:
+    @pytest.mark.parametrize("at_batch", [1, 2])  # launch + mid-chunk
+    def test_injected_oom_completes_at_stepped_down_batch(self, tmp_path,
+                                                          at_batch):
+        import dataclasses as dc
+
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        eng.ecfg = dc.replace(eng.ecfg, oom_backoff=True, oom_batch_floor=1,
+                              oom_batch_ladder=())
+        faulty = FaultyEngine(eng, [Fault("oom", at_batch=at_batch)])
+        df = _run_perturbation(tmp_path, faulty, score_chunk=12)
+
+        clean_eng, _, _ = _tiny_engine(batch_size=4)
+        clean = _run_perturbation(tmp_path / "clean", clean_eng)
+
+        # no row lost, none duplicated, values identical to the clean run
+        assert len(df) == 12
+        assert sorted(_row_keys(df)) == sorted(_row_keys(clean))
+        merged = df.merge(clean, on="Rephrased Main Part", suffixes=("", "_c"))
+        np.testing.assert_allclose(merged["Token_1_Prob"].astype(float),
+                                   merged["Token_1_Prob_c"].astype(float),
+                                   atol=1e-5)
+        # the degraded batch is on the audit trail
+        assert any(e["kind"] == "engine_oom_backoff" for e in eng.fault_events)
+        event = telemetry.fault_events("engine_oom_backoff")[0]
+        assert event["new_batch"] < event["batch"]
+        assert faulty.injected == [{"kind": "oom", "at_call": 0,
+                                    "at_batch": at_batch}]
+
+
+# ---------------------------------------------------------------------------
+# Instruct sweep fault matrix
+# ---------------------------------------------------------------------------
+
+MODELS = ["fake/gamma-7b-instruct", "fake/delta-7b-chat", "fake/eps-7b-chat"]
+QUESTIONS = [f'Is a "thing{i}" a "stuff{i}"?' for i in range(5)]
+
+
+class TestInstructSweepFaults:
+    def test_transient_error_retries_then_succeeds(self, tmp_path):
+        engines = {}
+
+        def factory(name):
+            faults = ([Fault("transient", at_call=1)]
+                      if name == MODELS[0] else [])
+            engines[name] = FaultyEngine(FakeEngine(name), faults)
+            return engines[name]
+
+        df = run_instruct_sweep(
+            factory, prompts=QUESTIONS, models=MODELS,
+            checkpoint_path=str(tmp_path / "ck.json"),
+            results_csv=str(tmp_path / "out.csv"),
+            retry_policy=FAST_RETRY,
+        )
+        assert len(df) == len(MODELS) * len(QUESTIONS)
+        # retried in place, not burned as MODEL_ERROR rows
+        assert not df["model_output"].str.startswith("MODEL_ERROR").any()
+        assert not df["yes_prob"].isna().any()
+        assert engines[MODELS[0]].calls == 2
+        assert telemetry.fault_events("transient_retry")
+
+    def test_sigterm_mid_sweep_resumes_losing_one_model(self, tmp_path):
+        def faulty_factory(name):
+            faults = [Fault("preempt", at_call=1)] if name == MODELS[1] else []
+            return FaultyEngine(FakeEngine(name), faults)
+
+        ck = str(tmp_path / "ck.json")
+        csv = str(tmp_path / "out.csv")
+        with pytest.raises(Preempted):
+            run_instruct_sweep(faulty_factory, prompts=QUESTIONS,
+                               models=MODELS, checkpoint_path=ck,
+                               results_csv=csv, retry_policy=FAST_RETRY)
+
+        # the guard checkpointed the completed model before exiting
+        factory_calls = []
+
+        def factory(name):
+            factory_calls.append(name)
+            return FakeEngine(name)
+
+        df = run_instruct_sweep(factory, prompts=QUESTIONS, models=MODELS,
+                                checkpoint_path=ck, results_csv=csv)
+        assert factory_calls == MODELS[1:]   # model 0 survived the SIGTERM
+        assert len(df) == len(MODELS) * len(QUESTIONS)
+        assert len(df.drop_duplicates(["model", "prompt"])) == len(df)
+
+
+# ---------------------------------------------------------------------------
+# 100q sweep fault matrix
+# ---------------------------------------------------------------------------
+
+PAIRS_100Q = [
+    {"base": "fake/alpha-7b", "instruct": "fake/alpha-7b-instruct",
+     "family": "Alpha"},
+    {"base": "fake/beta-7b", "instruct": "fake/beta-7b-chat",
+     "family": "Beta"},
+]
+
+
+class Test100qSweepFaults:
+    def test_sigterm_mid_sweep_never_duplicates_rows(self, tmp_path):
+        """Unlike the sibling sweeps, the 100q checkpoint keeps rows and the
+        completion marker as SEPARATE state; the save_checkpoint filter must
+        hold the invariant — rows exactly for completed models — no matter
+        where in the loop the preemption flush fires, or the resumed sweep
+        re-scores a model whose rows are already checkpointed and the CSV
+        carries them twice."""
+        import json as jsonlib
+
+        names = [m for p in PAIRS_100Q for m in (p["base"], p["instruct"])]
+
+        def faulty_factory(name):
+            faults_ = [Fault("preempt", at_call=1)] if name == names[2] else []
+            return FaultyEngine(FakeEngine(name), faults_)
+
+        ck = str(tmp_path / "ck.json")
+        csv = str(tmp_path / "out.csv")
+        with pytest.raises(Preempted):
+            run_sweep(faulty_factory, model_pairs=PAIRS_100Q,
+                      prompts=QUESTIONS, checkpoint_path=ck, results_csv=csv)
+
+        with open(ck) as f:
+            state = jsonlib.load(f)
+        assert state["completed_models"] == sorted(names[:2])
+        # the invariant: checkpointed rows belong exactly to completed models
+        assert {r["model"] for r in state["results"]} == set(names[:2])
+        assert len(state["results"]) == 2 * len(QUESTIONS)
+
+        df = run_sweep(lambda name: FakeEngine(name), model_pairs=PAIRS_100Q,
+                       prompts=QUESTIONS, checkpoint_path=ck, results_csv=csv)
+        assert len(df) == len(names) * len(QUESTIONS)
+        assert len(df.drop_duplicates(["model", "prompt"])) == len(df)
+        assert sorted(set(df["model"])) == sorted(names)
