@@ -1271,6 +1271,13 @@ def main(argv=None):
     p.set_defaults(fn=cmd_analyze_mae_100q)
 
     args = parser.parse_args(argv)
+    # Persistent XLA compilation cache, env-gated: export
+    # LLM_INTERP_COMPILE_CACHE=/path to make every CLI sweep start hot
+    # (resume-after-preemption and repeat runs deserialize their compiled
+    # programs in seconds instead of re-paying 1.5-4 min per program).
+    from .runtime.loader import enable_compile_cache
+
+    enable_compile_cache()
     args.fn(args)
 
 

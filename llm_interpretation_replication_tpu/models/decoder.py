@@ -482,6 +482,109 @@ def _prefill_impl(params, cfg: DecoderConfig, token_ids, attention_mask, cache_l
     return last, cache
 
 
+def _attn_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l):
+    """Attention sub-block for a suffix-extension prefill: queries are the
+    whole suffix (S > 1, known tokens — no sequential dependency), keys are
+    the read-only prefix cache slice plus the suffix's own K/V, softmaxed
+    jointly (ops/attention.cache_extend_attention).  Returns the suffix's
+    K/V so the caller can concatenate them onto the cache for decode."""
+    from ..ops.attention import cache_extend_attention
+
+    b, s, h = x.shape
+    n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
+    ap = lp["attn"]
+    q = quant.linear(ap, "wq", x)
+    k = quant.linear(ap, "wk", x)
+    v = quant.linear(ap, "wv", x)
+    if "bq" in ap:
+        q, k, v = q + ap["bq"], k + ap["bk"], v + ap["bv"]
+    q = q.reshape(b, s, n, d)
+    k = k.reshape(b, s, nkv, d)
+    v = v.reshape(b, s, nkv, d)
+    if sin_cos is not None:
+        sin, cos = sin_cos
+        rd = int(cfg.rotary_pct * d) // 2 * 2
+        q = apply_rotary(q, sin, cos, rd, cfg.rotary_style)
+        k = apply_rotary(k, sin, cos, rd, cfg.rotary_style)
+    out = cache_extend_attention(
+        q, kp_l.astype(x.dtype), vp_l.astype(x.dtype), k, v, bias)
+    out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
+    if "bo" in ap:
+        out = out + ap["bo"]
+    return out, (k, v)
+
+
+def _block_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l):
+    ln1_out = _norm(cfg, x, lp["ln1"])
+    attn_out, new_kv = _attn_extend(cfg, lp, ln1_out, sin_cos, bias, kp_l,
+                                    vp_l)
+    if cfg.parallel_residual:
+        mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
+        x = x + attn_out + _mlp(cfg, lp, mlp_in)
+    else:
+        x = x + attn_out
+        x = x + _mlp(cfg, lp, _norm(cfg, x, lp["ln2"]))
+    return x, new_kv
+
+
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def extend_prefill(params, cfg: DecoderConfig, cache: KVCache, token_ids,
+                   attention_mask, prefix_lengths):
+    """Suffix-extension prefill: run the trunk over ``token_ids`` ([B, S]
+    right-padded suffix tokens) attending over a prefilled prefix
+    :class:`KVCache` — the prefix-reuse half of the engine's fused two-leg
+    scoring (runtime/engine.score_prefixed).  Each leg's short format
+    suffix extends the SAME prefix cache instead of re-running the full
+    prompt forward, cutting per-row prefill FLOPs nearly in half for the
+    full-study row contract.
+
+    Suffix token j of row b sits at absolute position
+    ``prefix_lengths[b] + j``; the returned cache appends the suffix block's
+    K/V and slot->position mapping onto the prefix cache, so
+    :func:`decode_steps` continues from it exactly as from :func:`prefill`'s
+    output.  The caller must not mutate the input ``cache`` — the returned
+    cache shares its buffers (a concatenate, not a copy of the prefix).
+
+    Returns (last_logits [B, V] fp32 at each row's last real suffix token,
+    extended KVCache, total_lengths [B] = prefix + suffix real tokens).
+    """
+    b, s = token_ids.shape
+    mask = attention_mask.astype(bool)
+    rel = jnp.maximum(jnp.cumsum(attention_mask, axis=-1) - 1, 0)
+    positions = prefix_lengths[:, None] + rel                       # [B, S]
+    x = _embed(cfg, params, token_ids, positions)
+    sin_cos = None
+    if cfg.position_embedding == "rotary":
+        rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+        sin_cos = rotary_embedding(positions, rd, cfg.rope_theta,
+                                   params["embed"]["tokens"].dtype)
+    # One bias over the CONCATENATED key axis (prefix slots then suffix
+    # slots): make_attention_bias's position comparison yields causal
+    # masking within the suffix and full visibility of the valid prefix —
+    # the same mask the unfused full-prompt prefill builds, just laid out
+    # over cache slots.
+    kv_positions = jnp.concatenate([cache.positions, positions], axis=1)
+    kv_valid = jnp.concatenate([cache.valid, mask], axis=1)
+    bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
+
+    def body(h, xs):
+        lp, kp_l, vp_l = xs
+        h, (k_s, v_s) = _block_extend(cfg, lp, h, sin_cos, bias, kp_l, vp_l)
+        return h, (k_s, v_s)
+
+    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+    suffix_lengths = jnp.sum(attention_mask, axis=-1)
+    last_h = jnp.take_along_axis(x, (suffix_lengths - 1)[:, None, None], axis=1)
+    last = _unembed(cfg, params, last_h)[:, 0, :]
+    new_cache = KVCache(
+        k=jnp.concatenate([cache.k, ks.astype(cache.k.dtype)], axis=2),
+        v=jnp.concatenate([cache.v, vs.astype(cache.v.dtype)], axis=2),
+        positions=kv_positions, valid=kv_valid,
+        length=cache.length + s,
+    )
+    return last, new_cache, prefix_lengths + suffix_lengths
+
+
 @functools.partial(jax.jit, static_argnames=("cfg", "cache_len"))
 def prefill(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len: int):
     """Phase-1 of the two-phase sweep: one prompt forward that returns BOTH the

@@ -536,6 +536,45 @@ def attention_bsnd(q, k, v, lengths, causal: bool = True,
     return jnp.swapaxes(out, 1, 2)
 
 
+def cache_extend_attention(q, kp, vp, kt, vt, bias):
+    """Attention for a SUFFIX-EXTENSION prefill over a prefilled prefix KV
+    cache (the engine's prefix-reuse path, runtime/engine.score_prefixed):
+    the suffix's queries attend jointly over the big read-only prefix block
+    and the suffix's own K/V.
+
+    q: [B, S, N, D] suffix queries (projection layout, heads unrepeated in
+    K/V); kp/vp: [B, T, G, D] prefix cache block; kt/vt: [B, S, G, D] the
+    suffix's own K/V; bias: fp32 additive [B, N_or_1, S, T+S] built from the
+    cache's slot->position mapping (causal + padding + ALiBi — the caller
+    owns position semantics, exactly like the dense trunk path).
+
+    ONE joint softmax over the concatenated key axis, NOT the two-block
+    split-softmax decode trick (models/decoder.grouped_attention_two_block):
+    the split perturbs the summation grouping, and this path's contract is
+    that a fused prefix+suffix score is numerically indistinguishable from
+    the unfused full-prompt prefill — masked prefix pad slots contribute
+    exact zeros (exp(NEG_INF - max) underflows to 0.0), so the joint softmax
+    reproduces the full-sequence dense attention bit for bit.  A Pallas
+    two-block kernel is deliberately NOT attempted: the sweep's suffix
+    blocks are <=64 tokens, so the score tensor here is [B, N, S_suf, T+S]
+    — tiny next to the prompt forward's S×S — and the r2 outcome table
+    (this module's flash kernel losing ~12% in situ as an opaque fusion
+    boundary) says XLA dense wins at these shapes anyway."""
+    k = jnp.concatenate([kp, kt], axis=1)
+    v = jnp.concatenate([vp, vt], axis=1)
+    b, t, g, d = k.shape
+    n = q.shape[2]
+    if g != n:  # MQA/GQA: repeat K/V to full heads, like the dense trunk
+        k = jnp.broadcast_to(k[:, :, :, None, :], (b, t, g, n // g, d)
+                             ).reshape(b, t, n, d)
+        v = jnp.broadcast_to(v[:, :, :, None, :], (b, t, g, n // g, d)
+                             ).reshape(b, t, n, d)
+    scores = jnp.einsum("bsnd,btnd->bnst", q, k) / jnp.sqrt(d).astype(q.dtype)
+    scores = scores.astype(jnp.float32) + bias
+    probs = jax.nn.softmax(scores, axis=-1).astype(q.dtype)
+    return jnp.einsum("bnst,btnd->bsnd", probs, v)
+
+
 def attention(q, k, v, lengths, causal: bool = True, force: Optional[str] = None,
               interpret: bool = False):
     """Dispatch: 'pallas' on TPU, dense XLA elsewhere.  ``force`` overrides.

@@ -14,7 +14,8 @@ import collections
 import dataclasses
 import functools
 import sys
-from typing import Callable, Dict, Iterable, List, Optional, Sequence
+import time
+from typing import Callable, Dict, Iterable, List, Optional, Sequence, Tuple
 
 import numpy as np
 
@@ -25,8 +26,9 @@ from ..models import decoder as dmod
 from ..models import t5 as t5mod
 from ..scoring import yes_no as yn
 from ..scoring.confidence import weighted_confidence_digits
-from ..utils.telemetry import record_fault
+from ..utils.telemetry import record_counter, record_fault
 from . import batching, faults
+from . import plan as plan_mod
 
 
 @functools.partial(jax.jit, static_argnames=("num_positions", "k"))
@@ -134,6 +136,103 @@ class EngineConfig:
         default_factory=faults.default_engine_ladder)
 
 
+@dataclasses.dataclass
+class LegSpec:
+    """One suffix leg of a fused prefix-reuse scoring call
+    (:meth:`ScoringEngine.score_prefixed`).
+
+    The perturbation sweep's full-study contract is two legs per row over
+    the SAME rephrasing prefix: a binary leg (response format suffix,
+    50-token completion) and a confidence leg (confidence format suffix,
+    ``with_confidence`` + a 10-token cap).  ``max_new_tokens`` feeds the
+    generation-plan cache key (runtime/plan.GenerationPlan), so the two
+    legs keep separate plans/warm program families.
+    ``decode_completions=None`` inherits the engine config."""
+
+    name: str = ""
+    with_confidence: bool = False
+    max_new_tokens: Optional[int] = None
+    decode_completions: Optional[bool] = None
+
+
+class PrefixCachePool:
+    """Lifetime accounting for the fused path's per-batch prefix KV caches.
+
+    The engine prefills each batch's shared prefixes ONCE and every suffix
+    leg extends that cache; the cache itself travels inside the pipeline's
+    (batch, outputs) tuple, and this pool is the audit layer around it:
+    bytes live per entry, acquire/release pairing (a release is mandatory
+    exactly once — double frees raise, leaks are counted at close), and
+    the prefix_hit / prefix_miss telemetry counters.  The OOM-re-bucket
+    composition rule (PR-1 fault layer) is enforced here: a suffix batch
+    that fails mid-leg must release its prefix entry before the re-bucket
+    retries, so retried sub-batches acquire fresh entries and nothing is
+    orphaned or freed twice."""
+
+    class Entry:
+        __slots__ = ("nbytes", "rows", "released")
+
+        def __init__(self, nbytes: int, rows: int):
+            self.nbytes = int(nbytes)
+            self.rows = int(rows)
+            self.released = False
+
+    def __init__(self):
+        self.live: List[PrefixCachePool.Entry] = []
+        self.live_bytes = 0
+        self.peak_bytes = 0
+        self.acquired = 0
+        self.released = 0
+        self.hits = 0
+        self.misses = 0
+        self.leaked = 0
+
+    def acquire(self, nbytes: int, rows: int) -> "PrefixCachePool.Entry":
+        entry = self.Entry(nbytes, rows)
+        self.live.append(entry)
+        self.live_bytes += entry.nbytes
+        self.peak_bytes = max(self.peak_bytes, self.live_bytes)
+        self.acquired += 1
+        self.misses += entry.rows
+        record_counter("prefix_miss", entry.rows)
+        return entry
+
+    def hit(self, rows: int) -> None:
+        """A suffix leg reused an already-prefilled prefix cache for
+        ``rows`` real rows (every leg after the first rides free)."""
+        self.hits += int(rows)
+        record_counter("prefix_hit", int(rows))
+
+    def release(self, entry: "PrefixCachePool.Entry") -> None:
+        if entry.released:
+            raise RuntimeError(
+                "prefix cache entry released twice — the OOM re-bucket "
+                "path must hand each retried sub-batch a FRESH entry")
+        entry.released = True
+        self.live.remove(entry)
+        self.live_bytes -= entry.nbytes
+        self.released += 1
+
+    def close(self) -> None:
+        """End-of-call sweep: any still-live entry is a leak (an error
+        propagated past the pipeline) — force-release and count it so
+        tests and telemetry can tell a clean run from an aborted one."""
+        for entry in list(self.live):
+            entry.released = True
+            self.live.remove(entry)
+            self.live_bytes -= entry.nbytes
+            self.leaked += 1
+        if self.leaked:
+            record_counter("prefix_pool_leaked", self.leaked)
+
+    @property
+    def consistent(self) -> bool:
+        """Every acquire was matched by exactly one release (leaks are
+        force-released by close() but keep the pool inconsistent)."""
+        return (not self.live and self.leaked == 0
+                and self.acquired == self.released)
+
+
 class ScoringEngine:
     """Holds (family, model config, params, tokenizer, mesh) and runs batched
     scoring sweeps."""
@@ -149,6 +248,12 @@ class ScoringEngine:
         # per-engine mirror of the telemetry fault log: every OOM back-off
         # this engine performed (degraded batches are auditable per run)
         self.fault_events: List[Dict] = []
+        # per-(cap, schedule-knobs) generation plans (runtime/plan.py) —
+        # the binary and confidence legs' different max_new_tokens caps
+        # key DIFFERENT plans, so neither evicts the other's
+        self._plan_cache: Dict[Tuple, plan_mod.GenerationPlan] = {}
+        # audit trail of the most recent score_prefixed call's prefix pool
+        self.last_prefix_pool: Optional[PrefixCachePool] = None
 
     # -- helpers ---------------------------------------------------------
 
@@ -327,21 +432,105 @@ class ScoringEngine:
         THIS call only (never below the scored-scan steps) — e.g. the
         perturbation sweep's confidence leg caps at the API legs' 10-token
         contract while the binary leg keeps the full 50.
+
+        Prompts may be strings, pre-tokenized id sequences (lists of
+        ints — how the host pipeline hands over work it encoded on a
+        background thread), or ``(prefix, suffix)`` 2-tuples, which route
+        through the fused prefix-reuse path (:meth:`score_prefixed` with
+        one leg): the prefix prefills into a KV cache and the suffix runs
+        as a short cache-extension prefill.
         """
+        if prompts and _is_prefix_pair(prompts[0]):
+            leg = LegSpec(with_confidence=with_confidence,
+                          max_new_tokens=max_new_tokens)
+            return self.score_prefixed(
+                [(p[0], (p[1],)) for p in prompts], targets=targets,
+                legs=[leg])[0]
         if self.is_encoder_decoder:
             return self._score_encdec(prompts, targets, with_confidence,
                                       max_new_tokens)
         return self._score_decoder(prompts, targets, with_confidence,
                                    max_new_tokens)
 
-    def _gen_plan(self, max_new_tokens: Optional[int] = None):
-        """(scan_steps, total_new_tokens) for the current engine config;
-        ``max_new_tokens`` is a per-call override of the config cap."""
+    def score_prefixed(
+        self,
+        pairs: Sequence,
+        targets: Sequence[str] = ("Yes", "No"),
+        legs: Optional[Sequence[LegSpec]] = None,
+    ) -> List[List[Dict]]:
+        """Fused multi-leg scoring over shared prefixes — the full-study
+        row contract's hot path.
+
+        ``pairs``: one ``(prefix, suffixes)`` tuple per row, where
+        ``suffixes`` holds one format suffix per leg (strings tokenize
+        once per distinct text, with no special tokens; pre-tokenized id
+        lists pass through).  ``legs`` configures each leg (defaults to
+        plain scoring); ``targets`` is one (yes, no) pair or per-row
+        pairs, shared by every leg.
+
+        Instead of tokenizing and prefilling ``{prefix} {suffix}`` once
+        PER LEG (the unfused two-call contract — BENCH_r05's 31.64 rows/s
+        full-study path), the engine prefills each row's prefix exactly
+        once per batch into a bucketed KV cache and runs every leg as a
+        short suffix-extension prefill against that cache
+        (models/decoder.extend_prefill), cutting per-row prefill FLOPs
+        nearly in half for the two-leg contract.  Rows/legs are
+        numerically identical to unfused scoring over the same token
+        streams (tests/test_prefix_reuse.py pins bit-equality on the CPU
+        harness).
+
+        Returns one result-row list per leg, each aligned with ``pairs``.
+        Prefix cache lifetimes are audited on ``self.last_prefix_pool``
+        (prefix_hit/prefix_miss telemetry; OOM re-buckets release their
+        entry before retrying — the PR-1 composition rule)."""
+        n_legs = len(legs) if legs is not None else (
+            len(pairs[0][1]) if pairs else 1)
+        legs = list(legs) if legs is not None else [
+            LegSpec() for _ in range(n_legs)]
+        if pairs and len(pairs[0][1]) != len(legs):
+            raise ValueError(
+                f"{len(legs)} legs configured but pairs carry "
+                f"{len(pairs[0][1])} suffixes")
+        if not pairs:
+            return [[] for _ in legs]
+        prefix_encoded, suffix_encoded = batching.encode_prefix_pairs(
+            self.tokenizer, pairs)
+        if self.is_encoder_decoder:
+            # T5 has no decoder-side prompt cache to extend (the encoder
+            # re-reads the full prompt every leg anyway): score each leg
+            # over the same concatenated token streams — the
+            # tokenize-once half of the contract still holds.
+            return [
+                self.score_prompts(
+                    [list(p) + list(s) for p, s in
+                     zip(prefix_encoded, suffix_encoded[li])],
+                    targets=targets, with_confidence=leg.with_confidence,
+                    max_new_tokens=leg.max_new_tokens)
+                for li, leg in enumerate(legs)
+            ]
+        return self._score_decoder_prefixed(
+            prefix_encoded, suffix_encoded, targets, legs)
+
+    def _gen_plan(self, max_new_tokens: Optional[int] = None,
+                  decode_completions: Optional[bool] = None
+                  ) -> plan_mod.GenerationPlan:
+        """Cached :class:`~.plan.GenerationPlan` for the current engine
+        config; ``max_new_tokens`` is a per-call override of the config
+        cap and is PART OF THE CACHE KEY — the perturbation sweep's binary
+        (50-token) and confidence (10-token) legs resolve to distinct
+        plans instead of overwriting one entry between chunks.  Unpacks
+        like the legacy ``(steps, total)`` tuple."""
         ecfg = self.ecfg
-        steps = max(ecfg.score_steps, ecfg.max_look_ahead)
-        cap = ecfg.max_new_tokens if max_new_tokens is None else max_new_tokens
-        total = max(steps, cap) if ecfg.decode_completions else steps
-        return steps, total
+        dc = ecfg.decode_completions if decode_completions is None \
+            else decode_completions
+        key = (ecfg.score_steps, ecfg.max_look_ahead, ecfg.max_new_tokens,
+               dc, max_new_tokens)
+        plan = self._plan_cache.get(key)
+        if plan is None:
+            plan = self._plan_cache[key] = plan_mod.generation_plan(
+                ecfg.score_steps, ecfg.max_look_ahead, ecfg.max_new_tokens,
+                dc, max_new_tokens)
+        return plan
 
     def _completion_text(self, row_tokens, eos_id) -> str:
         """Decode one row's generated tokens the way the reference records
@@ -410,154 +599,9 @@ class ScoringEngine:
             return last, cache, lengths, scan0, first3
 
         def consume(batch, out):
-            last, cache, lengths, scan0, first3 = out
-            yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
-            first3 = tuple(np.asarray(a) for a in first3)
-            row_ids = self._batch_target_rows(ids_all, batch)
-            valid = batch.indices >= 0
-            undecided = np.flatnonzero(~hit0 & valid)
-            if with_confidence:
-                undecided = np.flatnonzero(valid)  # every row needs scores
-            need_scores = undecided.size > 0
-
-            tokens_np = None      # [B, n_generated] when completions decoded
-            conf_lp = conf_idx = None  # [B|m, P, 19] device top-k when
-                                       # the confidence leg needs it
-            res_np = None         # scan over positions 0..steps-1
-            sub_pos = None        # batch row -> row in the subset arrays
-
-            if ecfg.decode_completions:
-                # Completion chunks: every row generates (the reference's
-                # generate does, regardless of where the scan hit); the first
-                # chunk doubles as the scored look-ahead when any row needs it.
-                #
-                # REDUCED scores: the scored chunk stacks per-step
-                # ReducedScores statistics (top-19 + logsumexp + target
-                # logits) instead of [B, steps, V] fp32 logits — everything
-                # the yes/no scan and the confidence leg read, ~1600x
-                # smaller.  The fp32 buffer (~580 MB at full-study sweep
-                # shapes) was what HBM-capped the sweep's batch at 224
-                # (runtime/plan.resolve_full_sweep_plan).  Falls back to
-                # full scores only for top_k beyond the kept candidates.
-                #
-                # COMPILE FAN-OUT (deliberate): each chunk concatenates its
-                # tail into the cache, so successive chunks see cache lengths
-                # T, T+10, T+20, ... and compile ~gen_total/steps (≈5)
-                # executables per length bucket, amortized by XLA's
-                # persistent compilation cache.  The alternative — pre-pad
-                # the cache once to T+max_new_tokens and write tails in with
-                # dynamic-update-slice for a single shared executable — is
-                # exactly the scatter-updated-cache design the profiler
-                # killed in round 3: the DUS made XLA pick a T-minor cache
-                # layout whose full-cache relayout loop cost 150-310 ms per
-                # batch (models/decoder.KVCache docstring).  Five cheap
-                # compiles beat a relayout per batch.
-                reduced = ecfg.top_k <= dmod.REDUCED_TOPK
-                prev, done, offset = last, None, 0
-                chunk_toks, scores_dev = [], None
-                lag_flag = None  # all-done flag of the PREVIOUS chunk
-                while offset < gen_total:
-                    n = min(steps, gen_total - offset)
-                    ws = offset == 0 and need_scores
-                    toks, sc, cache, prev, done = dmod.decode_steps(
-                        self.params, self.cfg, cache, prev, lengths,
-                        np.int32(offset), n, eos_id, done,
-                        with_scores=("reduced" if reduced else True) if ws else False,
-                        target_ids=jnp.asarray(row_ids) if ws and reduced else None,
-                    )
-                    if ws:
-                        scores_dev = sc
-                    chunk_toks.append(toks)
-                    offset += n
-                    if eos_id is not None and offset < gen_total:
-                        # EOS early exit with a ONE-CHUNK LAG: reading chunk
-                        # k's `done` flag synchronously would leave the device
-                        # idle for a host round-trip before chunk k+1 could
-                        # dispatch.  Instead the flag is reduced on device,
-                        # its host copy starts immediately, and the LOOP EXIT
-                        # decision for chunk k+2 reads chunk k's flag — by
-                        # then chunk k+1 is already queued, so the device
-                        # pipeline never drains.  Cost: at most one surplus
-                        # chunk whose tokens are EOS-frozen (done rows emit
-                        # eos_id, _completion_text cuts at the first EOS), so
-                        # semantics are unchanged.
-                        if lag_flag is not None and bool(np.asarray(lag_flag)):
-                            break  # every row had emitted EOS — generate stops
-                        lag_flag = done.all()
-                        try:
-                            lag_flag.copy_to_host_async()
-                        except AttributeError:
-                            pass  # non-jax array backends: plain fetch later
-                tokens_np = np.concatenate(
-                    [np.asarray(t) for t in chunk_toks], axis=1
-                )
-                if need_scores:
-                    sc_steps = (
-                        dmod.ReducedScores(*(f[:, :steps] for f in scores_dev))
-                        if reduced else scores_dev[:, :steps])
-                    res = self._scan_results(
-                        sc_steps, row_ids[:, 0], row_ids[:, 1],
-                        chunk_toks[0][:, :steps], eos_id)
-                    res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
-                    if with_confidence:
-                        conf_lp, conf_idx = self._conf_topk_np(scores_dev)
-            elif need_scores:
-                # No completions wanted: scored decode only, and only for the
-                # undecided rows — gathered out of the prefill cache so the
-                # prompt forward never re-runs.  The gathered rows normally
-                # accumulate in the cross-batch pool (one decode per
-                # ~pool_target rows); when most of the batch is undecided the
-                # gather-copy is pointless and the batch decodes in place,
-                # and the confidence leg (which needs per-row score buffers
-                # at emission time) always decodes immediately.
-                m = _pad_slice(undecided.size, hit0.shape[0])
-                if m == hit0.shape[0]:
-                    sub_cache, last_s, len_s = cache, last, lengths
-                    real, sub_pos, ids_sub = valid, None, row_ids
-                else:
-                    idx = np.zeros((m,), np.int32)
-                    idx[: undecided.size] = undecided
-                    sub_cache, last_s, len_s = _gather_rows(
-                        cache, last, lengths, jnp.asarray(idx)
-                    )
-                    sub_pos = {int(r): j for j, r in enumerate(undecided)}
-                    real = np.zeros((m,), bool)
-                    real[: undecided.size] = True
-                    ids_sub = row_ids[idx]
-                sc, toks_s = self._scan_decode_chunked(
-                    sub_cache, last_s, len_s, steps, eos_id,
-                    ids_sub[:, 0], ids_sub[:, 1],
-                    min_steps=3 if with_confidence else 0,
-                    real_mask=real,
-                )
-                res = self._scan_results(sc, ids_sub[:, 0], ids_sub[:, 1],
-                                         toks_s, eos_id)
-                res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
-                if with_confidence:
-                    conf_lp, conf_idx = self._conf_topk_np(sc)
-
-            for r, orig in enumerate(batch.indices):
-                if orig < 0:
-                    continue
-                if hit0[r] and not with_confidence:
-                    vals = (yes0[r], no0[r], rel0[r], odds0[r], True)
-                else:
-                    j = r if sub_pos is None else sub_pos.get(r)
-                    vals = (
-                        res_np["yes_prob"][j], res_np["no_prob"][j],
-                        res_np["relative_prob"][j], res_np["odds_ratio"][j],
-                        res_np["found"][j],
-                    )
-                completion = ""
-                if ecfg.decode_completions:
-                    completion = self._completion_text(tokens_np[r], eos_id)
-                row = _attach_first_token(_result_row(*vals, completion),
-                                          first3, r)
-                if with_confidence:
-                    k = r if sub_pos is None else sub_pos[r]
-                    cands = self._candidates_from_topk(conf_lp[k], conf_idx[k])
-                    row["weighted_confidence"] = weighted_confidence_digits(cands)
-                results[int(orig)] = row
+            self._consume_scored_batch(
+                batch, out, ids_all, results, with_confidence, steps,
+                gen_total, ecfg.decode_completions, eos_id)
 
         self._run_pipelined(
             batching.batches_for_prompts(
@@ -568,6 +612,350 @@ class ScoringEngine:
             launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return [r if r is not None else _error_row("missing") for r in results]
+
+    def _consume_scored_batch(self, batch, out, ids_all, results,
+                              with_confidence, steps, gen_total,
+                              decode_completions, eos_id):
+        """Consume one launched scored batch into ``results``: position-0
+        scan rows, completion chunks, the scored look-ahead for undecided
+        rows, and the confidence top-k — the per-batch half of
+        ``score_prompts`` shared by the plain path (one prompt forward per
+        batch) and every suffix leg of the fused prefix-reuse path
+        (``out`` then comes from prefill+extend_prefill, and
+        ``decode_completions``/``gen_total`` are the LEG's plan, not the
+        engine default).  ``out`` is (last_logits, cache, lengths, scan0,
+        first3).  Keyed by prompt index, so a re-consume after an OOM
+        re-bucket is idempotent."""
+        ecfg = self.ecfg
+        last, cache, lengths, scan0, first3 = out
+        yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+        first3 = tuple(np.asarray(a) for a in first3)
+        row_ids = self._batch_target_rows(ids_all, batch)
+        valid = batch.indices >= 0
+        undecided = np.flatnonzero(~hit0 & valid)
+        if with_confidence:
+            undecided = np.flatnonzero(valid)  # every row needs scores
+        need_scores = undecided.size > 0
+
+        tokens_np = None      # [B, n_generated] when completions decoded
+        conf_lp = conf_idx = None  # [B|m, P, 19] device top-k when
+                                   # the confidence leg needs it
+        res_np = None         # scan over positions 0..steps-1
+        sub_pos = None        # batch row -> row in the subset arrays
+
+        if decode_completions:
+            # Completion chunks: every row generates (the reference's
+            # generate does, regardless of where the scan hit); the first
+            # chunk doubles as the scored look-ahead when any row needs it.
+            #
+            # REDUCED scores: the scored chunk stacks per-step
+            # ReducedScores statistics (top-19 + logsumexp + target
+            # logits) instead of [B, steps, V] fp32 logits — everything
+            # the yes/no scan and the confidence leg read, ~1600x
+            # smaller.  The fp32 buffer (~580 MB at full-study sweep
+            # shapes) was what HBM-capped the sweep's batch at 224
+            # (runtime/plan.resolve_full_sweep_plan).  Falls back to
+            # full scores only for top_k beyond the kept candidates.
+            #
+            # COMPILE FAN-OUT (deliberate): each chunk concatenates its
+            # tail into the cache, so successive chunks see cache lengths
+            # T, T+10, T+20, ... and compile ~gen_total/steps (≈5)
+            # executables per length bucket, amortized by XLA's
+            # persistent compilation cache.  The alternative — pre-pad
+            # the cache once to T+max_new_tokens and write tails in with
+            # dynamic-update-slice for a single shared executable — is
+            # exactly the scatter-updated-cache design the profiler
+            # killed in round 3: the DUS made XLA pick a T-minor cache
+            # layout whose full-cache relayout loop cost 150-310 ms per
+            # batch (models/decoder.KVCache docstring).  Five cheap
+            # compiles beat a relayout per batch.
+            reduced = ecfg.top_k <= dmod.REDUCED_TOPK
+            prev, done, offset = last, None, 0
+            chunk_toks, scores_dev = [], None
+            lag_flag = None  # all-done flag of the PREVIOUS chunk
+            while offset < gen_total:
+                n = min(steps, gen_total - offset)
+                ws = offset == 0 and need_scores
+                toks, sc, cache, prev, done = dmod.decode_steps(
+                    self.params, self.cfg, cache, prev, lengths,
+                    np.int32(offset), n, eos_id, done,
+                    with_scores=("reduced" if reduced else True) if ws else False,
+                    target_ids=jnp.asarray(row_ids) if ws and reduced else None,
+                )
+                if ws:
+                    scores_dev = sc
+                chunk_toks.append(toks)
+                offset += n
+                if eos_id is not None and offset < gen_total:
+                    # EOS early exit with a ONE-CHUNK LAG: reading chunk
+                    # k's `done` flag synchronously would leave the device
+                    # idle for a host round-trip before chunk k+1 could
+                    # dispatch.  Instead the flag is reduced on device,
+                    # its host copy starts immediately, and the LOOP EXIT
+                    # decision for chunk k+2 reads chunk k's flag — by
+                    # then chunk k+1 is already queued, so the device
+                    # pipeline never drains.  Cost: at most one surplus
+                    # chunk whose tokens are EOS-frozen (done rows emit
+                    # eos_id, _completion_text cuts at the first EOS), so
+                    # semantics are unchanged.
+                    if lag_flag is not None and bool(np.asarray(lag_flag)):
+                        break  # every row had emitted EOS — generate stops
+                    lag_flag = done.all()
+                    try:
+                        lag_flag.copy_to_host_async()
+                    except AttributeError:
+                        pass  # non-jax array backends: plain fetch later
+            tokens_np = np.concatenate(
+                [np.asarray(t) for t in chunk_toks], axis=1
+            )
+            if need_scores:
+                sc_steps = (
+                    dmod.ReducedScores(*(f[:, :steps] for f in scores_dev))
+                    if reduced else scores_dev[:, :steps])
+                res = self._scan_results(
+                    sc_steps, row_ids[:, 0], row_ids[:, 1],
+                    chunk_toks[0][:, :steps], eos_id)
+                res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+                if with_confidence:
+                    conf_lp, conf_idx = self._conf_topk_np(scores_dev)
+        elif need_scores:
+            # No completions wanted: scored decode only, and only for the
+            # undecided rows — gathered out of the prefill cache so the
+            # prompt forward never re-runs.  The gathered rows normally
+            # accumulate in the cross-batch pool (one decode per
+            # ~pool_target rows); when most of the batch is undecided the
+            # gather-copy is pointless and the batch decodes in place,
+            # and the confidence leg (which needs per-row score buffers
+            # at emission time) always decodes immediately.
+            m = _pad_slice(undecided.size, hit0.shape[0])
+            if m == hit0.shape[0]:
+                sub_cache, last_s, len_s = cache, last, lengths
+                real, sub_pos, ids_sub = valid, None, row_ids
+            else:
+                idx = np.zeros((m,), np.int32)
+                idx[: undecided.size] = undecided
+                sub_cache, last_s, len_s = _gather_rows(
+                    cache, last, lengths, jnp.asarray(idx)
+                )
+                sub_pos = {int(r): j for j, r in enumerate(undecided)}
+                real = np.zeros((m,), bool)
+                real[: undecided.size] = True
+                ids_sub = row_ids[idx]
+            sc, toks_s = self._scan_decode_chunked(
+                sub_cache, last_s, len_s, steps, eos_id,
+                ids_sub[:, 0], ids_sub[:, 1],
+                min_steps=3 if with_confidence else 0,
+                real_mask=real,
+            )
+            res = self._scan_results(sc, ids_sub[:, 0], ids_sub[:, 1],
+                                     toks_s, eos_id)
+            res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+            if with_confidence:
+                conf_lp, conf_idx = self._conf_topk_np(sc)
+
+        for r, orig in enumerate(batch.indices):
+            if orig < 0:
+                continue
+            if hit0[r] and not with_confidence:
+                vals = (yes0[r], no0[r], rel0[r], odds0[r], True)
+            else:
+                j = r if sub_pos is None else sub_pos.get(r)
+                vals = (
+                    res_np["yes_prob"][j], res_np["no_prob"][j],
+                    res_np["relative_prob"][j], res_np["odds_ratio"][j],
+                    res_np["found"][j],
+                )
+            completion = ""
+            if decode_completions:
+                completion = self._completion_text(tokens_np[r], eos_id)
+            row = _attach_first_token(_result_row(*vals, completion),
+                                      first3, r)
+            if with_confidence:
+                k = r if sub_pos is None else sub_pos[r]
+                cands = self._candidates_from_topk(conf_lp[k], conf_idx[k])
+                row["weighted_confidence"] = weighted_confidence_digits(cands)
+            results[int(orig)] = row
+
+    def _score_decoder_prefixed(self, prefix_encoded, suffix_encoded,
+                                targets, legs) -> List[List[Dict]]:
+        """Decoder-only fused path: batches form over PREFIX token lengths
+        (the ordinary length-sorted bucketing); per batch, one prefix
+        prefill + one suffix-extension prefill per leg, then each leg
+        consumes through the shared scored-batch consumer with its own
+        generation plan.  The prefix cache travels inside the pipeline's
+        in-flight tuple and its lifetime is audited by
+        :class:`PrefixCachePool`."""
+        ecfg = self.ecfg
+        n = len(prefix_encoded)
+        ids_all = self._target_id_rows(prefix_encoded, targets)
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
+        results: List[List[Optional[Dict]]] = [[None] * n for _ in legs]
+        decode_flags = [
+            ecfg.decode_completions if leg.decode_completions is None
+            else leg.decode_completions for leg in legs]
+        # each leg's plan resolves with the LEG's completion flag, not the
+        # engine default — a leg overriding decode_completions=True on an
+        # engine configured False must still budget its full decode length
+        plans = [self._gen_plan(leg.max_new_tokens, decode_flags[li])
+                 for li, leg in enumerate(legs)]
+        pad_id = self.tokenizer.pad_token_id or 0
+        pool = PrefixCachePool()
+        self.last_prefix_pool = pool
+
+        def _suffix_batch(batch, li):
+            """[B, suffix_bucket] ids+mask for one leg, aligned with the
+            batch's rows; pad rows (index -1) duplicate row 0's suffix,
+            mirroring batching._emit_batch's prefix padding."""
+            rows = [suffix_encoded[li][int(orig)] if orig >= 0 else None
+                    for orig in batch.indices]
+            first = next(r for r in rows if r is not None)
+            rows = [r if r is not None else first for r in rows]
+            sb = batching.suffix_bucket_for(max(len(r) for r in rows))
+            ids = np.full((len(rows), sb), pad_id, np.int32)
+            mask = np.zeros((len(rows), sb), np.int32)
+            for r, src in enumerate(rows):
+                ids[r, : len(src)] = src
+                mask[r, : len(src)] = 1
+            return ids, mask
+
+        def launch(batch):
+            entry = None
+            try:
+                ids = self._put(batch.token_ids)
+                mask = self._put(batch.attention_mask)
+                last_p, pcache = dmod.prefill(
+                    self.params, self.cfg, ids, mask,
+                    cache_len=batch.bucket_len)
+                plen = jnp.sum(mask, axis=-1)
+                n_real = int((batch.indices >= 0).sum())
+                entry = pool.acquire(_cache_nbytes(pcache), n_real)
+                row_ids = self._batch_target_rows(ids_all, batch)
+                leg_outs = []
+                for li in range(len(legs)):
+                    sids, smask = _suffix_batch(batch, li)
+                    last, cache, lengths = dmod.extend_prefill(
+                        self.params, self.cfg, pcache, self._put(sids),
+                        self._put(smask), plen)
+                    scan0 = yn.first_token_scan(
+                        last, row_ids[:, 0], row_ids[:, 1],
+                        top_k=ecfg.top_k)
+                    first3 = yn.relative_prob_first_token(
+                        last, row_ids[:, 0], row_ids[:, 1],
+                        ecfg.first_token_top_filter)
+                    leg_outs.append((last, cache, lengths, scan0, first3))
+                    if li:  # every leg past the first rides the warm cache
+                        pool.hit(n_real)
+                return entry, leg_outs
+            except Exception:
+                # an OOM here re-buckets THIS batch (runtime/faults.py);
+                # the retried sub-batches acquire fresh entries, so the
+                # failed attempt's entry must die now — never orphaned,
+                # never double-freed
+                if entry is not None:
+                    pool.release(entry)
+                raise
+
+        def consume(batch, out):
+            entry, leg_outs = out
+            try:
+                for li in range(len(legs)):
+                    self._consume_scored_batch(
+                        batch, leg_outs[li], ids_all, results[li],
+                        legs[li].with_confidence, plans[li].scan_steps,
+                        plans[li].total_new_tokens, decode_flags[li],
+                        eos_id)
+            finally:
+                # release exactly once whether the legs consumed clean or
+                # an OOM sends the batch back through the re-bucket ladder
+                pool.release(entry)
+
+        try:
+            self._run_pipelined(
+                batching.batches_for_prompts(
+                    prefix_encoded, ecfg.batch_size, ecfg.buckets,
+                    pad_id=pad_id,
+                    length_sorted=ecfg.length_sorted_batches,
+                ),
+                launch, consume, rebatch=self._oom_rebatch(prefix_encoded),
+            )
+        finally:
+            pool.close()
+        return [
+            [r if r is not None else _error_row("missing") for r in rows]
+            for rows in results
+        ]
+
+    def warmup(self, prompt_lengths: Optional[Sequence[int]] = None,
+               legs: Optional[Sequence[LegSpec]] = None,
+               suffix_length=0,
+               targets: Sequence[str] = ("Yes", "No"),
+               compile_hit_secs: float = 5.0) -> List[Dict]:
+        """Explicit bucket-warmup pass: score one synthetic full batch per
+        occupied length bucket so every device program the sweep will need
+        (prefill, suffix extends, decode chunks, scans) compiles — or
+        deserializes from the persistent compilation cache
+        (runtime/loader.enable_compile_cache) — BEFORE the timed/real rows
+        arrive.  Repeat-0 and preemption-resume runs then start hot
+        (BENCH_r05 measured ~150 s of repeat-0 compilation).
+
+        ``prompt_lengths``: representative prompt (or prefix) token
+        lengths; each distinct bucket warms once (default: the smallest
+        configured bucket).  With ``suffix_length`` truthy the fused
+        prefix-reuse programs warm instead, one suffix leg per entry of
+        ``legs`` (default: one plain leg); pass a PER-LEG sequence when
+        the legs' format suffixes land in different SUFFIX_BUCKETS (an
+        int warms only one suffix shape — a leg bucketing smaller would
+        still compile inside the timed run).  Each leg's
+        ``max_new_tokens`` keys its own generation plan, so warming
+        binary + confidence legs registers BOTH plans
+        (runtime/plan.GenerationPlan.cache_key).
+
+        Returns one report dict per bucket ({bucket, seconds, cache_hit});
+        a bucket whose wall time beat ``compile_hit_secs`` is counted a
+        ``compile_cache_hit`` (deserialization takes seconds; sweep-shape
+        compiles take minutes on the remote-compile chip), else a
+        ``compile_cache_miss``.  The heuristic is for telemetry trend
+        lines, not billing: a tiny model compiling fast on CPU also
+        counts as a hit."""
+        ecfg = self.ecfg
+        if prompt_lengths:
+            buckets = sorted({batching.bucket_for(int(l), ecfg.buckets)
+                              for l in prompt_lengths})
+        else:
+            buckets = [ecfg.buckets[0]]
+        legs = list(legs) if legs else [LegSpec()]
+        if isinstance(suffix_length, (int, np.integer)):
+            suffix_lens = [int(suffix_length)] * len(legs)
+        else:
+            suffix_lens = [int(s) for s in suffix_length]
+            if len(suffix_lens) != len(legs):
+                raise ValueError(
+                    f"{len(suffix_lens)} suffix lengths for "
+                    f"{len(legs)} legs")
+        # any real in-vocab token works; scoring output is discarded
+        tid = int(self.tokenizer.pad_token_id or 0)
+        report = []
+        for bucket in buckets:
+            prompt = [tid] * int(bucket)
+            t0 = time.perf_counter()
+            if any(suffix_lens):
+                pairs = [(prompt, tuple([tid] * max(1, sl)
+                                        for sl in suffix_lens))
+                         ] * ecfg.batch_size
+                self.score_prefixed(pairs, targets=targets, legs=legs)
+            else:
+                for leg in legs:
+                    self.score_prompts(
+                        [prompt] * ecfg.batch_size, targets=targets,
+                        with_confidence=leg.with_confidence,
+                        max_new_tokens=leg.max_new_tokens)
+            dt = time.perf_counter() - t0
+            hit = dt < compile_hit_secs
+            record_counter("compile_cache_hit" if hit
+                           else "compile_cache_miss")
+            report.append({"bucket": int(bucket), "seconds": dt,
+                           "cache_hit": hit})
+        return report
 
     def _score_decoder_pooled(self, encoded, ids_all, results, eos_id,
                               steps) -> List[Dict]:
@@ -894,6 +1282,19 @@ class ScoringEngine:
             launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return out
+
+
+def _is_prefix_pair(prompt) -> bool:
+    """A ``(prefix, suffix)`` 2-TUPLE routes score_prompts through the
+    fused path; pre-tokenized prompts are LISTS/arrays of ints, so the
+    two spellings never collide."""
+    return (isinstance(prompt, tuple) and len(prompt) == 2
+            and not isinstance(prompt[0], (int, np.integer)))
+
+
+def _cache_nbytes(cache) -> int:
+    """Device bytes of one KVCache's K/V blocks (the prefix-pool unit)."""
+    return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
 
 
 #: Fixed menu of phase-2 decode slice sizes.  Finer than powers of two

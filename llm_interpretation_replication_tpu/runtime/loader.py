@@ -22,6 +22,58 @@ from ..models import config as mcfg
 from ..models import convert as mconvert
 
 
+#: Environment gate for the persistent XLA compilation cache: a path
+#: enables it there; "0"/"off"/"" disables even when a caller passes a
+#: default; unset defers to the caller's ``path`` argument.
+COMPILE_CACHE_ENV = "LLM_INTERP_COMPILE_CACHE"
+
+
+def enable_compile_cache(path: Optional[str] = None,
+                         min_compile_secs: float = 5.0) -> Optional[str]:
+    """Point JAX's persistent compilation cache at a directory, env-gated.
+
+    Programs at sweep shapes take 1.5-4 min EACH to compile through the
+    remote-compile helper and were recompiled per process: BENCH_r05's
+    repeat 0 paid ~150 s over repeat 1 on identical code.  With the cache
+    on, repeat-0 and preemption-resume runs deserialize their executables
+    in seconds — combined with an explicit bucket warmup
+    (ScoringEngine.warmup) the cold-start penalty disappears.
+
+    Resolution order: ``$LLM_INTERP_COMPILE_CACHE`` wins when set (a path
+    enables; ``0``/``off``/empty disables); otherwise ``path`` when given;
+    otherwise no-op.  Returns the directory in effect, or None when
+    disabled/unsupported (older jax without the option — compile per run,
+    like before).  Records the ``compile_cache_enabled`` telemetry counter
+    so benchmarks can report whether their warm numbers had it.
+    """
+    env = os.environ.get(COMPILE_CACHE_ENV)
+    if env is not None:
+        if env.strip().lower() in ("", "0", "off", "none"):
+            return None
+        path = env
+    if not path:
+        return None
+    try:
+        import jax
+
+        jax.config.update("jax_compilation_cache_dir",
+                          os.path.abspath(path))
+        jax.config.update("jax_persistent_cache_min_compile_time_secs",
+                          float(min_compile_secs))
+    except Exception as err:
+        # a silently-missing cache costs ~150 s per cold run — leave a
+        # trail distinguishing "jax rejected it" from "env disabled it"
+        import warnings
+
+        warnings.warn(f"persistent compilation cache unavailable "
+                      f"({err}); compiling per process")
+        return None
+    from ..utils.telemetry import record_counter
+
+    record_counter("compile_cache_enabled")
+    return os.path.abspath(path)
+
+
 class CheckpointDir:
     """Random access over a local HF snapshot's weight files."""
 

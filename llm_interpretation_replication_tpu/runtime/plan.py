@@ -28,7 +28,7 @@ fixed runtime reserve.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional
+from typing import Optional, Tuple
 
 HBM_BYTES_V5E = 16 << 30
 #: Head-room XLA/runtime needs beside our tensors (compiled program
@@ -110,6 +110,74 @@ def completions_extra_bytes(cfg, batch: int, seq: int,
         # configuration or the plan under-reserves by ~580 MB per batch.
         scores = batch * score_steps * cfg.vocab_size * 4
     return pipeline_depth * (2 * (cache_b + cache_g) + logits + scores)
+
+
+@dataclasses.dataclass(frozen=True)
+class GenerationPlan:
+    """Resolved per-call generation schedule for one scoring leg.
+
+    ``cache_key`` EXPLICITLY includes the per-call ``max_new_tokens`` cap:
+    the engine keeps one plan per key (runtime/engine._gen_plan), and the
+    warmup pass registers one warmed program family per key — so the
+    perturbation sweep's binary leg (50-token cap, ~5 decode chunks) and
+    confidence leg (10-token cap, 1 chunk) each keep their own plan and
+    compiled-program family instead of a cap-blind key letting one leg
+    evict/overwrite the other's warm state between chunks.
+    """
+    scan_steps: int             # scored look-ahead positions (MAX_LOOK_AHEAD)
+    total_new_tokens: int       # completion decode length for this leg
+    chunks: Tuple[int, ...]     # decode_steps chunk sizes covering the total
+    cache_key: Tuple            # (scan_steps, total, decode_completions, cap)
+
+    def __iter__(self):         # legacy (steps, total) tuple unpacking
+        return iter((self.scan_steps, self.total_new_tokens))
+
+    def __eq__(self, other):    # legacy comparisons against (steps, total)
+        if isinstance(other, tuple):
+            return (self.scan_steps, self.total_new_tokens) == other
+        return (isinstance(other, GenerationPlan)
+                and self.cache_key == other.cache_key)
+
+    def __hash__(self):
+        return hash(self.cache_key)
+
+
+def generation_plan(score_steps: int, max_look_ahead: int, default_cap: int,
+                    decode_completions: bool,
+                    max_new_tokens: Optional[int] = None) -> GenerationPlan:
+    """Build the generation schedule the engine's ``_gen_plan`` used to
+    compute inline: scored-scan steps, the leg's total decode length (the
+    per-call ``max_new_tokens`` override, never below the scored scan), and
+    the decode chunk sizes (``score_steps``-sized chunks; the first doubles
+    as the scored look-ahead — runtime/engine consume loop)."""
+    steps = max(score_steps, max_look_ahead)
+    cap = default_cap if max_new_tokens is None else max_new_tokens
+    total = max(steps, cap) if decode_completions else steps
+    chunks, offset = [], 0
+    while offset < total:
+        chunks.append(min(steps, total - offset))
+        offset += chunks[-1]
+    return GenerationPlan(steps, total, tuple(chunks),
+                          cache_key=(steps, total, decode_completions, cap))
+
+
+def prefix_cache_extra_bytes(cfg, batch: int, prefix_len: int,
+                             n_legs: int = 2, suffix_len: int = 64,
+                             pipeline_depth: int = 2) -> int:
+    """Extra HBM the fused prefix-reuse path (engine.score_prefixed) pins
+    per in-flight pipelined batch beyond the unfused full-study live set:
+    the shared prefix KV cache (bf16, k+v) plus each leg's extended copy
+    (prefix + suffix slots — the extend concatenates, so prefix bytes count
+    once per leg again while the leg is live).  Callers sizing a fused
+    sweep batch should subtract this from the budget headroom the unfused
+    plan (resolve_full_sweep_plan) leaves, or simply step the batch down
+    one 32-step when it OOMs — the fused path also *removes* one full
+    prompt prefill per row, so in practice the measured operating point
+    moves by at most one menu step."""
+    per_tok = cfg.num_layers * batch * cfg.num_kv_heads * cfg.head_dim * 2 * 2
+    shared = per_tok * prefix_len
+    legs = n_legs * per_tok * (prefix_len + suffix_len)
+    return pipeline_depth * (shared + legs)
 
 
 @dataclasses.dataclass

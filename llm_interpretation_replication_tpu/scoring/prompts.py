@@ -36,6 +36,23 @@ def format_prompt(question: str, is_base_model: bool, model_name: str = "") -> s
     return format_instruct_prompt(question, model_name)
 
 
+def format_prompt_parts(question: str, is_base_model: bool,
+                        model_name: str = "") -> tuple:
+    """``(prefix, suffix)`` split of :func:`format_prompt` for the engine's
+    prefix-reuse path (runtime/engine.score_prefixed): concatenating the
+    parts reproduces the reference prompt byte-for-byte, and the split
+    puts the SHARED text in the prefix — the 2-shot preamble for base
+    checkpoints (identical across all 100 questions, so the host
+    tokenizes it once per sweep via encode_prefix_pairs' memo), the bare
+    question for instruct checkpoints."""
+    if is_base_model:
+        return (FEW_SHOT_PREFIX,
+                f"Question: {question} {ANSWER_INSTRUCTION}\nAnswer:")
+    if "baichuan" in model_name.lower():
+        return (f"<human>: {question}", f" {ANSWER_INSTRUCTION}\n<bot>:")
+    return (question, f" {ANSWER_INSTRUCTION}")
+
+
 def format_binary_prompt(main_part: str, response_format: str) -> str:
     """Perturbation-sweep binary prompt: ``{rephrased_main} {response_format}``
     (perturb_prompts.py 'Full Rephrased Prompt' column)."""

@@ -16,7 +16,7 @@ import pandas as pd
 
 from ..config import model_pairs_100q, ordinary_meaning_questions
 from ..runtime import faults
-from ..scoring.prompts import format_prompt
+from ..scoring.prompts import format_prompt, format_prompt_parts
 from ..utils.checkpoint import CheckpointFile
 from ..utils.logging import SessionLogger
 from ..utils.retry import RetryPolicy
@@ -29,12 +29,29 @@ def run_model_on_prompts(engine, model_name: str, prompts: Sequence[str],
                          is_base_model: bool,
                          retry_policy: Optional[RetryPolicy] = None) -> List[Dict]:
     formatted = [format_prompt(q, is_base_model, model_name) for q in prompts]
+    # Engines with the fused path get (prefix, suffix) pairs: the shared
+    # few-shot preamble (identical across all 100 base-model questions)
+    # tokenizes once per sweep and the question rides as a suffix
+    # extension over its prefix cache; the joined parts reproduce
+    # ``formatted`` byte-for-byte, so CSV columns and resume keys are
+    # unchanged.  Engines without it (API fakes) score the full strings.
+    # NOTE: with ONE leg there is no device-side prefill saving (the
+    # engine does not dedupe identical prefixes across rows, and the
+    # extend adds one program family + a KV concat per batch) — the win
+    # here is host-side tokenize-once; device-side dedupe of the shared
+    # preamble (prefill one row, broadcast its cache) is the natural
+    # follow-up if 100q throughput ever matters.
+    if callable(getattr(engine, "score_prefixed", None)):
+        scored = [tuple(format_prompt_parts(q, is_base_model, model_name))
+                  for q in prompts]
+    else:
+        scored = formatted
     try:
         # transient failures retry with backoff before the error-row
         # fallback burns the model's rows (runtime/faults.py)
         rows = faults.retry_transient(
             engine.score_prompts, retry_policy,
-            label=f"100q.{model_name}")(formatted)
+            label=f"100q.{model_name}")(scored)
     except Exception as err:  # error rows keep the sweep moving (ref :484-496)
         return [
             {

@@ -15,6 +15,7 @@ Resume: rows already present in the output workbook are skipped by
 
 from __future__ import annotations
 
+import contextlib
 import json
 import os
 from typing import Dict, List, Optional, Sequence, Tuple
@@ -36,6 +37,16 @@ TOP_LOGPROBS = 20  # API extractor scans top-20 of the first token
 
 def _sidelog_path(output_xlsx: str) -> str:
     return output_xlsx + ".rows.jsonl"
+
+
+@contextlib.contextmanager
+def _closing(prefetcher):
+    """contextlib.closing that tolerates None (no prefetcher in play)."""
+    try:
+        yield prefetcher
+    finally:
+        if prefetcher is not None:
+            prefetcher.close()
 
 
 def _row_key(row: Dict) -> Tuple:
@@ -97,7 +108,24 @@ def run_model_perturbation_sweep(
     score_chunk: int = 2000,
     retry_policy: Optional[RetryPolicy] = None,
     log: Optional[SessionLogger] = None,
+    fuse_prefix: bool = True,
+    host_prefetch: bool = True,
 ) -> pd.DataFrame:
+    """Local-model perturbation sweep (module docstring has the contract).
+
+    ``fuse_prefix`` (default on, engines with ``score_prefixed`` only):
+    each rephrasing tokenizes ONCE per chunk and prefills ONCE per row —
+    the binary and confidence legs run as short pre-tokenized format-suffix
+    extensions over the shared prefix KV cache instead of two full-prompt
+    passes (the r5 full-study path tokenized and prefilled every ~100-430
+    token rephrasing twice).  Suffixes tokenize as ``" " + format`` with no
+    special tokens, so a leg's token stream is the split spelling of the
+    reference's ``f"{rephrasing} {format}"`` prompt.  ``host_prefetch``
+    tokenizes chunk N+1 on a background thread while the device scores
+    chunk N (runtime/batching.HostPrefetcher; idle time the overlap fails
+    to hide lands in the ``host_overlap_idle_ms`` telemetry counter).
+    Engines without the fused API (older/foreign engines, API fakes) keep
+    the legacy two-full-string path bit-for-bit."""
     log = log or SessionLogger()
     all_rows, processed = load_existing_rows(output_xlsx)
     pending: List[Dict] = []
@@ -173,6 +201,8 @@ def run_model_perturbation_sweep(
     except (TypeError, ValueError):
         takes_cap = True
 
+    fuse = fuse_prefix and callable(getattr(engine, "score_prefixed", None))
+
     # Transient-retry wrappers (runtime/faults.py): an RPC hiccup or
     # connection reset from the tunneled runtime retries in place with
     # backoff instead of losing the chunk.  OOM is deliberately NOT
@@ -183,17 +213,111 @@ def run_model_perturbation_sweep(
     first_token = faults.retry_transient(
         engine.first_token_relative_prob, retry_policy,
         label="perturbation.first_token")
+    score_prefixed = (faults.retry_transient(
+        engine.score_prefixed, retry_policy,
+        label="perturbation.score_prefixed") if fuse else None)
+
+    # Fused path host work, done ONCE per sweep: each scenario's format
+    # suffixes pre-tokenize (leading space, no special tokens — the split
+    # spelling of the reference's f"{rephrasing} {format}"), so per chunk
+    # only the rephrasings themselves hit the tokenizer, once each
+    # (satellite fix: the r5 path encoded BOTH full leg strings from
+    # scratch — every rephrasing tokenized twice).
+    if fuse:
+        tok = engine.tokenizer
+        suffix_ids = []
+        for s in scenarios:
+            texts = [" " + s["response_format"]]
+            if confidence:
+                texts.append(" " + s["confidence_format"])
+            suffix_ids.append([
+                list(ids) for ids in
+                tok(texts, add_special_tokens=False)["input_ids"]])
+        scenario_slot = {id(s): i for i, s in enumerate(scenarios)}
+
+        def encode_chunk(chunk):
+            """Tokenize one chunk's rephrasings (once each) and assemble
+            pre-tokenized (prefix_ids, suffix_ids_per_leg) pairs — runs on
+            the prefetcher's background thread, overlapped with device
+            execution of the previous chunk."""
+            prefix_ids = tok([r for _, r in chunk])["input_ids"]
+            pairs = [
+                (list(p), tuple(suffix_ids[scenario_slot[id(s)]]))
+                for p, (s, _) in zip(prefix_ids, chunk)
+            ]
+            targets = [list(s["target_tokens"]) for s, _ in chunk]
+            return chunk, pairs, targets
+
+    def score_chunk_fused(chunk, pairs, targets):
+        """One fused engine call covers BOTH legs: the rephrasing prefix
+        prefills once per row and each leg extends the shared cache.  The
+        confidence leg caps at ``confidence_max_new_tokens`` (default 10):
+        every reference confidence contract is an API leg capped at
+        max_tokens=10 (perturb_prompts_gpt.py:118,143), the parse reads
+        only the first integer, and the weighted confidence reads only the
+        first 3 positions; the cap keys the leg's OWN generation plan
+        (runtime/plan.GenerationPlan), so it never evicts the binary
+        leg's."""
+        from ..runtime.engine import LegSpec
+
+        legs = [LegSpec("binary")]
+        if confidence:
+            legs.append(LegSpec(
+                "confidence", with_confidence=True,
+                max_new_tokens=confidence_max_new_tokens or None))
+        outs = score_prefixed(pairs, targets=targets, legs=legs)
+        return outs[0], (outs[1] if confidence else None)
+
+    def score_chunk_legacy(chunk, targets):
+        """Engines without score_prefixed: the original two-full-string
+        contract, byte-for-byte (API fakes and older engines hash/score
+        the exact prompt strings)."""
+        binary_prompts = [f"{r} {s['response_format']}" for s, r in chunk]
+        responses = score_prompts(binary_prompts, targets=targets)
+        conf_rows = None
+        if confidence:
+            conf_prompts = [f"{r} {s['confidence_format']}"
+                            for s, r in chunk]
+            cap_kw = ({"max_new_tokens": confidence_max_new_tokens}
+                      if confidence_max_new_tokens and takes_cap else {})
+            conf_rows = score_prompts(
+                conf_prompts, targets=targets, with_confidence=True,
+                **cap_kw)
+        return responses, conf_rows
+
+    chunks = [todo_items[start:start + score_chunk]
+              for start in range(0, len(todo_items), score_chunk)]
+    prefetcher = None
+    if fuse and host_prefetch and len(chunks) > 1:
+        # double-buffered host pipeline: chunk N+1 tokenizes while the
+        # device scores chunk N
+        from ..runtime.batching import HostPrefetcher
+
+        prefetcher = HostPrefetcher(chunks, encode_chunk)
+        chunk_iter = iter(prefetcher)
+    elif fuse:
+        chunk_iter = iter(map(encode_chunk, chunks))
+    else:
+        chunk_iter = iter((c, None, [list(s["target_tokens"]) for s, _ in c])
+                          for c in chunks)
 
     # Preemption safety: shared/preemptible slices SIGTERM with a short
     # grace window.  The guard flushes the pending side-log rows before
     # exiting, so a preempted 10k sweep resumes losing at most the
     # in-flight score_chunk (the resume path skips every flushed row).
-    with faults.PreemptionGuard(flush, label="perturbation"):
-        for start in range(0, len(todo_items), score_chunk):
-            chunk = todo_items[start:start + score_chunk]
-            targets = [list(s["target_tokens"]) for s, _ in chunk]
-            binary_prompts = [f"{r} {s['response_format']}" for s, r in chunk]
-            responses = score_prompts(binary_prompts, targets=targets)
+    with faults.PreemptionGuard(flush, label="perturbation"), \
+            _closing(prefetcher):
+        # _closing: a mid-sweep error (device OOM bubbling to the caller's
+        # retry policy, preemption exit) must stop the prefetcher's worker
+        # thread, or it keeps tokenizing the remaining corpus for a sweep
+        # that is no longer running
+        for start, (chunk, pairs, targets) in zip(
+                range(0, len(todo_items), score_chunk), chunk_iter):
+            if fuse:
+                responses, conf_rows = score_chunk_fused(chunk, pairs,
+                                                         targets)
+            else:
+                responses, conf_rows = score_chunk_legacy(chunk, targets)
             ecfg = getattr(engine, "ecfg", None)
             if (ecfg is not None
                     and getattr(ecfg, "first_token_top_filter", None) == TOP_LOGPROBS
@@ -209,6 +333,9 @@ def run_model_perturbation_sweep(
                      row["first_token_relative_prob"]] for row in responses
                 ])
             else:   # foreign/fake engines, custom filters, or error rows
+                binary_prompts = (
+                    [list(p) + list(s[0]) for p, s in pairs] if fuse
+                    else [f"{r} {s['response_format']}" for s, r in chunk])
                 probs = first_token(
                     binary_prompts, targets=targets, top_filter=TOP_LOGPROBS
                 )
@@ -228,22 +355,6 @@ def run_model_perturbation_sweep(
             conf_texts = [""] * len(chunk)
             weighted: List[Optional[float]] = [None] * len(chunk)
             if confidence:
-                conf_prompts = [f"{r} {s['confidence_format']}" for s, r in chunk]
-                # The confidence leg generates at most ``confidence_max_new_
-                # tokens`` (default 10): every reference confidence contract is
-                # an API leg capped at max_tokens=10 (perturb_prompts_gpt.py:
-                # 118,143 — there is no local confidence leg to mirror), the
-                # parse reads only the first integer, and the weighted
-                # confidence reads only the first 3 positions — while a 50-token
-                # generate would spend 5x the decode on text nothing consumes.
-                # (Measured: 26.6 -> 29.0 full-study rows/s on the 10k corpus.)
-                # 0 disables the cap; takes_cap is the signature probe above.
-                cap_kw = ({"max_new_tokens": confidence_max_new_tokens}
-                          if confidence_max_new_tokens and takes_cap else {})
-                conf_rows = score_prompts(
-                    conf_prompts, targets=targets, with_confidence=True,
-                    **cap_kw
-                )
                 for i, row in enumerate(conf_rows):
                     conf_texts[i] = row["completion"]
                     conf_values[i] = extract_first_int(row["completion"])

@@ -12,6 +12,7 @@ from __future__ import annotations
 
 import gc
 import shutil
+import threading
 import time
 from typing import Dict, List, Optional
 
@@ -48,6 +49,49 @@ def fault_events(kind: Optional[str] = None) -> List[Dict]:
 
 def clear_fault_events() -> None:
     _FAULT_EVENTS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Performance counters (runtime/engine.py prefix-KV reuse, compile-cache
+# warmup, host pipeline)
+#
+# Monotonic named counters for the hot-path reuse machinery: how many
+# suffix legs rode an already-prefilled prefix cache (``prefix_hit``) vs
+# paid a fresh prefix prefill (``prefix_miss``), how many warmup programs
+# came out of the persistent XLA compilation cache (``compile_cache_hit`` /
+# ``compile_cache_miss``), and how long the device-feed loop sat idle
+# waiting for background host tokenization (``host_overlap_idle_ms`` /
+# ``host_overlap_chunks``).  Benchmarks and the perf smoke test read these
+# to prove the reuse paths actually engaged; a sweep that silently fell
+# back to unfused scoring is a different measurement.
+# ---------------------------------------------------------------------------
+
+_COUNTERS: Dict[str, float] = {}
+_COUNTERS_LOCK = threading.Lock()  # the host prefetcher records from its
+                                   # worker thread
+
+
+def record_counter(name: str, value: float = 1) -> None:
+    """Add ``value`` to the named monotonic counter (creates it at 0)."""
+    with _COUNTERS_LOCK:
+        _COUNTERS[name] = _COUNTERS.get(name, 0) + value
+
+
+def counter(name: str) -> float:
+    """Current value of one counter (0 when never recorded)."""
+    with _COUNTERS_LOCK:
+        return _COUNTERS.get(name, 0)
+
+
+def counters() -> Dict[str, float]:
+    """Snapshot of all counters."""
+    with _COUNTERS_LOCK:
+        return dict(_COUNTERS)
+
+
+def clear_counters() -> None:
+    with _COUNTERS_LOCK:
+        _COUNTERS.clear()
 
 
 def get_memory_usage() -> str:

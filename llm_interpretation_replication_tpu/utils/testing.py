@@ -115,6 +115,12 @@ class FaultyEngine:
         self.batches = 0
         self.injected: List[dict] = []
         self._hook_batches = any(f.at_batch for f in self.faults)
+        # expose score_prefixed ONLY when the wrapped engine has it, so
+        # hasattr probes (the sweeps' fused-path capability check) see the
+        # same surface as the bare engine — a FakeEngine without the fused
+        # path keeps routing sweeps through the legacy string path
+        if hasattr(engine, "score_prefixed"):
+            self.score_prefixed = self._score_prefixed
 
     @contextlib.contextmanager
     def _batch_hook(self):
@@ -165,6 +171,29 @@ class FaultyEngine:
                     if key in row:
                         row[key] = float("nan")
         return rows
+
+    def _score_prefixed(self, pairs, targets=("Yes", "No"), legs=None, **kw):
+        """Fused-path injection point (installed as ``score_prefixed`` when
+        the wrapped engine has one): shares the call counter and fault
+        schedule with score_prompts — a sweep chunk is one call either
+        way — and hooks device-batch launches identically."""
+        self.calls += 1
+        nan = self._take(at_call=self.calls, kinds=("nan",))
+        self._maybe_fire(at_call=self.calls)
+        with self._batch_hook():
+            outs = self.engine.score_prefixed(pairs, targets=targets,
+                                              legs=legs, **kw)
+        if nan is not None:
+            self._record(nan, at_call=self.calls)
+            for rows in outs:
+                for row in rows:
+                    for key in ("yes_prob", "no_prob", "relative_prob",
+                                "odds_ratio", "first_token_yes_prob",
+                                "first_token_no_prob",
+                                "first_token_relative_prob"):
+                        if key in row:
+                            row[key] = float("nan")
+        return outs
 
     def first_token_relative_prob(self, prompts, targets=("Yes", "No"),
                                   top_filter: int = 0):

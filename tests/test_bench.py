@@ -152,14 +152,28 @@ def test_sweep_full_oom_steps_batch_down_and_keeps_workbook(tmp_path,
     params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
     args = _args(tmp_path, batch=320)
     args.sweep_out = None               # per-repeat tmpdirs: successes stay
-    state = _fault_injector(monkeypatch, fail_on_calls={1})
+    args.warmup = False                 # keep the call accounting exact
+    # the fused sweep shell scores both legs through ONE score_prefixed
+    # call per chunk; inject the repeat-level OOM there
+    real = ScoringEngine.score_prefixed
+    state = {"calls": 0}
+
+    def wrapper(self, pairs, **kw):
+        state["calls"] += 1
+        if state["calls"] == 1:
+            raise RuntimeError("RESOURCE_EXHAUSTED: TPU backend error (fake)")
+        return real(self, pairs, **kw)
+
+    monkeypatch.setattr(ScoringEngine, "score_prefixed", wrapper)
     rps, rate, out = bench.run_sweep_full_mode(args, cfg, params)
     assert args.sweep_batch == 288      # one -32 step, not a flat 256
-    # per repeat the shell calls score_prompts twice (binary + confidence):
-    # failed attempt (1) + retried repeat 0 (2,3) + repeat 1 (4,5)
-    assert state["calls"] == 5
+    # ONE fused call per repeat (binary + confidence legs together):
+    # failed attempt (1) + retried repeat 0 (2) + repeat 1 (3)
+    assert state["calls"] == 3
     assert rps > 0 and np.isfinite(rps)
     assert out and os.path.exists(out)
+    # warm-vs-cold repeat report rides along for the JSON record
+    assert len(args.repeat_times) == 2
 
 
 def test_non_oom_errors_propagate(tmp_path, monkeypatch):

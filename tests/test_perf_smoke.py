@@ -1,0 +1,59 @@
+"""Tier-1 ``-m perf`` smoke test: a 2-chunk FUSED perturbation sweep on the
+in-process harness must engage the prefix-reuse path (nonzero prefix-hit
+counter), keep the prefix pool consistent, and emit rows matching the
+15-column workbook contract — the fast canary that the perf layer did not
+silently fall back to unfused scoring."""
+
+import numpy as np
+import pytest
+
+from test_runtime import _tiny_engine
+
+from llm_interpretation_replication_tpu.sweeps import (
+    run_model_perturbation_sweep,
+)
+from llm_interpretation_replication_tpu.sweeps.writers import (
+    PERTURBATION_COLUMNS,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+
+SCENARIOS = [
+    {
+        "original_main": "Scenario one text.",
+        "response_format": "Answer only 'Yes' or 'No'.",
+        "target_tokens": ["Yes", "No"],
+        "confidence_format": "How confident, 0-100?",
+        "rephrasings": [f"Is thing {i} a stuff?" for i in range(4)],
+    },
+    {
+        "original_main": "Scenario two text.",
+        "response_format": "Answer only 'No' or 'Yes'.",
+        "target_tokens": ["No", "Yes"],
+        "confidence_format": "Confidence from 0 to 100?",
+        "rephrasings": [f"Does item {i} count?" for i in range(4)],
+    },
+]
+
+
+@pytest.mark.perf
+def test_two_chunk_fused_sweep_smoke(tmp_path):
+    eng, _, _ = _tiny_engine(batch_size=4)
+    telemetry.clear_counters()
+    out = str(tmp_path / "results.xlsx")
+    df = run_model_perturbation_sweep(
+        eng, "tiny/perf-smoke", SCENARIOS, out,
+        checkpoint_every=3, score_chunk=4,  # 8 rows -> exactly 2 chunks
+    )
+    # 15-column workbook contract, one row per rephrasing
+    assert list(df.columns) == PERTURBATION_COLUMNS
+    assert len(df) == 8
+    assert df["Token_1_Prob"].astype(float).notna().all()
+    assert (df["Model Confidence Response"].astype(str).str.len() > 0).any()
+    # the fused path actually engaged: each row's confidence leg rode the
+    # binary leg's prefix cache...
+    assert telemetry.counter("prefix_hit") == 8
+    assert telemetry.counter("prefix_miss") == 8
+    # ...the 2-chunk host pipeline served both chunks...
+    assert telemetry.counter("host_overlap_chunks") == 2
+    # ...and every prefix cache entry was released exactly once
+    assert eng.last_prefix_pool.consistent

@@ -1,0 +1,350 @@
+"""Prefix-KV reuse layer (runtime/engine.score_prefixed) + the machinery
+around it: fused-vs-unfused equivalence over identical token streams, the
+prefix cache pool's lifetime accounting under OOM re-bucketing, the
+generation-plan cache keying, the host prefetcher, suffix bucketing, and
+the env-gated persistent compilation cache."""
+
+import os
+
+import numpy as np
+import pytest
+
+from helpers import build_test_tokenizer
+from test_runtime import _tiny_engine
+
+from llm_interpretation_replication_tpu.runtime import batching
+from llm_interpretation_replication_tpu.runtime.engine import LegSpec
+from llm_interpretation_replication_tpu.utils import telemetry
+
+BIN_SUFFIX = " Answer only 'Yes' or 'No'."
+CONF_SUFFIX = " How confident are you, 0-100?"
+
+#: fields the fused path must reproduce EXACTLY: position-0 logits come
+#: out of the suffix-extension prefill bit-identical to the full-prompt
+#: prefill (masked pad slots contribute exact zeros to the joint softmax)
+EXACT_FIELDS = ("first_token_yes_prob", "first_token_no_prob",
+                "first_token_relative_prob", "completion", "success",
+                "scan_found")
+#: fields read from the scored look-ahead DECODE, whose cache is laid out
+#: prefix-bucket + suffix-bucket instead of one full-length bucket — the
+#: same masked key set reduces in a different slot order, so these agree
+#: to reduction-order noise (last-ulp), not bit-for-bit
+SCAN_FIELDS = ("yes_prob", "no_prob", "relative_prob", "odds_ratio")
+
+
+def _pairs(prefixes, confidence=True):
+    sufs = (BIN_SUFFIX, CONF_SUFFIX) if confidence else (BIN_SUFFIX,)
+    return [(p, sufs) for p in prefixes]
+
+
+def _token_streams(tok, pairs):
+    """The unfused comparison prompts: the SAME token ids the fused path
+    consumes, concatenated per leg."""
+    pe, se = batching.encode_prefix_pairs(tok, pairs)
+    return [[p + s for p, s in zip(pe, se[li])] for li in range(len(se))]
+
+
+class TestFusedEquivalence:
+    def test_two_leg_fused_matches_unfused_rows(self):
+        """The acceptance contract: fused prefix+suffix scoring returns the
+        same yes/no logprob rows and confidence rows as the unfused
+        two-leg path over identical token streams — position-0 /
+        completion / first-token fields bit-identical, scored-decode
+        fields to reduction-order noise."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        prefixes = [f"Is thing {i} a stuff?" for i in range(6)]
+        pairs = _pairs(prefixes)
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        fused = eng.score_prefixed(pairs, targets=("Yes", "No"), legs=legs)
+        bin_ids, conf_ids = _token_streams(tok, pairs)
+        unfused = [
+            eng.score_prompts(bin_ids, targets=("Yes", "No")),
+            eng.score_prompts(conf_ids, targets=("Yes", "No"),
+                              with_confidence=True, max_new_tokens=10),
+        ]
+        assert [len(r) for r in fused] == [6, 6]
+        for leg_f, leg_u in zip(fused, unfused):
+            for a, b in zip(leg_f, leg_u):
+                for f in EXACT_FIELDS:
+                    assert a[f] == b[f], f
+                for f in SCAN_FIELDS:
+                    np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                               atol=1e-9, err_msg=f)
+        for a, b in zip(fused[1], unfused[1]):
+            np.testing.assert_allclose(a["weighted_confidence"],
+                                       b["weighted_confidence"],
+                                       rtol=1e-4, atol=1e-6)
+
+    def test_score_prompts_accepts_pairs(self):
+        """A (prefix, suffix) 2-tuple routes score_prompts through the
+        fused single-leg path; rows match scoring the concatenated token
+        stream."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        prefixes = [f"prompt {i} about soup" for i in range(3)]
+        rows_pair = eng.score_prompts([(p, BIN_SUFFIX) for p in prefixes])
+        (bin_ids,) = _token_streams(tok, _pairs(prefixes, confidence=False))
+        rows_flat = eng.score_prompts(bin_ids)
+        for a, b in zip(rows_pair, rows_flat):
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+        # single leg: nothing to reuse, so misses only
+        assert eng.last_prefix_pool.hits == 0
+        assert eng.last_prefix_pool.misses == 3
+
+    def test_per_row_targets_and_counters(self):
+        """Mixed per-row target pairs flow through the fused path, and the
+        prefix-hit counter records one hit per real row per extra leg."""
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prefixes = [f"Is item {i} a thing?" for i in range(5)]
+        targets = [("Yes", "No") if i % 2 else ("No", "Yes")
+                   for i in range(5)]
+        telemetry.clear_counters()
+        fused = eng.score_prefixed(_pairs(prefixes), targets=targets,
+                                   legs=[LegSpec(), LegSpec()])
+        assert len(fused[0]) == len(fused[1]) == 5
+        pool = eng.last_prefix_pool
+        assert pool.consistent
+        assert pool.misses == 5 and pool.hits == 5
+        assert telemetry.counter("prefix_hit") == 5
+        assert telemetry.counter("prefix_miss") == 5
+        # swapped targets really swap the probabilities
+        flat = eng.score_prompts([(p, BIN_SUFFIX) for p in prefixes],
+                                 targets=targets)
+        for a, b in zip(fused[0], flat):
+            assert a["first_token_yes_prob"] == b["first_token_yes_prob"]
+
+    def test_empty_and_mismatched_legs(self):
+        eng, _, _ = _tiny_engine(batch_size=2)
+        assert eng.score_prefixed([], legs=[LegSpec(), LegSpec()]) == [[], []]
+        with pytest.raises(ValueError, match="legs"):
+            eng.score_prefixed([("p", (BIN_SUFFIX,))],
+                               legs=[LegSpec(), LegSpec()])
+
+
+class TestGenerationPlanCache:
+    def test_cap_keys_separate_plans(self):
+        """Satellite: the confidence leg's max_new_tokens cap is part of
+        the plan cache key — the binary (50) and confidence (10) legs hold
+        two live plans side by side instead of evicting each other."""
+        eng, _, _ = _tiny_engine(batch_size=2)
+        eng._plan_cache.clear()
+        p_bin = eng._gen_plan()          # engine default cap (50)
+        p_conf = eng._gen_plan(10)       # confidence cap
+        assert p_bin == (10, 50) and p_conf == (10, 10)  # legacy unpack
+        assert p_bin.cache_key != p_conf.cache_key
+        assert p_bin.cache_key[-1] == 50 and p_conf.cache_key[-1] == 10
+        assert len(eng._plan_cache) == 2
+        # re-resolving either cap returns the SAME cached plan object
+        assert eng._gen_plan() is p_bin
+        assert eng._gen_plan(10) is p_conf
+        assert len(eng._plan_cache) == 2
+        # chunk schedule covers the total in scan-step chunks
+        assert sum(p_bin.chunks) == 50 and p_bin.chunks[0] == 10
+        assert p_conf.chunks == (10,)
+
+
+class TestSuffixBuckets:
+    def test_menu_and_rounding(self):
+        assert batching.suffix_bucket_for(1) == 8
+        assert batching.suffix_bucket_for(8) == 8
+        assert batching.suffix_bucket_for(9) == 16
+        assert batching.suffix_bucket_for(64) == 64
+        assert batching.suffix_bucket_for(65) == 128   # rounds up, no raise
+        assert batching.suffix_bucket_for(130) == 192
+
+
+class TestEncodePrefixPairs:
+    def test_memoizes_and_passes_through_ids(self):
+        tok = build_test_tokenizer()
+        pairs = [("alpha one", (BIN_SUFFIX, CONF_SUFFIX)),
+                 ("beta two", (BIN_SUFFIX, CONF_SUFFIX)),
+                 ([5, 6, 7], ([8], [9, 10]))]
+        pe, se = batching.encode_prefix_pairs(tok, pairs)
+        assert len(pe) == 3 and len(se) == 2
+        assert pe[2] == [5, 6, 7]
+        assert se[0][2] == [8] and se[1][2] == [9, 10]
+        # shared suffix text encodes identically across rows
+        assert se[0][0] == se[0][1]
+        # suffixes tokenize WITHOUT special tokens, prefixes with defaults
+        assert se[0][0] == list(
+            tok([BIN_SUFFIX], add_special_tokens=False)["input_ids"][0])
+
+    def test_encode_prompts_mixed(self):
+        tok = build_test_tokenizer()
+        enc = batching.encode_prompts(tok, ["soup", [1, 2, 3]])
+        assert enc[1] == [1, 2, 3]
+        assert enc[0] == list(tok(["soup"])["input_ids"][0])
+
+
+class TestHostPrefetcher:
+    def test_order_and_counters(self):
+        telemetry.clear_counters()
+        out = list(batching.HostPrefetcher(range(7), lambda i: i * i))
+        assert out == [i * i for i in range(7)]
+        assert telemetry.counter("host_overlap_chunks") == 7
+        assert "host_overlap_idle_ms" in telemetry.counters()
+
+    def test_worker_exception_reraises_in_consumer(self):
+        def fn(i):
+            if i == 2:
+                raise ValueError("boom at 2")
+            return i
+
+        it = iter(batching.HostPrefetcher(range(5), fn))
+        assert next(it) == 0 and next(it) == 1
+        with pytest.raises(ValueError, match="boom at 2"):
+            next(it)
+
+    def test_overlap_actually_runs_ahead(self):
+        """While the consumer sits on item N, the worker should already
+        have produced item N+1 (depth-1 double buffering)."""
+        import time
+
+        produced = []
+
+        def fn(i):
+            produced.append(i)
+            return i
+
+        it = iter(batching.HostPrefetcher(range(3), fn))
+        assert next(it) == 0
+        deadline = time.monotonic() + 2.0
+        while len(produced) < 2 and time.monotonic() < deadline:
+            time.sleep(0.01)
+        assert len(produced) >= 2  # item 1 tokenized before it was asked for
+
+
+class TestCompileCacheEnv:
+    def test_env_gate(self, tmp_path, monkeypatch):
+        from llm_interpretation_replication_tpu.runtime.loader import (
+            enable_compile_cache,
+        )
+
+        import jax
+
+        prev = jax.config.jax_compilation_cache_dir
+        try:
+            # env path wins over the caller's default
+            monkeypatch.setenv("LLM_INTERP_COMPILE_CACHE", str(tmp_path))
+            assert enable_compile_cache("/ignored") == str(tmp_path)
+            assert jax.config.jax_compilation_cache_dir == str(tmp_path)
+            # off-switch beats any default
+            monkeypatch.setenv("LLM_INTERP_COMPILE_CACHE", "0")
+            assert enable_compile_cache(str(tmp_path)) is None
+            # unset env: caller's path is used; no path -> no-op
+            monkeypatch.delenv("LLM_INTERP_COMPILE_CACHE")
+            assert enable_compile_cache(None) is None
+            assert enable_compile_cache(str(tmp_path)) == str(tmp_path)
+        finally:
+            jax.config.update("jax_compilation_cache_dir", prev)
+
+
+class TestWarmup:
+    def test_warmup_compiles_and_records_counters(self):
+        eng, _, _ = _tiny_engine(batch_size=2)
+        telemetry.clear_counters()
+        report = eng.warmup(
+            prompt_lengths=[10, 20], suffix_length=6,
+            legs=[LegSpec("binary"),
+                  LegSpec("confidence", with_confidence=True,
+                          max_new_tokens=10)],
+            compile_hit_secs=1e9,  # tiny CPU compiles always classify hit
+        )
+        assert [r["bucket"] for r in report] == [32]  # both lengths, 1 bucket
+        assert all(r["cache_hit"] for r in report)
+        assert telemetry.counter("compile_cache_hit") == 1
+        # both legs' plans registered under their own cap keys
+        caps = {k[-1] for k in eng._plan_cache}
+        assert {None, 10} <= caps
+
+
+@pytest.mark.faults
+class TestPrefixPoolFaults:
+    def test_oom_mid_suffix_leaves_pool_consistent(self, monkeypatch):
+        """An OOM raised by a suffix-extension launch re-buckets the batch
+        (PR-1 ladder); the failed attempt's prefix cache entry must be
+        released exactly once — never orphaned past the sweep, never
+        double-freed — and the retried rows still land correct rows."""
+        import dataclasses as dc
+
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+        from llm_interpretation_replication_tpu.utils.testing import (
+            injected_oom_error,
+        )
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        eng.ecfg = dc.replace(eng.ecfg, oom_backoff=True, oom_batch_floor=1,
+                              oom_batch_ladder=())
+        prefixes = [f"Is thing {i} a stuff?" for i in range(6)]
+        clean = eng.score_prefixed(_pairs(prefixes),
+                                   legs=[LegSpec(), LegSpec()])
+
+        real_extend = dmod.extend_prefill
+        state = {"calls": 0}
+
+        def failing_extend(*a, **kw):
+            state["calls"] += 1
+            if state["calls"] == 2:  # mid-suffix: leg 2 of the first batch
+                raise injected_oom_error()
+            return real_extend(*a, **kw)
+
+        monkeypatch.setattr(dmod, "extend_prefill", failing_extend)
+        fused = eng.score_prefixed(_pairs(prefixes),
+                                   legs=[LegSpec(), LegSpec()])
+        pool = eng.last_prefix_pool
+        assert pool.consistent, (pool.acquired, pool.released, pool.leaked)
+        assert pool.live_bytes == 0 and not pool.live
+        assert pool.acquired == pool.released > 1  # the retry re-acquired
+        assert any(e["kind"] == "engine_oom_backoff"
+                   for e in eng.fault_events)
+        for leg_c, leg_f in zip(clean, fused):
+            for a, b in zip(leg_c, leg_f):
+                assert b["success"]
+                np.testing.assert_allclose(a["relative_prob"],
+                                           b["relative_prob"], rtol=2e-5)
+
+    def test_double_release_raises(self):
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            PrefixCachePool,
+        )
+
+        pool = PrefixCachePool()
+        entry = pool.acquire(128, 4)
+        pool.release(entry)
+        with pytest.raises(RuntimeError, match="released twice"):
+            pool.release(entry)
+        assert pool.consistent
+
+    def test_abandoned_entry_counts_as_leak(self):
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            PrefixCachePool,
+        )
+
+        pool = PrefixCachePool()
+        pool.acquire(128, 4)
+        pool.close()
+        assert pool.leaked == 1 and not pool.consistent
+        assert pool.live_bytes == 0
+
+
+class TestSweep100qPairs:
+    def test_format_prompt_parts_rejoin(self):
+        from llm_interpretation_replication_tpu.scoring.prompts import (
+            format_prompt,
+            format_prompt_parts,
+        )
+
+        q = 'Is a "tweet" a "publication"?'
+        for base in (True, False):
+            for name in ("org/falcon-7b", "org/Baichuan-13B-Chat"):
+                pre, suf = format_prompt_parts(q, base, name)
+                assert pre + suf == format_prompt(q, base, name)
+        # base-model prefix is the SHARED few-shot preamble
+        pre, _ = format_prompt_parts(q, True)
+        from llm_interpretation_replication_tpu.scoring.prompts import (
+            FEW_SHOT_PREFIX,
+        )
+
+        assert pre == FEW_SHOT_PREFIX
