@@ -836,6 +836,14 @@ def main():
                              "side-log every N rows (the sweep shells' "
                              "resume checkpoint; the xlsx renders once at "
                              "end of sweep)")
+    parser.add_argument("--strict", action="store_true",
+                        help="arm strict mode (runtime/strict.py, same as "
+                             "LLM_INTERP_STRICT=1): transfer-guard the "
+                             "scoring pipeline and count XLA recompiles; "
+                             "the record gains a 'strict' block with the "
+                             "recompile_events / blocked_transfers "
+                             "telemetry counters so the measured operating "
+                             "point is auditable")
     parser.add_argument("--microbatch", type=int, default=1, metavar="N",
                         help="split the batch into N independent chunks "
                              "inside the jit so XLA can overlap one chunk's "
@@ -870,6 +878,20 @@ def main():
 
     import jax
     import jax.numpy as jnp
+
+    from llm_interpretation_replication_tpu.runtime import strict as strict_mod
+
+    if args.strict:
+        strict_mod.activate()
+    else:
+        strict_mod.activate_from_env()
+
+    def _attach_strict(record):
+        """Append the strict-mode audit block (recompile_events /
+        blocked_transfers) to a bench JSON record when armed."""
+        if strict_mod.strict_enabled():
+            record["strict"] = strict_mod.strict_report()
+        return record
 
     # Persistent compilation cache: programs at sweep shapes take 1.5-4 min
     # EACH to compile through the remote-compile helper and are recompiled
@@ -1172,7 +1194,7 @@ def main():
                 "vs_baseline": round(rps / (A100_BASELINE_PROMPTS_PER_SEC / 2), 2),
             }
             record.update(_repeat_report(args))
-            print(json.dumps(record))
+            print(json.dumps(_attach_strict(record)))
             return
         pps, rate, out_path = run_sweep_mode(args, cfg, params)
         print(f"# sweep workbook: {out_path}", file=sys.stderr)
@@ -1267,7 +1289,7 @@ def main():
             except Exception as err:
                 print(f"# full-study secondary failed ({err}); headline "
                       f"record unaffected", file=sys.stderr)
-        print(json.dumps(record))
+        print(json.dumps(_attach_strict(record)))
         return
 
     primary = measure(args.mode, args.iters, args.repeats)
@@ -1288,7 +1310,7 @@ def main():
                 ("decode", measure("decode", max(4, args.iters // 2), 2)),
             )
         ]
-    print(json.dumps(record))
+    print(json.dumps(_attach_strict(record)))
 
 
 if __name__ == "__main__":

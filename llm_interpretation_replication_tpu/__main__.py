@@ -31,6 +31,12 @@ def _write_json(obj, path):
 
 
 def _add_run_config_args(p: argparse.ArgumentParser):
+    p.add_argument("--strict", action="store_true",
+                   help="arm strict mode (runtime/strict.py): disallow "
+                        "implicit device->host transfers outside the "
+                        "engine's sanctioned fetch points and count XLA "
+                        "recompiles into telemetry (recompile_events / "
+                        "blocked_transfers) — same as LLM_INTERP_STRICT=1")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -617,6 +623,19 @@ def cmd_analyze_mae_100q(args):
         _write_json({"families": families, "meta": meta}, args.output_json)
 
 
+def cmd_lint(args):
+    """graftlint: the repo's JAX-aware static-analysis gate (lint/).
+
+    In practice UNREACHABLE — ``main()`` routes ``lint`` to
+    :mod:`..lint.cli` before argparse runs, because REMAINDER cannot
+    accept leading optionals like ``--explain``.  The subparser (and this
+    equivalent forwarder) is registered anyway so the subcommand shows up
+    in ``--help`` next to its siblings."""
+    from .lint.cli import main as lint_main
+
+    raise SystemExit(lint_main(args.lint_args))
+
+
 def cmd_repair_batch(args):
     """Rewrite a corrupted batch-response JSONL (fix_batch_responses.py as a
     subcommand)."""
@@ -936,6 +955,15 @@ def cmd_verify_replication(args):
 
 
 def main(argv=None):
+    if argv is None:
+        argv = sys.argv[1:]
+    if argv and argv[0] == "lint":
+        # routed before argparse: REMAINDER cannot swallow leading
+        # optionals (`lint --explain all` would error against the parent
+        # parser), and the linter needs none of the run-config machinery
+        from .lint.cli import main as lint_main
+
+        raise SystemExit(lint_main(argv[1:]))
     parser = argparse.ArgumentParser(prog="llm_interpretation_replication_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -1158,6 +1186,15 @@ def main(argv=None):
                    help="also write the analysis records here")
     p.set_defaults(fn=cmd_analyze_100q)
 
+    p = sub.add_parser("lint",
+                       help="JAX-aware static analysis (graftlint rules "
+                            "G01-G05) gated by lint_baseline.json")
+    p.add_argument("lint_args", nargs=argparse.REMAINDER,
+                   help="forwarded to the linter: paths, --format "
+                        "text|json, --baseline PATH, --no-baseline, "
+                        "--write-baseline, --explain RULE|all")
+    p.set_defaults(fn=cmd_lint)
+
     p = sub.add_parser("repair-batch",
                        help="re-pair a corrupted batch-response JSONL")
     p.add_argument("--requests", required=True, help="request JSONL")
@@ -1278,6 +1315,16 @@ def main(argv=None):
     from .runtime.loader import enable_compile_cache
 
     enable_compile_cache()
+    # Strict mode (runtime/strict.py): --strict on any local-model command
+    # or LLM_INTERP_STRICT=1 arms the transfer guard + recompile sentry so
+    # the run's operating point is auditable (recompile_events /
+    # blocked_transfers telemetry counters).
+    from .runtime import strict as strict_mod
+
+    if getattr(args, "strict", False):
+        strict_mod.activate()
+    else:
+        strict_mod.activate_from_env()
     args.fn(args)
 
 

@@ -1,0 +1,47 @@
+"""graftlint: JAX-aware static analysis gating this repo's hot paths.
+
+The silent killers of a TPU serving stack are not crashes — they are
+unintended device→host syncs (one ``.item()`` serializes the async
+dispatch pipeline) and shape-driven recompiles (one traced ``if`` retraces
+per batch).  PR 2 built the machinery that avoids them (prefix-KV reuse,
+plan-keyed compile caching, double-buffered host pipeline); this package
+makes reintroducing them a TEST FAILURE instead of a perf mystery.
+
+Layout:
+
+- :mod:`.visitor` — the AST pass: function stack, jit/device-region and
+  static-argname resolution, suppression comments.
+- :mod:`.rules` — rules G01 (host-sync), G02 (traced control flow),
+  G03 (PRNG key reuse), G04 (jit-boundary hygiene), G05 (broad except
+  before fault classification).
+- :mod:`.report` — findings, fingerprints, formatting.
+- :mod:`.baseline` — the grandfathered-findings ratchet
+  (``lint_baseline.json``).
+- :mod:`.cli` — the ``python -m llm_interpretation_replication_tpu lint``
+  subcommand; ``tests/test_lint.py`` runs it inside tier-1.
+
+The runtime complement lives in :mod:`..runtime.strict`: an env-gated
+strict mode (``LLM_INTERP_STRICT=1``) that arms ``jax.transfer_guard``
+around the scoring pipeline and counts recompiles, so the same contract
+the linter enforces statically is enforced (and telemetered) on device.
+"""
+
+from .baseline import apply_baseline, load_baseline, save_baseline
+from .cli import default_paths, lint_paths, main
+from .report import Finding, format_report
+from .rules import RULES, default_rules
+from .visitor import lint_source
+
+__all__ = [
+    "Finding",
+    "RULES",
+    "apply_baseline",
+    "default_paths",
+    "default_rules",
+    "format_report",
+    "lint_paths",
+    "lint_source",
+    "load_baseline",
+    "main",
+    "save_baseline",
+]

@@ -1,0 +1,153 @@
+"""``python -m llm_interpretation_replication_tpu lint`` — the repo gate.
+
+Collects the default target set (the package itself plus the repo-root
+``bench.py``), runs every rule, subtracts the checked-in baseline, and
+exits non-zero on any new finding.  ``tests/test_lint.py`` runs exactly
+this entry point inside tier-1, which is what makes the pass a permanent
+CI gate rather than a one-shot audit.
+
+Usage::
+
+    python -m llm_interpretation_replication_tpu lint
+    python -m llm_interpretation_replication_tpu lint --format json
+    python -m llm_interpretation_replication_tpu lint path/to/file.py
+    python -m llm_interpretation_replication_tpu lint --explain G02
+    python -m llm_interpretation_replication_tpu lint --write-baseline  # refresh
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from typing import List, Optional, Sequence
+
+from .baseline import apply_baseline, load_baseline, save_baseline
+from .report import Finding, format_report, sort_findings
+from .rules import RULES, default_rules
+from .visitor import lint_source
+
+#: directories never linted (vendored/caches); tests are exempt because
+#: fixtures deliberately contain violations.
+EXCLUDE_PARTS = ("/.git/", "/__pycache__/", "/.jax_cache/", "/tests/")
+
+
+def repo_root() -> str:
+    """The directory holding the package (and bench.py / the baseline)."""
+    pkg = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    return os.path.dirname(pkg)
+
+
+def default_paths() -> List[str]:
+    root = repo_root()
+    pkg = os.path.join(root, "llm_interpretation_replication_tpu")
+    paths = [pkg]
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        paths.append(bench)
+    return paths
+
+
+def default_baseline_path() -> str:
+    return os.path.join(repo_root(), "lint_baseline.json")
+
+
+def iter_python_files(paths: Sequence[str]) -> List[str]:
+    out: List[str] = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                for fname in sorted(filenames):
+                    if fname.endswith(".py"):
+                        full = os.path.join(dirpath, fname)
+                        posix = full.replace(os.sep, "/")
+                        if not any(part in posix for part in EXCLUDE_PARTS):
+                            out.append(full)
+        elif p.endswith(".py"):
+            out.append(p)
+    return out
+
+
+def lint_paths(paths: Sequence[str], root: Optional[str] = None,
+               rules=None) -> List[Finding]:
+    """Lint files/directories; paths in findings are relative to ``root``
+    (default: the repo root) so baselines are machine-independent."""
+    root = os.path.abspath(root or repo_root())
+    rules = rules if rules is not None else default_rules()
+    findings: List[Finding] = []
+    for fname in iter_python_files(paths):
+        try:
+            with open(fname, encoding="utf-8") as f:
+                text = f.read()
+        except OSError as err:
+            print(f"# lint: cannot read {fname}: {err}", file=sys.stderr)
+            continue
+        rel = os.path.relpath(os.path.abspath(fname), root)
+        findings.extend(lint_source(rel.replace(os.sep, "/"), text, rules))
+    return sort_findings(findings)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="llm_interpretation_replication_tpu lint",
+        description="JAX-aware static analysis (rules G01-G05) with a "
+                    "grandfathered-findings baseline")
+    parser.add_argument("paths", nargs="*",
+                        help="files/dirs to lint (default: the package + "
+                             "bench.py)")
+    parser.add_argument("--baseline", default=None,
+                        help="baseline JSON (default: lint_baseline.json "
+                             "at the repo root; missing file = empty)")
+    parser.add_argument("--no-baseline", action="store_true",
+                        help="report every finding, grandfathered or not")
+    parser.add_argument("--write-baseline", action="store_true",
+                        help="write the current findings as the new "
+                             "baseline (preserving rationales of entries "
+                             "that still match) and exit 0")
+    parser.add_argument("--format", choices=["text", "json"], default="text")
+    parser.add_argument("--explain", metavar="RULE", default=None,
+                        help="print a rule's description and exit")
+    args = parser.parse_args(argv)
+
+    if args.explain:
+        rid = args.explain.upper()
+        if rid == "ALL":
+            for rule_id, (title, desc) in sorted(RULES.items()):
+                print(f"{rule_id} [{title}] {desc}")
+            return 0
+        if rid not in RULES:
+            print(f"unknown rule {args.explain!r}; known: "
+                  f"{', '.join(sorted(RULES))}")
+            return 2
+        title, desc = RULES[rid]
+        print(f"{rid} [{title}] {desc}")
+        return 0
+
+    paths = args.paths or default_paths()
+    findings = lint_paths(paths)
+    baseline_path = args.baseline or default_baseline_path()
+
+    if args.write_baseline:
+        old = load_baseline(baseline_path)
+        rationales = {
+            (e.get("rule", ""), e.get("path", ""),
+             " ".join(e.get("code", "").split())): e.get("rationale", "")
+            for e in old if e.get("rationale")}
+        save_baseline(findings, baseline_path, rationales)
+        print(f"wrote {len(findings)} finding(s) to {baseline_path}")
+        return 0
+
+    entries = [] if args.no_baseline else load_baseline(baseline_path)
+    new, stale, matched = apply_baseline(findings, entries)
+    print(format_report(new, stale=stale, baselined=matched,
+                        fmt=args.format))
+    # stale entries fail the gate too: the baseline is a ratchet, and a
+    # leftover entry for fixed code would silently re-shield the next
+    # violation with the same fingerprint — delete it (or --write-baseline)
+    return 1 if (new or stale) else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

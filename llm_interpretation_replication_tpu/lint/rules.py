@@ -1,0 +1,405 @@
+"""graftlint rules G01-G05: the TPU-hostile patterns this repo bans.
+
+Each rule is a small class plugging into :class:`..lint.visitor.LintVisitor`
+hooks.  The catalogue (also printed by ``lint --explain``):
+
+- **G01 host-sync** — implicit device→host syncs inside device regions:
+  ``.item()``, ``float()/int()/bool()`` on arrays, ``np.asarray``/
+  ``np.array``/``jax.device_get`` inside jit-compiled functions or the
+  engine's ``launch`` pipeline closures.  One stray sync serializes the
+  async dispatch queue the engine's pipelining depends on (the measured
+  1→2 pipeline-depth gap was 67.6 → 91.5 prompts/s); inside a jit trace it
+  is a ConcretizationError waiting for a shape change.  The sanctioned
+  fetch points are the pipeline's ``consume`` callbacks — runtime/strict.py
+  arms the same contract at runtime via ``jax.transfer_guard``.
+- **G02 traced-control-flow** — Python ``if``/``while`` on traced values
+  inside jit regions.  Works on today's shapes, then either crashes
+  (ConcretizationTypeError) or — worse — silently retraces per value and
+  recompiles per batch.  Static knobs belong in ``static_argnames``;
+  value-dependent branches belong in ``lax.cond``/``jnp.where``.
+- **G03 key-reuse** — the same PRNG key consumed by two ``jax.random``
+  draws without a ``split``: the draws are then CORRELATED (identical for
+  the same shape/dtype), which silently destroys initialization scaling
+  and any sampled statistic downstream.  ``split``/``fold_in`` are
+  derivations, not draws, and don't count as consumption.
+- **G04 jit-boundary** — jit-boundary hygiene: mutable default arguments
+  on jit'd functions (one shared default across every trace), jit over
+  bound methods / ``self`` captures (cache keyed per instance — exactly
+  the leak the ``GenerationPlan`` cache keys were built to avoid), and
+  bare ``jax.jit`` over shape-like parameters (``*_len``/``*_size``/...)
+  that must be static or every distinct value recompiles.
+- **G05 broad-except** — ``except Exception``/bare ``except`` that
+  SWALLOWS (no re-raise) in the fault-handling layers (runtime/, ops/,
+  models/, sweeps/, parallel/, native/): a swallowed RESOURCE_EXHAUSTED
+  never reaches runtime/faults.py's OOM classification, so the batch
+  back-off ladder can't engage and the sweep records a silently degraded
+  operating point.  Handlers that re-raise (``raise`` / ``raise err``)
+  pass; intentional keep-alive catches take an inline
+  ``# graftlint: disable=G05 <reason>``.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import Dict, List, Optional, Set, Tuple
+
+from .visitor import FileContext, LintVisitor, dotted_name
+
+#: rule id -> (title, one-line summary) — the CLI's --explain table.
+RULES: Dict[str, Tuple[str, str]] = {
+    "G00": ("syntax-error", "file failed to parse; nothing else was checked"),
+    "G01": ("host-sync", "implicit device->host sync inside a device region "
+                         "(.item(), float()/bool(), np.asarray in jit/launch)"),
+    "G02": ("traced-control-flow", "Python if/while on a traced value inside "
+                                   "a jit region (retrace/recompile per value)"),
+    "G03": ("key-reuse", "PRNG key consumed twice without split "
+                         "(correlated draws)"),
+    "G04": ("jit-boundary", "jit-boundary hygiene: mutable defaults, "
+                            "self/bound-method capture, unpinned shape params"),
+    "G05": ("broad-except", "broad except swallows errors before "
+                            "runtime/faults.py classification"),
+}
+
+#: numpy-namespace fetch calls (host materialization of a device value)
+_FETCH_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
+                "jax.device_get", "device_get"}
+_CAST_BUILTINS = {"float", "int", "bool"}
+
+
+class HostSyncRule:
+    """G01 — see module docstring."""
+
+    rule = "G01"
+
+    @staticmethod
+    def _device_names(frame) -> set:
+        """Names plausibly holding traced/device values, walked up to the
+        device-region root: every jit frame contributes its non-static
+        params + jax-derived locals (anything reaching a jit body is
+        traced); ``launch`` closures contribute only jax-derived locals
+        (their params are host batch metadata)."""
+        names: set = set()
+        f = frame
+        while f is not None:
+            if f.in_jit:
+                names |= f.traced_names()
+            else:
+                names |= f.traced_locals
+            if f.is_jit or f.is_launch:
+                break
+            f = f.parent
+        return names
+
+    def check_call(self, node: ast.Call, ctx: FileContext,
+                   v: LintVisitor) -> None:
+        frame = v.function
+        fn = dotted_name(node.func)
+        is_item = isinstance(node.func, ast.Attribute) and node.func.attr == "item"
+        in_device = frame is not None and frame.in_device_region
+        if is_item and (in_device or ctx.hot_module):
+            where = ("a jit region" if frame is not None and frame.in_jit
+                     else "a hot-path module")
+            v.report(self.rule, node,
+                     f".item() forces a per-element device sync inside "
+                     f"{where}; fetch whole arrays at the sanctioned "
+                     f"consume points instead")
+            return
+        if not in_device:
+            return
+        if fn in _FETCH_CALLS:
+            v.report(self.rule, node,
+                     f"{fn}() materializes a device value inside a device "
+                     f"region (jit trace / launch closure); move the fetch "
+                     f"to the pipeline's consume callback")
+        elif fn in _CAST_BUILTINS and node.args:
+            arg_names = {n.id for n in ast.walk(node.args[0])
+                         if isinstance(n, ast.Name)}
+            hits = sorted(arg_names & self._device_names(frame))
+            if hits:
+                v.report(self.rule, node,
+                         f"{fn}() on traced/device value(s) "
+                         f"{', '.join(hits)} inside a device region blocks "
+                         f"on the device (ConcretizationError under jit); "
+                         f"keep scalars on device or fetch in consume")
+
+
+class TracedControlFlowRule:
+    """G02 — see module docstring."""
+
+    rule = "G02"
+
+    @staticmethod
+    def _skip_test(test: ast.expr) -> bool:
+        """Tests that are fine in a trace: identity-vs-None, isinstance,
+        hasattr — they interrogate Python structure, not traced values."""
+        if isinstance(test, ast.Compare) and all(
+                isinstance(op, (ast.Is, ast.IsNot)) for op in test.ops):
+            return True
+        if isinstance(test, ast.Call) and dotted_name(test.func) in (
+                "isinstance", "hasattr", "callable", "len"):
+            return True
+        return False
+
+    def _check(self, node, test: ast.expr, ctx: FileContext,
+               v: LintVisitor, kind: str) -> None:
+        frame = v.function
+        if frame is None or not frame.in_jit:
+            return
+        if self._skip_test(test):
+            return
+        # the innermost jit frame's traced names (params minus statics,
+        # plus locals derived from jnp/jax/lax expressions)
+        jit_frame = frame
+        while jit_frame is not None and not jit_frame.is_jit:
+            jit_frame = jit_frame.parent
+        traced = (jit_frame or frame).traced_names() | frame.traced_names()
+        names = {n.id for sub in ast.walk(test)
+                 for n in [sub] if isinstance(sub, ast.Name)}
+        # skip sub-tests that are themselves identity checks (`x is None`)
+        for sub in ast.walk(test):
+            if isinstance(sub, ast.Compare) and all(
+                    isinstance(op, (ast.Is, ast.IsNot)) for op in sub.ops):
+                for n in ast.walk(sub):
+                    if isinstance(n, ast.Name):
+                        names.discard(n.id)
+        hits = sorted(names & traced)
+        if hits:
+            v.report(self.rule, node,
+                     f"Python {kind} on traced value(s) {', '.join(hits)} "
+                     f"inside a jit region — concretizes the tracer (or "
+                     f"retraces per value); use lax.cond/jnp.where, or "
+                     f"declare the parameter in static_argnames")
+
+    def check_if(self, node: ast.If, ctx, v) -> None:
+        self._check(node, node.test, ctx, v, "if")
+
+    def check_while(self, node: ast.While, ctx, v) -> None:
+        self._check(node, node.test, ctx, v, "while")
+
+    def check_ifexp(self, node: ast.IfExp, ctx, v) -> None:
+        self._check(node, node.test, ctx, v, "conditional expression")
+
+
+#: jax.random.* calls that DERIVE keys rather than consuming entropy.
+_KEY_DERIVERS = {"split", "fold_in", "PRNGKey", "key", "key_data",
+                 "wrap_key_data", "clone"}
+
+
+class KeyReuseRule:
+    """G03 — see module docstring.  Statement-order scan per scope."""
+
+    rule = "G03"
+
+    def check_module(self, tree: ast.Module, ctx: FileContext,
+                     v: LintVisitor) -> None:
+        self._scan_scope(tree.body, ctx, v)
+
+    def check_functiondef(self, node, ctx: FileContext,
+                          v: LintVisitor) -> None:
+        if isinstance(node.body, list):  # lambdas carry a bare expression
+            self._scan_scope(node.body, ctx, v)
+
+    # -- implementation ---------------------------------------------------
+
+    @staticmethod
+    def _random_fn(call: ast.Call) -> Optional[str]:
+        """'normal' for jax.random.normal(...) / random.normal(...)."""
+        fn = dotted_name(call.func)
+        if fn.startswith("jax.random.") or fn.startswith("jrandom."):
+            return fn.rsplit(".", 1)[1]
+        if fn.startswith("random.") and fn.count(".") == 1:
+            # `from jax import random` idiom; the stdlib `random` module
+            # takes no key argument, so key-var tracking disambiguates
+            return fn.rsplit(".", 1)[1]
+        return None
+
+    def _scan_scope(self, body, ctx: FileContext, v: LintVisitor) -> None:
+        # keys: name -> (consumed_once, assigned_loop_depth)
+        keys: Dict[str, Tuple[bool, int]] = {}
+
+        def handle_call(call: ast.Call, loop_depth: int) -> None:
+            fn = self._random_fn(call)
+            if fn is None or fn in _KEY_DERIVERS - {"split", "fold_in"}:
+                return
+            consumes = fn not in _KEY_DERIVERS
+            for arg in call.args[:1]:  # the key is the first positional arg
+                if not isinstance(arg, ast.Name) or arg.id not in keys:
+                    continue
+                consumed, assigned_depth = keys[arg.id]
+                if not consumes:
+                    continue
+                if consumed:
+                    v.report(self.rule, call,
+                             f"PRNG key '{arg.id}' consumed again without "
+                             f"split — draws from a reused key are "
+                             f"correlated; split it first")
+                elif loop_depth > assigned_depth:
+                    v.report(self.rule, call,
+                             f"PRNG key '{arg.id}' (assigned outside this "
+                             f"loop) is consumed every iteration — each "
+                             f"pass draws IDENTICAL values; split per "
+                             f"iteration or fold_in the loop index")
+                else:
+                    keys[arg.id] = (True, assigned_depth)
+
+        def note_assign(targets, value, loop_depth: int) -> None:
+            is_key_expr = False
+            if isinstance(value, ast.Call):
+                fn = self._random_fn(value)
+                is_key_expr = fn in ("PRNGKey", "split", "fold_in", "key",
+                                     "clone", "wrap_key_data")
+            elif isinstance(value, ast.Name) and value.id in keys:
+                is_key_expr = True  # aliasing
+            names: List[str] = []
+            for t in targets:
+                for n in ast.walk(t):
+                    if isinstance(n, ast.Name):
+                        names.append(n.id)
+            for name in names:
+                if is_key_expr:
+                    keys[name] = (False, loop_depth)
+                elif name in keys:
+                    del keys[name]  # rebound to a non-key value
+
+        def walk(stmts, loop_depth: int) -> None:
+            for stmt in stmts:
+                if isinstance(stmt, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    continue  # nested scopes get their own scan
+                # calls in this statement's HEADER only — compound bodies
+                # are recursed below at their own loop depth, and walking
+                # them here too would double-count every consumption
+                if isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    headers = [stmt.iter]
+                elif isinstance(stmt, (ast.While, ast.If)):
+                    headers = [stmt.test]
+                elif isinstance(stmt, (ast.With, ast.AsyncWith)):
+                    headers = [item.context_expr for item in stmt.items]
+                elif isinstance(stmt, ast.Try):
+                    headers = []
+                else:
+                    headers = [stmt]
+                for header in headers:
+                    for sub in ast.walk(header):
+                        if isinstance(sub, ast.Call):
+                            handle_call(sub, loop_depth)
+                if isinstance(stmt, ast.Assign):
+                    note_assign(stmt.targets, stmt.value, loop_depth)
+                elif isinstance(stmt, ast.AugAssign):
+                    note_assign([stmt.target], stmt.value, loop_depth)
+                elif isinstance(stmt, (ast.For, ast.AsyncFor)):
+                    walk(stmt.body, loop_depth + 1)
+                    walk(stmt.orelse, loop_depth)
+                elif isinstance(stmt, ast.While):
+                    walk(stmt.body, loop_depth + 1)
+                    walk(stmt.orelse, loop_depth)
+                elif isinstance(stmt, ast.If):
+                    walk(stmt.body, loop_depth)
+                    walk(stmt.orelse, loop_depth)
+                elif isinstance(stmt, (ast.With, ast.AsyncWith, ast.Try)):
+                    for attr in ("body", "orelse", "finalbody"):
+                        walk(getattr(stmt, attr, []) or [], loop_depth)
+                    for h in getattr(stmt, "handlers", []) or []:
+                        walk(h.body, loop_depth)
+
+        walk(body, 0)
+
+
+#: parameter-name suffixes that are shape-like in this codebase (bucket
+#: lengths, batch sizes, chunk/step counts) — feeding them traced means one
+#: recompile per distinct value.
+_SHAPE_SUFFIXES = ("_len", "_size", "_steps", "_chunk")
+
+
+class JitBoundaryRule:
+    """G04 — see module docstring."""
+
+    rule = "G04"
+
+    def check_functiondef(self, node, ctx: FileContext,
+                          v: LintVisitor) -> None:
+        frame = v.function
+        if frame is None or not frame.is_jit:
+            return
+        # (a) mutable defaults: one instance shared by EVERY trace
+        args = node.args
+        for default in list(args.defaults) + [
+                d for d in args.kw_defaults if d is not None]:
+            if isinstance(default, (ast.List, ast.Dict, ast.Set)):
+                v.report(self.rule, default,
+                         "mutable default argument on a jit-compiled "
+                         "function: one shared instance leaks state across "
+                         "traces; default to None and normalize inside")
+        # (b) methods: jit over `self` keys the compile cache per instance
+        if frame.params[:1] == ["self"]:
+            v.report(self.rule, node,
+                     "jax.jit directly on a method: the cache is keyed on "
+                     "the bound instance, so every engine object re-traces "
+                     "and holds its programs alive (defeats plan-key "
+                     "sharing); jit a free function or use a cached "
+                     "closure")
+        # (d) bare jit over shape-like params
+        if not frame.static_params:
+            shapeish = [p for p in frame.params
+                        if p.endswith(_SHAPE_SUFFIXES)]
+            if shapeish:
+                v.report(self.rule, node,
+                         f"jit without static_argnums/static_argnames over "
+                         f"shape-like parameter(s) {', '.join(shapeish)}: "
+                         f"tracing them defeats bucketing (a recompile per "
+                         f"distinct value) — declare them static")
+
+    def check_call(self, node: ast.Call, ctx: FileContext,
+                   v: LintVisitor) -> None:
+        # (c) jax.jit(self.method) / jax.jit(obj.method)
+        fn = dotted_name(node.func)
+        if fn not in ("jax.jit", "jit", "pjit", "jax.pjit"):
+            return
+        if node.args and isinstance(node.args[0], ast.Attribute):
+            target = dotted_name(node.args[0])
+            v.report(self.rule, node,
+                     f"jax.jit({target}): jitting a bound method/attribute "
+                     f"keys the compile cache on the instance — every new "
+                     f"object recompiles and pins its executables; jit a "
+                     f"module-level function instead")
+
+
+class BroadExceptRule:
+    """G05 — see module docstring."""
+
+    rule = "G05"
+
+    def check_excepthandler(self, node: ast.ExceptHandler, ctx: FileContext,
+                            v: LintVisitor) -> None:
+        if not ctx.fault_module:
+            return
+        def is_broad(t) -> bool:
+            if t is None:
+                return True
+            if isinstance(t, ast.Name):
+                return t.id in ("Exception", "BaseException")
+            if isinstance(t, ast.Attribute):
+                return t.attr in ("Exception", "BaseException")
+            if isinstance(t, ast.Tuple):  # except (Exception, OSError):
+                return any(is_broad(e) for e in t.elts)
+            return False
+
+        if not is_broad(node.type):
+            return
+        for sub in ast.walk(node):
+            if isinstance(sub, ast.Raise):
+                return  # re-raises: classification still sees the error
+        label = ("bare except" if node.type is None
+                 else f"except {dotted_name(node.type) or 'Exception'}")
+        v.report(self.rule, node,
+                 f"{label} swallows device errors before runtime/faults.py "
+                 f"can classify them (RESOURCE_EXHAUSTED never reaches the "
+                 f"batch back-off ladder); catch typed exceptions, route "
+                 f"through faults.is_oom/oom_detail, or add "
+                 f"'# graftlint: disable=G05 <reason>' if the swallow is "
+                 f"deliberate")
+
+
+def default_rules() -> List:
+    return [HostSyncRule(), TracedControlFlowRule(), KeyReuseRule(),
+            JitBoundaryRule(), BroadExceptRule()]

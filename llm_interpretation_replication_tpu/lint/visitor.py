@@ -1,0 +1,312 @@
+"""AST walking infrastructure for graftlint.
+
+The linter is a single :class:`ast.NodeVisitor` pass per file that keeps a
+stack of :class:`FunctionInfo` frames (so rules always know the enclosing
+function, whether it is jit-compiled, and which of its parameters are
+static) and dispatches each node to every rule that declares a matching
+``check_<nodetype>`` method.  Rules stay declarative — all the JAX-specific
+context resolution (what counts as a jit decorator, which arguments are
+static, what a "device region" is) lives here, once.
+
+Terminology the rules share:
+
+- **jit region** — the body of a function decorated with ``jax.jit`` /
+  ``pjit`` (directly or through ``functools.partial``), where Python
+  control flow runs at TRACE time and any host sync is a bug.
+- **device region** — a jit region, or a ``launch``-named closure inside a
+  hot-path module: the engine's pipeline contract (runtime/engine.
+  _run_pipelined) is that ``launch`` only dispatches device programs and
+  ``consume`` is the sanctioned host-fetch point, so host syncs inside
+  ``launch`` stall the very pipeline the PR-2 work built.
+- **hot path** — runtime/engine.py + runtime/batching.py + models/ + ops/:
+  the per-batch code where one stray ``.item()`` multiplies by every batch
+  of a 10k-row sweep.
+"""
+
+from __future__ import annotations
+
+import ast
+from typing import List, Optional, Sequence, Set, Tuple
+
+from .report import Finding, parse_suppressions, suppressed
+
+#: Path fragments marking the per-batch hot path (see module docstring).
+HOT_PATH_MARKERS = (
+    "runtime/engine.py",
+    "runtime/batching.py",
+    "/models/",
+    "models/decoder.py",
+    "/ops/",
+)
+
+#: Path fragments where G05 (broad except) applies: every layer that sits
+#: between a device error and runtime/faults.py's OOM/transient
+#: classification.  Analysis/stats/viz modules keep their defensive
+#: catches — nothing there handles device errors.
+FAULT_PATH_MARKERS = (
+    "/runtime/", "/ops/", "/models/", "/sweeps/", "/parallel/", "/native/",
+    "runtime/", "ops/", "models/", "sweeps/", "parallel/", "native/",
+)
+
+
+def dotted_name(node: ast.AST) -> str:
+    """'jax.random.normal' for a Name/Attribute chain; '' otherwise."""
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _jit_decorator_info(dec: ast.expr) -> Optional[Tuple[Set[str], Set[int]]]:
+    """(static_argnames, static_argnums) when ``dec`` is a jit decorator,
+    else None.  Recognizes ``jax.jit``, ``jit``, ``pjit``, ``jax.pjit``,
+    and ``functools.partial(jax.jit, static_argnames=(...))``."""
+    target = dec
+    names: Set[str] = set()
+    nums: Set[int] = set()
+    if isinstance(dec, ast.Call):
+        fn = dotted_name(dec.func)
+        if fn.endswith("partial") and dec.args:
+            target = dec.args[0]
+            kws = dec.keywords
+        else:
+            target = dec.func
+            kws = dec.keywords
+        for kw in kws:
+            if kw.arg == "static_argnames":
+                names |= set(_const_strings(kw.value))
+            elif kw.arg == "static_argnums":
+                nums |= set(_const_ints(kw.value))
+    name = dotted_name(target)
+    if name in ("jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"):
+        return names, nums
+    return None
+
+
+def _const_strings(node: ast.expr) -> List[str]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, str):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, str)]
+    return []
+
+
+def _const_ints(node: ast.expr) -> List[int]:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int):
+        return [node.value]
+    if isinstance(node, (ast.Tuple, ast.List)):
+        return [e.value for e in node.elts
+                if isinstance(e, ast.Constant) and isinstance(e.value, int)]
+    return []
+
+
+class FunctionInfo:
+    """One frame of the visitor's function stack."""
+
+    def __init__(self, node, parent: Optional["FunctionInfo"],
+                 hot_module: bool):
+        self.node = node
+        self.parent = parent
+        self.name = getattr(node, "name", "<lambda>")
+        args = node.args
+        self.params: List[str] = [
+            a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)]
+        self.static_params: Set[str] = set()
+        self.is_jit = False
+        for dec in getattr(node, "decorator_list", ()):
+            info = _jit_decorator_info(dec)
+            if info is not None:
+                self.is_jit = True
+                names, nums = info
+                self.static_params |= names
+                for i in nums:
+                    if 0 <= i < len(self.params):
+                        self.static_params.add(self.params[i])
+        # the engine pipeline contract: `launch` closures dispatch device
+        # programs and must not fetch (see module docstring)
+        self.is_launch = hot_module and self.name == "launch"
+        self.in_jit = self.is_jit or (parent is not None and parent.in_jit)
+        self.in_device_region = (
+            self.is_jit or self.is_launch
+            or (parent is not None and parent.in_device_region))
+        #: locals assigned from jnp./jax./lax. expressions — treated as
+        #: traced values by G02's control-flow rule
+        self.traced_locals: Set[str] = set()
+        self.loop_depth = 0
+
+    def traced_names(self) -> Set[str]:
+        """Names holding (potentially) traced arrays in this jit frame."""
+        return (set(self.params) - self.static_params
+                - {"self", "cls"}) | self.traced_locals
+
+
+class FileContext:
+    """Per-file state shared by every rule."""
+
+    def __init__(self, path: str, text: str):
+        self.path = path.replace("\\", "/")
+        self.text = text
+        self.lines = text.splitlines()
+        self.suppressions = parse_suppressions(self.lines)
+        self.hot_module = any(m in self.path for m in HOT_PATH_MARKERS)
+        self.fault_module = any(m in self.path for m in FAULT_PATH_MARKERS)
+
+    def source_line(self, lineno: int) -> str:
+        if 1 <= lineno <= len(self.lines):
+            return self.lines[lineno - 1].strip()
+        return ""
+
+
+class LintVisitor(ast.NodeVisitor):
+    """Drives the rules over one parsed file.
+
+    Rules implement any of ``check_call / check_if / check_while /
+    check_ifexp / check_excepthandler / check_functiondef(node, ctx,
+    visitor)`` and append to ``visitor.findings`` via :meth:`report`.
+    Inline ``graftlint: disable=`` suppressions are applied here so no
+    rule needs to know about them.
+    """
+
+    def __init__(self, ctx: FileContext, rules: Sequence):
+        self.ctx = ctx
+        self.rules = rules
+        self.findings: List[Finding] = []
+        self.stack: List[FunctionInfo] = []
+
+    # -- rule-facing API --------------------------------------------------
+
+    @property
+    def function(self) -> Optional[FunctionInfo]:
+        return self.stack[-1] if self.stack else None
+
+    def report(self, rule: str, node: ast.AST, message: str) -> None:
+        line = getattr(node, "lineno", 1)
+        finding = Finding(
+            rule=rule, path=self.ctx.path, line=line,
+            col=getattr(node, "col_offset", 0) + 1, message=message,
+            code=self.ctx.source_line(line),
+        )
+        if not suppressed(finding, self.ctx.suppressions):
+            self.findings.append(finding)
+
+    # -- traversal --------------------------------------------------------
+
+    def _dispatch(self, hook: str, node: ast.AST) -> None:
+        for rule in self.rules:
+            fn = getattr(rule, hook, None)
+            if fn is not None:
+                fn(node, self.ctx, self)
+
+    def _visit_function(self, node) -> None:
+        frame = FunctionInfo(node, self.function, self.ctx.hot_module)
+        self.stack.append(frame)
+        self._dispatch("check_functiondef", node)
+        decorators = set(map(id, getattr(node, "decorator_list", ())))
+        try:
+            for child in ast.iter_child_nodes(node):
+                if id(child) in decorators:
+                    continue  # decorators belong to the ENCLOSING frame
+                self.visit(child)
+        finally:
+            self.stack.pop()
+
+    visit_FunctionDef = _visit_function
+    visit_AsyncFunctionDef = _visit_function
+    visit_Lambda = _visit_function
+
+    def visit_Assign(self, node: ast.Assign) -> None:
+        self._note_traced_assignment(node.targets, node.value)
+        self.generic_visit(node)
+
+    def visit_AugAssign(self, node: ast.AugAssign) -> None:
+        self._note_traced_assignment([node.target], node.value)
+        self.generic_visit(node)
+
+    def _note_traced_assignment(self, targets, value) -> None:
+        """Track locals bound from jnp./jax./lax. expressions inside jit or
+        launch frames, so the rules can tell traced/device values from host
+        ones."""
+        frame = self.function
+        if frame is None or not (frame.in_jit or frame.in_device_region):
+            return
+        # metadata access (`x.shape[0]`, `x.dtype`, `x.ndim`) is Python-
+        # static under trace — a local bound from it is a host int, not a
+        # traced value, even when `x` itself is traced
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Attribute) and sub.attr in (
+                    "shape", "ndim", "dtype", "size"):
+                return
+        traced = False
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                fn = dotted_name(sub.func)
+                if fn.split(".", 1)[0] in ("jnp", "jax", "lax"):
+                    traced = True
+                    break
+            elif isinstance(sub, ast.Name) and sub.id in frame.traced_names():
+                traced = True
+                break
+        if traced:
+            for t in targets:
+                for name_node in ast.walk(t):
+                    if isinstance(name_node, ast.Name):
+                        frame.traced_locals.add(name_node.id)
+
+    def _visit_loop(self, node) -> None:
+        frame = self.function
+        if frame is not None:
+            frame.loop_depth += 1
+        if isinstance(node, ast.While):
+            self._dispatch("check_while", node)
+        try:
+            self.generic_visit(node)
+        finally:
+            if frame is not None:
+                frame.loop_depth -= 1
+
+    visit_For = _visit_loop
+    visit_AsyncFor = _visit_loop
+    visit_While = _visit_loop
+
+    def visit_Call(self, node: ast.Call) -> None:
+        self._dispatch("check_call", node)
+        self.generic_visit(node)
+
+    def visit_If(self, node: ast.If) -> None:
+        self._dispatch("check_if", node)
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node: ast.IfExp) -> None:
+        self._dispatch("check_ifexp", node)
+        self.generic_visit(node)
+
+    def visit_ExceptHandler(self, node: ast.ExceptHandler) -> None:
+        self._dispatch("check_excepthandler", node)
+        self.generic_visit(node)
+
+
+def lint_source(path: str, text: str, rules: Sequence) -> List[Finding]:
+    """Run ``rules`` over one file's source; syntax errors become a single
+    G00 finding instead of crashing the whole run (the linter gates a repo
+    that must stay importable anyway — the test suite catches real syntax
+    rot; the G00 row just keeps the lint report honest)."""
+    ctx = FileContext(path, text)
+    try:
+        tree = ast.parse(text)
+    except SyntaxError as err:
+        return [Finding("G00", ctx.path, err.lineno or 1,
+                        (err.offset or 0) + 1,
+                        f"syntax error: {err.msg}",
+                        ctx.source_line(err.lineno or 1))]
+    visitor = LintVisitor(ctx, rules)
+    for rule in rules:
+        fn = getattr(rule, "check_module", None)
+        if fn is not None:
+            fn(tree, ctx, visitor)
+    visitor.visit(tree)
+    return visitor.findings

@@ -93,7 +93,10 @@ try:  # pallas imports fail gracefully on unsupported backends
     from jax.experimental.pallas import tpu as pltpu
 
     _PALLAS_OK = True
-except Exception:  # pragma: no cover
+except (ImportError, AttributeError):  # pragma: no cover
+    # absent/renamed experimental module only — a device error at import
+    # time must propagate to faults classification, not silently route
+    # every sweep onto the dense fallback (graftlint G05)
     _PALLAS_OK = False
 
 

@@ -259,7 +259,8 @@ class HostPrefetcher:
                 if self._stop.is_set():
                     return
                 self._put((None, fn(item)))
-        except BaseException as err:  # re-raised at the consumer's get
+        # graftlint: disable=G05 producer-thread relay: the error is stored and re-raised at the consumer's get (classification still sees it there)
+        except BaseException as err:
             self._put((err, None))
             return
         self._put((None, self._DONE))

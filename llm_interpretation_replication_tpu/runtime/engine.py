@@ -27,7 +27,7 @@ from ..models import t5 as t5mod
 from ..scoring import yes_no as yn
 from ..scoring.confidence import weighted_confidence_digits
 from ..utils.telemetry import record_counter, record_fault
-from . import batching, faults
+from . import batching, faults, strict
 from . import plan as plan_mod
 
 
@@ -331,7 +331,15 @@ class ScoringEngine:
         fetch of ITS outputs, the (batch, outputs) pairing below attributes
         the error to the right rows even mid-pipeline.  A consume that
         fails part-way re-scores its whole batch; results are keyed by
-        prompt index, so the rewrite is idempotent."""
+        prompt index, so the rewrite is idempotent.
+
+        Under strict mode (runtime/strict.py, ``LLM_INTERP_STRICT=1``) the
+        whole loop runs inside a device→host transfer guard and ONLY the
+        ``consume`` callbacks — the sanctioned fetch points — may
+        materialize device values: an implicit sync anywhere in a launch
+        path raises (counted in the ``blocked_transfers`` telemetry
+        counter) instead of silently draining the pipeline.  This is the
+        runtime half of the graftlint G01 contract."""
         depth = max(1, self.ecfg.pipeline_depth)
         pending: collections.deque = collections.deque()
         retries: collections.deque = collections.deque()
@@ -342,22 +350,26 @@ class ScoringEngine:
                 raise err
             retries.extend(rebatch(batch, err))  # re-raises non-OOM/at-floor
 
-        while True:
-            batch = retries.popleft() if retries else next(it, None)
-            if batch is not None:
-                try:
-                    pending.append((batch, launch(batch)))
-                except Exception as err:
-                    handle(batch, err)
-                    continue
-            elif not pending:
-                break
-            if len(pending) >= depth or batch is None:
-                done, out = pending.popleft()
-                try:
-                    consume(done, out)
-                except Exception as err:
-                    handle(done, err)
+        with strict.scoring_guard(type(self).__name__):
+            while True:
+                batch = retries.popleft() if retries else next(it, None)
+                if batch is not None:
+                    try:
+                        pending.append((batch, launch(batch)))
+                    # graftlint: disable=G05 pipeline handler: handle() re-raises via the _oom_rebatch faults classification
+                    except Exception as err:
+                        handle(batch, err)
+                        continue
+                elif not pending:
+                    break
+                if len(pending) >= depth or batch is None:
+                    done, out = pending.popleft()
+                    try:
+                        with strict.sanctioned_fetch():
+                            consume(done, out)
+                    # graftlint: disable=G05 pipeline handler: handle() re-raises via the _oom_rebatch faults classification
+                    except Exception as err:
+                        handle(done, err)
 
     def _oom_rebatch(self, encoded) -> Optional[Callable]:
         """Per-call OOM back-off hook for :meth:`_run_pipelined`.
@@ -523,8 +535,9 @@ class ScoringEngine:
         ecfg = self.ecfg
         dc = ecfg.decode_completions if decode_completions is None \
             else decode_completions
-        key = (ecfg.score_steps, ecfg.max_look_ahead, ecfg.max_new_tokens,
-               dc, max_new_tokens)
+        key = plan_mod.plan_cache_key(
+            ecfg.score_steps, ecfg.max_look_ahead, ecfg.max_new_tokens,
+            dc, max_new_tokens)
         plan = self._plan_cache.get(key)
         if plan is None:
             plan = self._plan_cache[key] = plan_mod.generation_plan(

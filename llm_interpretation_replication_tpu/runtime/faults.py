@@ -325,6 +325,7 @@ class PreemptionGuard:
         for fn in self.flushes:
             try:
                 fn()
+            # graftlint: disable=G05 preemption grace window: a failing flush (full disk) must not block the remaining checkpoint state from landing
             except Exception as err:  # pragma: no cover - best-effort path
                 print(f"# preemption flush failed ({reason}): {err}",
                       file=sys.stderr)

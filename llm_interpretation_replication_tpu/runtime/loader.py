@@ -60,9 +60,14 @@ def enable_compile_cache(path: Optional[str] = None,
                           os.path.abspath(path))
         jax.config.update("jax_persistent_cache_min_compile_time_secs",
                           float(min_compile_secs))
-    except Exception as err:
-        # a silently-missing cache costs ~150 s per cold run — leave a
-        # trail distinguishing "jax rejected it" from "env disabled it"
+    except (AttributeError, KeyError, TypeError, ValueError) as err:
+        # older jax without the option spells rejection as AttributeError/
+        # KeyError from config.update (ValueError/TypeError for a bad
+        # path/seconds value); anything else — e.g. RESOURCE_EXHAUSTED
+        # surfacing through jax init — must propagate to faults
+        # classification, not be swallowed here (graftlint G05).  A
+        # silently-missing cache costs ~150 s per cold run — leave a
+        # trail distinguishing "jax rejected it" from "env disabled it".
         import warnings
 
         warnings.warn(f"persistent compilation cache unavailable "

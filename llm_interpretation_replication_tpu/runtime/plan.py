@@ -142,6 +142,23 @@ class GenerationPlan:
         return hash(self.cache_key)
 
 
+def plan_cache_key(score_steps: int, max_look_ahead: int, default_cap: int,
+                   decode_completions: bool,
+                   max_new_tokens: Optional[int] = None) -> Tuple:
+    """Engine-side lookup key for a leg's :class:`GenerationPlan`.
+
+    Lives HERE, next to the plan it keys, so the cap-sensitivity contract
+    (the per-call ``max_new_tokens`` override MUST be part of the key —
+    see :class:`GenerationPlan`) has exactly one spelling; the engine's
+    ``_gen_plan`` and the strict-mode recompile sentry's audit trail both
+    depend on distinct legs resolving to distinct keys.  The raw config
+    knobs are kept (rather than the resolved ``cache_key``) so two knob
+    combinations that HAPPEN to resolve identically today still map to
+    one plan each if resolution ever diverges."""
+    return (score_steps, max_look_ahead, default_cap,
+            bool(decode_completions), max_new_tokens)
+
+
 def generation_plan(score_steps: int, max_look_ahead: int, default_cap: int,
                     decode_completions: bool,
                     max_new_tokens: Optional[int] = None) -> GenerationPlan:

@@ -1,0 +1,230 @@
+"""Runtime strict mode: the on-device complement of the graftlint rules.
+
+The linter (:mod:`..lint`) catches host syncs and recompile bait
+STATICALLY; this module catches what slips through at RUN time, and makes
+both failure modes auditable numbers instead of perf mysteries:
+
+- **Transfer guard** — :func:`scoring_guard` arms
+  ``jax.transfer_guard_device_to_host("disallow")`` around the engine's
+  scoring pipeline (runtime/engine._run_pipelined), so any implicit
+  device→host sync in a launch path raises instead of silently
+  serializing the async dispatch queue.  The pipeline's ``consume``
+  callbacks — the sanctioned fetch points — run inside
+  :func:`sanctioned_fetch`, which locally re-allows the fetch.  A blocked
+  transfer increments the ``blocked_transfers`` telemetry counter before
+  the error propagates, so a clean operating point is provable as
+  ``blocked_transfers == 0``.
+- **Recompile sentry** — :class:`RecompileSentry` turns on
+  ``jax_log_compiles`` and attaches a logging handler to the ``jax``
+  logger that counts every "Compiling <name> ..." record into the
+  ``recompile_events`` telemetry counter.  A warm repeat of a sweep must
+  hold this counter flat; growth means a shape/plan key leak (exactly
+  what the PR-2 ``GenerationPlan`` cache keys and bucket warmup exist to
+  prevent).
+
+Enablement is env-gated — ``LLM_INTERP_STRICT=1`` (0/off/empty disables)
+— or explicit via :func:`activate`; ``bench.py --strict`` and the CLI's
+``--strict`` flag route here.  When inactive every context manager in
+this module is a no-op, so the engine integration costs nothing in
+ordinary runs.
+
+Backend note: on the CPU test backend (``JAX_PLATFORMS=cpu``) jax treats
+array→numpy conversion as zero-copy, so the device→host guard never
+fires there — the tier-1 strict tests therefore exercise the counting
+machinery through :func:`device_region` (which also guards host→device,
+enforced on every backend) and prove the sweep contract as
+``blocked_transfers == 0`` plus a flat warm-repeat ``recompile_events``.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import logging
+import os
+from typing import Dict, Iterator, List, Optional
+
+from ..utils.telemetry import counter, record_counter, record_fault
+
+STRICT_ENV = "LLM_INTERP_STRICT"
+
+#: telemetry counter names (documented in utils/telemetry.py)
+RECOMPILE_COUNTER = "recompile_events"
+BLOCKED_COUNTER = "blocked_transfers"
+
+_ACTIVE = False
+_SENTRY: Optional["RecompileSentry"] = None
+
+
+def env_requests_strict() -> bool:
+    val = os.environ.get(STRICT_ENV)
+    if val is None:
+        return False
+    return val.strip().lower() not in ("", "0", "off", "false", "no")
+
+
+def strict_enabled() -> bool:
+    """Is strict mode currently armed (activate() or the env gate)?"""
+    return _ACTIVE
+
+
+class RecompileSentry(logging.Handler):
+    """Counts XLA compilations via ``jax_log_compiles`` log records.
+
+    jax emits one "Compiling <name> with global shapes and types ..."
+    WARNING per XLA compile when ``jax_log_compiles`` is on
+    (jax._src.interpreters.pxla); matching that prefix counts real
+    compiles while ignoring the tracing/lowering chatter on the same
+    logger.  Each hit feeds the ``recompile_events`` telemetry counter
+    and keeps the program name (bounded ring) so a leaking plan key is
+    attributable by name, not just by count."""
+
+    MATCH = "Compiling "
+    KEEP = 200
+
+    def __init__(self):
+        super().__init__(level=logging.DEBUG)
+        self.programs: List[str] = []
+
+    def emit(self, record: logging.LogRecord) -> None:
+        try:
+            msg = record.getMessage()
+        # graftlint: disable=G05 logging contract: a handler must never raise into the emitting code; a malformed record is not a device error
+        except Exception:  # pragma: no cover - malformed record
+            return
+        if not msg.startswith(self.MATCH):
+            return
+        record_counter(RECOMPILE_COUNTER)
+        name = msg[len(self.MATCH):].split(" ", 1)[0]
+        self.programs.append(name)
+        if len(self.programs) > self.KEEP:
+            del self.programs[: len(self.programs) - self.KEEP]
+
+    def install(self) -> None:
+        import jax
+
+        jax.config.update("jax_log_compiles", True)
+        logging.getLogger("jax").addHandler(self)
+
+    def uninstall(self) -> None:
+        logging.getLogger("jax").removeHandler(self)
+        try:
+            import jax
+
+            jax.config.update("jax_log_compiles", False)
+        except (AttributeError, KeyError):  # pragma: no cover - old jax
+            pass
+
+
+def activate(sentry: bool = True) -> None:
+    """Arm strict mode process-wide (idempotent).
+
+    ``sentry=False`` arms only the transfer guards — for callers that
+    cannot tolerate the log_compiles stderr chatter but still want
+    blocked-transfer accounting."""
+    global _ACTIVE, _SENTRY
+    _ACTIVE = True
+    # a later activate(sentry=True) upgrades an earlier guards-only
+    # activation — idempotency must not freeze recompile_events at 0
+    if sentry and _SENTRY is None:
+        s = RecompileSentry()
+        s.install()
+        _SENTRY = s
+
+
+def deactivate() -> None:
+    global _ACTIVE, _SENTRY
+    _ACTIVE = False
+    if _SENTRY is not None:
+        _SENTRY.uninstall()
+        _SENTRY = None
+
+
+def activate_from_env() -> bool:
+    """Arm strict mode iff ``LLM_INTERP_STRICT`` requests it; returns the
+    resulting state.  The CLI and bench call this once at startup."""
+    if env_requests_strict():
+        activate()
+    return _ACTIVE
+
+
+def sentry_programs() -> List[str]:
+    """Names of the programs the sentry saw compile (newest last)."""
+    return list(_SENTRY.programs) if _SENTRY is not None else []
+
+
+def _is_transfer_guard_error(err: BaseException) -> bool:
+    text = str(err)
+    return "isallowed" in text and "transfer" in text
+
+
+@contextlib.contextmanager
+def _counting(label: str) -> Iterator[None]:
+    """Count guard trips into ``blocked_transfers`` (+ a fault event for
+    the audit trail) before propagating them."""
+    try:
+        yield
+    except Exception as err:
+        if _is_transfer_guard_error(err):
+            record_counter(BLOCKED_COUNTER)
+            record_fault("blocked_transfer", label=label,
+                         error=" ".join(str(err).split())[:160])
+        raise
+
+
+@contextlib.contextmanager
+def scoring_guard(label: str = "") -> Iterator[None]:
+    """Disallow implicit device→host transfers for the duration — the
+    engine wraps its scoring pipeline in this, so only code inside
+    :func:`sanctioned_fetch` may materialize device values.  No-op unless
+    strict mode is active."""
+    if not _ACTIVE:
+        yield
+        return
+    import jax
+
+    with _counting(label or "scoring_guard"):
+        with jax.transfer_guard_device_to_host("disallow"):
+            yield
+
+
+@contextlib.contextmanager
+def sanctioned_fetch() -> Iterator[None]:
+    """Re-allow device→host fetches inside a :func:`scoring_guard` — the
+    pipeline's ``consume`` callbacks are THE sanctioned fetch points
+    (mirrors graftlint G01's static contract).  No-op unless strict mode
+    is active."""
+    if not _ACTIVE:
+        yield
+        return
+    import jax
+
+    with jax.transfer_guard_device_to_host("allow"):
+        yield
+
+
+@contextlib.contextmanager
+def device_region(label: str = "") -> Iterator[None]:
+    """Strictest probe: disallow implicit transfers in BOTH directions.
+
+    For code that must be transfer-free end to end (warmed inner loops,
+    kernels, tests of the guard machinery itself).  Unlike
+    :func:`scoring_guard` this also trips on host→device feeds, which the
+    CPU backend enforces too — the tier-1 self-test drives the
+    ``blocked_transfers`` counter through this."""
+    if not _ACTIVE:
+        yield
+        return
+    import jax
+
+    with _counting(label or "device_region"):
+        with jax.transfer_guard("disallow"):
+            yield
+
+
+def strict_report() -> Dict:
+    """Snapshot for bench JSON / operator audit."""
+    return {
+        "enabled": _ACTIVE,
+        RECOMPILE_COUNTER: int(counter(RECOMPILE_COUNTER)),
+        BLOCKED_COUNTER: int(counter(BLOCKED_COUNTER)),
+    }

@@ -64,6 +64,14 @@ def clear_fault_events() -> None:
 # ``host_overlap_chunks``).  Benchmarks and the perf smoke test read these
 # to prove the reuse paths actually engaged; a sweep that silently fell
 # back to unfused scoring is a different measurement.
+#
+# Strict mode (runtime/strict.py, LLM_INTERP_STRICT=1) adds two more:
+# ``recompile_events`` — one per XLA compilation seen by the log_compiles
+# sentry (a warm repeat must hold this flat; growth means a shape or
+# plan-key leak) — and ``blocked_transfers`` — one per implicit transfer
+# the armed jax.transfer_guard rejected inside a scoring pipeline (a clean
+# operating point is provable as blocked_transfers == 0).  bench.py
+# --strict reports both in its JSON record.
 # ---------------------------------------------------------------------------
 
 _COUNTERS: Dict[str, float] = {}
@@ -92,6 +100,21 @@ def counters() -> Dict[str, float]:
 def clear_counters() -> None:
     with _COUNTERS_LOCK:
         _COUNTERS.clear()
+
+
+def counters_since(snapshot: Dict[str, float]) -> Dict[str, float]:
+    """Per-counter delta vs an earlier :func:`counters` snapshot.
+
+    The counters are process-global monotones; callers measuring one
+    phase (a bench repeat, a strict-mode sweep, a test) snapshot before,
+    run, and diff — ``clear_counters`` would destroy concurrent readers'
+    baselines.  Counters absent from ``snapshot`` count from 0; counters
+    that only exist in ``snapshot`` are omitted (monotones cannot have
+    shrunk)."""
+    now = counters()
+    return {name: value - snapshot.get(name, 0)
+            for name, value in now.items()
+            if value != snapshot.get(name, 0)}
 
 
 def get_memory_usage() -> str:

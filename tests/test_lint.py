@@ -1,0 +1,713 @@
+"""graftlint static-analysis gate + strict-mode runtime guards.
+
+Three layers, all tier-1 (``-m lint``):
+
+1. **Rule self-tests** — synthetic fixtures proving every rule
+   (G01/G02/G03/G04/G05) fires on its target pattern and stays quiet on
+   the blessed idiom next to it.  This is what guarantees the repo gate
+   below has teeth: a violation introduced into the tree is, by
+   construction of these fixtures, a pattern the analyzer flags.
+2. **Baseline machinery** — fingerprint matching survives line drift,
+   stale entries surface, suppression comments work.
+3. **The repo gate + strict mode** — the analyzer runs over the actual
+   package (plus bench.py) against the checked-in ``lint_baseline.json``
+   and must exit clean, and a real 2-batch fused two-leg sweep runs under
+   ``LLM_INTERP_STRICT`` semantics with ``blocked_transfers == 0`` and a
+   flat warm-repeat ``recompile_events`` count.
+"""
+
+import json
+import os
+import textwrap
+
+import pytest
+
+from llm_interpretation_replication_tpu.lint import (
+    apply_baseline,
+    default_paths,
+    default_rules,
+    lint_paths,
+    lint_source,
+    load_baseline,
+    save_baseline,
+)
+from llm_interpretation_replication_tpu.lint.cli import main as lint_main
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.lint
+
+
+def run(path, source):
+    return lint_source(path, textwrap.dedent(source), default_rules())
+
+
+def rules_of(findings):
+    return sorted(f.rule for f in findings)
+
+
+# ---------------------------------------------------------------------------
+# G01 host-sync
+# ---------------------------------------------------------------------------
+
+class TestG01HostSync:
+    def test_item_in_jit_region(self):
+        findings = run("ops/kernels.py", """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return x.item()
+        """)
+        assert rules_of(findings) == ["G01"]
+        assert ".item()" in findings[0].message
+
+    def test_item_in_hot_module_outside_jit(self):
+        findings = run("models/decoder.py", "def f(x):\n    return x.item()\n")
+        assert rules_of(findings) == ["G01"]
+
+    def test_item_in_cold_module_ok(self):
+        assert run("stats/bootstrap.py",
+                   "def f(x):\n    return x.item()\n") == []
+
+    def test_np_asarray_in_jit(self):
+        findings = run("ops/kernels.py", """
+            import functools, jax
+            import numpy as np
+
+            @functools.partial(jax.jit, static_argnames=("k",))
+            def f(x, k):
+                return np.asarray(x)
+        """)
+        assert rules_of(findings) == ["G01"]
+
+    def test_float_on_traced_param_in_jit(self):
+        findings = run("ops/kernels.py", """
+            import jax
+
+            @jax.jit
+            def f(x):
+                return float(x) + 1.0
+        """)
+        assert rules_of(findings) == ["G01"]
+
+    def test_float_on_static_param_ok(self):
+        assert run("ops/kernels.py", """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnames=("cfg",))
+            def f(x, cfg):
+                rd = int(cfg.rotary_pct * 64)
+                return x * rd
+        """) == []
+
+    def test_shape_derived_local_ok(self):
+        # `t = xb.shape[0]` is Python-static under trace: int(t * k) is fine
+        assert run("ops/kernels.py", """
+            import jax
+
+            @jax.jit
+            def f(xb, k):
+                t = xb.shape[0]
+                cap = max(1, int(0.5 * t))
+                return xb[:cap]
+        """) == []
+
+    def test_launch_closure_fetch_flagged_consume_ok(self):
+        findings = run("runtime/engine.py", """
+            import numpy as np
+            import jax.numpy as jnp
+
+            def pipeline(batches):
+                def launch(batch):
+                    out = jnp.sum(batch.ids)
+                    return np.asarray(out)      # device fetch in launch: BAD
+
+                def consume(batch, out):
+                    return np.asarray(out)      # sanctioned fetch point
+
+                return launch, consume
+        """)
+        assert rules_of(findings) == ["G01"]
+        assert findings[0].message.count("consume")
+
+
+# ---------------------------------------------------------------------------
+# G02 traced control flow
+# ---------------------------------------------------------------------------
+
+class TestG02TracedControlFlow:
+    def test_if_on_traced_param(self):
+        findings = run("m.py", """
+            import jax
+
+            @jax.jit
+            def f(x):
+                if x > 0:
+                    return x
+                return -x
+        """)
+        assert rules_of(findings) == ["G02"]
+
+    def test_while_on_traced_local(self):
+        findings = run("m.py", """
+            import jax
+            import jax.numpy as jnp
+
+            @jax.jit
+            def f(x):
+                s = jnp.sum(x)
+                while s > 0:
+                    s = s - 1
+                return s
+        """)
+        assert "G02" in rules_of(findings)
+
+    def test_static_argname_ok(self):
+        assert run("m.py", """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnames=("causal",))
+            def f(x, causal):
+                if causal:
+                    return x
+                return -x
+        """) == []
+
+    def test_is_none_and_isinstance_ok(self):
+        assert run("m.py", """
+            import jax
+
+            @jax.jit
+            def f(x, mask):
+                if mask is None:
+                    return x
+                if isinstance(x, tuple):
+                    return x[0]
+                return x
+        """) == []
+
+    def test_shape_comparison_ok(self):
+        assert run("m.py", """
+            import jax
+
+            @jax.jit
+            def f(x):
+                b = x.shape[0]
+                if b % 2:
+                    raise ValueError("odd batch")
+                return x
+        """) == []
+
+    def test_plain_function_ok(self):
+        assert run("m.py", "def f(x):\n    if x > 0:\n        return x\n    return -x\n") == []
+
+
+# ---------------------------------------------------------------------------
+# G03 PRNG key reuse
+# ---------------------------------------------------------------------------
+
+class TestG03KeyReuse:
+    def test_double_consumption(self):
+        findings = run("m.py", """
+            import jax
+
+            def init(hidden):
+                key = jax.random.PRNGKey(0)
+                a = jax.random.normal(key, (hidden,))
+                b = jax.random.normal(key, (hidden,))
+                return a, b
+        """)
+        assert rules_of(findings) == ["G03"]
+        assert "'key'" in findings[0].message
+
+    def test_split_is_clean(self):
+        assert run("m.py", """
+            import jax
+
+            def init(hidden):
+                key = jax.random.PRNGKey(0)
+                ka, kb = jax.random.split(key)
+                a = jax.random.normal(ka, (hidden,))
+                b = jax.random.normal(kb, (hidden,))
+                return a, b
+        """) == []
+
+    def test_fold_in_derives_not_consumes(self):
+        assert run("m.py", """
+            import jax
+
+            def init(hidden):
+                key = jax.random.PRNGKey(0)
+                heads = jax.random.split(key, 4)
+                extra = jax.random.fold_in(key, 99)
+                return heads, jax.random.normal(extra, (hidden,))
+        """) == []
+
+    def test_loop_reuse(self):
+        findings = run("m.py", """
+            import jax
+
+            def draws(n):
+                key = jax.random.PRNGKey(0)
+                out = []
+                for i in range(n):
+                    out.append(jax.random.uniform(key, (3,)))
+                return out
+        """)
+        assert rules_of(findings) == ["G03"]
+        assert "IDENTICAL" in findings[0].message
+
+    def test_rebind_in_loop_ok(self):
+        assert run("m.py", """
+            import jax
+
+            def draws(n):
+                key = jax.random.PRNGKey(0)
+                out = []
+                for i in range(n):
+                    key, sub = jax.random.split(key)
+                    out.append(jax.random.uniform(sub, (3,)))
+                return out
+        """) == []
+
+    def test_module_level_scan(self):
+        findings = run("m.py", """
+            import jax
+
+            KEY = jax.random.PRNGKey(0)
+            A = jax.random.normal(KEY, (4,))
+            B = jax.random.normal(KEY, (4,))
+        """)
+        assert rules_of(findings) == ["G03"]
+
+
+# ---------------------------------------------------------------------------
+# G04 jit-boundary hygiene
+# ---------------------------------------------------------------------------
+
+class TestG04JitBoundary:
+    def test_mutable_default(self):
+        findings = run("m.py", """
+            import jax
+
+            @jax.jit
+            def f(x, buckets=[]):
+                return x
+        """)
+        assert "G04" in rules_of(findings)
+        assert "mutable default" in " ".join(f.message for f in findings)
+
+    def test_jit_on_method_self(self):
+        findings = run("m.py", """
+            import jax
+
+            class Engine:
+                @jax.jit
+                def step(self, x):
+                    return x
+        """)
+        assert "G04" in rules_of(findings)
+
+    def test_jit_of_bound_attribute(self):
+        findings = run("m.py", """
+            import jax
+
+            def build(engine):
+                return jax.jit(engine.step)
+        """)
+        assert rules_of(findings) == ["G04"]
+
+    def test_bare_jit_over_shape_param(self):
+        findings = run("m.py", """
+            import jax
+
+            @jax.jit
+            def prefill(x, cache_len):
+                return x[:cache_len]
+        """)
+        assert "G04" in rules_of(findings)
+        assert "cache_len" in " ".join(f.message for f in findings)
+
+    def test_static_shape_param_ok(self):
+        assert run("m.py", """
+            import functools, jax
+
+            @functools.partial(jax.jit, static_argnames=("cache_len",))
+            def prefill(x, cache_len):
+                return x[:cache_len]
+        """) == []
+
+    def test_jit_of_local_function_ok(self):
+        assert run("m.py", """
+            import jax
+
+            def build(params):
+                def step(x):
+                    return x @ params
+                return jax.jit(step)
+        """) == []
+
+
+# ---------------------------------------------------------------------------
+# G05 broad except
+# ---------------------------------------------------------------------------
+
+class TestG05BroadExcept:
+    SWALLOW = """
+        def f():
+            try:
+                g()
+            except Exception:
+                return None
+    """
+
+    def test_swallow_in_fault_scope(self):
+        findings = run("runtime/thing.py", self.SWALLOW)
+        assert rules_of(findings) == ["G05"]
+
+    def test_out_of_scope_module_ok(self):
+        assert run("viz/figures.py", self.SWALLOW) == []
+
+    def test_reraise_ok(self):
+        assert run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                except Exception:
+                    cleanup()
+                    raise
+        """) == []
+
+    def test_typed_except_ok(self):
+        assert run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                except (ValueError, OSError):
+                    return None
+        """) == []
+
+    def test_bare_except_flagged(self):
+        findings = run("sweeps/s.py", """
+            def f():
+                try:
+                    g()
+                except:
+                    pass
+        """)
+        assert rules_of(findings) == ["G05"]
+
+    def test_suppression_comment(self):
+        assert run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                # graftlint: disable=G05 deliberate keep-alive
+                except Exception:
+                    return None
+        """) == []
+
+    def test_trailing_suppression_comment(self):
+        assert run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                except Exception:  # graftlint: disable=G05 keep-alive
+                    return None
+        """) == []
+
+    def test_tuple_except_containing_broad_flagged(self):
+        findings = run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                except (Exception, OSError):
+                    return None
+        """)
+        assert rules_of(findings) == ["G05"]
+
+    def test_tuple_of_typed_excepts_ok(self):
+        assert run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                except (ValueError, OSError):
+                    return None
+        """) == []
+
+    def test_trailing_suppression_does_not_bleed_to_next_line(self):
+        # the same-line disable must not exempt the NEXT statement's
+        # violation
+        findings = run("models/decoder.py", """
+            def f(x):
+                y = x  # graftlint: disable=G01 unrelated trailing comment
+                return x.item()
+        """)
+        assert rules_of(findings) == ["G01"]
+
+    def test_suppression_is_rule_specific(self):
+        findings = run("runtime/thing.py", """
+            def f():
+                try:
+                    g()
+                except Exception:  # graftlint: disable=G01 wrong rule
+                    return None
+        """)
+        assert rules_of(findings) == ["G05"]
+
+
+# ---------------------------------------------------------------------------
+# Baseline machinery
+# ---------------------------------------------------------------------------
+
+class TestBaseline:
+    def _findings(self, line_pad=0):
+        src = "\n" * line_pad + textwrap.dedent("""
+            def f():
+                try:
+                    g()
+                except Exception:
+                    return None
+        """)
+        return lint_source("runtime/thing.py", src, default_rules())
+
+    def test_roundtrip_and_line_drift(self, tmp_path):
+        findings = self._findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(findings, path,
+                      {findings[0].fingerprint: "known keep-alive"})
+        entries = load_baseline(path)
+        assert entries[0]["rationale"] == "known keep-alive"
+        # the same violation 7 lines lower still matches (fingerprint is
+        # line-independent)
+        drifted = self._findings(line_pad=7)
+        new, stale, matched = apply_baseline(drifted, entries)
+        assert new == [] and stale == [] and matched == 1
+
+    def test_stale_entry_surfaces(self, tmp_path):
+        findings = self._findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(findings, path)
+        new, stale, matched = apply_baseline([], load_baseline(path))
+        assert matched == 0 and len(stale) == 1
+
+    def test_entry_absorbs_once(self, tmp_path):
+        findings = self._findings()
+        path = str(tmp_path / "baseline.json")
+        save_baseline(findings, path)
+        twice = findings + findings
+        new, stale, matched = apply_baseline(twice, load_baseline(path))
+        assert matched == 1 and len(new) == 1
+
+    def test_cli_gate_exit_codes(self, tmp_path):
+        bad = tmp_path / "runtime"
+        bad.mkdir()
+        (bad / "x.py").write_text(
+            "def f():\n    try:\n        g()\n    except Exception:\n"
+            "        return None\n")
+        empty_baseline = tmp_path / "b.json"
+        assert lint_main([str(bad), "--baseline", str(empty_baseline)]) == 1
+        # --write-baseline grandfathers it; the gate then passes
+        assert lint_main([str(bad), "--baseline", str(empty_baseline),
+                          "--write-baseline"]) == 0
+        assert lint_main([str(bad), "--baseline", str(empty_baseline)]) == 0
+        # fixing the code turns the entry stale — the ratchet FAILS the
+        # gate until the entry is deleted (it would otherwise re-shield
+        # the next violation with the same fingerprint)
+        (bad / "x.py").write_text("def f():\n    return g()\n")
+        assert lint_main([str(bad), "--baseline", str(empty_baseline)]) == 1
+        assert lint_main([str(bad), "--baseline", str(empty_baseline),
+                          "--write-baseline"]) == 0
+        assert lint_main([str(bad), "--baseline", str(empty_baseline)]) == 0
+
+
+# ---------------------------------------------------------------------------
+# The repo gate
+# ---------------------------------------------------------------------------
+
+class TestRepoGate:
+    def test_repo_is_clean_vs_checked_in_baseline(self):
+        """THE gate: the analyzer over the real tree + lint_baseline.json
+        must report zero new findings.  A PR introducing any fixture-class
+        violation (the self-tests above) fails here."""
+        assert lint_main([]) == 0
+
+    def test_checked_in_baseline_is_small_and_justified(self):
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        entries = load_baseline(default_baseline_path())
+        assert len(entries) <= 10
+        for e in entries:
+            assert e["rationale"].strip(), f"no rationale: {e}"
+            assert "TODO" not in e["rationale"]
+
+    def test_default_paths_cover_package_and_bench(self):
+        paths = default_paths()
+        assert any(p.endswith("llm_interpretation_replication_tpu")
+                   for p in paths)
+        assert any(p.endswith("bench.py") for p in paths)
+
+    def test_gate_would_catch_an_injected_violation(self, tmp_path):
+        """End-to-end teeth check: copy one real hot-path file, inject a
+        G01 `.item()` into it, and confirm the same entry point that the
+        gate test runs reports it."""
+        victim = tmp_path / "models"
+        victim.mkdir()
+        src = os.path.join(os.path.dirname(default_paths()[0]),
+                           "llm_interpretation_replication_tpu", "models",
+                           "decoder.py")
+        text = open(src).read()
+        text += ("\n\ndef _injected(x):\n"
+                 "    return x.item()\n")
+        (victim / "decoder.py").write_text(text)
+        findings = lint_paths([str(victim)], root=str(tmp_path))
+        injected = [f for f in findings if f.rule == "G01"]
+        assert injected and injected[0].path == "models/decoder.py"
+
+
+# ---------------------------------------------------------------------------
+# Strict mode (runtime/strict.py) — the runtime complement
+# ---------------------------------------------------------------------------
+
+class TestStrictMode:
+    @pytest.fixture(autouse=True)
+    def _clean(self):
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        strict.deactivate()
+        yield
+        strict.deactivate()
+
+    def test_env_gate(self, monkeypatch):
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        monkeypatch.delenv(strict.STRICT_ENV, raising=False)
+        assert not strict.activate_from_env()
+        monkeypatch.setenv(strict.STRICT_ENV, "0")
+        assert not strict.activate_from_env()
+        monkeypatch.setenv(strict.STRICT_ENV, "1")
+        assert strict.activate_from_env()
+        assert strict.strict_enabled()
+
+    def test_contexts_are_noops_when_inactive(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        snap = telemetry.counters()
+        with strict.scoring_guard("t"), strict.device_region("t"):
+            with strict.sanctioned_fetch():
+                jnp.sin(np.ones((2,)))  # implicit h2d: fine when inactive
+        assert telemetry.counters_since(snap).get(
+            strict.BLOCKED_COUNTER, 0) == 0
+
+    def test_device_region_blocks_and_counts(self):
+        import numpy as np
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        strict.activate(sentry=False)
+        snap = telemetry.counters()
+        with pytest.raises(Exception, match="[Dd]isallowed"):
+            with strict.device_region("test"):
+                jnp.sin(np.ones((4,)))  # implicit host->device transfer
+        assert telemetry.counters_since(snap)[strict.BLOCKED_COUNTER] == 1
+        assert telemetry.fault_events("blocked_transfer")
+
+    def test_recompile_sentry_counts_fresh_compiles_only(self):
+        import jax
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        strict.activate()
+
+        @jax.jit
+        def probe(x):
+            return x * 3.0 + 1.0
+
+        snap = telemetry.counters()
+        probe(jnp.ones((5,))).block_until_ready()
+        cold = telemetry.counters_since(snap).get(strict.RECOMPILE_COUNTER, 0)
+        assert cold >= 1
+        assert strict.sentry_programs()
+        snap = telemetry.counters()
+        probe(jnp.ones((5,))).block_until_ready()  # warm: cached executable
+        assert telemetry.counters_since(snap).get(
+            strict.RECOMPILE_COUNTER, 0) == 0
+
+    def test_activate_upgrades_guards_only_to_sentry(self):
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        strict.activate(sentry=False)
+        assert strict.strict_enabled() and strict.sentry_programs() == []
+        strict.activate()  # bench/CLI arming later in the same process
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def upgrade_probe(x):
+            return x - 7.0
+
+        snap = telemetry.counters()
+        upgrade_probe(jnp.ones((3,))).block_until_ready()
+        assert telemetry.counters_since(snap).get(
+            strict.RECOMPILE_COUNTER, 0) >= 1
+
+    def test_strict_report_shape(self):
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        strict.activate(sentry=False)
+        rep = strict.strict_report()
+        assert rep["enabled"] is True
+        assert set(rep) == {"enabled", strict.RECOMPILE_COUNTER,
+                            strict.BLOCKED_COUNTER}
+
+
+class TestStrictFusedSweep:
+    """Acceptance: a 2-batch fused two-leg sweep runs under strict mode
+    with blocked_transfers == 0 and a flat warm-repeat recompile count."""
+
+    def test_two_chunk_fused_sweep_clean_and_warm_stable(self):
+        from test_runtime import _tiny_engine
+
+        from llm_interpretation_replication_tpu.runtime import strict
+        from llm_interpretation_replication_tpu.runtime.engine import LegSpec
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        # 8 rows at batch 4 -> two pipelined batches ("2-chunk")
+        pairs = [
+            (f"Scenario {i}: the contract covers vehicles.",
+             ("Answer Yes or No.", "Give a confidence from 0 to 100."))
+            for i in range(8)
+        ]
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        strict.activate()
+        try:
+            snap = telemetry.counters()
+            cold = eng.score_prefixed(pairs, targets=("Yes", "No"),
+                                      legs=legs)
+            d_cold = telemetry.counters_since(snap)
+            assert d_cold.get(strict.BLOCKED_COUNTER, 0) == 0
+            assert len(cold) == 2 and len(cold[0]) == 8
+            assert eng.last_prefix_pool.consistent
+
+            snap = telemetry.counters()
+            warm = eng.score_prefixed(pairs, targets=("Yes", "No"),
+                                      legs=legs)
+            d_warm = telemetry.counters_since(snap)
+            assert d_warm.get(strict.BLOCKED_COUNTER, 0) == 0
+            # warm repeat must not recompile: plan keys + bucketed shapes
+            # are stable, so a nonzero delta is a cache-key leak
+            assert d_warm.get(strict.RECOMPILE_COUNTER, 0) == 0
+            for a, b in zip(cold[0], warm[0]):
+                assert a["relative_prob"] == pytest.approx(
+                    b["relative_prob"], abs=1e-9)
+        finally:
+            strict.deactivate()

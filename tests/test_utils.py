@@ -145,3 +145,98 @@ class TestCheckpoint:
         assert ("claude", "scenario_2", 4) in reloaded
         assert ("claude", "scenario_2", 5) not in reloaded
         assert len(reloaded) == 3
+
+
+class TestTelemetryCounters:
+    """The counters API (utils/telemetry.py) that the prefix-reuse,
+    host-pipeline, and strict-mode layers all report through."""
+
+    @pytest.fixture(autouse=True)
+    def _fresh(self):
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        telemetry.clear_counters()
+        yield
+        telemetry.clear_counters()
+
+    def test_record_read_and_reset_semantics(self):
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        assert telemetry.counter("never_recorded") == 0
+        telemetry.record_counter("hits")            # default increment 1
+        telemetry.record_counter("hits", 2.5)       # float increments sum
+        assert telemetry.counter("hits") == 3.5
+        snap = telemetry.counters()
+        snap["hits"] = -1                            # snapshot is a COPY
+        assert telemetry.counter("hits") == 3.5
+        telemetry.clear_counters()
+        assert telemetry.counter("hits") == 0
+        assert telemetry.counters() == {}
+
+    def test_counters_since_deltas(self):
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        telemetry.record_counter("a", 2)
+        snap = telemetry.counters()
+        telemetry.record_counter("a", 3)
+        telemetry.record_counter("b")
+        delta = telemetry.counters_since(snap)
+        assert delta == {"a": 3, "b": 1}
+        # unchanged counters are omitted; a fresh snapshot yields {}
+        assert telemetry.counters_since(telemetry.counters()) == {}
+
+    def test_thread_safety_under_concurrent_recording(self):
+        import threading
+
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        n_threads, n_each = 8, 500
+        barrier = threading.Barrier(n_threads)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(n_each):
+                telemetry.record_counter("contended")
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        # without the lock, lost read-modify-write updates would land
+        # below the exact total
+        assert telemetry.counter("contended") == n_threads * n_each
+
+    def test_host_prefetcher_background_thread_records(self):
+        from llm_interpretation_replication_tpu.runtime.batching import (
+            HostPrefetcher,
+        )
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        out = list(HostPrefetcher(range(5), lambda i: i * i))
+        assert out == [0, 1, 4, 9, 16]
+        # the worker thread and the consumer both recorded through the
+        # shared lock: one chunk count per item, idle time accumulated
+        assert telemetry.counter("host_overlap_chunks") == 5
+        assert telemetry.counter("host_overlap_idle_ms") >= 0
+
+    def test_strict_mode_counters_flow_through_this_api(self):
+        """recompile_events / blocked_transfers are ordinary counters:
+        strict mode records them, benches diff them via counters_since."""
+        import numpy as np
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.runtime import strict
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        strict.activate(sentry=False)
+        try:
+            snap = telemetry.counters()
+            with pytest.raises(Exception, match="[Dd]isallowed"):
+                with strict.device_region("utils-test"):
+                    jnp.cos(np.ones((3,)))
+            assert telemetry.counters_since(snap) == {
+                strict.BLOCKED_COUNTER: 1}
+            assert strict.strict_report()[strict.BLOCKED_COUNTER] == 1
+        finally:
+            strict.deactivate()
