@@ -459,7 +459,9 @@ def run_sweep_mode(args, cfg, params):
     all_prompts = [p for ps in prompts_by_scenario for p in ps]
     all_targets = [list(s["target_tokens"]) for s, _ in items]
     best_dt = float("inf")
+    best_score_s = float("inf")
     last_ok_rows = 0
+    last_rows = None
     repeat_times = []
     rep = 0
     while rep < max(1, args.sweep_repeats):
@@ -490,6 +492,8 @@ def run_sweep_mode(args, cfg, params):
                 rep += 1
             continue
         t_score = timemod.perf_counter() - t0
+        best_score_s = min(best_score_s, t_score)
+        last_rows = rows
         for (scenario, reph), row in zip(items, rows):
             pending.append(perturbation_row(
                 args.model, scenario, reph,
@@ -517,6 +521,33 @@ def run_sweep_mode(args, cfg, params):
         rep += 1
     assert last_ok_rows == n_total, (last_ok_rows, n_total)
     args.repeat_times = repeat_times  # warm-vs-cold report (main())
+
+    if getattr(args, "serve_replay", False):
+        # Route the SAME workload through the serve/ continuous-batching
+        # scheduler and verify row-level parity against the offline rows
+        # the repeats above already produced — the coalescing win (or
+        # cost) becomes a measured number next to the offline headline.
+        from llm_interpretation_replication_tpu.serve import SchedulerConfig
+        from llm_interpretation_replication_tpu.serve.replay import replay
+
+        rep_report = replay(
+            engine, all_prompts, targets=all_targets,
+            config=SchedulerConfig(max_batch=args.sweep_batch,
+                                   queue_capacity=max(4096, n_total)),
+            # compare scoring against scoring: the serve pass has no
+            # row-building/xlsx tail, so the offline side is the best
+            # repeat's SCORING time, not its e2e wall clock
+            offline_rows=last_rows, offline_s=best_score_s,
+            require_parity=False,
+        )
+        rep_report.pop("serve_rows", None)
+        args.serve_report = rep_report
+        print(f"# serve replay: {rep_report['serve_rows_per_s']} rows/s "
+              f"through the scheduler vs {rep_report['offline_rows_per_s']} "
+              f"offline best, {rep_report['serve_batches']} micro-batches, "
+              f"{rep_report['mismatched_rows']} mismatched row(s)",
+              file=sys.stderr)
+
     return n_total / best_dt, measured_rate, out_path
 
 
@@ -836,6 +867,14 @@ def main():
                              "side-log every N rows (the sweep shells' "
                              "resume checkpoint; the xlsx renders once at "
                              "end of sweep)")
+    parser.add_argument("--serve-replay", action="store_true",
+                        help="sweep mode: after the offline repeats, push "
+                             "the same workload through the serve/ "
+                             "continuous-batching scheduler, verify "
+                             "row-level parity against the offline rows, "
+                             "and attach a 'serve' block (scheduler vs "
+                             "offline rows/sec, micro-batch count, queue "
+                             "latency percentiles) to the JSON record")
     parser.add_argument("--strict", action="store_true",
                         help="arm strict mode (runtime/strict.py, same as "
                              "LLM_INTERP_STRICT=1): transfer-guard the "
@@ -875,6 +914,9 @@ def main():
     if args.mode in ("parity", "sweep") and args.microbatch > 1:
         parser.error("--microbatch applies to the single/decode modes; the "
                      "parity/sweep decode slice is sized from the full batch")
+    if args.serve_replay and args.mode != "sweep":
+        parser.error("--serve-replay rides the sweep mode's offline rows "
+                     "(row-parity needs them); use --mode sweep")
 
     import jax
     import jax.numpy as jnp
@@ -1213,6 +1255,8 @@ def main():
             "vs_baseline": round(pps / A100_BASELINE_PROMPTS_PER_SEC, 2),
         }
         record.update(_repeat_report(args))
+        if getattr(args, "serve_report", None):
+            record["serve"] = args.serve_report
         if not args.no_secondary:
             # (a) the steady-state device rate at the sweep's own dominant
             # operating point — the e2e number should be >=90% of this, the

@@ -4,6 +4,7 @@ One typed CLI replaces the reference's 43 standalone scripts::
 
     python -m llm_interpretation_replication_tpu run-100q --checkpoint-dir ...
     python -m llm_interpretation_replication_tpu run-instruct-sweep ...
+    python -m llm_interpretation_replication_tpu serve --model ... --input requests.jsonl
     python -m llm_interpretation_replication_tpu run-perturbation --model ... --perturbations data/perturbations.json
     python -m llm_interpretation_replication_tpu generate-irrelevant --output data/perturbations_irrelevant.json
     python -m llm_interpretation_replication_tpu analyze-perturbations --workbook results.xlsx --output-dir out/
@@ -623,6 +624,20 @@ def cmd_analyze_mae_100q(args):
         _write_json({"families": families, "meta": meta}, args.output_json)
 
 
+def cmd_serve(args):
+    """Continuous-batching scoring service (serve/): one resident model,
+    independent requests coalescing onto its warm compiled shapes.  The
+    stdlib JSONL driver reads requests from --input (file or stdin) and
+    answers every line in input order; --replay routes the perturbation
+    sweep workload through the scheduler and asserts row-level parity
+    with the offline score_prompts path."""
+    from .serve.cli import main as serve_main
+
+    rc = _run_config(args)
+    engine = _engine_factory(rc)(args.model)
+    raise SystemExit(serve_main(engine, args))
+
+
 def cmd_lint(args):
     """graftlint: the repo's JAX-aware static-analysis gate (lint/).
 
@@ -1185,6 +1200,43 @@ def main(argv=None):
     p.add_argument("--output-json", default=None,
                    help="also write the analysis records here")
     p.set_defaults(fn=cmd_analyze_100q)
+
+    p = sub.add_parser(
+        "serve",
+        help="continuous-batching scoring service over one resident "
+             "model (serve/): JSONL stdin/file driver, or --replay for "
+             "offline-parity verification")
+    _add_run_config_args(p)
+    p.add_argument("--model", required=True,
+                   help="model snapshot name under --checkpoint-dir")
+    p.add_argument("--input", default="-",
+                   help="JSONL request stream: one "
+                        '{"prompt": ...}/{"prefix": ..., "suffix": ...} '
+                        "object per line ('-' = stdin)")
+    p.add_argument("--output", default="-",
+                   help="JSONL results, input order ('-' = stdout)")
+    p.add_argument("--max-batch", type=int, default=0, metavar="N",
+                   help="rows per coalesced micro-batch (0 = the "
+                        "engine's batch size — the warm compiled shape)")
+    p.add_argument("--max-wait-ms", type=float, default=20.0, metavar="MS",
+                   help="admission policy: hold the head request at most "
+                        "this long for co-batchable traffic before "
+                        "launching a partial micro-batch")
+    p.add_argument("--queue-capacity", type=int, default=2048, metavar="N",
+                   help="admission bound; a submit past it is a typed "
+                        "QueueFull backpressure rejection")
+    p.add_argument("--timeout-s", type=float, default=None, metavar="S",
+                   help="default per-request deadline (expired requests "
+                        "are rejected with a typed DeadlineExceeded, "
+                        "never silently dropped)")
+    p.add_argument("--replay", metavar="PERTURBATIONS", default=None,
+                   help="replay mode: push the perturbation sweep "
+                        "workload through the scheduler, assert "
+                        "row-level parity vs the offline path, and "
+                        "report scheduler-vs-offline throughput")
+    p.add_argument("--max-rephrasings", type=int, default=None,
+                   help="replay mode: cap rephrasings per scenario")
+    p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("lint",
                        help="JAX-aware static analysis (graftlint rules "

@@ -41,11 +41,15 @@ HOT_PATH_MARKERS = (
 
 #: Path fragments where G05 (broad except) applies: every layer that sits
 #: between a device error and runtime/faults.py's OOM/transient
-#: classification.  Analysis/stats/viz modules keep their defensive
-#: catches — nothing there handles device errors.
+#: classification.  serve/ is in scope from day one — the scheduler's
+#: micro-batch launches are exactly where a swallowed RESOURCE_EXHAUSTED
+#: would skip the split/re-queue ladder.  Analysis/stats/viz modules keep
+#: their defensive catches — nothing there handles device errors.
 FAULT_PATH_MARKERS = (
     "/runtime/", "/ops/", "/models/", "/sweeps/", "/parallel/", "/native/",
+    "/serve/",
     "runtime/", "ops/", "models/", "sweeps/", "parallel/", "native/",
+    "serve/",
 )
 
 

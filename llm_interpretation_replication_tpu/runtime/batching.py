@@ -241,8 +241,12 @@ class HostPrefetcher:
 
     Worker exceptions re-raise in the consumer at the failed item's
     position.  ``close()`` (or dropping the iterator mid-way) stops the
-    worker; the thread is a daemon either way, so an abandoned prefetcher
-    can never hang interpreter exit."""
+    worker — IDEMPOTENTLY: the serve scheduler's shutdown path calls it
+    from both the drain loop and ``__exit__``, and the iterator's own
+    ``finally`` may already have run, so a second (or third) close is a
+    no-op that never double-joins or raises.  The thread is a daemon
+    either way, so an abandoned prefetcher can never hang interpreter
+    exit."""
 
     _DONE = object()
 
@@ -293,5 +297,17 @@ class HostPrefetcher:
             # its full queue forever
             self.close()
 
+    @property
+    def closed(self) -> bool:
+        return self._stop.is_set()
+
     def close(self):
+        """Stop the worker (idempotent; see class docstring).  The first
+        close signals the stop event and briefly joins the worker so its
+        queue slots free deterministically; later closes return
+        immediately."""
+        if self._stop.is_set():
+            return
         self._stop.set()
+        if self._thread is not threading.current_thread():
+            self._thread.join(timeout=1.0)

@@ -11,6 +11,7 @@ whose keys match the reference CSV schemas (§2.8).
 from __future__ import annotations
 
 import collections
+import contextlib
 import dataclasses
 import functools
 import sys
@@ -186,6 +187,7 @@ class PrefixCachePool:
         self.hits = 0
         self.misses = 0
         self.leaked = 0
+        self.closed = False
 
     def acquire(self, nbytes: int, rows: int) -> "PrefixCachePool.Entry":
         entry = self.Entry(nbytes, rows)
@@ -216,7 +218,16 @@ class PrefixCachePool:
     def close(self) -> None:
         """End-of-call sweep: any still-live entry is a leak (an error
         propagated past the pipeline) — force-release and count it so
-        tests and telemetry can tell a clean run from an aborted one."""
+        tests and telemetry can tell a clean run from an aborted one.
+
+        IDEMPOTENT (safe double-close): the serve scheduler's shutdown
+        path closes the engine's audit pool from both its drain loop and
+        ``__exit__``, on top of the engine's own per-call close — a
+        second close must neither re-count leaks into telemetry nor
+        disturb the accounting."""
+        if self.closed:
+            return
+        self.closed = True
         for entry in list(self.live):
             entry.released = True
             self.live.remove(entry)
@@ -260,6 +271,25 @@ class ScoringEngine:
     @property
     def is_encoder_decoder(self) -> bool:
         return self.family == "t5"
+
+    @contextlib.contextmanager
+    def config_overrides(self, **overrides):
+        """Temporarily replace :class:`EngineConfig` fields for the
+        duration — the serve scheduler's composition hook: a
+        scheduler-driven launch disarms the engine's in-place OOM ladder
+        (``oom_backoff=False`` — a split micro-batch re-enters the QUEUE,
+        not the engine) and steps ``batch_size`` down for re-queued split
+        chunks, while every other caller keeps the configured values.
+
+        NOT safe against CONCURRENT engine calls (the scheduler
+        serializes all engine access on its loop thread, which is also
+        the engine's own thread-safety contract)."""
+        prev = self.ecfg
+        self.ecfg = dataclasses.replace(prev, **overrides)
+        try:
+            yield self.ecfg
+        finally:
+            self.ecfg = prev
 
     def target_ids(self, targets: Sequence[str]) -> List[int]:
         return yn.target_token_ids(self.tokenizer, targets, self.is_encoder_decoder)

@@ -61,6 +61,7 @@ __all__ = [
     "next_batch_down",
     "oom_detail",
     "retry_transient",
+    "split_for_requeue",
     "sweep_oom_action",
 ]
 
@@ -119,6 +120,30 @@ def next_batch_down(batch: int, ladder: Sequence[int] = (),
         if step < batch:
             return max(floor, int(step))
     return max(floor, batch // 2)
+
+
+def split_for_requeue(rows: int, current: int, ladder: Sequence[int] = (),
+                      floor: int = 1
+                      ) -> Optional[Tuple[int, Tuple[int, ...]]]:
+    """Serve-path OOM composition rule: ``(new_batch, chunk_sizes)`` for a
+    micro-batch that must re-enter the scheduler QUEUE (never the engine's
+    in-place retry — the scheduler owns serve-path recovery so queued
+    traffic keeps flowing between retries).
+
+    ``current`` is the engine batch size the failed launch ran at;
+    ``new_batch`` is the next ladder step down (:func:`next_batch_down` —
+    the PR-1 machinery) and ``chunk_sizes`` partitions the micro-batch's
+    ``rows`` real rows into re-queue chunks of at most ``new_batch`` rows
+    each, so every re-entered chunk fits one stepped-down device batch.
+    ``None`` at the floor: the caller fails the requests with the original
+    error instead of splitting forever."""
+    new_batch = next_batch_down(current, ladder=ladder, floor=floor)
+    if new_batch is None:
+        return None
+    sizes = [new_batch] * (rows // new_batch)
+    if rows % new_batch:
+        sizes.append(rows % new_batch)
+    return new_batch, tuple(sizes)
 
 
 def _env_flag(name: str, default: bool) -> bool:

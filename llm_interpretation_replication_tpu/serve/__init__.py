@@ -1,0 +1,40 @@
+"""serve/ — continuous-batching request scheduler over the scoring engine.
+
+The serving front door the ROADMAP's "heavy traffic from millions of
+users" north star needs: independent scoring requests share ONE resident
+model by coalescing onto the engine's warm compiled shapes
+(:mod:`.coalescer`), launching as micro-batches under a
+max-wait/max-batch admission policy (:mod:`.scheduler`), and fanning
+results back out per-request as futures (:mod:`.request`).  Replay
+(:mod:`.replay`) proves row-level parity with the offline sweep path;
+the stdlib JSONL driver (:mod:`.cli`) is the
+``python -m llm_interpretation_replication_tpu serve`` subcommand.
+"""
+
+from .config import SchedulerConfig
+from .queue import RequestQueue, Ticket
+from .replay import replay, rows_equal
+from .request import (
+    DeadlineExceeded,
+    QueueFull,
+    SchedulerClosed,
+    ScoreFuture,
+    ScoreRequest,
+    ServeError,
+)
+from .scheduler import Scheduler
+
+__all__ = [
+    "DeadlineExceeded",
+    "QueueFull",
+    "RequestQueue",
+    "SchedulerClosed",
+    "Scheduler",
+    "SchedulerConfig",
+    "ScoreFuture",
+    "ScoreRequest",
+    "ServeError",
+    "Ticket",
+    "replay",
+    "rows_equal",
+]

@@ -1,0 +1,144 @@
+"""Stdlib-only JSONL driver for the ``serve`` CLI subcommand.
+
+One request per input line::
+
+    {"prompt": "Is a tweet a publication? ...", "targets": ["Yes", "No"]}
+    {"prefix": "Is soup a beverage?", "suffix": " Answer Yes or No.",
+     "with_confidence": false, "max_new_tokens": 10,
+     "priority": 5, "timeout_s": 30.0}
+
+One result per output line, in INPUT order, each echoing the 0-based
+input ``id``: the engine's ordinary result-row dict on success, or
+``{"id": N, "error": "...", "error_type": "DeadlineExceeded"}`` on a
+typed rejection — a request is always answered, never dropped.
+
+The replay entry (``serve --replay perturbations.json``) rebuilds the
+perturbation sweep's prompt workload exactly as the offline sweep shell
+does and routes it through :func:`..serve.replay.replay`, asserting
+row-level parity and reporting scheduler-vs-offline throughput.
+"""
+
+from __future__ import annotations
+
+import json
+import sys
+from typing import Dict, Optional
+
+from .config import SchedulerConfig
+from .replay import replay
+from .request import ScoreRequest, ServeError
+from .scheduler import Scheduler
+
+#: request-line keys accepted by :func:`parse_request_line`
+_REQUEST_KEYS = ("prompt", "prefix", "suffix", "targets",
+                 "with_confidence", "max_new_tokens", "priority",
+                 "timeout_s")
+
+
+def parse_request_line(obj: Dict) -> ScoreRequest:
+    unknown = set(obj) - set(_REQUEST_KEYS)
+    if unknown:
+        raise ValueError(f"unknown request field(s): {sorted(unknown)}")
+    kw = {k: obj[k] for k in _REQUEST_KEYS if k in obj}
+    if "targets" in kw:
+        kw["targets"] = tuple(kw["targets"])
+    req = ScoreRequest(**kw)
+    req.validate()
+    return req
+
+
+def run_jsonl_driver(engine, in_stream, out_stream,
+                     config: Optional[SchedulerConfig] = None) -> Dict:
+    """Read JSONL requests, serve them, write JSONL results in input
+    order.  Returns ``{"requests": N, "errors": M}``."""
+    entries = []  # (id, future-or-None, error-or-None)
+    with Scheduler(engine, config) as sched:
+        for i, line in enumerate(in_stream):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                future = sched.submit(parse_request_line(json.loads(line)))
+                entries.append((i, future, None))
+            except (ValueError, KeyError, TypeError, ServeError) as err:
+                # malformed line, OR a typed admission rejection
+                # (QueueFull backpressure / SchedulerClosed): this line
+                # gets its error answer and the driver keeps going —
+                # already-admitted requests must still be served
+                entries.append((i, None, err))
+        results = []
+        for i, future, parse_err in entries:
+            if parse_err is not None:
+                results.append((i, None, parse_err))
+                continue
+            try:
+                results.append((i, future.result(timeout=None), None))
+            except Exception as err:  # graftlint: disable=G05 CLI result relay: every per-request failure (typed rejection or engine error) becomes that request's JSON error line; the driver must answer the remaining lines
+                results.append((i, None, err))
+    errors = 0
+    for i, row, err in results:
+        if err is not None:
+            errors += 1
+            out_stream.write(json.dumps(
+                {"id": i, "error": str(err),
+                 "error_type": type(err).__name__}) + "\n")
+        else:
+            out_stream.write(json.dumps({"id": i, **row}) + "\n")
+    return {"requests": len(results), "errors": errors}
+
+
+def run_replay(engine, perturbations_path: str,
+               max_rephrasings: Optional[int] = None,
+               config: Optional[SchedulerConfig] = None,
+               require_parity: bool = True) -> Dict:
+    """Replay the perturbation sweep's binary-leg workload through the
+    scheduler (the prompts the offline shell builds: ``{rephrasing}
+    {response_format}`` with per-scenario target pairs) and return the
+    parity + throughput report."""
+    with open(perturbations_path, encoding="utf-8") as f:
+        scenarios = json.load(f)
+    prompts, targets = [], []
+    for s in scenarios:
+        rephrasings = s["rephrasings"]
+        if max_rephrasings is not None:
+            rephrasings = rephrasings[:max_rephrasings]
+        for r in rephrasings:
+            prompts.append(f"{r} {s['response_format']}")
+            targets.append(tuple(s["target_tokens"][:2]))
+    report = replay(engine, prompts, targets=targets, config=config,
+                    require_parity=require_parity)
+    report.pop("serve_rows", None)
+    return report
+
+
+def main(engine, args) -> int:
+    """The ``serve`` subcommand body (argparse args from __main__)."""
+    config = SchedulerConfig(
+        max_batch=args.max_batch,
+        max_wait_s=args.max_wait_ms / 1000.0,
+        queue_capacity=args.queue_capacity,
+        default_timeout_s=args.timeout_s,
+    )
+    if args.replay:
+        # require_parity=False: the CLI's job on a skew is the full JSON
+        # report plus exit 1 — raising would swallow the report the
+        # operator needs to see WHICH rows diverged
+        report = run_replay(engine, args.replay,
+                            max_rephrasings=args.max_rephrasings,
+                            config=config, require_parity=False)
+        print(json.dumps(report, indent=2))
+        return 0 if report["mismatched_rows"] == 0 else 1
+    in_stream = sys.stdin if args.input == "-" else open(
+        args.input, encoding="utf-8")
+    out_stream = sys.stdout if args.output == "-" else open(
+        args.output, "w", encoding="utf-8")
+    try:
+        summary = run_jsonl_driver(engine, in_stream, out_stream, config)
+    finally:
+        if in_stream is not sys.stdin:
+            in_stream.close()
+        if out_stream is not sys.stdout:
+            out_stream.close()
+    print(f"# serve: {summary['requests']} request(s), "
+          f"{summary['errors']} error(s)", file=sys.stderr)
+    return 0

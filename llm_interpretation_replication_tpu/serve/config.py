@@ -1,0 +1,42 @@
+"""Scheduler admission/backpressure knobs.
+
+Deliberately jax-free (importable by the CLI argument layer and tests
+without touching the device runtime).  The defaults target the latency
+knee the batch-prompting literature keeps rediscovering (PAPERS.md,
+Auto-Demo Prompting; the TPU-vs-GPU serving comparison): coalesce as
+wide as one engine batch, but never hold the head request more than a
+few tens of milliseconds waiting for co-batchable traffic.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Optional, Sequence
+
+
+@dataclasses.dataclass
+class SchedulerConfig:
+    #: rows per micro-batch; 0 = the engine's ``EngineConfig.batch_size``
+    #: (the shape the warm compiled programs already exist for).
+    max_batch: int = 0
+    #: how long the scheduler holds the HEAD request open for compatible
+    #: co-batchable traffic before launching a partial micro-batch.
+    max_wait_s: float = 0.02
+    #: admission-queue bound; a submit past it raises the typed
+    #: :class:`~.request.QueueFull` (backpressure, never silent deferral).
+    queue_capacity: int = 2048
+    #: default per-request deadline applied when a request carries none
+    #: (None = requests without ``timeout_s`` never expire).
+    default_timeout_s: Optional[float] = None
+    #: OOM re-queue ladder for split micro-batches (the PR-1 machinery,
+    #: runtime/faults.next_batch_down); () = halving.  The FLOOR is where
+    #: the scheduler stops splitting and fails the requests instead.
+    oom_ladder: Sequence[int] = ()
+    oom_floor: int = 1
+    #: transient-retry policy for scheduler-driven engine calls (None =
+    #: runtime/faults.default_transient_policy); OOM is excluded — the
+    #: split/re-queue path owns it.
+    retry_policy: Optional[object] = None
+    #: close(drain=True) gives in-flight + queued work this long to
+    #: finish before leftover requests fail with SchedulerClosed.
+    drain_timeout_s: float = 120.0

@@ -1,0 +1,119 @@
+"""Thread-safe admission queue with priority ordering and deadline sweep.
+
+The queue holds :class:`Ticket`\\ s — a request plus its future, arrival
+order, absolute deadline, pre-tokenized ids, and compatibility key — and
+implements the max-wait/max-batch admission policy: ``pop_group`` blocks
+for the highest-priority head ticket, then coalesces every compatible
+ticket (same :mod:`.coalescer` key) up to ``max_batch``, launching early
+only when the head has already waited ``max_wait_s``.  Deadline-expired
+tickets are swept out and RETURNED to the caller (the scheduler rejects
+them with the typed :class:`~.request.DeadlineExceeded`) — they are
+never silently dropped inside the queue.
+
+Capacity is a hard bound enforced at ``put`` (typed
+:class:`~.request.QueueFull`); split micro-batches re-entering after an
+OOM go through ``requeue`` which bypasses the bound — those rows were
+already admitted once and dropping them on re-entry would lose work.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+import time
+from typing import Any, List, Optional, Tuple
+
+from .request import QueueFull, SchedulerClosed, ScoreFuture, ScoreRequest
+
+
+@dataclasses.dataclass
+class Ticket:
+    """One admitted request travelling through the scheduler."""
+
+    request: ScoreRequest
+    future: ScoreFuture
+    seq: int                        # admission order (FIFO tie-break)
+    enqueue_t: float                # monotonic submit time
+    deadline: Optional[float]       # absolute monotonic, None = never
+    encoded: Any = None             # token ids (or (prefix_ids, suffix_ids))
+    key: Any = None                 # coalescer compatibility key
+    degraded: Optional[int] = None  # engine batch override after OOM splits
+
+    def sort_key(self) -> Tuple[int, int]:
+        return (-self.request.priority, self.seq)
+
+    def expired(self, now: float) -> bool:
+        return self.deadline is not None and now >= self.deadline
+
+
+class RequestQueue:
+    def __init__(self, capacity: int):
+        self.capacity = max(1, int(capacity))
+        self._items: List[Ticket] = []
+        self._cond = threading.Condition()
+        self._closed = False
+
+    def __len__(self) -> int:
+        with self._cond:
+            return len(self._items)
+
+    def put(self, ticket: Ticket) -> None:
+        with self._cond:
+            if self._closed:
+                raise SchedulerClosed("scheduler is shut down")
+            if len(self._items) >= self.capacity:
+                raise QueueFull(
+                    f"admission queue at capacity ({self.capacity})")
+            self._items.append(ticket)
+            self._cond.notify_all()
+
+    def requeue(self, tickets: List[Ticket]) -> None:
+        """Re-admit split micro-batch tickets (OOM re-entry): original
+        ``seq`` values are preserved, so they sort ahead of traffic that
+        arrived after them; the capacity bound does not apply."""
+        with self._cond:
+            self._items.extend(tickets)
+            self._cond.notify_all()
+
+    def close(self) -> None:
+        """Stop admitting; ``pop_group`` keeps draining what is queued
+        and returns ``None`` once empty."""
+        with self._cond:
+            self._closed = True
+            self._cond.notify_all()
+
+    def pop_group(self, max_batch: int, max_wait_s: float,
+                  now_fn=time.monotonic
+                  ) -> Tuple[Optional[List[Ticket]], List[Ticket]]:
+        """``(group, expired)``: the next launchable micro-batch plus the
+        tickets whose deadline passed while queued.  ``group`` is ``None``
+        exactly when the queue is closed and drained."""
+        expired: List[Ticket] = []
+        with self._cond:
+            while True:
+                now = now_fn()
+                live: List[Ticket] = []
+                for t in self._items:
+                    (expired if t.expired(now) else live).append(t)
+                self._items = live
+                if not live:
+                    if self._closed:
+                        return None, expired
+                    if expired:
+                        # surface rejections promptly instead of holding
+                        # them until the next arrival
+                        return [], expired
+                    self._cond.wait(timeout=0.05)
+                    continue
+                head = min(live, key=Ticket.sort_key)
+                group = [t for t in sorted(live, key=Ticket.sort_key)
+                         if t.key == head.key][: max(1, max_batch)]
+                full = len(group) >= max(1, max_batch)
+                waited = now - head.enqueue_t
+                if full or self._closed or waited >= max_wait_s:
+                    for t in group:
+                        self._items.remove(t)
+                    return group, expired
+                if expired:
+                    return [], expired
+                self._cond.wait(timeout=max(0.001, max_wait_s - waited))

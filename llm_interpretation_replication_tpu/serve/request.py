@@ -1,0 +1,114 @@
+"""Typed request/future surface of the continuous-batching scheduler.
+
+A :class:`ScoreRequest` is one independent scoring question — a formatted
+prompt (or a ``(prefix, suffix)`` pair that rides the engine's fused
+prefix-reuse path), its yes/no target pair, the leg knobs the engine's
+``GenerationPlan`` cache keys on (``with_confidence`` /
+``max_new_tokens``), a priority, and an optional deadline.  ``submit``
+returns a :class:`ScoreFuture` that resolves to the engine's ordinary
+result-row dict (runtime/engine._result_row contract) or to one of the
+TYPED errors below — a rejected request is always told WHY (deadline,
+backpressure, shutdown), never silently dropped.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import threading
+from typing import Any, Dict, Optional, Tuple
+
+
+class ServeError(RuntimeError):
+    """Base of every scheduler-raised error."""
+
+
+class DeadlineExceeded(ServeError):
+    """The request's deadline passed before its micro-batch launched."""
+
+
+class QueueFull(ServeError):
+    """Backpressure: the admission queue is at capacity.  Raised at
+    ``submit`` time so the caller can shed load or retry — admission is
+    never silently deferred past the queue bound."""
+
+
+class SchedulerClosed(ServeError):
+    """The scheduler shut down before (or while) the request could run."""
+
+
+@dataclasses.dataclass
+class ScoreRequest:
+    """One scoring request.
+
+    Exactly one of ``prompt`` (a formatted prompt string or a
+    pre-tokenized id list) or ``prefix``+``suffix`` (the fused
+    prefix-reuse spelling — requests sharing a prefix coalesce into one
+    ``score_prefixed`` batch and ride one ``PrefixCachePool`` entry per
+    micro-batch).  ``timeout_s`` is relative to submit time; the
+    scheduler converts it to an absolute monotonic deadline.  Higher
+    ``priority`` launches first; FIFO within a priority level."""
+
+    prompt: Any = None
+    prefix: Any = None
+    suffix: Any = None
+    targets: Tuple[str, str] = ("Yes", "No")
+    with_confidence: bool = False
+    max_new_tokens: Optional[int] = None
+    priority: int = 0
+    timeout_s: Optional[float] = None
+
+    def validate(self) -> None:
+        has_prompt = self.prompt is not None
+        has_pair = self.prefix is not None or self.suffix is not None
+        if has_prompt == has_pair:
+            raise ValueError(
+                "ScoreRequest takes exactly one of prompt= or "
+                "prefix=+suffix=")
+        if has_pair and (self.prefix is None or self.suffix is None):
+            raise ValueError("prefix and suffix must be given together")
+        if len(self.targets) != 2:
+            raise ValueError(f"targets must be a (yes, no) pair, got "
+                             f"{self.targets!r}")
+
+
+class ScoreFuture:
+    """Thread-safe one-shot result slot for a submitted request."""
+
+    __slots__ = ("_event", "_row", "_err")
+
+    def __init__(self):
+        self._event = threading.Event()
+        self._row: Optional[Dict] = None
+        self._err: Optional[BaseException] = None
+
+    # -- scheduler side --------------------------------------------------
+
+    def _set_result(self, row: Dict) -> None:
+        self._row = row
+        self._event.set()
+
+    def _set_exception(self, err: BaseException) -> None:
+        self._err = err
+        self._event.set()
+
+    # -- caller side -----------------------------------------------------
+
+    def done(self) -> bool:
+        return self._event.is_set()
+
+    def result(self, timeout: Optional[float] = None) -> Dict:
+        """The result-row dict; raises the request's typed error (or the
+        engine error that failed its micro-batch) instead of returning.
+        ``TimeoutError`` when the result is not ready within ``timeout``."""
+        if not self._event.wait(timeout):
+            raise TimeoutError("score request still pending")
+        if self._err is not None:
+            raise self._err
+        assert self._row is not None
+        return self._row
+
+    def exception(self, timeout: Optional[float] = None
+                  ) -> Optional[BaseException]:
+        if not self._event.wait(timeout):
+            raise TimeoutError("score request still pending")
+        return self._err

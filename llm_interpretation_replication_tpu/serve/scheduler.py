@@ -1,0 +1,291 @@
+"""In-process continuous-batching scheduler over the scoring engine.
+
+After PRs 1-3 the stack can only run OFFLINE sweeps: ``ScoringEngine``
+consumes pre-materialized batch iterators, so a perturbation sweep, a
+100q sweep, and ad-hoc judgment queries cannot share one resident model.
+This scheduler closes that gap: independent :class:`~.request.ScoreRequest`\\ s
+land on a thread-safe queue, coalesce into micro-batches of COMPATIBLE
+requests (same :mod:`.coalescer` key — the same ``GenerationPlan`` cache
+key and length bucket the engine's warm compiled shapes already exist
+for; prefix-pair requests ride ``score_prefixed`` so a shared prefix
+occupies one ``PrefixCachePool`` entry per batch), launch through the
+existing engine entry points under a max-wait/max-batch admission
+policy, and fan results back out per-request as futures.
+
+Composition with the existing layers — the scheduler goes THROUGH them,
+never around them:
+
+- **OOM** — the engine's in-place re-bucket ladder is disarmed for
+  scheduler-driven launches (``engine.config_overrides(oom_backoff=False)``);
+  a device OOM instead splits the micro-batch down the SAME PR-1 ladder
+  (:func:`~..runtime.faults.split_for_requeue`) and the chunks RE-ENTER
+  THE QUEUE with a stepped-down engine batch override, so queued traffic
+  interleaves with the retry instead of stalling behind an in-engine
+  retry loop.  At the floor the requests fail with the original error.
+- **Transients** — scheduler launches run under
+  :func:`~..runtime.faults.retry_transient` (OOM excluded, as always).
+- **Strict mode** — launches go through ``engine._run_pipelined``, so the
+  transfer guard and recompile sentry stay armed; a clean serving run is
+  provable as ``blocked_transfers == 0``.
+- **Telemetry** — admission, rejection, batching factor, queue-depth and
+  latency distributions land in the ``serve_*`` counters/samples
+  (utils/telemetry.py).
+
+Thread model: ``submit`` is safe from any thread (tokenization happens on
+the submitting thread); ALL engine access is serialized on the single
+scheduler loop thread, so the non-thread-safe engine needs no locking.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+import threading
+import time
+from typing import List, Optional
+
+from ..runtime import faults
+from ..runtime.engine import LegSpec
+from ..utils.telemetry import record_counter, record_fault, record_sample
+from . import coalescer
+from .config import SchedulerConfig
+from .queue import RequestQueue, Ticket
+from .request import (
+    DeadlineExceeded,
+    QueueFull,
+    SchedulerClosed,
+    ScoreFuture,
+    ScoreRequest,
+)
+
+
+class Scheduler:
+    """Continuous-batching front door for one resident :class:`ScoringEngine`.
+
+    Usage::
+
+        with Scheduler(engine) as sched:
+            futures = [sched.submit(ScoreRequest(prompt=p)) for p in work]
+            rows = [f.result(timeout=300) for f in futures]
+
+    ``submit`` before ``start`` queues; ``close(drain=True)`` (the
+    ``with`` exit) finishes queued work, then rejects anything left with
+    the typed :class:`SchedulerClosed`."""
+
+    def __init__(self, engine, config: Optional[SchedulerConfig] = None):
+        self.engine = engine
+        self.config = config or SchedulerConfig()
+        self.queue = RequestQueue(self.config.queue_capacity)
+        self._seq = 0
+        self._seq_lock = threading.Lock()
+        self._thread: Optional[threading.Thread] = None
+        self._closed = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    def start(self) -> "Scheduler":
+        if self._closed:
+            raise SchedulerClosed("scheduler is shut down")
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, name="serve-scheduler", daemon=True)
+            self._thread.start()
+        return self
+
+    def close(self, drain: bool = True,
+              timeout: Optional[float] = None) -> None:
+        """Idempotent shutdown: stop admitting, drain (or abandon) queued
+        work, join the loop, and sweep the engine-side audit state.  Safe
+        to call twice — the drain loop and ``__exit__`` both do."""
+        if self._closed:
+            return
+        self._closed = True
+        self.queue.close()
+        if self._thread is not None:
+            self._thread.join(
+                timeout if timeout is not None
+                else (self.config.drain_timeout_s if drain else 1.0))
+        # anything still queued after the drain window gets a typed error
+        while True:
+            leftover, expired = self.queue.pop_group(max_batch=1 << 30,
+                                                     max_wait_s=0)
+            for t in expired:
+                record_counter("serve_rejected_deadline")
+                self._reject(t, DeadlineExceeded(
+                    "deadline passed before the scheduler shut down"))
+            if not leftover:
+                break
+            for t in leftover:
+                self._reject(t, SchedulerClosed(
+                    "scheduler shut down before the request launched"))
+        # the prefix pool's close() is idempotent (safe double-close): the
+        # engine already closed it per call; closing again here only sweeps
+        # leak accounting from a launch that died mid-flight
+        pool = getattr(self.engine, "last_prefix_pool", None)
+        if pool is not None:
+            pool.close()
+
+    def __enter__(self) -> "Scheduler":
+        return self.start()
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close(drain=exc_type is None)
+
+    # -- submission ------------------------------------------------------
+
+    def submit(self, request: ScoreRequest) -> ScoreFuture:
+        """Admit one request; returns its future.  Raises the typed
+        :class:`QueueFull` on backpressure and :class:`SchedulerClosed`
+        after shutdown.  An already-expired deadline resolves the future
+        with :class:`DeadlineExceeded` (counted, never dropped)."""
+        request.validate()
+        if self._closed:
+            raise SchedulerClosed("scheduler is shut down")
+        now = time.monotonic()
+        timeout_s = (request.timeout_s if request.timeout_s is not None
+                     else self.config.default_timeout_s)
+        future = ScoreFuture()
+        with self._seq_lock:
+            self._seq += 1
+            seq = self._seq
+        ticket = Ticket(
+            request=request, future=future, seq=seq, enqueue_t=now,
+            deadline=None if timeout_s is None else now + timeout_s,
+            encoded=coalescer.encode_request(self.engine, request),
+        )
+        ticket.key = coalescer.compat_key(self.engine, request,
+                                          ticket.encoded)
+        try:
+            self.queue.put(ticket)
+        except QueueFull:
+            record_counter("serve_rejected_full")
+            raise
+        record_counter("serve_enqueued")
+        record_sample("serve_queue_depth", len(self.queue))
+        return future
+
+    def submit_many(self, requests) -> List[ScoreFuture]:
+        return [self.submit(r) for r in requests]
+
+    # -- scheduler loop --------------------------------------------------
+
+    def _loop(self) -> None:
+        while True:
+            group, expired = self.queue.pop_group(
+                self._max_batch(), self.config.max_wait_s)
+            for t in expired:
+                record_counter("serve_rejected_deadline")
+                self._reject(t, DeadlineExceeded(
+                    f"deadline passed {time.monotonic() - t.deadline:.3f}s "
+                    f"before the micro-batch launched"))
+            if group is None:
+                return          # closed and drained
+            if group:
+                self._launch(group)
+
+    def _max_batch(self) -> int:
+        if self.config.max_batch:
+            return self.config.max_batch
+        ecfg = getattr(self.engine, "ecfg", None)
+        return ecfg.batch_size if ecfg is not None else 32
+
+    @staticmethod
+    def _reject(ticket: Ticket, err: Exception) -> None:
+        ticket.future._set_exception(err)
+
+    def _engine_overrides(self, group: List[Ticket]):
+        """Per-launch EngineConfig overrides: the serve path owns OOM
+        recovery (in-place ladder disarmed), and split chunks carry the
+        stepped-down batch size they re-entered the queue with."""
+        ov = {"oom_backoff": False}
+        degraded = [t.degraded for t in group if t.degraded]
+        if degraded:
+            ov["batch_size"] = min(degraded)
+        ctx = getattr(self.engine, "config_overrides", None)
+        return ctx(**ov) if ctx is not None else contextlib.nullcontext()
+
+    def _launch(self, group: List[Ticket]) -> None:
+        now = time.monotonic()
+        record_counter("serve_batches")
+        record_counter("serve_batch_rows", len(group))
+        for t in group:
+            record_sample("serve_queue_wait_ms",
+                          (now - t.enqueue_t) * 1000.0)
+        first = group[0].request
+        pair_list = [tuple(t.request.targets) for t in group]
+        targets = (list(first.targets) if len(set(pair_list)) == 1
+                   else pair_list)
+
+        if first.prefix is not None:
+            pairs = [
+                (t.encoded[0], (t.encoded[1],)) if t.encoded is not None
+                else (t.request.prefix, (t.request.suffix,))
+                for t in group
+            ]
+
+            def call():
+                return self.engine.score_prefixed(
+                    pairs, targets=targets,
+                    legs=[LegSpec("serve",
+                                  with_confidence=first.with_confidence,
+                                  max_new_tokens=first.max_new_tokens)])[0]
+        else:
+            prompts = [t.encoded if t.encoded is not None
+                       else t.request.prompt for t in group]
+
+            def call():
+                return self.engine.score_prompts(
+                    prompts, targets=targets,
+                    with_confidence=first.with_confidence,
+                    max_new_tokens=first.max_new_tokens)
+
+        try:
+            with self._engine_overrides(group):
+                rows = faults.retry_transient(
+                    call, self.config.retry_policy, label="serve")()
+        # graftlint: disable=G05 serve fault boundary: the error IS classified (faults.is_oom routes to the split/re-queue ladder) and everything else lands typed on each request's future — nothing above the scheduler thread could observe a re-raise
+        except Exception as err:
+            if faults.is_oom(err) and self._split_requeue(group, err):
+                return
+            record_counter("serve_failed", len(group))
+            for t in group:
+                self._reject(t, err)
+            return
+        done = time.monotonic()
+        for t, row in zip(group, rows):
+            record_sample("serve_latency_ms", (done - t.enqueue_t) * 1000.0)
+            t.future._set_result(row)
+        record_counter("serve_completed", len(group))
+
+    def _split_requeue(self, group: List[Ticket], err) -> bool:
+        """OOM recovery: split the micro-batch down the PR-1 ladder and
+        push the chunks BACK INTO THE QUEUE (never an in-engine retry) at
+        a stepped-down engine batch size.  False at the floor — the
+        caller propagates ``err`` to the futures."""
+        ecfg = getattr(self.engine, "ecfg", None)
+        current = min(t.degraded for t in group if t.degraded) \
+            if any(t.degraded for t in group) else (
+                ecfg.batch_size if ecfg is not None else len(group))
+        ladder = self.config.oom_ladder or (
+            ecfg.oom_batch_ladder if ecfg is not None else ())
+        split = faults.split_for_requeue(len(group), current,
+                                         ladder=ladder,
+                                         floor=self.config.oom_floor)
+        if split is None:
+            return False
+        new_batch, sizes = split
+        record_counter("serve_oom_splits")
+        record_fault("serve_oom_split", rows=len(group), batch=current,
+                     new_batch=new_batch, error=faults.oom_detail(err))
+        print(f"# serve: device OOM at batch {current}; re-queueing "
+              f"{len(group)} rows as {len(sizes)} micro-batch(es) at "
+              f"batch {new_batch} [{faults.oom_detail(err)}]",
+              file=sys.stderr)
+        offset = 0
+        for size in sizes:
+            chunk = group[offset: offset + size]
+            offset += size
+            for t in chunk:
+                t.degraded = new_batch
+            self.queue.requeue(chunk)
+        return True

@@ -72,6 +72,20 @@ def clear_fault_events() -> None:
 # the armed jax.transfer_guard rejected inside a scoring pipeline (a clean
 # operating point is provable as blocked_transfers == 0).  bench.py
 # --strict reports both in its JSON record.
+#
+# The serve/ scheduler (continuous-batching request coalescing) adds:
+# ``serve_enqueued`` — requests admitted to the queue; ``serve_completed``
+# — result rows delivered to futures; ``serve_rejected_full`` — typed
+# QueueFull backpressure rejections; ``serve_rejected_deadline`` —
+# deadline-expired requests rejected with a typed error (never silently
+# dropped); ``serve_batches`` / ``serve_batch_rows`` — micro-batches
+# launched and the rows they coalesced (rows/batches = achieved batching
+# factor); ``serve_oom_splits`` — micro-batches split down the PR-1
+# ladder and re-queued after a device OOM; ``serve_failed`` — requests
+# failed with a non-recoverable error.  Queue-depth and latency
+# DISTRIBUTIONS go through the bounded sample rings below
+# (``serve_queue_depth``, ``serve_queue_wait_ms``, ``serve_latency_ms``)
+# so percentiles are reportable without unbounded growth.
 # ---------------------------------------------------------------------------
 
 _COUNTERS: Dict[str, float] = {}
@@ -115,6 +129,74 @@ def counters_since(snapshot: Dict[str, float]) -> Dict[str, float]:
     return {name: value - snapshot.get(name, 0)
             for name, value in now.items()
             if value != snapshot.get(name, 0)}
+
+
+# ---------------------------------------------------------------------------
+# Bounded sample rings (serve/ queue-depth and latency percentiles)
+#
+# Counters are monotones; distributions (how long did a request WAIT, how
+# deep was the queue WHEN it launched) need samples.  Each named ring keeps
+# the most recent _SAMPLES_CAP values — enough for stable p50/p90/p99 over
+# a serving window, bounded so a week-long server never grows host memory.
+# ---------------------------------------------------------------------------
+
+_SAMPLES: Dict[str, List[float]] = {}
+_SAMPLE_TOTALS: Dict[str, int] = {}   # ever-recorded count per ring, so a
+                                      # phase can be measured as "the last
+                                      # (total_now - total_then) samples"
+_SAMPLES_CAP = 4096
+
+
+def record_sample(name: str, value: float) -> None:
+    """Append one observation to the named bounded sample ring."""
+    with _COUNTERS_LOCK:
+        ring = _SAMPLES.setdefault(name, [])
+        ring.append(float(value))
+        _SAMPLE_TOTALS[name] = _SAMPLE_TOTALS.get(name, 0) + 1
+        if len(ring) > _SAMPLES_CAP:
+            del ring[: len(ring) - _SAMPLES_CAP]
+
+
+def sample_count(name: str) -> int:
+    """Samples currently IN the ring (bounded by the cap)."""
+    with _COUNTERS_LOCK:
+        return len(_SAMPLES.get(name, ()))
+
+
+def sample_total(name: str) -> int:
+    """Monotonic count of samples EVER recorded to the ring — snapshot it
+    before a phase and pass the delta as ``sample_percentiles``'s
+    ``last`` to scope percentiles to that phase (clearing the ring would
+    destroy concurrent readers' windows, like ``clear_counters`` would)."""
+    with _COUNTERS_LOCK:
+        return _SAMPLE_TOTALS.get(name, 0)
+
+
+def sample_percentiles(name: str, pcts: tuple = (50.0, 90.0, 99.0),
+                       last: Optional[int] = None) -> Dict[str, float]:
+    """``{"p50": ..., "p90": ..., "p99": ...}`` (nearest-rank) over the
+    named ring — the whole current window, or only the most recent
+    ``last`` samples (one measured phase).  ``{}`` when nothing was
+    recorded (or ``last == 0``)."""
+    with _COUNTERS_LOCK:
+        ring = _SAMPLES.get(name, ())
+        if last is not None:
+            ring = ring[len(ring) - min(len(ring), max(0, last)):]
+        values = sorted(ring)
+    if not values:
+        return {}
+    out = {}
+    for p in pcts:
+        rank = max(0, min(len(values) - 1,
+                          int(round(p / 100.0 * (len(values) - 1)))))
+        out[f"p{p:g}"] = values[rank]
+    return out
+
+
+def clear_samples() -> None:
+    with _COUNTERS_LOCK:
+        _SAMPLES.clear()
+        _SAMPLE_TOTALS.clear()
 
 
 def get_memory_usage() -> str:

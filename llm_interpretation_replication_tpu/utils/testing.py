@@ -195,6 +195,19 @@ class FaultyEngine:
                             row[key] = float("nan")
         return outs
 
+    def serve_scheduler(self, config=None):
+        """Serve-path injection point: a continuous-batching scheduler
+        (serve/.Scheduler) built over THIS wrapper, so scheduler-driven
+        micro-batches launch through the counting/injecting
+        ``score_prompts`` / ``score_prefixed`` above — ``at_call`` and
+        ``at_batch`` faults fire inside serve launches exactly as they do
+        inside sweep calls, and the fault matrix covers the scheduler's
+        own recovery paths (OOM → split + queue re-entry, transient →
+        in-place retry) with the same schedules."""
+        from ..serve import Scheduler
+
+        return Scheduler(self, config)
+
     def first_token_relative_prob(self, prompts, targets=("Yes", "No"),
                                   top_filter: int = 0):
         self.calls += 1

@@ -479,6 +479,95 @@ class TestPerturbationEngineOOM:
 
 
 # ---------------------------------------------------------------------------
+# Serve-path fault matrix: the continuous-batching scheduler (serve/) over
+# the real tiny engine, injected through FaultyEngine.serve_scheduler —
+# OOM splits re-enter the QUEUE down the PR-1 ladder (never the engine's
+# in-place retry), transients retry in place, floor OOMs fail TYPED.
+# ---------------------------------------------------------------------------
+
+
+class TestServeSchedulerFaults:
+    def _serve(self, faulty, prompts, config=None):
+        from llm_interpretation_replication_tpu.serve import (
+            ScoreRequest,
+            SchedulerConfig,
+        )
+
+        cfg = config or SchedulerConfig(max_wait_s=0.01,
+                                        retry_policy=FAST_RETRY)
+        with faulty.serve_scheduler(cfg) as sched:
+            futures = [sched.submit(ScoreRequest(prompt=p))
+                       for p in prompts]
+            return [f.result(timeout=300) for f in futures]
+
+    def test_micro_batch_oom_mid_queue_splits_and_completes(self):
+        """A micro-batch whose device launch OOMs mid-queue is split down
+        the ladder and re-queued at a stepped-down engine batch; every
+        request still resolves, values match a clean run."""
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(6)]
+        clean = eng.score_prompts(prompts)
+        faulty = FaultyEngine(eng, [Fault("oom", at_call=1)])
+        snap = telemetry.counters()
+        rows = self._serve(faulty, prompts)
+        delta = telemetry.counters_since(snap)
+        assert all(r["success"] for r in rows)
+        assert faulty.calls >= 2                 # the split re-launched
+        assert delta["serve_oom_splits"] >= 1
+        events = telemetry.fault_events("serve_oom_split")
+        assert events and events[0]["new_batch"] < events[0]["batch"]
+        np.testing.assert_allclose(
+            [r["relative_prob"] for r in rows],
+            [r["relative_prob"] for r in clean], rtol=2e-5)
+
+    def test_transient_retried_in_place_on_serve_path(self):
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is item {i} a thing?" for i in range(3)]
+        faulty = FaultyEngine(eng, [Fault("transient", at_call=1)])
+        rows = self._serve(faulty, prompts)
+        assert all(r["success"] for r in rows)
+        assert faulty.calls == 2                 # one retry, in place
+        assert telemetry.fault_events("transient_retry")
+
+    def test_oom_at_floor_fails_requests_with_the_original_error(self):
+        """At the ladder floor the scheduler stops splitting: every
+        request in the micro-batch gets the ORIGINAL device error on its
+        future — a typed answer, not a hang or a silent drop."""
+        from llm_interpretation_replication_tpu.serve import (
+            ScoreRequest,
+            SchedulerConfig,
+        )
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        faulty = FaultyEngine(eng, [Fault("oom", at_call=1)])
+        snap = telemetry.counters()
+        cfg = SchedulerConfig(max_wait_s=0.01, oom_floor=4,
+                              retry_policy=FAST_RETRY)
+        with faulty.serve_scheduler(cfg) as sched:
+            futures = [sched.submit(ScoreRequest(prompt=f"q{i}"))
+                       for i in range(4)]
+            errs = [f.exception(timeout=300) for f in futures]
+        assert all(is_oom(e) for e in errs)
+        assert telemetry.counters_since(snap)["serve_failed"] == 4
+
+    def test_split_for_requeue_walks_the_ladder(self):
+        from llm_interpretation_replication_tpu.runtime.faults import (
+            split_for_requeue,
+        )
+
+        assert split_for_requeue(10, 8) == (4, (4, 4, 2))
+        assert split_for_requeue(4, 384, ladder=MEASURED_SWEEP_LADDER,
+                                 floor=256) == (320, (4,))
+        assert split_for_requeue(4, 1) is None            # at the floor
+        assert split_for_requeue(4, 8, floor=8) is None
+
+
+# ---------------------------------------------------------------------------
 # Instruct sweep fault matrix
 # ---------------------------------------------------------------------------
 

@@ -365,6 +365,12 @@ class TestG05BroadExcept:
         findings = run("runtime/thing.py", self.SWALLOW)
         assert rules_of(findings) == ["G05"]
 
+    def test_serve_package_in_fault_scope(self):
+        """serve/ sits between device errors and the split/re-queue
+        ladder, so G05 applies there from day one."""
+        findings = run("serve/scheduler.py", self.SWALLOW)
+        assert rules_of(findings) == ["G05"]
+
     def test_out_of_scope_module_ok(self):
         assert run("viz/figures.py", self.SWALLOW) == []
 
@@ -548,6 +554,35 @@ class TestRepoGate:
         assert any(p.endswith("llm_interpretation_replication_tpu")
                    for p in paths)
         assert any(p.endswith("bench.py") for p in paths)
+
+    def test_default_paths_cover_serve_package(self):
+        """serve/ lives inside the scanned package dir, so the repo gate
+        lints it on every run — asserted via the gate's own file walker."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            iter_python_files,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        assert os.path.isdir(os.path.join(pkg, "serve"))
+        scanned = [f.replace(os.sep, "/") for f in iter_python_files([pkg])]
+        assert any("/serve/scheduler.py" in f for f in scanned)
+        assert any("/serve/queue.py" in f for f in scanned)
+
+    def test_serve_package_lint_clean_without_baseline(self):
+        """Satellite: serve/ ships lint-clean from day one — zero
+        findings even with NO baseline, and no lint_baseline.json entry
+        grandfathers anything under serve/."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        assert lint_paths([os.path.join(pkg, "serve")]) == []
+        entries = load_baseline(default_baseline_path())
+        assert not [e for e in entries if e.get("path", "").startswith(
+            "llm_interpretation_replication_tpu/serve/")]
 
     def test_gate_would_catch_an_injected_violation(self, tmp_path):
         """End-to-end teeth check: copy one real hot-path file, inject a

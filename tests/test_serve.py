@@ -1,0 +1,448 @@
+"""serve/ continuous-batching scheduler: typed request/future surface,
+admission-policy edges (deadline, priority, backpressure), coalescing
+compatibility (GenerationPlan keys, length buckets), replay parity with
+the offline score_prompts path (bit-identical rows, strict-mode clean),
+idempotent shutdown (PrefixCachePool / HostPrefetcher double-close), and
+the stdlib JSONL CLI driver."""
+
+import io
+import json
+import time
+
+import numpy as np
+import pytest
+
+from test_runtime import _tiny_engine
+from test_sweeps import FakeEngine
+
+from llm_interpretation_replication_tpu.runtime.batching import HostPrefetcher
+from llm_interpretation_replication_tpu.serve import (
+    DeadlineExceeded,
+    QueueFull,
+    Scheduler,
+    SchedulerClosed,
+    SchedulerConfig,
+    ScoreFuture,
+    ScoreRequest,
+)
+from llm_interpretation_replication_tpu.serve import coalescer
+from llm_interpretation_replication_tpu.serve import cli as serve_cli
+from llm_interpretation_replication_tpu.serve.replay import replay
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.serve
+
+FAST = dict(max_wait_s=0.01)
+
+
+class RecordingEngine(FakeEngine):
+    """FakeEngine that logs every micro-batch launch's composition."""
+
+    def __init__(self):
+        super().__init__("rec/model")
+        self.call_log = []
+
+    def score_prompts(self, prompts, targets=("Yes", "No"),
+                      with_confidence=False, max_new_tokens=None):
+        self.call_log.append({
+            "prompts": list(prompts),
+            "with_confidence": with_confidence,
+            "max_new_tokens": max_new_tokens,
+        })
+        return super().score_prompts(prompts, targets, with_confidence,
+                                     max_new_tokens)
+
+
+# ---------------------------------------------------------------------------
+# Request / future surface
+# ---------------------------------------------------------------------------
+
+class TestRequestSurface:
+    def test_request_validation(self):
+        with pytest.raises(ValueError, match="exactly one"):
+            ScoreRequest().validate()
+        with pytest.raises(ValueError, match="exactly one"):
+            ScoreRequest(prompt="p", prefix="a", suffix="b").validate()
+        with pytest.raises(ValueError, match="together"):
+            ScoreRequest(prefix="a").validate()
+        with pytest.raises(ValueError, match="pair"):
+            ScoreRequest(prompt="p", targets=("Yes",)).validate()
+        ScoreRequest(prompt="p").validate()
+        ScoreRequest(prefix="a", suffix="b").validate()
+
+    def test_future_timeout_and_exception(self):
+        f = ScoreFuture()
+        with pytest.raises(TimeoutError):
+            f.result(timeout=0.01)
+        f._set_exception(DeadlineExceeded("late"))
+        assert f.done()
+        with pytest.raises(DeadlineExceeded):
+            f.result()
+        assert isinstance(f.exception(), DeadlineExceeded)
+
+
+# ---------------------------------------------------------------------------
+# Admission policy edges
+# ---------------------------------------------------------------------------
+
+class TestAdmissionPolicy:
+    def test_full_queue_typed_backpressure(self):
+        sched = Scheduler(RecordingEngine(),
+                          SchedulerConfig(queue_capacity=3, **FAST))
+        futs = [sched.submit(ScoreRequest(prompt=f"q{i}")) for i in range(3)]
+        snap = telemetry.counters()
+        with pytest.raises(QueueFull):
+            sched.submit(ScoreRequest(prompt="overflow"))
+        assert telemetry.counters_since(snap)["serve_rejected_full"] == 1
+        # never started: close rejects the queued work with a TYPED error
+        sched.close()
+        for f in futs:
+            assert isinstance(f.exception(timeout=5), SchedulerClosed)
+
+    def test_priority_ordering_under_full_queue(self):
+        """Higher priority launches first; FIFO within a level — asserted
+        on the queue's own pop order with the queue at capacity."""
+        sched = Scheduler(RecordingEngine(),
+                          SchedulerConfig(queue_capacity=6, **FAST))
+        prios = [0, 5, 1, 5, 0, 3]
+        for i, p in enumerate(prios):
+            sched.submit(ScoreRequest(prompt=f"q{i}", priority=p))
+        group, expired = sched.queue.pop_group(max_batch=6, max_wait_s=0)
+        assert expired == []
+        assert [t.request.priority for t in group] == [5, 5, 3, 1, 0, 0]
+        # FIFO within a priority level: seq (admission order) ascending
+        assert [t.seq for t in group] == [2, 4, 6, 3, 1, 5]
+        sched.close()
+
+    def test_deadline_expired_rejected_typed_not_dropped(self):
+        eng = RecordingEngine()
+        snap = telemetry.counters()
+        with Scheduler(eng, SchedulerConfig(**FAST)) as sched:
+            late = sched.submit(ScoreRequest(prompt="too-late",
+                                             timeout_s=0.0))
+            ok = sched.submit(ScoreRequest(prompt="on-time"))
+            assert ok.result(timeout=30)["success"]
+            err = late.exception(timeout=30)
+        assert isinstance(err, DeadlineExceeded)   # typed, never silent
+        assert telemetry.counters_since(snap)["serve_rejected_deadline"] == 1
+        launched = [p for c in eng.call_log for p in c["prompts"]]
+        assert "too-late" not in launched
+
+    def test_submit_after_close_raises_and_close_is_idempotent(self):
+        sched = Scheduler(RecordingEngine(), SchedulerConfig(**FAST))
+        sched.start()
+        sched.close()
+        sched.close()   # safe double-close
+        with pytest.raises(SchedulerClosed):
+            sched.submit(ScoreRequest(prompt="late"))
+
+
+# ---------------------------------------------------------------------------
+# Coalescing compatibility
+# ---------------------------------------------------------------------------
+
+class TestCoalescing:
+    def test_never_mixes_incompatible_plan_or_leg_keys(self):
+        """One micro-batch = one (GenerationPlan key, with_confidence)
+        combination: requests differing in max_new_tokens or
+        with_confidence launch as separate engine calls."""
+        eng = RecordingEngine()
+        sched = Scheduler(eng, SchedulerConfig(max_batch=16, **FAST))
+        futs = []
+        for i in range(9):
+            futs.append(sched.submit(ScoreRequest(
+                prompt=f"q{i}",
+                with_confidence=(i % 3 == 2),
+                max_new_tokens=10 if i % 3 == 1 else None)))
+        with sched:
+            rows = [f.result(timeout=30) for f in futs]
+        assert all(r["success"] for r in rows)
+        assert len(eng.call_log) == 3
+        for call in eng.call_log:
+            assert len(call["prompts"]) == 3   # each group fully coalesced
+        combos = {(c["with_confidence"], c["max_new_tokens"])
+                  for c in eng.call_log}
+        assert combos == {(False, None), (False, 10), (True, None)}
+
+    def test_compat_key_tracks_engine_plan_cache_and_buckets(self):
+        """The key is the engine's own GenerationPlan cache key plus the
+        length bucket: distinct caps → distinct keys (the binary/
+        confidence legs never share a micro-batch), and prompts landing
+        in different length buckets never share a shape."""
+        eng, _, _ = _tiny_engine(batch_size=4)
+        short = ScoreRequest(prompt="short one")
+        capped = ScoreRequest(prompt="short one", max_new_tokens=10)
+        long = ScoreRequest(prompt="much longer prompt " * 12)
+        conf = ScoreRequest(prompt="short one", with_confidence=True)
+        enc = {id(r): coalescer.encode_request(eng, r)
+               for r in (short, capped, long, conf)}
+        key = {id(r): coalescer.compat_key(eng, r, enc[id(r)])
+               for r in (short, capped, long, conf)}
+        assert key[id(short)] != key[id(capped)]     # plan cache key differs
+        assert key[id(short)] != key[id(long)]       # bucket differs
+        assert key[id(short)] != key[id(conf)]       # leg differs
+        # identical knobs + same bucket coalesce
+        twin = ScoreRequest(prompt="short two")
+        assert coalescer.compat_key(
+            eng, twin, coalescer.encode_request(eng, twin)) == key[id(short)]
+
+    def test_prefixed_requests_ride_score_prefixed(self):
+        eng, _, _ = _tiny_engine(batch_size=4)
+        telemetry.clear_counters()
+        with Scheduler(eng, SchedulerConfig(max_batch=4, **FAST)) as sched:
+            futs = [sched.submit(ScoreRequest(
+                prefix=f"Is item {i} a thing?",
+                suffix=" Answer Yes or No.")) for i in range(5)]
+            rows = [f.result(timeout=300) for f in futs]
+        assert all(r["success"] for r in rows)
+        assert eng.last_prefix_pool is not None
+        assert eng.last_prefix_pool.consistent
+        assert telemetry.counter("prefix_miss") > 0
+
+
+# ---------------------------------------------------------------------------
+# Replay parity — the acceptance contract
+# ---------------------------------------------------------------------------
+
+class TestReplayParity:
+    def test_rows_bit_identical_to_offline_path(self):
+        """Routing a sweep workload through the scheduler yields
+        row-identical results to the offline score_prompts path, across
+        multiple coalesced micro-batches."""
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(10)]
+        report = replay(eng, prompts)     # require_parity raises on skew
+        assert report["rows"] == 10
+        assert report["mismatched_rows"] == 0
+        assert report["serve_batches"] >= 2   # really went through coalescing
+        assert report["serve_batch_rows"] == 10
+        offline = eng.score_prompts(prompts)
+        assert report["serve_rows"] == offline   # bit-identical, not approx
+
+    def test_per_row_targets_parity(self):
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is item {i} a thing?" for i in range(6)]
+        targets = [("Yes", "No") if i % 2 else ("No", "Yes")
+                   for i in range(6)]
+        report = replay(eng, prompts, targets=targets)
+        assert report["mismatched_rows"] == 0
+
+    def test_strict_mode_serve_launches_stay_clean(self):
+        """Acceptance: the transfer guard stays armed around
+        scheduler-driven launches — a replay under strict mode completes
+        with blocked_transfers == 0."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(6)]
+        eng.score_prompts(prompts)   # warm outside the strict window
+        strict.activate(sentry=False)
+        try:
+            report = replay(eng, prompts)
+        finally:
+            strict.deactivate()
+        assert report["mismatched_rows"] == 0
+        assert report["blocked_transfers"] == 0
+
+    def test_parity_failure_is_loud(self):
+        """A skewed row fails the replay with a named mismatch, never a
+        silent pass."""
+        from llm_interpretation_replication_tpu.serve import ServeError
+
+        eng = RecordingEngine()
+        prompts = [f"q{i}" for i in range(4)]
+        offline = eng.score_prompts(prompts)
+        offline[2] = dict(offline[2], yes_prob=0.123456)   # poison one row
+        with pytest.raises(ServeError, match="row 2"):
+            replay(eng, prompts, offline_rows=offline, offline_s=1.0)
+
+
+# ---------------------------------------------------------------------------
+# Shutdown path: idempotent closes (satellite)
+# ---------------------------------------------------------------------------
+
+class TestIdempotentCloses:
+    def test_prefix_cache_pool_double_close(self):
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            PrefixCachePool,
+        )
+
+        pool = PrefixCachePool()
+        pool.acquire(128, 4)
+        snap = telemetry.counters()
+        pool.close()
+        assert pool.leaked == 1 and pool.live_bytes == 0
+        first = telemetry.counters_since(snap).get("prefix_pool_leaked", 0)
+        assert first == 1
+        pool.close()   # double-close: no re-count, no state churn
+        pool.close()
+        assert pool.leaked == 1
+        assert telemetry.counters_since(snap).get(
+            "prefix_pool_leaked", 0) == 1
+
+    def test_host_prefetcher_double_close(self):
+        hp = HostPrefetcher(range(100), lambda i: i)
+        it = iter(hp)
+        assert next(it) == 0
+        hp.close()
+        assert hp.closed
+        hp.close()   # idempotent: drain loop + __exit__ both close
+        hp.close()
+        assert not hp._thread.is_alive()
+
+    def test_scheduler_close_sweeps_engine_pool(self):
+        """The scheduler's shutdown closes the engine's last prefix pool
+        AGAIN after the engine's own per-call close — the double-close
+        the idempotence contract exists for."""
+        eng, _, _ = _tiny_engine(batch_size=4)
+        sched = Scheduler(eng, SchedulerConfig(max_batch=4, **FAST))
+        with sched:
+            f = sched.submit(ScoreRequest(prefix="Is soup a thing?",
+                                          suffix=" Answer Yes or No."))
+            assert f.result(timeout=300)["success"]
+        assert eng.last_prefix_pool.closed   # swept twice, still consistent
+        assert eng.last_prefix_pool.consistent
+
+
+# ---------------------------------------------------------------------------
+# JSONL CLI driver (stdlib-only)
+# ---------------------------------------------------------------------------
+
+class TestJsonlDriver:
+    def test_roundtrip_order_and_typed_errors(self):
+        eng = RecordingEngine()
+        lines = "\n".join([
+            json.dumps({"prompt": "Is a tweet a publication?"}),
+            json.dumps({"prompt": "Is soup a beverage?",
+                        "targets": ["Yes", "No"], "priority": 3}),
+            json.dumps({"bogus_field": 1}),
+            json.dumps({"prompt": "third", "timeout_s": 0.0}),
+        ]) + "\n"
+        out = io.StringIO()
+        summary = serve_cli.run_jsonl_driver(
+            eng, io.StringIO(lines), out,
+            SchedulerConfig(**FAST))
+        assert summary == {"requests": 4, "errors": 2}
+        rows = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert [r["id"] for r in rows] == [0, 1, 2, 3]   # input order
+        assert rows[0]["success"] and rows[1]["success"]
+        assert rows[2]["error_type"] == "ValueError"
+        assert rows[3]["error_type"] == "DeadlineExceeded"
+
+    def test_replay_cli_builds_sweep_workload(self, tmp_path):
+        scenarios = [
+            {"original_main": f"Is thing {s} a stuff?",
+             "response_format": "Answer only 'Yes' or 'No'.",
+             "target_tokens": ["Yes", "No"] if s == 0 else ["No", "Yes"],
+             "rephrasings": [f"Is thing {s} variant {i} a stuff?"
+                             for i in range(3)]}
+            for s in range(2)
+        ]
+        path = tmp_path / "perturbations.json"
+        path.write_text(json.dumps(scenarios))
+        report = serve_cli.run_replay(FakeEngine("fake/model-7b"),
+                                      str(path), max_rephrasings=2,
+                                      config=SchedulerConfig(**FAST))
+        assert report["rows"] == 4
+        assert report["mismatched_rows"] == 0
+        assert "serve_rows" not in report   # CLI report stays JSON-light
+
+
+# ---------------------------------------------------------------------------
+# Telemetry distributions
+# ---------------------------------------------------------------------------
+
+class TestServeTelemetry:
+    def test_latency_and_depth_samples_recorded(self):
+        telemetry.clear_samples()
+        eng = RecordingEngine()
+        with Scheduler(eng, SchedulerConfig(**FAST)) as sched:
+            futs = [sched.submit(ScoreRequest(prompt=f"q{i}"))
+                    for i in range(5)]
+            [f.result(timeout=30) for f in futs]
+        assert telemetry.sample_count("serve_queue_depth") == 5
+        assert telemetry.sample_count("serve_latency_ms") == 5
+        pcts = telemetry.sample_percentiles("serve_latency_ms")
+        assert set(pcts) == {"p50", "p90", "p99"}
+        assert pcts["p50"] <= pcts["p99"]
+
+    def test_sample_ring_is_bounded(self):
+        telemetry.clear_samples()
+        for i in range(5000):
+            telemetry.record_sample("serve_test_ring", float(i))
+        assert telemetry.sample_count("serve_test_ring") == 4096
+        assert telemetry.sample_total("serve_test_ring") == 5000
+        # the window keeps the most recent observations
+        assert telemetry.sample_percentiles("serve_test_ring")["p99"] > 4900
+
+    def test_percentiles_scope_to_a_phase_via_last(self):
+        """Regression: a later phase's percentiles must not mix in an
+        earlier phase's samples — snapshot sample_total, diff, and pass
+        the delta as ``last``."""
+        telemetry.clear_samples()
+        for _ in range(10):
+            telemetry.record_sample("serve_phase_ring", 1.0)
+        before = telemetry.sample_total("serve_phase_ring")
+        for _ in range(5):
+            telemetry.record_sample("serve_phase_ring", 1000.0)
+        last = telemetry.sample_total("serve_phase_ring") - before
+        scoped = telemetry.sample_percentiles("serve_phase_ring", last=last)
+        assert scoped["p50"] == 1000.0       # only the new phase
+        mixed = telemetry.sample_percentiles("serve_phase_ring")
+        assert mixed["p50"] == 1.0           # whole window still available
+        assert telemetry.sample_percentiles("serve_phase_ring", last=0) == {}
+
+
+# ---------------------------------------------------------------------------
+# Failure-path regressions (review findings)
+# ---------------------------------------------------------------------------
+
+class TestFailurePaths:
+    def test_replay_closes_scheduler_when_a_future_fails(self):
+        """Regression: a failed micro-batch must not leak the scheduler
+        loop thread past replay() — close() runs in a finally."""
+        import threading
+
+        class BoomEngine:
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                raise ValueError("boom")
+
+        offline = [{"yes_prob": 1.0, "success": True}] * 3
+        with pytest.raises(ValueError, match="boom"):
+            replay(BoomEngine(), ["a", "b", "c"], offline_rows=offline,
+                   offline_s=1.0)
+        time.sleep(0.2)
+        assert not any(t.name == "serve-scheduler" and t.is_alive()
+                       for t in threading.enumerate())
+
+    def test_jsonl_driver_answers_backpressure_instead_of_crashing(self):
+        """Regression: QueueFull during the driver's submit loop becomes
+        that line's typed error answer; every other line is still served
+        and answered."""
+        import threading
+
+        gate = threading.Event()
+
+        class SlowEngine(RecordingEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                gate.wait(timeout=30)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        lines = "".join(json.dumps({"prompt": f"q{i}"}) + "\n"
+                        for i in range(6))
+        out = io.StringIO()
+        threading.Timer(0.5, gate.set).start()
+        summary = serve_cli.run_jsonl_driver(
+            SlowEngine(), io.StringIO(lines), out,
+            SchedulerConfig(queue_capacity=2, max_batch=1, **FAST))
+        rows = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert summary["requests"] == 6          # every line answered
+        assert [r["id"] for r in rows] == list(range(6))
+        rejected = [r for r in rows if r.get("error_type") == "QueueFull"]
+        served = [r for r in rows if r.get("success")]
+        assert rejected and served               # backpressure hit, no crash
+        assert len(rejected) + len(served) == 6
