@@ -355,6 +355,15 @@ def _sweep_oom_action(err, args, engine, rep, had_success, floor,
     action, new_batch = sweep_oom_action(err, args.sweep_batch, rep,
                                          had_success, floor, fallback, label)
     if action == "retry":
+        predicted = getattr(args, "predicted_batch", None)
+        if predicted is not None:
+            # the budget model (runtime/plan.py) predicted a fit the chip
+            # refused — make the prediction error auditable next to the
+            # ladder step so the anchors can be re-calibrated from logs
+            print(f"# {label}: planner predicted batch {predicted} fits "
+                  f"({getattr(args, 'fit_decision', '')}); hardware OOM'd "
+                  f"at {args.sweep_batch}, ladder steps to {new_batch}",
+                  file=sys.stderr)
         args.sweep_batch = new_batch
         engine.ecfg = dc.replace(engine.ecfg, batch_size=new_batch)
     return action
@@ -405,6 +414,8 @@ def run_sweep_mode(args, cfg, params):
             batch_size=args.sweep_batch, decode_completions=False,
             phase2_pool_target=args.pool_target,
             pipeline_depth=args.pipeline_depth,
+            kv_dtype=getattr(args, "kv_dtype", "bf16"),
+            prefill_chunk=getattr(args, "prefill_chunk", 0),
             # the bench MEASURES an operating point: a mid-repeat OOM must
             # step the whole repeat down the ladder visibly (below), never
             # degrade single batches silently inside the engine
@@ -458,6 +469,11 @@ def run_sweep_mode(args, cfg, params):
     items = [(s, r) for s in scenarios for r in s["rephrasings"]]
     all_prompts = [p for ps in prompts_by_scenario for p in ps]
     all_targets = [list(s["target_tokens"]) for s, _ in items]
+    from llm_interpretation_replication_tpu.utils.telemetry import counters
+
+    # scope the record's context-block counters to the measured repeats
+    # (calibration above must not inflate them) — _operating_context
+    args.counters_snap = counters()
     best_dt = float("inf")
     best_score_s = float("inf")
     last_ok_rows = 0
@@ -603,6 +619,8 @@ def run_sweep_full_mode(args, cfg, params):
             batch_size=args.sweep_batch, decode_completions=True,
             phase2_pool_target=args.pool_target,
             pipeline_depth=args.pipeline_depth,
+            kv_dtype=getattr(args, "kv_dtype", "bf16"),
+            prefill_chunk=getattr(args, "prefill_chunk", 0),
             # measured operating point: repeat-level step-down only (the
             # engine's silent per-batch degradation would skew the record)
             oom_backoff=False,
@@ -666,6 +684,11 @@ def run_sweep_full_mode(args, cfg, params):
             print(f"# warmup failed ({err}); repeat 0 compiles inline",
                   file=sys.stderr)
 
+    from llm_interpretation_replication_tpu.utils.telemetry import counters
+
+    # context-block counters scope to the measured repeats: the warmup
+    # pass above also runs _prefill and must not inflate the record
+    args.counters_snap = counters()
     best_dt = float("inf")
     last_ok_path = None
     repeat_times = []
@@ -715,7 +738,9 @@ def run_sweep_full_mode(args, cfg, params):
     c = counters()
     print(f"# sweep-full telemetry: prefix_hit={c.get('prefix_hit', 0):.0f} "
           f"prefix_miss={c.get('prefix_miss', 0):.0f} "
-          f"host_overlap_idle_ms={c.get('host_overlap_idle_ms', 0):.0f}",
+          f"host_overlap_idle_ms={c.get('host_overlap_idle_ms', 0):.0f} "
+          f"prefill_chunks={c.get('prefill_chunks', 0):.0f} "
+          f"kv_cache_bytes_saved={c.get('kv_cache_bytes_saved', 0):.0f}",
           file=sys.stderr)
     args.repeat_times = repeat_times
     if last_ok_path and not os.path.exists(last_ok_path):
@@ -745,6 +770,36 @@ def _repeat_report(args) -> dict:
     return {"repeats": report}
 
 
+def _operating_context(args) -> dict:
+    """Auditable operating-point context for the sweep JSON records: the
+    KV-cache dtype, the chunked-prefill setting, and the budget planner's
+    fit-decision string (runtime/plan.py reason) — so every recorded
+    number names the configuration AND the prediction that chose it.
+
+    Counters report the delta since the sweep's own measured repeats began
+    (``args.counters_snap``, set after calibration/warmup) — the counters
+    are process-global monotones and warmup's throwaway prefills must not
+    inflate the recorded operating point."""
+    from llm_interpretation_replication_tpu.utils.telemetry import (
+        counters,
+        counters_since,
+    )
+
+    snap = getattr(args, "counters_snap", None)
+    c = counters() if snap is None else counters_since(snap)
+    ctx = {
+        "kv_dtype": getattr(args, "kv_dtype", "bf16"),
+        "prefill_chunk": getattr(args, "prefill_chunk", 0),
+        "planner": getattr(args, "fit_decision", ""),
+    }
+    if c.get("prefill_chunks"):
+        ctx["prefill_chunks"] = int(c["prefill_chunks"])
+    if c.get("kv_cache_bytes_saved"):
+        ctx["kv_cache_gib_saved"] = round(
+            c["kv_cache_bytes_saved"] / 2**30, 2)
+    return {"context": ctx}
+
+
 def main():
     parser = argparse.ArgumentParser()
     parser.add_argument("--model", choices=["falcon-7b", "small-1b"], default="falcon-7b")
@@ -756,6 +811,22 @@ def main():
                         help="w8a8 int8 projections (the reference path is "
                              "bitsandbytes int8, so int8-vs-int8 is the fair "
                              "comparison; ~0.9997 logit correlation vs bf16)")
+    parser.add_argument("--kv-dtype", choices=["bf16", "int8"],
+                        default="bf16",
+                        help="decode-time KV cache storage dtype: bf16 "
+                             "keeps every bit-parity contract; int8 "
+                             "(per-head scales, quantize-on-append — "
+                             "ops/quant.quantize_kv) nearly halves the "
+                             "cache HBM the full-study contract pins, "
+                             "lifting the sweep batch off the 224 cliff "
+                             "(tolerance documented in PARITY.md)")
+    parser.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
+                        help="> 0: prompts above N tokens prefill in "
+                             "N-token chunks through the suffix-extension "
+                             "path (models/decoder.chunked_prefill), "
+                             "bounding the [B,S,T] attention transients "
+                             "the long buckets pay; the budget planner "
+                             "(runtime/plan.py) budgets the chunked bound")
     parser.add_argument("--attn", choices=["xla", "flash"], default="xla",
                         help="attention impl: XLA dense (the DecoderConfig "
                              "'xla' value) or the Pallas kernels "
@@ -1194,12 +1265,28 @@ def main():
                 # kept candidates makes the engine stack full fp32 score
                 # tensors, which the plan must budget (plan.py)
                 top_k=EngineConfig().top_k,
+                # kv-dtype-aware cache terms + the chunked-prefill
+                # activation bound — the planner PREDICTS the int8-KV
+                # operating point instead of discovering it by OOM
+                kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
             )
         else:
             sweep_plan = resolve_scoring_plan(
                 cfg, args.quant, args.sweep_batch, 256,
                 requested_impl="flash" if args.attn == "flash" else None,
+                # NO chunk discount here: the binary sweep runs the pooled
+                # phase-2 path, whose _prefill_select program keeps
+                # monolithic prefill by design (EngineConfig.prefill_chunk
+                # docstring) — budgeting the chunked bound would predict a
+                # fit the actual program cannot run
+                prefill_chunk=0,
             )
+        # auditable fit decision: the planner's reason string and predicted
+        # batch land in the JSON record's context block, and the OOM
+        # ladder prints predicted-vs-actual when the prediction was wrong
+        # on hardware (_sweep_oom_action)
+        args.fit_decision = sweep_plan.reason
+        args.predicted_batch = sweep_plan.batch
         if sweep_plan.batch != args.sweep_batch or (
                 sweep_plan.attention_impl != args.attn):
             print(f"# sweep plan: {sweep_plan.reason}; batch "
@@ -1236,6 +1323,7 @@ def main():
                 "vs_baseline": round(rps / (A100_BASELINE_PROMPTS_PER_SEC / 2), 2),
             }
             record.update(_repeat_report(args))
+            record.update(_operating_context(args))
             print(json.dumps(_attach_strict(record)))
             return
         pps, rate, out_path = run_sweep_mode(args, cfg, params)
@@ -1255,6 +1343,7 @@ def main():
             "vs_baseline": round(pps / A100_BASELINE_PROMPTS_PER_SEC, 2),
         }
         record.update(_repeat_report(args))
+        record.update(_operating_context(args))
         if getattr(args, "serve_report", None):
             record["serve"] = args.serve_report
         if not args.no_secondary:
@@ -1313,6 +1402,8 @@ def main():
                     "--decided-frac", str(args.decided_frac),
                     "--checkpoint-every", str(args.checkpoint_every),
                     "--model", args.model, "--quant", args.quant,
+                    "--kv-dtype", args.kv_dtype,
+                    "--prefill-chunk", str(args.prefill_chunk),
                     "--attn", args.attn,
                     "--perturbations", args.perturbations,
                     "--fuse-prefix" if args.fuse_prefix else "--no-fuse-prefix",

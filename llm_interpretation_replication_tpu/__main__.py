@@ -48,6 +48,16 @@ def _add_run_config_args(p: argparse.ArgumentParser):
                    help="'auto' keeps XLA dense at sweep lengths and "
                         "switches to the Pallas kernel past 1k tokens, "
                         "where dense's S^2 scores would exhaust HBM")
+    p.add_argument("--kv-dtype", choices=["bf16", "int8"], default="bf16",
+                   help="decode-time KV cache storage dtype: bf16 keeps "
+                        "the bit-parity contracts; int8 (per-head scales, "
+                        "quantize-on-append) nearly halves the cache HBM "
+                        "the full-study sweep pins — tolerance in "
+                        "PARITY.md")
+    p.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
+                   help="> 0: prompts above N tokens prefill in N-token "
+                        "chunks through the suffix-extension path, "
+                        "bounding the long buckets' attention transients")
     p.add_argument("--mesh-model", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=16)
@@ -60,6 +70,7 @@ def _run_config(args):
 
     return RunConfig(
         device=args.device, dtype=args.dtype, quant=args.quant,
+        kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
         attention_impl=args.attention_impl,
         mesh_model=args.mesh_model,
         mesh_seq=args.mesh_seq, batch_size=args.batch_size,
@@ -92,7 +103,11 @@ def _engine_factory(run_config):
         tokenizer = load_tokenizer(path)
         return ScoringEngine(
             family, cfg, params, tokenizer, mesh=mesh,
-            engine_config=EngineConfig(batch_size=run_config.batch_size),
+            engine_config=EngineConfig(
+                batch_size=run_config.batch_size,
+                kv_dtype=run_config.kv_dtype,
+                prefill_chunk=run_config.prefill_chunk,
+            ),
         )
 
     return factory

@@ -127,6 +127,11 @@ class RunConfig:
     quant: str = "none"                  # 'none' | 'int8' (w8a8, decoder-only;
                                          # the TPU answer to the reference's
                                          # bitsandbytes load_in_8bit)
+    kv_dtype: str = "bf16"               # 'bf16' | 'int8' decode-time KV cache
+                                         # storage (per-head scales, quantize-
+                                         # on-append — runtime/engine kv_dtype)
+    prefill_chunk: int = 0               # > 0: chunked prefill threshold/size
+                                         # (models/decoder.chunked_prefill)
     attention_impl: str = "xla"          # 'xla' | 'flash' | 'auto' (dense up
                                          # to 1k tokens, Pallas kernel beyond
                                          # — models/config.DecoderConfig)

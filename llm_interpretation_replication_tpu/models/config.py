@@ -78,6 +78,14 @@ class DecoderConfig:
     #           sliding-window configs always stay dense)
     attention_impl: str = "xla"
     auto_flash_seq: int = 1024
+    # Decode-time KV cache storage dtype: "bf16" (the compute dtype —
+    # bit-parity default) | "int8" (per-head symmetric scales, quantized on
+    # append — ops/quant.quantize_kv).  Halves the cache bytes the
+    # full-study row contract pins per in-flight batch (runtime/plan.py
+    # kv_cache_bytes); the prompt forward itself always runs on exact
+    # projections, so only decode / suffix-extension steps read
+    # dequantized values (tolerance documented in PARITY.md).
+    kv_cache_dtype: str = "bf16"
 
     def __post_init__(self):
         if self.num_kv_heads is None:
@@ -88,6 +96,8 @@ class DecoderConfig:
             object.__setattr__(self, "intermediate_size", 4 * self.hidden_size)
         if self.attention_impl not in ("xla", "flash", "auto"):
             raise ValueError(f"unknown attention_impl {self.attention_impl!r}")
+        if self.kv_cache_dtype not in ("bf16", "int8"):
+            raise ValueError(f"unknown kv_cache_dtype {self.kv_cache_dtype!r}")
         if self.attention_impl == "flash" and (
             self.position_embedding == "alibi" or self.sliding_window is not None
         ):

@@ -241,12 +241,57 @@ class KVCache(NamedTuple):
     accumulate in a small per-chunk tail and are concatenated once per chunk
     (decode_steps) — so XLA keeps one loop-invariant buffer instead of
     round-tripping a ~700 MB cache through every step (the scatter-based
-    cache cost a full-cache relayout loop, ~150-310 ms/batch, on v5e)."""
-    k: jnp.ndarray          # [L, B, T, Nkv, D]
+    cache cost a full-cache relayout loop, ~150-310 ms/batch, on v5e).
+
+    With ``DecoderConfig.kv_cache_dtype == "int8"`` the k/v blocks store
+    int8 codes and ``k_scale``/``v_scale`` carry the per-head symmetric
+    fp32 scales (ops/quant.quantize_kv: one scale per (layer, row, slot,
+    head) — absmax over head_dim).  Quantization happens ON APPEND — the
+    prefill scan body, extend_prefill's suffix block, and decode_steps'
+    end-of-chunk tail fold — so every slot is quantized exactly once and
+    the full-precision cache never materializes.  Readers dequantize at
+    the attention op (ops/attention.cache_extend_attention, the decode
+    two-block path).  ``None`` scales mean the bf16 bit-parity layout."""
+    k: jnp.ndarray          # [L, B, T, Nkv, D] (compute dtype, or int8)
     v: jnp.ndarray          # [L, B, T, Nkv, D]
     positions: jnp.ndarray  # [B, T] int32 absolute position of each slot
     valid: jnp.ndarray      # [B, T] bool: slot holds a real token
     length: jnp.ndarray     # [] int32 — slots filled so far
+    k_scale: Optional[jnp.ndarray] = None  # [L, B, T, Nkv] fp32 (int8 only)
+    v_scale: Optional[jnp.ndarray] = None  # [L, B, T, Nkv] fp32 (int8 only)
+
+
+def cache_kv_map(cache: KVCache, f, **replace) -> KVCache:
+    """Apply ``f`` to the cache's k/v blocks AND (when quantized) their
+    scale arrays, returning a cache with any extra ``replace`` fields set.
+
+    ``f`` must act only on the leading ``[L, B, T, ...]`` axes the two
+    layouts share (gather rows on axis 1, pad/concat slots on axis 2) —
+    the one spelling every cache-reshaping call site (engine row gather,
+    pool padding, slice selection) uses so none can forget the scales."""
+    return cache._replace(
+        k=f(cache.k), v=f(cache.v),
+        k_scale=None if cache.k_scale is None else f(cache.k_scale),
+        v_scale=None if cache.v_scale is None else f(cache.v_scale),
+        **replace)
+
+
+def _deq(x, scale, dtype):
+    """Cache block -> compute dtype: dequantize when per-head scales are
+    present (int8 cache), plain cast otherwise."""
+    if scale is None:
+        return x.astype(dtype)
+    return quant.dequantize_kv(x, scale, dtype)
+
+
+def _quantize_append(cfg: DecoderConfig, k, v):
+    """Quantize-on-append hook: (k, v, k_scale|None, v_scale|None) in the
+    cache's storage layout for a freshly-computed K/V block."""
+    if cfg.kv_cache_dtype != "int8":
+        return k, v, None, None
+    kq, ks = quant.quantize_kv(k)
+    vq, vs = quant.quantize_kv(v)
+    return kq, vq, ks, vs
 
 
 
@@ -433,13 +478,19 @@ def _trunk(params, cfg: DecoderConfig, token_ids, attention_mask,
 
     def body(h, lp):
         h, (ck, cv) = _block(cfg, lp, h, sin_cos, bias, t, flash_lengths)
-        return h, (ck, cv)
+        # quantize-on-append INSIDE the scan body: the stacked cache the
+        # scan emits is already int8 + scales, so the full-precision
+        # [L, B, T, G, D] block never materializes (the attention above
+        # still read this layer's exact bf16 K/V — quantization touches
+        # storage only, prompt logits stay bit-identical)
+        return h, _quantize_append(cfg, ck, cv)
 
-    x, (ks, vs) = lax.scan(body, x, params["layers"])
+    x, (ks, vs, kss, vss) = lax.scan(body, x, params["layers"])
     lengths = jnp.sum(attention_mask, axis=-1)  # [B] per-row prompt length
     cache = KVCache(
         k=ks, v=vs, positions=kv_positions, valid=kv_valid,
         length=jnp.max(lengths).astype(jnp.int32),
+        k_scale=kss, v_scale=vss,
     )
     return x, cache
 
@@ -482,12 +533,15 @@ def _prefill_impl(params, cfg: DecoderConfig, token_ids, attention_mask, cache_l
     return last, cache
 
 
-def _attn_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l):
+def _attn_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l,
+                 ks_l=None, vs_l=None):
     """Attention sub-block for a suffix-extension prefill: queries are the
     whole suffix (S > 1, known tokens — no sequential dependency), keys are
     the read-only prefix cache slice plus the suffix's own K/V, softmaxed
-    jointly (ops/attention.cache_extend_attention).  Returns the suffix's
-    K/V so the caller can concatenate them onto the cache for decode."""
+    jointly (ops/attention.cache_extend_attention — which also owns the
+    dequant when the prefix block is int8: ``ks_l``/``vs_l`` are this
+    layer's per-head scales).  Returns the suffix's K/V so the caller can
+    concatenate them onto the cache for decode."""
     from ..ops.attention import cache_extend_attention
 
     b, s, h = x.shape
@@ -506,18 +560,21 @@ def _attn_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l):
         rd = int(cfg.rotary_pct * d) // 2 * 2
         q = apply_rotary(q, sin, cos, rd, cfg.rotary_style)
         k = apply_rotary(k, sin, cos, rd, cfg.rotary_style)
-    out = cache_extend_attention(
-        q, kp_l.astype(x.dtype), vp_l.astype(x.dtype), k, v, bias)
+    # dequant-or-cast of the prefix block happens inside the attention op
+    # (ONE spelling of the rule, shared with every reader)
+    out = cache_extend_attention(q, kp_l, vp_l, k, v, bias,
+                                 kp_scale=ks_l, vp_scale=vs_l)
     out = quant.linear(ap, "wo", out.reshape(b, s, n * d))
     if "bo" in ap:
         out = out + ap["bo"]
     return out, (k, v)
 
 
-def _block_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l):
+def _block_extend(cfg: DecoderConfig, lp, x, sin_cos, bias, kp_l, vp_l,
+                  ks_l=None, vs_l=None):
     ln1_out = _norm(cfg, x, lp["ln1"])
     attn_out, new_kv = _attn_extend(cfg, lp, ln1_out, sin_cos, bias, kp_l,
-                                    vp_l)
+                                    vp_l, ks_l, vs_l)
     if cfg.parallel_residual:
         mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
         x = x + attn_out + _mlp(cfg, lp, mlp_in)
@@ -566,13 +623,39 @@ def extend_prefill(params, cfg: DecoderConfig, cache: KVCache, token_ids,
     kv_positions = jnp.concatenate([cache.positions, positions], axis=1)
     kv_valid = jnp.concatenate([cache.valid, mask], axis=1)
     bias = make_attention_bias(cfg, positions, kv_positions, kv_valid)
+    # structure checks only (trace-time Python on pytree layout, never on
+    # traced values): the scale fields are None or arrays, decided by how
+    # the cache was built
+    if (cache.k_scale is not None) != (cfg.kv_cache_dtype == "int8"):
+        # a mismatch would concat int8 codes into a bf16 block (or vice
+        # versa) and silently corrupt every later read — fail loudly
+        raise ValueError(
+            f"cache quantization "
+            f"({'int8' if cache.k_scale is not None else 'bf16'}) does "
+            f"not match cfg.kv_cache_dtype={cfg.kv_cache_dtype!r}")
 
-    def body(h, xs):
-        lp, kp_l, vp_l = xs
-        h, (k_s, v_s) = _block_extend(cfg, lp, h, sin_cos, bias, kp_l, vp_l)
-        return h, (k_s, v_s)
+    # quantize-on-append inside both bodies: the suffix block's K/V enter
+    # the cache in the cache's own storage layout (attention reads the
+    # exact values); the body variant is picked at trace time on the
+    # cache's pytree STRUCTURE, never on traced values
+    if cache.k_scale is None:
+        def body(h, xs):
+            lp, kp_l, vp_l = xs
+            h, (k_s, v_s) = _block_extend(cfg, lp, h, sin_cos, bias,
+                                          kp_l, vp_l)
+            return h, _quantize_append(cfg, k_s, v_s)
 
-    x, (ks, vs) = lax.scan(body, x, (params["layers"], cache.k, cache.v))
+        xs = (params["layers"], cache.k, cache.v)
+    else:
+        def body(h, xs):
+            lp, kp_l, vp_l, ks_l, vs_l = xs
+            h, (k_s, v_s) = _block_extend(cfg, lp, h, sin_cos, bias,
+                                          kp_l, vp_l, ks_l, vs_l)
+            return h, _quantize_append(cfg, k_s, v_s)
+
+        xs = (params["layers"], cache.k, cache.v, cache.k_scale,
+              cache.v_scale)
+    x, (ks, vs, kss, vss) = lax.scan(body, x, xs)
     suffix_lengths = jnp.sum(attention_mask, axis=-1)
     last_h = jnp.take_along_axis(x, (suffix_lengths - 1)[:, None, None], axis=1)
     last = _unembed(cfg, params, last_h)[:, 0, :]
@@ -581,6 +664,10 @@ def extend_prefill(params, cfg: DecoderConfig, cache: KVCache, token_ids,
         v=jnp.concatenate([cache.v, vs.astype(cache.v.dtype)], axis=2),
         positions=kv_positions, valid=kv_valid,
         length=cache.length + s,
+        k_scale=(None if kss is None
+                 else jnp.concatenate([cache.k_scale, kss], axis=2)),
+        v_scale=(None if vss is None
+                 else jnp.concatenate([cache.v_scale, vss], axis=2)),
     )
     return last, new_cache, prefix_lengths + suffix_lengths
 
@@ -597,6 +684,61 @@ def prefill(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len: in
     Returns (last_logits [B, V] fp32, KVCache padded to ``cache_len``).
     """
     return _prefill_impl(params, cfg, token_ids, attention_mask, cache_len)
+
+
+def chunked_prefill(params, cfg: DecoderConfig, token_ids, attention_mask,
+                    chunk: int):
+    """Prompt forward in fixed-size chunks: chunk 0 runs the ordinary
+    :func:`prefill`, every later chunk replays through the suffix-extension
+    prefill (:func:`extend_prefill`) against the cache built so far.
+
+    The monolithic prompt forward materializes ``[B, S, S]``-shaped
+    attention transients (fp32 bias + scores per layer step) — at the long
+    buckets that transient, not FLOPs, is what throttles the sweep (430-
+    token buckets measured 36.8 p/s vs 128.7 at 104 tokens).  Chunking
+    bounds the query axis at ``chunk``: the widest attention transient
+    becomes ``[B, chunk, S]`` and peak activations scale with ``chunk``
+    instead of the bucket length (runtime/plan.py budgets exactly this —
+    the ``prefill_chunk`` term).  Each chunk is its own device program; no
+    host fetch happens between chunks, so the launch loop stays legal
+    inside strict mode's transfer guard and the pipeline never drains.
+
+    Equivalence: a chunk's queries attend over the concatenated (prefix
+    cache + own K/V) key axis under ONE joint softmax with the same
+    position/validity mask the monolithic forward builds, and masked slots
+    contribute exact zeros — so at bf16 KV the chunked forward reproduces
+    the monolithic one to reduction-order noise (pinned by the tier-1
+    ``-m kvcache`` equivalence test).  With an int8 KV cache, later chunks
+    read DEQUANTIZED prefix K/V, so chunking composes with quantization
+    under the same documented tolerance (PARITY.md), which is why bf16
+    stays the bit-parity default.
+
+    Compile cost: one ``extend_prefill`` executable per (chunk index,
+    bucket) pair — the same fan-out discipline as decode_steps' per-chunk
+    cache growth, amortized by the persistent compilation cache.
+
+    Returns (last_logits [B, V] fp32 at each row's LAST real token,
+    KVCache over all ``S`` slots, n_chunks).
+    """
+    b, s = token_ids.shape
+    c0 = min(int(chunk), s)
+    last, cache = prefill(params, cfg, token_ids[:, :c0],
+                          attention_mask[:, :c0], cache_len=c0)
+    lengths = jnp.sum(attention_mask[:, :c0], axis=-1)
+    offset, n_chunks = c0, 1
+    while offset < s:
+        c = min(int(chunk), s - offset)
+        sub_mask = attention_mask[:, offset:offset + c]
+        nlast, cache, lengths = extend_prefill(
+            params, cfg, cache, token_ids[:, offset:offset + c], sub_mask,
+            lengths)
+        # rows right-padded out before this chunk have no real suffix token;
+        # their answer logits came from the chunk holding their last token
+        has = jnp.sum(sub_mask, axis=-1) > 0
+        last = jnp.where(has[:, None], nlast, last)
+        offset += c
+        n_chunks += 1
+    return last, cache, n_chunks
 
 
 #: Candidates kept per step by the REDUCED score mode — the confidence leg's
@@ -634,7 +776,11 @@ def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
                        target_ids=None):
     b = prev_logits.shape[0]
     n = num_steps
-    cdt = cache.k.dtype
+    quantized = cache.k_scale is not None
+    # the in-chunk tail always lives in the COMPUTE dtype (this chunk's
+    # attention reads it exactly); an int8 cache quantizes the tail once,
+    # at the end-of-chunk fold below
+    cdt = params["embed"]["tokens"].dtype if quantized else cache.k.dtype
     tail_shape = (cfg.num_layers, b, n, cfg.num_kv_heads, cfg.head_dim)
     tail_k0 = jnp.zeros(tail_shape, cdt)
     tail_v0 = jnp.zeros(tail_shape, cdt)
@@ -661,15 +807,22 @@ def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
 
         def body(carry_h, xs):
             h = carry_h
-            lp, kp_l, vp_l, tk_l, tv_l = xs
+            if quantized:
+                lp, kp_l, vp_l, ks_l, vs_l, tk_l, tv_l = xs
+            else:
+                (lp, kp_l, vp_l, tk_l, tv_l), ks_l, vs_l = xs, None, None
             h, (tk_l, tv_l) = _block_decode(
-                cfg, lp, h, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i
+                cfg, lp, h, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l,
+                i, ks_l, vs_l
             )
             return h, (tk_l, tv_l)
 
-        x, (tail_k, tail_v) = lax.scan(
-            body, x, (params["layers"], cache.k, cache.v, tail_k, tail_v)
-        )
+        layer_xs = (
+            (params["layers"], cache.k, cache.v, cache.k_scale,
+             cache.v_scale, tail_k, tail_v)
+            if quantized
+            else (params["layers"], cache.k, cache.v, tail_k, tail_v))
+        x, (tail_k, tail_v) = lax.scan(body, x, layer_xs)
         step_logits = _unembed(cfg, params, x)[:, 0, :]                 # [B,V]
         if eos_token_id is not None:
             done = done | (next_tok == eos_token_id)
@@ -686,13 +839,21 @@ def _decode_steps_impl(params, cfg: DecoderConfig, cache, prev_logits, lengths,
     )
     # One concat per CHUNK (not per step) folds the tail into the read-only
     # block for the next chunk; callers that ignore the returned cache (the
-    # scored look-ahead subset) get it DCE'd by XLA.
+    # scored look-ahead subset) get it DCE'd by XLA.  An int8 cache
+    # quantizes the tail here — once per generated token, on append.
+    if quantized:
+        tail_k, tk_s = quant.quantize_kv(tail_k)
+        tail_v, tv_s = quant.quantize_kv(tail_v)
     cache = KVCache(
         k=jnp.concatenate([cache.k, tail_k], axis=2),
         v=jnp.concatenate([cache.v, tail_v], axis=2),
         positions=jnp.concatenate([cache.positions, tail_positions], axis=1),
         valid=jnp.concatenate([cache.valid, jnp.ones((b, n), bool)], axis=1),
         length=cache.length + n,
+        k_scale=(jnp.concatenate([cache.k_scale, tk_s], axis=2)
+                 if quantized else None),
+        v_scale=(jnp.concatenate([cache.v_scale, tv_s], axis=2)
+                 if quantized else None),
     )
     if with_scores == "reduced":
         tokens, (s_vals, s_ids, s_logz, s_tgt) = out
@@ -778,12 +939,15 @@ def greedy_decode(
     return tokens, scores
 
 
-def _block_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i):
+def _block_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l,
+                  tv_l, i, ks_l=None, vs_l=None):
     """_block variant for decode: the layer's new K/V land in the small tail
-    buffer; the prompt cache slice (kp_l/vp_l) is read-only."""
+    buffer; the prompt cache slice (kp_l/vp_l, with per-head scales
+    ks_l/vs_l when int8) is read-only."""
     ln1_out = _norm(cfg, x, lp["ln1"])
     attn_out, new_tail = _attn_decode(
-        cfg, lp, ln1_out, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i
+        cfg, lp, ln1_out, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l,
+        i, ks_l, vs_l
     )
     if cfg.parallel_residual:
         mlp_in = ln1_out if cfg.shared_layernorm else _norm(cfg, x, lp["ln2"])
@@ -794,7 +958,8 @@ def _block_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i
     return x, new_tail
 
 
-def _attn_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i):
+def _attn_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l,
+                 tv_l, i, ks_l=None, vs_l=None):
     b, s, h = x.shape  # s == 1 during decode
     n, nkv, d = cfg.num_heads, cfg.num_kv_heads, cfg.head_dim
     ap = lp["attn"]
@@ -816,7 +981,7 @@ def _attn_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l, i)
     tk_l = lax.dynamic_update_slice(tk_l, k.astype(tk_l.dtype), (0, i, 0, 0))
     tv_l = lax.dynamic_update_slice(tv_l, v.astype(tv_l.dtype), (0, i, 0, 0))
     out = grouped_attention_two_block(
-        q, kp_l.astype(x.dtype), vp_l.astype(x.dtype), bias_p,
+        q, _deq(kp_l, ks_l, x.dtype), _deq(vp_l, vs_l, x.dtype), bias_p,
         tk_l.astype(x.dtype), tv_l.astype(x.dtype), bias_t,
     )
     out = quant.linear(ap, "wo", out.reshape(b, s, n * d))

@@ -539,7 +539,8 @@ def attention_bsnd(q, k, v, lengths, causal: bool = True,
     return jnp.swapaxes(out, 1, 2)
 
 
-def cache_extend_attention(q, kp, vp, kt, vt, bias):
+def cache_extend_attention(q, kp, vp, kt, vt, bias,
+                           kp_scale=None, vp_scale=None):
     """Attention for a SUFFIX-EXTENSION prefill over a prefilled prefix KV
     cache (the engine's prefix-reuse path, runtime/engine.score_prefixed):
     the suffix's queries attend jointly over the big read-only prefix block
@@ -550,6 +551,14 @@ def cache_extend_attention(q, kp, vp, kt, vt, bias):
     suffix's own K/V; bias: fp32 additive [B, N_or_1, S, T+S] built from the
     cache's slot->position mapping (causal + padding + ALiBi — the caller
     owns position semantics, exactly like the dense trunk path).
+
+    ``kp_scale``/``vp_scale`` ([B, T, G] fp32, or None): when the prefix
+    cache is int8-quantized (models/decoder.KVCache with per-head scales,
+    ops/quant.quantize_kv) the dequant happens HERE, right before the
+    joint softmax, so the int8 block streams from HBM at half the bf16
+    bandwidth and only the current extension's working set ever exists in
+    the compute dtype.  The suffix's own kt/vt are always exact (they were
+    just projected); quantization applies only to the stored prefix.
 
     ONE joint softmax over the concatenated key axis, NOT the two-block
     split-softmax decode trick (models/decoder.grouped_attention_two_block):
@@ -563,6 +572,16 @@ def cache_extend_attention(q, kp, vp, kt, vt, bias):
     — tiny next to the prompt forward's S×S — and the r2 outcome table
     (this module's flash kernel losing ~12% in situ as an opaque fusion
     boundary) says XLA dense wins at these shapes anyway."""
+    from . import quant
+
+    if kp_scale is not None:
+        kp = quant.dequantize_kv(kp, kp_scale, kt.dtype)
+    elif kp.dtype != kt.dtype:
+        kp = kp.astype(kt.dtype)
+    if vp_scale is not None:
+        vp = quant.dequantize_kv(vp, vp_scale, vt.dtype)
+    elif vp.dtype != vt.dtype:
+        vp = vp.astype(vt.dtype)
     k = jnp.concatenate([kp, kt], axis=1)
     v = jnp.concatenate([vp, vt], axis=1)
     b, t, g, d = k.shape

@@ -53,6 +53,34 @@ def quantize_activations(x: jnp.ndarray):
     return q, scale
 
 
+def quantize_kv(x: jnp.ndarray):
+    """Per-head symmetric int8 for decode-time K/V cache blocks.
+
+    ``x`` is a K or V block whose LAST axis is head_dim (``[..., G, D]`` —
+    the ``[L, B, T, G, D]`` stacked cache layout or any per-layer slice of
+    it); the scale is the absmax over that head_dim axis, one fp32 value
+    per (…, slot, head).  Per-head (not per-tensor) scales matter because
+    attention K/V magnitudes vary strongly across slots and heads: a
+    shared scale would crush early-token K vectors to a few codes.
+
+    Returns ``(q_int8, scale_f32)`` with ``scale`` shaped like ``x`` minus
+    the head_dim axis, such that ``x ≈ q * scale[..., None]``.  Pairs with
+    :func:`dequantize_kv`; the cache stores both
+    (models/decoder.KVCache.k_scale / v_scale)."""
+    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
+    scale = jnp.maximum(absmax, 1e-8) / 127.0
+    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
+    return q, jnp.squeeze(scale, axis=-1)
+
+
+def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
+    """Inverse of :func:`quantize_kv`: ``q * scale[..., None]`` in ``dtype``.
+
+    The multiply runs in fp32 (scales are fp32) before the final cast so a
+    bf16 target dtype rounds the PRODUCT, not the scale."""
+    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+
+
 def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray):
     """``x @ dequant(w_q)`` computed on the int8 MXU path.
 

@@ -118,6 +118,28 @@ class EngineConfig:
                                     # exceed it, so pooling can never push a
                                     # budget-fitting sweep into OOM (long
                                     # buckets hold ~3.5 MB/row at 7B)
+    kv_dtype: str = "bf16"          # decode-time KV cache storage dtype:
+                                    # "bf16" keeps every bit-parity contract
+                                    # (fused-vs-unfused, serve --replay);
+                                    # "int8" quantizes on append (per-head
+                                    # symmetric scales, ops/quant.quantize_kv)
+                                    # — ~1.88x less cache HBM, the documented
+                                    # sweep operating point (PARITY.md
+                                    # tolerance).  Resolved into the decoder
+                                    # config at engine construction; not a
+                                    # config_overrides-able knob (compiled
+                                    # program families key on it).
+    prefill_chunk: int = 0          # > 0: prompts whose bucket exceeds this
+                                    # prefill in fixed-size chunks through
+                                    # the suffix-extension path
+                                    # (models/decoder.chunked_prefill),
+                                    # bounding the [B, S, T] attention
+                                    # transients of the long buckets.  0 =
+                                    # monolithic prefill (default).  The
+                                    # pooled phase-2 path keeps monolithic
+                                    # prefills either way: its in-program
+                                    # row selection (_prefill_select) is one
+                                    # fused device program by design.
     # -- adaptive OOM back-off (runtime/faults.py) ----------------------
     # The chip is shared: a co-tenant allocation can RESOURCE_EXHAUST one
     # batch of a sweep that ran clean for hours.  With oom_backoff on, a
@@ -251,6 +273,18 @@ class ScoringEngine:
     def __init__(self, family, cfg, params, tokenizer, mesh=None,
                  engine_config: Optional[EngineConfig] = None):
         self.family = family
+        ecfg = engine_config or EngineConfig()
+        if ecfg.kv_dtype not in ("bf16", "int8"):
+            raise ValueError(f"unknown kv_dtype {ecfg.kv_dtype!r}")
+        # the KV storage dtype is a property of the compiled decoder
+        # programs, so it lives on the (static, hashable) decoder config:
+        # resolve the engine knob into cfg ONCE, at construction.  T5 (and
+        # test fakes without the field) have no decoder-side prompt cache
+        # to quantize; the knob is a no-op there.
+        if (ecfg.kv_dtype != "bf16" and dataclasses.is_dataclass(cfg)
+                and hasattr(cfg, "kv_cache_dtype")
+                and cfg.kv_cache_dtype != ecfg.kv_dtype):
+            cfg = dataclasses.replace(cfg, kv_cache_dtype=ecfg.kv_dtype)
         self.cfg = cfg
         self.params = params
         self.tokenizer = tokenizer
@@ -445,6 +479,33 @@ class ScoringEngine:
 
         return rebatch
 
+    def _prefill(self, ids, mask, cache_len: int):
+        """One prompt forward -> (last_logits, KVCache): monolithic
+        :func:`models.decoder.prefill`, or — when ``prefill_chunk`` is set
+        and the bucket exceeds it — the chunked replay through the
+        suffix-extension path (:func:`models.decoder.chunked_prefill`),
+        bounding the long buckets' [B, S, T] attention transients.
+
+        Telemetry: ``prefill_chunks`` counts extension programs launched
+        (auditable proof the chunked path engaged) and, when the engine
+        runs an int8 KV cache, ``kv_cache_bytes_saved`` accumulates the
+        HBM the quantized cache does NOT pin vs its bf16 layout — both
+        computed from static shapes, so no host sync happens inside the
+        strict-mode transfer guard."""
+        chunk = int(self.ecfg.prefill_chunk or 0)
+        if chunk > 0 and cache_len > chunk:
+            last, cache, n_chunks = dmod.chunked_prefill(
+                self.params, self.cfg, ids, mask, chunk)
+            record_counter("prefill_chunks", n_chunks)
+        else:
+            last, cache = dmod.prefill(self.params, self.cfg, ids, mask,
+                                       cache_len=cache_len)
+        if cache.k_scale is not None:
+            bf16_bytes = 2 * int(cache.k.size + cache.v.size)
+            record_counter("kv_cache_bytes_saved",
+                           bf16_bytes - _cache_nbytes(cache))
+        return last, cache
+
     # -- core ------------------------------------------------------------
 
     def score_prompts(
@@ -629,9 +690,7 @@ class ScoringEngine:
             # cache_len == prompt length: generated K/V are concatenated as
             # per-chunk tails by decode_steps, so pre-padding slots for them
             # would only add permanently-invalid slots to every attention
-            last, cache = dmod.prefill(
-                self.params, self.cfg, ids, mask, cache_len=batch.bucket_len,
-            )
+            last, cache = self._prefill(ids, mask, batch.bucket_len)
             lengths = jnp.sum(mask, axis=-1)
             row_ids = self._batch_target_rows(ids_all, batch)
             scan0 = yn.first_token_scan(
@@ -866,9 +925,7 @@ class ScoringEngine:
             try:
                 ids = self._put(batch.token_ids)
                 mask = self._put(batch.attention_mask)
-                last_p, pcache = dmod.prefill(
-                    self.params, self.cfg, ids, mask,
-                    cache_len=batch.bucket_len)
+                last_p, pcache = self._prefill(ids, mask, batch.bucket_len)
                 plen = jnp.sum(mask, axis=-1)
                 n_real = int((batch.indices >= 0).sum())
                 entry = pool.acquire(_cache_nbytes(pcache), n_real)
@@ -1050,9 +1107,7 @@ class ScoringEngine:
                 # cache and decode in place.
                 ids = self._put(batch.token_ids)
                 mask = self._put(batch.attention_mask)
-                last_f, cache = dmod.prefill(
-                    self.params, self.cfg, ids, mask,
-                    cache_len=batch.bucket_len)
+                last_f, cache = self._prefill(ids, mask, batch.bucket_len)
                 sc, toks_s = self._scan_decode_chunked(
                     cache, last_f, jnp.sum(mask, axis=-1), steps, eos_id,
                     row_ids[:, 0], row_ids[:, 1], real_mask=valid,
@@ -1336,8 +1391,12 @@ def _is_prefix_pair(prompt) -> bool:
 
 
 def _cache_nbytes(cache) -> int:
-    """Device bytes of one KVCache's K/V blocks (the prefix-pool unit)."""
-    return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
+    """Device bytes of one KVCache's K/V blocks (the prefix-pool unit) —
+    including the per-head fp32 scales of an int8-quantized cache."""
+    n = int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
+    if cache.k_scale is not None:
+        n += 4 * int(cache.k_scale.size + cache.v_scale.size)
+    return n
 
 
 #: Fixed menu of phase-2 decode slice sizes.  Finer than powers of two
@@ -1413,7 +1472,7 @@ class _Phase2Pool:
 
     @staticmethod
     def _entry_bytes(cache) -> int:
-        return int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
+        return _cache_nbytes(cache)
 
     def add(self, pool_len, sub_cache, last_s, len_s, n_real, orig_idx,
             row_ids, first3):
@@ -1468,10 +1527,15 @@ class _Phase2Pool:
         L, _, T, G, D = cache_t.k.shape
         kv = jnp.zeros((L, rows, T, G, D), cache_t.k.dtype)
         valid = jnp.zeros((rows, T), bool).at[:, 0].set(True)
+        # unit scales keep a quantized blank inert: zero codes decode to
+        # exact zeros, matching the bf16 blank's zero-K slots
+        scale = (jnp.ones((L, rows, T, G), jnp.float32)
+                 if cache_t.k_scale is not None else None)
         cache = dmod.KVCache(
             k=kv, v=kv,
             positions=jnp.zeros((rows, T), cache_t.positions.dtype),
             valid=valid, length=cache_t.length,
+            k_scale=scale, v_scale=scale,
         )
         last = jnp.zeros((rows, last_t.shape[1]), last_t.dtype)
         lens = jnp.ones((rows,), len_t.dtype)
@@ -1491,12 +1555,19 @@ class _Phase2Pool:
         if len(entries) == 1:
             cache, last, lens = entries[0][:3]
         else:
+            first = entries[0][0]
             cache = dmod.KVCache(
                 k=jnp.concatenate([e[0].k for e in entries], axis=1),
                 v=jnp.concatenate([e[0].v for e in entries], axis=1),
                 positions=jnp.concatenate([e[0].positions for e in entries], axis=0),
                 valid=jnp.concatenate([e[0].valid for e in entries], axis=0),
-                length=entries[0][0].length,
+                length=first.length,
+                k_scale=(jnp.concatenate([e[0].k_scale for e in entries],
+                                         axis=1)
+                         if first.k_scale is not None else None),
+                v_scale=(jnp.concatenate([e[0].v_scale for e in entries],
+                                         axis=1)
+                         if first.v_scale is not None else None),
             )
             last = jnp.concatenate([e[1] for e in entries], axis=0)
             lens = jnp.concatenate([e[2] for e in entries], axis=0)
@@ -1614,22 +1685,26 @@ def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
     scan0 = yn.first_token_scan(last, yes_ids, no_ids, top_k=top_k)
     decided = scan0[4] | ~valid_rows
     sel = jnp.argsort(decided, stable=True)[:slice_m]   # undecided first
-    sub = dmod.KVCache(
-        k=cache.k[:, sel], v=cache.v[:, sel],
+    sub = dmod.cache_kv_map(
+        cache, lambda a: a[:, sel],
         positions=cache.positions[sel], valid=cache.valid[sel],
-        length=cache.length,
     )
     if out_len and out_len > cache_len:
         # Pad the slice to the pool's quantized cache length (_POOL_LEN_MENU)
         # INSIDE the prefill program — invalid zero slots the attention bias
         # masks out — so cross-bucket pooling costs zero extra programs.
+        # (Zero int8 codes decode to zero under any scale, so the padded
+        # slots stay inert in the quantized layout too.)
         pad_t = out_len - cache_len
-        sub = dmod.KVCache(
-            k=jnp.pad(sub.k, ((0, 0), (0, 0), (0, pad_t), (0, 0), (0, 0))),
-            v=jnp.pad(sub.v, ((0, 0), (0, 0), (0, pad_t), (0, 0), (0, 0))),
+
+        def pad_slots(a):  # k/v are [L, m, T, G, D]; scales [L, m, T, G]
+            widths = ((0, 0), (0, 0), (0, pad_t)) + ((0, 0),) * (a.ndim - 3)
+            return jnp.pad(a, widths)
+
+        sub = dmod.cache_kv_map(
+            sub, pad_slots,
             positions=jnp.pad(sub.positions, ((0, 0), (0, pad_t))),
             valid=jnp.pad(sub.valid, ((0, 0), (0, pad_t))),
-            length=sub.length,
         )
     first3 = yn.relative_prob_first_token(last, yes_ids, no_ids, top_filter)
     # Deliberately NOT returning the full-batch `last`/`lengths`: the
@@ -1641,13 +1716,11 @@ def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
 @jax.jit
 def _gather_rows(cache, last, lengths, idx):
     """Gather the phase-2 subset's rows out of the prefill outputs: cache
-    k/v are [L, B, T, G, D] (batch axis 1); everything else batch-leading."""
-    from ..models.decoder import KVCache
-
-    sub = KVCache(
-        k=cache.k[:, idx], v=cache.v[:, idx],
+    k/v (and their int8 per-head scales, when present) are [L, B, T, ...]
+    (batch axis 1); everything else batch-leading."""
+    sub = dmod.cache_kv_map(
+        cache, lambda a: a[:, idx],
         positions=cache.positions[idx], valid=cache.valid[idx],
-        length=cache.length,
     )
     return sub, last[idx], lengths[idx]
 

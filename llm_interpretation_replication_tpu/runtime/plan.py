@@ -59,16 +59,46 @@ def weight_bytes(cfg, quant: str) -> int:
     return int(n * 1.01) if quant == "int8" else 2 * n
 
 
-def dense_attention_bytes(cfg, batch: int, seq: int) -> int:
-    """The bf16 [B, H, S, S] score tensor of one dense-attention layer."""
-    return batch * cfg.num_heads * seq * seq * 2
+def dense_attention_bytes(cfg, batch: int, seq: int,
+                          prefill_chunk: int = 0) -> int:
+    """The bf16 [B, H, Sq, S] score tensor of one dense-attention layer.
+
+    ``prefill_chunk`` > 0 is the chunked-prefill activation bound
+    (models/decoder.chunked_prefill): the query axis of the widest
+    transient is the chunk, not the bucket — the [B, S, T] blowup the long
+    buckets pay under monolithic prefill shrinks to [B, chunk, T]."""
+    q = min(prefill_chunk, seq) if prefill_chunk else seq
+    return batch * cfg.num_heads * q * seq * 2
 
 
-def activation_bytes(cfg, batch: int, seq: int) -> int:
+def activation_bytes(cfg, batch: int, seq: int,
+                     prefill_chunk: int = 0) -> int:
     """Live activation set per layer step: residual stream + the widest
-    transient (MLP intermediate), at half weight for fusion overlap."""
+    transient (MLP intermediate), at half weight for fusion overlap.
+    Under chunked prefill only one chunk's activations are live at a
+    time, so the token axis is bounded by the chunk."""
     h, f = cfg.hidden_size, cfg.intermediate_size
-    return batch * seq * (h + 2 * f)
+    q = min(prefill_chunk, seq) if prefill_chunk else seq
+    return batch * q * (h + 2 * f)
+
+
+def kv_cache_bytes(cfg, batch: int, tokens: int,
+                   kv_dtype: str = "bf16") -> int:
+    """K+V cache bytes for ``tokens`` slots per row, dtype-aware.
+
+    bf16 stores 2 B/element; int8 stores 1 B/element plus one fp32
+    per-head scale per slot (ops/quant.quantize_kv — [L, B, T, G] scales
+    beside [L, B, T, G, D] codes), i.e. ``1 + 4/head_dim`` bytes per
+    element — a 1.88x cut at head_dim 64.  This is the term that makes the
+    planner dtype-aware instead of discovering the int8 operating point by
+    OOM (ISSUE 5 / arxiv 2204.06514's memory-planner lesson)."""
+    if kv_dtype not in ("bf16", "int8"):
+        raise ValueError(f"unknown kv_dtype {kv_dtype!r}")
+    elems = cfg.num_layers * batch * tokens * cfg.num_kv_heads * cfg.head_dim
+    if kv_dtype == "int8":
+        scales = cfg.num_layers * batch * tokens * cfg.num_kv_heads
+        return 2 * (elems + 4 * scales)          # k+v: codes + fp32 scales
+    return 2 * elems * 2                         # k+v: bf16
 
 
 def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
@@ -79,7 +109,8 @@ def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
 def completions_extra_bytes(cfg, batch: int, seq: int,
                             gen_tokens: int = 50, score_steps: int = 10,
                             pipeline_depth: int = 2,
-                            reduced_scores: bool = True) -> int:
+                            reduced_scores: bool = True,
+                            kv_dtype: str = "bf16") -> int:
     """Extra live set of the FULL-STUDY row contract (decode_completions +
     confidence), per in-flight pipelined batch: the prefill-output bf16 KV
     cache at the bucket length, the cache grown to seq+gen_tokens by the
@@ -94,11 +125,13 @@ def completions_extra_bytes(cfg, batch: int, seq: int,
     fits and is the measured optimum (31.4 rows/s warm); 240 still runs
     but thrashes near the HBM edge (14.1 rows/s warm — allocator
     pressure); 256 OOMs mid-sweep.  The terms put 240 just past the
-    budget, so requests above the boundary clamp to 224."""
-    cache_b = (cfg.num_layers * batch * seq
-               * cfg.num_kv_heads * cfg.head_dim * 2 * 2)    # bf16, k+v
-    cache_g = (cfg.num_layers * batch * (seq + gen_tokens)
-               * cfg.num_kv_heads * cfg.head_dim * 2 * 2)
+    budget, so requests above the boundary clamp to 224.
+
+    ``kv_dtype`` makes the pinned-cache terms dtype-aware
+    (:func:`kv_cache_bytes`): int8 KV nearly halves them, which is what
+    lifts the full-study batch off the 224 cliff."""
+    cache_b = kv_cache_bytes(cfg, batch, seq, kv_dtype)
+    cache_g = kv_cache_bytes(cfg, batch, seq + gen_tokens, kv_dtype)
     logits = batch * cfg.vocab_size * 4                      # fp32 [B, V]
     if reduced_scores:
         scores = batch * score_steps * 41 * 4                # ReducedScores
@@ -208,7 +241,8 @@ class ScoringPlan:
 
 def resolve_scoring_plan(cfg, quant: str, batch: int, seq: int,
                          hbm_bytes: int = HBM_BYTES_V5E,
-                         requested_impl: Optional[str] = None) -> ScoringPlan:
+                         requested_impl: Optional[str] = None,
+                         prefill_chunk: int = 0) -> ScoringPlan:
     """Route a scoring sweep onto the chip.
 
     - dense (XLA) attention is the throughput default (bench.py's outcome
@@ -220,20 +254,29 @@ def resolve_scoring_plan(cfg, quant: str, batch: int, seq: int,
       (PARITY.md, measured: flash batch 64 = 21.2 p/s, dense OOM).
 
     ``requested_impl='flash'`` skips the dense feasibility check but still
-    clamps the batch.
+    clamps the batch.  ``prefill_chunk`` > 0 budgets the chunked-prefill
+    transient bound (the widest score/activation tensors carry a
+    chunk-sized query axis — see dense_attention_bytes).  Callers must
+    pass it ONLY for paths that actually prefill through
+    ``engine._prefill`` (the completions / fused-leg paths): the pooled
+    phase-2 path's ``_prefill_select`` keeps monolithic prefill by
+    design, and claiming the discount for it would predict a fit the
+    real program cannot run.
     """
     wb = weight_bytes(cfg, quant)
     budget = hbm_bytes - RESERVE_BYTES
-    dense_need = wb + dense_attention_bytes(cfg, batch, seq) \
-        + activation_bytes(cfg, batch, seq)
+    dense_need = wb + dense_attention_bytes(cfg, batch, seq, prefill_chunk) \
+        + activation_bytes(cfg, batch, seq, prefill_chunk)
     fits_dense = dense_need <= budget
     if fits_dense and requested_impl != "flash":
         return ScoringPlan("xla", batch, True, wb,
                            f"dense fits: {dense_need / 2**30:.1f} GiB of "
-                           f"{budget / 2**30:.1f}")
+                           f"{budget / 2**30:.1f}"
+                           + (f" (prefill chunk {prefill_chunk})"
+                              if prefill_chunk else ""))
 
     def flash_need(b):
-        return wb + activation_bytes(cfg, b, seq) \
+        return wb + activation_bytes(cfg, b, seq, prefill_chunk) \
             + flash_workspace_bytes(cfg, b, seq)
 
     if flash_need(batch) <= budget:
@@ -258,7 +301,9 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
                             pipeline_depth: int = 2,
                             hbm_bytes: int = HBM_BYTES_V5E,
                             requested_impl: Optional[str] = None,
-                            top_k: Optional[int] = None) -> ScoringPlan:
+                            top_k: Optional[int] = None,
+                            kv_dtype: str = "bf16",
+                            prefill_chunk: int = 0) -> ScoringPlan:
     """Route the FULL-STUDY sweep (binary leg with completions + confidence
     leg): resolve the attention impl like a binary sweep, then shrink the
     batch (steps of 32) until the live set INCLUDING the completion path's
@@ -267,12 +312,19 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
     ``top_k``: the engine's scan top-k, when known — a value beyond
     ReducedScores' kept candidates makes the engine stack full fp32
     score tensors, which this plan must budget for (None assumes the
-    default reduced path)."""
+    default reduced path).
+
+    ``kv_dtype``/``prefill_chunk`` are the ISSUE-5 levers: int8 KV halves
+    the pinned cache terms and chunked prefill bounds the attention
+    transients, so the planner PREDICTS the full-study fit back at batch
+    >= 320 (int8 KV + 128-token chunks) instead of clamping to the
+    measured bf16 224 cliff — with the PR-1 OOM ladder as the safety net
+    if the prediction is wrong on hardware."""
     from ..models.decoder import REDUCED_TOPK
 
     reduced_scores = top_k is None or top_k <= REDUCED_TOPK
     base = resolve_scoring_plan(cfg, quant, batch, seq, hbm_bytes,
-                                requested_impl)
+                                requested_impl, prefill_chunk)
     wb = base.weight_bytes
     # The completions path churns large short-lived buffers (chunk concats,
     # per-chunk caches), so running AT the budget edge thrashes the
@@ -285,11 +337,11 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
     def need(b):
         attn = (flash_workspace_bytes(cfg, b, seq)
                 if base.attention_impl == "flash"
-                else dense_attention_bytes(cfg, b, seq))
-        return (wb + attn + activation_bytes(cfg, b, seq)
+                else dense_attention_bytes(cfg, b, seq, prefill_chunk))
+        return (wb + attn + activation_bytes(cfg, b, seq, prefill_chunk)
                 + completions_extra_bytes(cfg, b, seq, gen_tokens,
                                           score_steps, pipeline_depth,
-                                          reduced_scores))
+                                          reduced_scores, kv_dtype))
 
     b = min(batch, base.batch)
     if need(b) > budget:
@@ -297,10 +349,18 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
         while b > 32 and need(b) > budget:  # batches stay sublane-aligned
             b -= 32
     if b == base.batch:
-        return base
+        # no full-study clamp: still report the full-study fit decision
+        # (bench records this string per operating point)
+        return dataclasses.replace(base, reason=(
+            f"full-study fits at batch {b} with {kv_dtype} KV"
+            + (f" + prefill chunk {prefill_chunk}" if prefill_chunk else "")
+            + f": {need(b) / 2**30:.1f} GiB of {budget / 2**30:.1f}"
+            + f" [{base.reason}]"))
     return ScoringPlan(
         base.attention_impl, b, base.fits_dense, wb,
-        f"full-study row contract pins {completions_extra_bytes(cfg, b, seq, gen_tokens, score_steps, pipeline_depth, reduced_scores) / 2**30:.1f} GiB "
-        f"of completion caches/scores at depth {pipeline_depth}; "
-        f"batch {batch} -> {b} to fit {budget / 2**30:.1f} GiB",
+        f"full-study row contract pins {completions_extra_bytes(cfg, b, seq, gen_tokens, score_steps, pipeline_depth, reduced_scores, kv_dtype) / 2**30:.1f} GiB "
+        f"of {kv_dtype} KV completion caches/scores at depth "
+        f"{pipeline_depth}"
+        + (f" (prefill chunk {prefill_chunk})" if prefill_chunk else "")
+        + f"; batch {batch} -> {b} to fit {budget / 2**30:.1f} GiB",
     )

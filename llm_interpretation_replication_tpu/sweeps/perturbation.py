@@ -305,6 +305,10 @@ def run_model_perturbation_sweep(
     # grace window.  The guard flushes the pending side-log rows before
     # exiting, so a preempted 10k sweep resumes losing at most the
     # in-flight score_chunk (the resume path skips every flushed row).
+    from ..utils.telemetry import counters as _counters
+    from ..utils.telemetry import counters_since as _counters_since
+
+    counters_snap = _counters()
     with faults.PreemptionGuard(flush, label="perturbation"), \
             _closing(prefetcher):
         # _closing: a mid-sweep error (device OOM bubbling to the caller's
@@ -382,4 +386,12 @@ def run_model_perturbation_sweep(
                 if len(pending) >= checkpoint_every:
                     flush()
         flush(final=True)
+    delta = _counters_since(counters_snap)
+    if delta.get("kv_cache_bytes_saved") or delta.get("prefill_chunks"):
+        # the int8-KV / chunked-prefill operating point is auditable per
+        # sweep, not just per bench run: a sweep that silently fell back
+        # to the bf16 monolithic path is a different measurement
+        log(f"{model_name}: kv_cache_bytes_saved="
+            f"{delta.get('kv_cache_bytes_saved', 0):.0f} "
+            f"prefill_chunks={delta.get('prefill_chunks', 0):.0f}")
     return pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS)

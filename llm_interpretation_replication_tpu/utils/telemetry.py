@@ -73,6 +73,13 @@ def clear_fault_events() -> None:
 # operating point is provable as blocked_transfers == 0).  bench.py
 # --strict reports both in its JSON record.
 #
+# The KV-cache quantization / chunked-prefill layer (runtime/engine
+# ._prefill) adds: ``kv_cache_bytes_saved`` — HBM an int8-quantized KV
+# cache does NOT pin vs its bf16 layout, accumulated per prefill from
+# static shapes (a sweep that silently fell back to bf16 shows 0) — and
+# ``prefill_chunks`` — chunked-prefill programs launched (chunk 0's
+# ordinary prefill plus each suffix-extension replay).
+#
 # The serve/ scheduler (continuous-batching request coalescing) adds:
 # ``serve_enqueued`` — requests admitted to the queue; ``serve_completed``
 # — result rows delivered to futures; ``serve_rejected_full`` — typed

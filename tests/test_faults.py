@@ -354,6 +354,94 @@ class TestEngineBackoffMechanics:
 
 
 # ---------------------------------------------------------------------------
+# Int8 KV cache + chunked prefill fault rows (ISSUE 5): OOM mid-chunked-
+# prefill must release PrefixCachePool entries before the ladder retry (no
+# double-free, no orphan), and int8-KV sweeps re-bucket down the SAME
+# measured ladder as bf16
+# ---------------------------------------------------------------------------
+
+
+class TestChunkedPrefillFaults:
+    def _fused_engine(self, **ecfg_kw):
+        import dataclasses as dc
+
+        from test_runtime import _tiny_engine
+
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            ScoringEngine,
+        )
+
+        eng, _, tok = _tiny_engine(batch_size=4)
+        ecfg = dc.replace(eng.ecfg, oom_backoff=True, oom_batch_floor=1,
+                          **ecfg_kw)
+        return ScoringEngine(eng.family, eng.cfg, eng.params, tok,
+                             engine_config=ecfg)
+
+    def _pairs(self, n=6):
+        return [(f"Is thing number {i} considered a kind of stuff?",
+                 (" Answer Yes or No.", " How confident, 0-100?"))
+                for i in range(n)]
+
+    def _legs(self):
+        from llm_interpretation_replication_tpu.runtime.engine import LegSpec
+
+        return [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+
+    @pytest.mark.parametrize("fail_call", [1, 2])
+    def test_oom_mid_chunked_prefill_releases_pool_before_retry(
+            self, monkeypatch, fail_call):
+        """A fused batch with chunked prefix prefill calls extend_prefill
+        for the chunk replay FIRST (before the pool entry exists) and for
+        each suffix leg AFTER acquire.  An injected OOM at either point
+        must re-bucket down the ladder with the entry released exactly
+        once: retried sub-batches acquire fresh entries, nothing is
+        orphaned or double-freed, and every row completes."""
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+
+        eng = self._fused_engine(prefill_chunk=16, kv_dtype="int8")
+        real = dmod.extend_prefill
+        state = {"calls": 0}
+
+        def failing(*a, **kw):
+            state["calls"] += 1
+            if state["calls"] == fail_call:
+                raise injected_oom_error()
+            return real(*a, **kw)
+
+        # chunked_prefill and the engine's suffix legs both resolve
+        # extend_prefill off the decoder module at call time
+        monkeypatch.setattr(dmod, "extend_prefill", failing)
+        outs = eng.score_prefixed(self._pairs(), legs=self._legs())
+        pool = eng.last_prefix_pool
+        assert pool.consistent, (pool.acquired, pool.released, pool.leaked)
+        assert pool.leaked == 0
+        assert len(outs) == 2
+        assert all(r["success"] for rows in outs for r in rows)
+        assert [e["kind"] for e in eng.fault_events] == ["engine_oom_backoff"]
+        assert eng.fault_events[0]["new_batch"] < eng.fault_events[0]["batch"]
+
+    def test_int8_kv_sweep_rebuckets_down_measured_ladder(self):
+        """An int8-KV engine walks the SAME back-off machinery as bf16: an
+        injected device OOM at the first batch launch re-buckets the rows
+        at the configured ladder step and the sweep completes with every
+        row scored (none lost, none duplicated)."""
+        eng = self._fused_engine(kv_dtype="int8", oom_batch_ladder=(2,))
+        faulty = FaultyEngine(eng, [Fault("oom", at_batch=1)])
+        prompts = [f"Is item {i} a vehicle of some sort?" for i in range(6)]
+        rows = faulty.score_prompts(prompts)
+        assert len(rows) == 6 and all(r["success"] for r in rows)
+        assert faulty.injected == [{"kind": "oom", "at_call": 0,
+                                    "at_batch": 1}]
+        events = telemetry.fault_events("engine_oom_backoff")
+        assert events and events[0]["new_batch"] == 2
+        # int8 KV held through the retry: the re-bucketed batches still
+        # produced a quantized cache (bytes-saved telemetry is monotone)
+        assert telemetry.counter("kv_cache_bytes_saved") > 0
+
+
+# ---------------------------------------------------------------------------
 # Perturbation sweep fault matrix (fake engine: 2 scenarios x 6 rephrasings,
 # score_chunk=4 -> 3 chunks, confidence off -> 2 engine calls per chunk)
 # ---------------------------------------------------------------------------

@@ -1,0 +1,443 @@
+"""Int8 KV cache + chunked prefill (ISSUE 5, ``-m kvcache``, tier-1).
+
+Pins the three contracts of the kv-dtype layer:
+
+- **bf16 stays bit-parity**: the default engine's cache layout and every
+  chunked-vs-monolithic prefill comparison reproduce the monolithic bf16
+  path (exact position-0 fields; scored fields to reduction-order noise),
+  so the fused-vs-unfused and serve `--replay` parity contracts are
+  untouched.
+- **int8 KV is tolerance-parity**: quantize/dequant round-trips within the
+  per-head-scale error bound, prompt-forward logits stay bit-identical
+  (quantization touches STORAGE only), and full scoring rows agree with
+  the bf16 engine within the tolerance documented in PARITY.md
+  (|Δ relative_prob| <= 0.05 on this harness).
+- **the budget model predicts, never discovers**: the calibrated v5e
+  anchor points (w8a8 192/432 fits; bf16 flash 64 fits / 128+ OOM; the
+  full-study 224 boundary) cannot drift, and the kv-dtype-aware +
+  chunked-prefill terms put the full-study sweep back at batch >= 320
+  under int8 KV.
+"""
+
+import dataclasses
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from test_runtime import _tiny_engine
+
+from llm_interpretation_replication_tpu.models.config import DecoderConfig
+from llm_interpretation_replication_tpu.models import decoder as dmod
+from llm_interpretation_replication_tpu.ops import quant
+from llm_interpretation_replication_tpu.runtime.engine import (
+    EngineConfig,
+    LegSpec,
+    ScoringEngine,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.kvcache
+
+#: Documented int8-KV tolerance (PARITY.md "Int8 KV cache"): scored-decode
+#: probability fields of an int8-KV engine vs the bf16 engine.  The prompt
+#: forward always runs on exact projections, so monolithic position-0
+#: fields are bit-identical; only decode / suffix-extension reads pass
+#: through dequantized values.
+INT8_KV_ATOL = 0.05
+
+EXACT_FIELDS = ("first_token_yes_prob", "first_token_no_prob",
+                "first_token_relative_prob")
+PROB_FIELDS = ("yes_prob", "no_prob", "relative_prob")
+
+
+def _clone_engine(eng, tok, **ecfg_kw):
+    """A second engine over the SAME params/tokenizer with engine-config
+    overrides — kv_dtype lands on the decoder config at construction."""
+    return ScoringEngine(
+        eng.family, eng.cfg, eng.params, tok,
+        engine_config=dataclasses.replace(eng.ecfg, **ecfg_kw))
+
+
+def _tiny_cfg(**kw):
+    base = dict(vocab_size=128, hidden_size=32, num_layers=2, num_heads=4,
+                num_kv_heads=2, intermediate_size=64,
+                position_embedding="rotary", qkv_bias=False, out_bias=False,
+                mlp_bias=False)
+    base.update(kw)
+    return DecoderConfig(**base)
+
+
+def _prompt_batch(cfg, batch=3, seq=24, lens=(24, 13, 7), seed=0):
+    rng = np.random.default_rng(seed)
+    ids = jnp.asarray(
+        rng.integers(1, cfg.vocab_size - 1, size=(batch, seq)).astype(np.int32))
+    mask = jnp.asarray(
+        (np.arange(seq)[None, :] < np.asarray(lens)[:, None]).astype(np.int32))
+    return ids, mask
+
+
+# ---------------------------------------------------------------------------
+# Quantize/dequant round-trip (ops/quant.py)
+# ---------------------------------------------------------------------------
+
+class TestQuantRoundTrip:
+    def test_round_trip_within_per_head_scale_bound(self):
+        rng = np.random.default_rng(3)
+        # cache-shaped block with wildly different per-(slot, head) ranges,
+        # the case per-TENSOR scales would butcher
+        x = rng.standard_normal((2, 3, 8, 2, 16)).astype(np.float32)
+        x *= (10.0 ** rng.integers(-3, 3, size=(2, 3, 8, 2, 1)))
+        q, scale = quant.quantize_kv(jnp.asarray(x))
+        assert q.dtype == jnp.int8 and q.shape == x.shape
+        assert scale.shape == x.shape[:-1]
+        deq = np.asarray(quant.dequantize_kv(q, scale))
+        # symmetric int8: round-trip error is at most half a code step,
+        # i.e. scale/2 per element — PER HEAD, independent of other heads
+        bound = np.asarray(scale)[..., None] * 0.5 + 1e-12
+        assert np.all(np.abs(deq - x) <= bound)
+
+    def test_zero_block_is_exact_and_finite(self):
+        q, scale = quant.quantize_kv(jnp.zeros((1, 2, 4, 1, 8)))
+        assert np.all(np.asarray(q) == 0)
+        assert np.all(np.isfinite(np.asarray(scale)))
+        assert np.all(np.asarray(quant.dequantize_kv(q, scale)) == 0)
+
+    def test_codes_cover_the_full_range(self):
+        x = jnp.asarray(np.linspace(-1, 1, 64, dtype=np.float32)
+                        .reshape(1, 1, 4, 1, 16))
+        q, _ = quant.quantize_kv(x)
+        assert int(jnp.max(jnp.abs(q))) == 127  # absmax maps to full scale
+
+
+# ---------------------------------------------------------------------------
+# Chunked-vs-monolithic prefill equivalence at bf16 (models/decoder.py)
+# ---------------------------------------------------------------------------
+
+class TestChunkedPrefill:
+    """Chunk boundaries must be invisible at bf16: same last-token logits,
+    same greedy decode continuation, same per-step scores.  Masked key
+    slots contribute exact zeros to the joint softmax, so the chunked
+    replay agrees to reduction-order noise."""
+
+    def _run(self, chunk):
+        cfg = _tiny_cfg()
+        from helpers import random_decoder_params
+
+        params = random_decoder_params(cfg)
+        ids, mask = _prompt_batch(cfg)
+        if chunk is None:
+            last, cache = dmod.prefill(params, cfg, ids, mask,
+                                       cache_len=ids.shape[1])
+        else:
+            last, cache, n = dmod.chunked_prefill(params, cfg, ids, mask,
+                                                  chunk)
+        lengths = jnp.sum(mask, axis=-1)
+        toks, scores, _, _, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, jnp.int32(0), 5, None,
+            with_scores=True)
+        return np.asarray(last), np.asarray(toks), np.asarray(scores)
+
+    @pytest.mark.parametrize("chunk", [8, 9, 16])
+    def test_chunk_sizes_match_monolithic(self, chunk):
+        last_m, toks_m, sc_m = self._run(None)
+        last_c, toks_c, sc_c = self._run(chunk)
+        np.testing.assert_allclose(last_c, last_m, rtol=2e-5, atol=1e-6)
+        np.testing.assert_array_equal(toks_c, toks_m)
+        np.testing.assert_allclose(sc_c, sc_m, rtol=2e-5, atol=1e-6)
+
+    def test_chunk_count_and_degenerate_chunk(self):
+        cfg = _tiny_cfg()
+        from helpers import random_decoder_params
+
+        params = random_decoder_params(cfg)
+        ids, mask = _prompt_batch(cfg)
+        _, _, n = dmod.chunked_prefill(params, cfg, ids, mask, 8)
+        assert n == 3                       # 24 tokens / 8-token chunks
+        # chunk >= S degenerates to one ordinary prefill
+        last_m, cache_m = dmod.prefill(params, cfg, ids, mask, cache_len=24)
+        last_1, cache_1, n1 = dmod.chunked_prefill(params, cfg, ids, mask, 64)
+        assert n1 == 1
+        np.testing.assert_array_equal(np.asarray(last_1), np.asarray(last_m))
+
+    def test_mismatched_cache_dtype_raises(self):
+        """extend_prefill must refuse a bf16 cache under an int8 config (and
+        vice versa) — a silent concat would corrupt every later read."""
+        cfg = _tiny_cfg()
+        from helpers import random_decoder_params
+
+        params = random_decoder_params(cfg)
+        ids, mask = _prompt_batch(cfg)
+        _, cache = dmod.prefill(params, cfg, ids, mask, cache_len=24)
+        cfg8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            dmod.extend_prefill(params, cfg8, cache, ids[:, :4], mask[:, :4],
+                                jnp.sum(mask, axis=-1))
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: chunked prefill rows == monolithic rows (bf16)
+# ---------------------------------------------------------------------------
+
+class TestEngineChunkedPrefill:
+    def test_rows_match_and_counter_fires(self):
+        eng, _, tok = _tiny_engine(batch_size=4)
+        chunked = _clone_engine(eng, tok, prefill_chunk=16)
+        prompts = [f"Is thing number {i} a kind of stuff?" for i in range(6)]
+        base_rows = eng.score_prompts(prompts)
+        telemetry.clear_counters()
+        rows = chunked.score_prompts(prompts)
+        assert telemetry.counter("prefill_chunks") >= 2
+        for a, b in zip(rows, base_rows):
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+            for f in PROB_FIELDS:
+                np.testing.assert_allclose(a[f], b[f], rtol=2e-5, atol=1e-9,
+                                           err_msg=f)
+            assert a["completion"] == b["completion"]
+
+    def test_fused_two_leg_path_matches_under_chunking(self):
+        """score_prefixed with a chunked prefix prefill reproduces the
+        unchunked fused rows — the chunk replays through the SAME
+        suffix-extension machinery the legs use."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        chunked = _clone_engine(eng, tok, prefill_chunk=16)
+        pairs = [(f"Scenario {i}: the bylaw covers bicycles in the park.",
+                  (" Answer Yes or No.", " How confident, 0-100?"))
+                 for i in range(5)]
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        base = eng.score_prefixed(pairs, legs=legs)
+        rows = chunked.score_prefixed(pairs, legs=legs)
+        assert chunked.last_prefix_pool.consistent
+        for leg_a, leg_b in zip(rows, base):
+            for a, b in zip(leg_a, leg_b):
+                for f in EXACT_FIELDS:
+                    assert a[f] == b[f], f
+                for f in PROB_FIELDS:
+                    np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                               atol=1e-9, err_msg=f)
+
+
+# ---------------------------------------------------------------------------
+# Int8 KV parity (tolerance-based — the documented operating point)
+# ---------------------------------------------------------------------------
+
+class TestInt8KVParity:
+    def test_prompt_forward_bit_identical_storage_only(self):
+        """Quantization must touch STORAGE only: the monolithic prefill's
+        last-token logits come from exact projections and stay
+        bit-identical; the cache itself is int8 + per-head scales."""
+        cfg = _tiny_cfg()
+        from helpers import random_decoder_params
+
+        params = random_decoder_params(cfg)
+        ids, mask = _prompt_batch(cfg)
+        last, cache = dmod.prefill(params, cfg, ids, mask, cache_len=24)
+        cfg8 = dataclasses.replace(cfg, kv_cache_dtype="int8")
+        last8, cache8 = dmod.prefill(params, cfg8, ids, mask, cache_len=24)
+        np.testing.assert_array_equal(np.asarray(last8), np.asarray(last))
+        assert cache8.k.dtype == jnp.int8
+        assert cache8.k_scale.shape == cache8.k.shape[:-1]
+        assert cache.k_scale is None
+
+    def test_rows_within_documented_tolerance(self):
+        eng, _, tok = _tiny_engine(batch_size=4)
+        eng8 = _clone_engine(eng, tok, kv_dtype="int8")
+        assert eng8.cfg.kv_cache_dtype == "int8"
+        assert eng.cfg.kv_cache_dtype == "bf16"   # source engine untouched
+        prompts = [f"Is item {i} considered a vehicle?" for i in range(6)]
+        telemetry.clear_counters()
+        rows_bf16 = eng.score_prompts(prompts)
+        rows_int8 = eng8.score_prompts(prompts)
+        assert telemetry.counter("kv_cache_bytes_saved") > 0
+        for a, b in zip(rows_int8, rows_bf16):
+            # monolithic prefill: position-0 fields are exact
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+            # scored-decode fields: within the documented tolerance
+            for f in PROB_FIELDS:
+                assert abs(a[f] - b[f]) <= INT8_KV_ATOL, (f, a[f], b[f])
+            assert a["success"] and b["success"]
+
+    def test_fused_legs_within_tolerance_and_pool_consistent(self):
+        eng, _, tok = _tiny_engine(batch_size=4)
+        eng8 = _clone_engine(eng, tok, kv_dtype="int8", prefill_chunk=16)
+        pairs = [(f"Clause {i} talks about animals kept as pets.",
+                  (" Answer Yes or No.", " How confident, 0-100?"))
+                 for i in range(5)]
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        base = eng.score_prefixed(pairs, legs=legs)
+        rows = eng8.score_prefixed(pairs, legs=legs)
+        assert eng8.last_prefix_pool.consistent
+        for leg_a, leg_b in zip(rows, base):
+            for a, b in zip(leg_a, leg_b):
+                for f in PROB_FIELDS:
+                    assert abs(a[f] - b[f]) <= INT8_KV_ATOL, (f, a[f], b[f])
+
+    def test_pooled_phase2_path_handles_int8(self):
+        """The cross-batch phase-2 pool (gather, blank padding, concat,
+        pooled decode) must carry the scale arrays: no-completions
+        no-confidence scoring on an int8 engine completes with rows in
+        tolerance."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        bf = _clone_engine(eng, tok, decode_completions=False)
+        i8 = _clone_engine(eng, tok, decode_completions=False,
+                           kv_dtype="int8")
+        prompts = [f"Is object {i} a beverage or not?" for i in range(9)]
+        rows_bf = bf.score_prompts(prompts)
+        rows_i8 = i8.score_prompts(prompts)
+        for a, b in zip(rows_i8, rows_bf):
+            assert a["success"]
+            for f in PROB_FIELDS:
+                assert abs(a[f] - b[f]) <= INT8_KV_ATOL, (f, a[f], b[f])
+
+    def test_bad_kv_dtype_rejected(self):
+        eng, _, tok = _tiny_engine(batch_size=2)
+        with pytest.raises(ValueError, match="kv_dtype"):
+            _clone_engine(eng, tok, kv_dtype="fp8")
+        with pytest.raises(ValueError, match="kv_cache_dtype"):
+            _tiny_cfg(kv_cache_dtype="int4")
+
+
+# ---------------------------------------------------------------------------
+# Strict mode: chunked-prefill sweep keeps blocked_transfers == 0
+# ---------------------------------------------------------------------------
+
+class TestStrictChunkedSweep:
+    def test_chunked_sweep_no_blocked_transfers(self):
+        """Acceptance: the chunked-prefill launch loop is pure device work
+        (no host fetch between chunks), so a sweep under the strict-mode
+        transfer guard holds ``blocked_transfers == 0``."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng, _, tok = _tiny_engine(batch_size=4)
+        chunked = _clone_engine(eng, tok, prefill_chunk=16, kv_dtype="int8")
+        prompts = [f"Does rule {i} apply to boats?" for i in range(8)]
+        strict.activate()
+        try:
+            snap = telemetry.counters()
+            rows = chunked.score_prompts(prompts)
+            delta = telemetry.counters_since(snap)
+            assert delta.get(strict.BLOCKED_COUNTER, 0) == 0
+            assert delta.get("prefill_chunks", 0) >= 2
+            assert len(rows) == 8 and all(r["success"] for r in rows)
+        finally:
+            strict.deactivate()
+
+
+# ---------------------------------------------------------------------------
+# Budget-model anchor regression (runtime/plan.py — satellite b)
+# ---------------------------------------------------------------------------
+
+def _falcon7b():
+    return DecoderConfig(
+        vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71,
+        num_kv_heads=1, intermediate_size=18176, parallel_residual=True,
+        shared_layernorm=True, qkv_bias=False, out_bias=False,
+        mlp_bias=False, position_embedding="rotary",
+        tie_word_embeddings=True, max_position_embeddings=2048,
+    )
+
+
+class TestBudgetModelAnchors:
+    """The documented v5e anchor points, pinned so estimator changes can't
+    silently drift the operating point (each line is a measured fact from
+    BASELINE/PARITY rounds 3-5 or the ISSUE-5 target)."""
+
+    def test_w8a8_headline_fits(self):
+        from llm_interpretation_replication_tpu.runtime import (
+            resolve_scoring_plan,
+        )
+
+        p = resolve_scoring_plan(_falcon7b(), "int8", 192, 432)
+        assert p.fits_dense and p.attention_impl == "xla" and p.batch == 192
+
+    def test_bf16_flash_64_fits_128_ooms(self):
+        from llm_interpretation_replication_tpu.runtime import (
+            resolve_scoring_plan,
+        )
+
+        p64 = resolve_scoring_plan(_falcon7b(), "none", 64, 432)
+        assert not p64.fits_dense and p64.attention_impl == "flash"
+        assert p64.batch == 64
+        p128 = resolve_scoring_plan(_falcon7b(), "none", 128, 432)
+        assert p128.attention_impl == "flash" and p128.batch == 64
+
+    def test_full_study_224_boundary_bf16(self):
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            resolve_full_sweep_plan,
+        )
+
+        f7 = _falcon7b()
+        for req in (256, 240):
+            assert resolve_full_sweep_plan(
+                f7, "int8", req, 256, pipeline_depth=2).batch == 224
+        assert resolve_full_sweep_plan(
+            f7, "int8", 224, 256, pipeline_depth=2).batch == 224
+        assert resolve_full_sweep_plan(
+            f7, "int8", 192, 256, pipeline_depth=2).batch == 192
+
+    def test_int8_kv_plus_chunked_prefill_fits_at_320(self):
+        """THE ISSUE-5 acceptance anchor: kv-dtype-aware cache bytes + the
+        chunked-prefill activation bound predict a full-study fit at
+        batch >= 320 — each lever alone lands at 288, only both together
+        clear the 320 point."""
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            resolve_full_sweep_plan,
+        )
+
+        f7 = _falcon7b()
+        both = resolve_full_sweep_plan(f7, "int8", 320, 256,
+                                       pipeline_depth=2, kv_dtype="int8",
+                                       prefill_chunk=128)
+        assert both.batch == 320
+        assert "int8" in both.reason
+        assert resolve_full_sweep_plan(
+            f7, "int8", 384, 256, pipeline_depth=2, kv_dtype="int8",
+            prefill_chunk=128).batch >= 320
+        only_kv = resolve_full_sweep_plan(f7, "int8", 320, 256,
+                                          pipeline_depth=2,
+                                          kv_dtype="int8")
+        assert only_kv.batch == 288
+        only_chunk = resolve_full_sweep_plan(f7, "int8", 320, 256,
+                                             pipeline_depth=2,
+                                             prefill_chunk=128)
+        assert only_chunk.batch == 288
+
+    def test_kv_cache_bytes_dtype_aware(self):
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            kv_cache_bytes,
+        )
+
+        f7 = _falcon7b()
+        bf16 = kv_cache_bytes(f7, 320, 256, "bf16")
+        int8 = kv_cache_bytes(f7, 320, 256, "int8")
+        # 1 B codes + 4 B per-head scale over head_dim 64 -> 1.0625 B/elem
+        assert int8 / bf16 == pytest.approx((1 + 4 / 64) / 2)
+        with pytest.raises(ValueError):
+            kv_cache_bytes(f7, 1, 1, "fp8")
+
+
+# ---------------------------------------------------------------------------
+# Serve replay parity with chunked prefill (bf16 contract untouched)
+# ---------------------------------------------------------------------------
+
+class TestServeReplayChunked:
+    def test_replay_rows_identical_under_chunked_prefill(self):
+        """The serve scheduler coalesces requests back onto the engine's
+        own bucketed shapes; with chunked prefill on (bf16 KV) replay
+        parity must stay row-identical — require_parity raises on skew."""
+        from llm_interpretation_replication_tpu.serve.replay import replay
+
+        eng, _, tok = _tiny_engine(batch_size=4)
+        chunked = _clone_engine(eng, tok, prefill_chunk=16)
+        prompts = [f"Is gadget {i} an appliance?" for i in range(6)]
+        report = replay(chunked, prompts)   # raises ServeError on mismatch
+        assert report["mismatched_rows"] == 0
+        assert report["serve_rows_per_s"] > 0

@@ -584,6 +584,25 @@ class TestRepoGate:
         assert not [e for e in entries if e.get("path", "").startswith(
             "llm_interpretation_replication_tpu/serve/")]
 
+    def test_kvcache_touched_modules_carry_no_baseline_entries(self):
+        """Satellite (ISSUE 5): the int8-KV-cache / chunked-prefill change
+        ships lint-clean — zero new ``lint_baseline.json`` entries for the
+        modules it touches in ops/, models/, and runtime/ (the repo gate
+        above already proves zero NEW findings; this pins that none were
+        grandfathered instead)."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        touched = ("ops/quant.py", "ops/attention.py", "models/decoder.py",
+                   "models/config.py", "runtime/plan.py",
+                   "runtime/engine.py", "runtime/faults.py",
+                   "sweeps/perturbation.py")
+        entries = load_baseline(default_baseline_path())
+        offenders = [e for e in entries
+                     if e.get("path", "").endswith(touched)]
+        assert not offenders, offenders
+
     def test_gate_would_catch_an_injected_violation(self, tmp_path):
         """End-to-end teeth check: copy one real hot-path file, inject a
         G01 `.item()` into it, and confirm the same entry point that the
