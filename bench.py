@@ -474,6 +474,7 @@ def run_sweep_mode(args, cfg, params):
     # scope the record's context-block counters to the measured repeats
     # (calibration above must not inflate them) — _operating_context
     args.counters_snap = counters()
+    _obs_phase_snap(args)
     best_dt = float("inf")
     best_score_s = float("inf")
     last_ok_rows = 0
@@ -486,7 +487,9 @@ def run_sweep_mode(args, cfg, params):
             os.remove(sidelog)  # each repeat checkpoints from scratch
         t0 = timemod.perf_counter()
         try:
-            rows = engine.score_prompts(all_prompts, targets=all_targets)
+            with _profile_window(args, rep):
+                rows = engine.score_prompts(all_prompts,
+                                            targets=all_targets)
         except Exception as err:
             # step through the MEASURED ladder (384/352 -> 320 -> 256,
             # runtime/faults.MEASURED_SWEEP_LADDER): 320 is a fully-
@@ -564,6 +567,8 @@ def run_sweep_mode(args, cfg, params):
               f"{rep_report['mismatched_rows']} mismatched row(s)",
               file=sys.stderr)
 
+    args.phases_report = _phases_report(
+        args, sum(repeat_times), n_total * max(1, len(repeat_times)))
     return n_total / best_dt, measured_rate, out_path
 
 
@@ -689,6 +694,7 @@ def run_sweep_full_mode(args, cfg, params):
     # context-block counters scope to the measured repeats: the warmup
     # pass above also runs _prefill and must not inflate the record
     args.counters_snap = counters()
+    _obs_phase_snap(args)
     best_dt = float("inf")
     last_ok_path = None
     repeat_times = []
@@ -711,12 +717,13 @@ def run_sweep_full_mode(args, cfg, params):
                 os.remove(stale)
         t0 = timemod.perf_counter()
         try:
-            df = run_model_perturbation_sweep(
-                engine, args.model, scenarios, out_path,
-                checkpoint_every=args.checkpoint_every,
-                confidence=True, log=lambda *a, **k: None,
-                fuse_prefix=fuse,
-            )
+            with _profile_window(args, rep):
+                df = run_model_perturbation_sweep(
+                    engine, args.model, scenarios, out_path,
+                    checkpoint_every=args.checkpoint_every,
+                    confidence=True, log=lambda *a, **k: None,
+                    fuse_prefix=fuse,
+                )
         except Exception as err:
             action = _sweep_oom_action(
                 err, args, engine, rep, best_dt < float("inf"),
@@ -743,6 +750,8 @@ def run_sweep_full_mode(args, cfg, params):
           f"kv_cache_bytes_saved={c.get('kv_cache_bytes_saved', 0):.0f}",
           file=sys.stderr)
     args.repeat_times = repeat_times
+    args.phases_report = _phases_report(
+        args, sum(repeat_times), n_total * max(1, len(repeat_times)))
     if last_ok_path and not os.path.exists(last_ok_path):
         # with a fixed --sweep-out, a later failed repeat deleted the
         # successful repeat's workbook at loop start — never hand the
@@ -752,6 +761,47 @@ def run_sweep_full_mode(args, cfg, params):
               f"report", file=sys.stderr)
         last_ok_path = None
     return n_total / best_dt, measured_rate, last_ok_path
+
+
+def _obs_phase_snap(args):
+    """Snapshot the span tracer's phase totals so the ``phases`` block
+    scopes to the measured repeats (the ``counters_snap`` pattern —
+    calibration/warmup spans must not inflate the decomposition)."""
+    from llm_interpretation_replication_tpu import obs
+
+    args.phase_snap = obs.phase_snapshot()
+
+
+def _phases_report(args, wall_s: float, rows: int) -> dict:
+    """The ``phases`` block for the sweep JSON records (obs/report.py):
+    per-phase (and per-leg) self-time seconds since :func:`_obs_phase_snap`
+    with coverage against the measured wall-clock — ISSUE-6's missing
+    decomposition of where the full-study row's time goes.  Also renders
+    the stderr table.  {} when tracing is off."""
+    from llm_interpretation_replication_tpu import obs
+    from llm_interpretation_replication_tpu.obs.report import (
+        format_phase_table,
+        phases_block,
+    )
+
+    if not obs.enabled():
+        return {}
+    totals = obs.phase_totals_since(getattr(args, "phase_snap", {}),
+                                    by_leg=True)
+    block = phases_block(totals, wall_s=wall_s or None, rows=rows or None)
+    print(format_phase_table(block, title="phase attribution "
+                                          "(measured repeats)"),
+          file=sys.stderr)
+    return {"phases": block}
+
+
+def _profile_window(args, rep: int):
+    """Windowed jax.profiler capture of repeat 0 (``--profile DIR``)."""
+    from llm_interpretation_replication_tpu.obs.profiler import (
+        profile_window,
+    )
+
+    return profile_window(getattr(args, "profile", None), enabled=rep == 0)
 
 
 def _repeat_report(args) -> dict:
@@ -954,6 +1004,32 @@ def main():
                              "recompile_events / blocked_transfers "
                              "telemetry counters so the measured operating "
                              "point is auditable")
+    parser.add_argument("--trace", nargs="?", const="bench_trace.json",
+                        default=None, metavar="PATH",
+                        help="span tracing (obs/): record the hot path's "
+                             "phase spans (tokenize, prefill, "
+                             "extend_prefill, decode, pooled decode, d2h "
+                             "fetch — tagged by leg/bucket/batch), export "
+                             "a Perfetto-loadable Chrome trace to PATH "
+                             "(default bench_trace.json) plus a JSONL span "
+                             "log at PATH.spans.jsonl, and attach a "
+                             "'phases' block decomposing the measured "
+                             "wall-clock per phase (and per leg in "
+                             "sweep-full) to the JSON record; "
+                             "measurement-only, strict-safe")
+    parser.add_argument("--trace-sync", action="store_true",
+                        help="with --trace: opt-in block_until_ready at "
+                             "phase-span close for per-phase DEVICE time "
+                             "attribution — deliberately serializes the "
+                             "async-dispatch overlap, so throughput "
+                             "numbers from a sync-traced run are NOT "
+                             "operating-point measurements")
+    parser.add_argument("--profile", metavar="DIR", default=None,
+                        help="windowed jax.profiler capture (obs/"
+                             "profiler.py): capture repeat 0 of the sweep "
+                             "modes into DIR (TensorBoard/Perfetto "
+                             "viewable; headless analysis via "
+                             "utils/profiling.top_device_ops)")
     parser.add_argument("--microbatch", type=int, default=1, metavar="N",
                         help="split the batch into N independent chunks "
                              "inside the jit so XLA can overlap one chunk's "
@@ -998,6 +1074,27 @@ def main():
         strict_mod.activate()
     else:
         strict_mod.activate_from_env()
+
+    if args.trace:
+        # span tracing (obs/): armed for the whole run; the Chrome trace
+        # exports at interpreter exit so every return path below is
+        # covered, and the JSONL span log streams as spans close (a
+        # crashed run still leaves its spans on disk)
+        import atexit
+
+        from llm_interpretation_replication_tpu import obs as obs_mod
+
+        obs_mod.enable(jsonl_path=args.trace + ".spans.jsonl",
+                       sync=args.trace_sync, memory=True)
+
+        def _export_trace():
+            path = obs_mod.export_chrome(args.trace)
+            print(f"# obs: trace written to {path} (span log "
+                  f"{args.trace}.spans.jsonl; view in Perfetto or "
+                  f"'obs report --trace {args.trace}.spans.jsonl')",
+                  file=sys.stderr)
+
+        atexit.register(_export_trace)
 
     def _attach_strict(record):
         """Append the strict-mode audit block (recompile_events /
@@ -1324,6 +1421,7 @@ def main():
             }
             record.update(_repeat_report(args))
             record.update(_operating_context(args))
+            record.update(getattr(args, "phases_report", None) or {})
             print(json.dumps(_attach_strict(record)))
             return
         pps, rate, out_path = run_sweep_mode(args, cfg, params)
@@ -1344,6 +1442,7 @@ def main():
         }
         record.update(_repeat_report(args))
         record.update(_operating_context(args))
+        record.update(getattr(args, "phases_report", None) or {})
         if getattr(args, "serve_report", None):
             record["serve"] = args.serve_report
         if not args.no_secondary:
@@ -1409,6 +1508,20 @@ def main():
                     "--fuse-prefix" if args.fuse_prefix else "--no-fuse-prefix",
                     "--warmup" if args.warmup else "--no-warmup",
                 ]
+                # forward the instrumentation flags (the PR-5 --kv-dtype/
+                # --prefill-chunk forwarding discipline): a traced/profiled
+                # parent must not silently run its full-study child
+                # uninstrumented — the child gets its own artifact paths
+                # so it never clobbers the parent's trace
+                if args.trace:
+                    cmd += ["--trace", args.trace + ".sweep-full.json"]
+                    if args.trace_sync:
+                        cmd += ["--trace-sync"]
+                if args.profile:
+                    cmd += ["--profile",
+                            os.path.join(args.profile, "sweep-full")]
+                if args.strict:
+                    cmd += ["--strict"]
                 proc = subprocess.run(cmd, capture_output=True, text=True,
                                       timeout=7200)
                 sys.stderr.write(proc.stderr)

@@ -38,6 +38,18 @@ def _add_run_config_args(p: argparse.ArgumentParser):
                         "engine's sanctioned fetch points and count XLA "
                         "recompiles into telemetry (recompile_events / "
                         "blocked_transfers) — same as LLM_INTERP_STRICT=1")
+    p.add_argument("--trace", nargs="?", const="obs_trace.json",
+                   default=None, metavar="PATH",
+                   help="span tracing (obs/): record hot-path phase spans "
+                        "(tokenize/prefill/extend/decode/fetch, serve "
+                        "request spans), stream a JSONL span log to "
+                        "PATH.spans.jsonl, and export a Perfetto-loadable "
+                        "Chrome trace to PATH at exit; analyze saved "
+                        "traces with the 'obs report' subcommand")
+    p.add_argument("--profile", metavar="DIR", default=None,
+                   help="windowed jax.profiler capture into DIR for the "
+                        "command's run (obs/profiler.py; headless "
+                        "analysis: utils/profiling.top_device_ops)")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -984,6 +996,18 @@ def cmd_verify_replication(args):
         raise SystemExit(1)
 
 
+def cmd_obs(args):
+    """``obs report``: phase-attribution table over a saved span trace.
+
+    Like ``lint``, in practice UNREACHABLE — ``main()`` routes ``obs`` to
+    :mod:`.obs.report` before argparse runs (REMAINDER cannot accept
+    leading optionals like ``--trace``); the subparser exists so the
+    subcommand shows up in ``--help``."""
+    from .obs.report import main as obs_main
+
+    raise SystemExit(obs_main(args.obs_args))
+
+
 def main(argv=None):
     if argv is None:
         argv = sys.argv[1:]
@@ -994,6 +1018,12 @@ def main(argv=None):
         from .lint.cli import main as lint_main
 
         raise SystemExit(lint_main(argv[1:]))
+    if argv and argv[0] == "obs":
+        # same pre-argparse routing as lint: `obs report --trace PATH`
+        # leads with an optional the parent parser would reject
+        from .obs.report import main as obs_main
+
+        raise SystemExit(obs_main(argv[1:]))
     parser = argparse.ArgumentParser(prog="llm_interpretation_replication_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -1262,6 +1292,15 @@ def main(argv=None):
                         "--write-baseline, --explain RULE|all")
     p.set_defaults(fn=cmd_lint)
 
+    p = sub.add_parser("obs",
+                       help="observability reports: 'obs report --trace "
+                            "PATH' aggregates a saved span trace (JSONL "
+                            "log or Chrome-trace JSON) per phase/leg")
+    p.add_argument("obs_args", nargs=argparse.REMAINDER,
+                   help="forwarded: report --trace PATH [--wall-s S] "
+                        "[--rows N] [--format table|json]")
+    p.set_defaults(fn=cmd_obs)
+
     p = sub.add_parser("repair-batch",
                        help="re-pair a corrupted batch-response JSONL")
     p.add_argument("--requests", required=True, help="request JSONL")
@@ -1392,7 +1431,29 @@ def main(argv=None):
         strict_mod.activate()
     else:
         strict_mod.activate_from_env()
-    args.fn(args)
+    # Observability (obs/): --trace arms the span tracer for the whole
+    # command (JSONL streams as spans close; the Chrome trace exports on
+    # the way out, success or failure), --profile wraps the command in a
+    # jax.profiler capture window.  Both are measurement-only.
+    trace_path = getattr(args, "trace", None)
+    profile_dir = getattr(args, "profile", None)
+    if not trace_path and not profile_dir:
+        args.fn(args)
+        return
+    from .obs import enable as obs_enable
+    from .obs import export_chrome as obs_export
+    from .obs.profiler import profile_window
+
+    if trace_path:
+        obs_enable(jsonl_path=trace_path + ".spans.jsonl", memory=True)
+    try:
+        with profile_window(profile_dir, enabled=bool(profile_dir)):
+            args.fn(args)
+    finally:
+        if trace_path:
+            path = obs_export(trace_path)
+            print(f"# obs: trace written to {path} (span log "
+                  f"{trace_path}.spans.jsonl)", file=sys.stderr)
 
 
 if __name__ == "__main__":

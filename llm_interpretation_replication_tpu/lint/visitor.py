@@ -43,13 +43,17 @@ HOT_PATH_MARKERS = (
 #: between a device error and runtime/faults.py's OOM/transient
 #: classification.  serve/ is in scope from day one — the scheduler's
 #: micro-batch launches are exactly where a swallowed RESOURCE_EXHAUSTED
-#: would skip the split/re-queue ladder.  Analysis/stats/viz modules keep
-#: their defensive catches — nothing there handles device errors.
+#: would skip the split/re-queue ladder.  obs/ is in scope too: its spans
+#: wrap the engine's launch/consume callbacks, so a swallowed error there
+#: would hide a device failure inside the instrumentation (its deliberate
+#: best-effort catches — memory-stats probes, profiler start/stop — carry
+#: disable annotations).  Analysis/stats/viz modules keep their defensive
+#: catches — nothing there handles device errors.
 FAULT_PATH_MARKERS = (
     "/runtime/", "/ops/", "/models/", "/sweeps/", "/parallel/", "/native/",
-    "/serve/",
+    "/serve/", "/obs/",
     "runtime/", "ops/", "models/", "sweeps/", "parallel/", "native/",
-    "serve/",
+    "serve/", "obs/",
 )
 
 

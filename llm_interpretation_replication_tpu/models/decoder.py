@@ -655,7 +655,8 @@ def extend_prefill(params, cfg: DecoderConfig, cache: KVCache, token_ids,
 
         xs = (params["layers"], cache.k, cache.v, cache.k_scale,
               cache.v_scale)
-    x, (ks, vs, kss, vss) = lax.scan(body, x, xs)
+    with jax.named_scope("extend_prefill"):  # profiler attribution (obs/)
+        x, (ks, vs, kss, vss) = lax.scan(body, x, xs)
     suffix_lengths = jnp.sum(attention_mask, axis=-1)
     last_h = jnp.take_along_axis(x, (suffix_lengths - 1)[:, None, None], axis=1)
     last = _unembed(cfg, params, last_h)[:, 0, :]
@@ -683,7 +684,12 @@ def prefill(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len: in
 
     Returns (last_logits [B, V] fp32, KVCache padded to ``cache_len``).
     """
-    return _prefill_impl(params, cfg, token_ids, attention_mask, cache_len)
+    # named_scope carries into the HLO op metadata: a --profile capture
+    # (obs/) attributes this program's ops to "prefill" on the device
+    # timeline, where host-side spans cannot see
+    with jax.named_scope("prefill"):
+        return _prefill_impl(params, cfg, token_ids, attention_mask,
+                             cache_len)
 
 
 def chunked_prefill(params, cfg: DecoderConfig, token_ids, attention_mask,
@@ -907,9 +913,10 @@ def decode_steps(
         done = jnp.zeros((prev_logits.shape[0],), bool)
     if with_scores == "reduced" and target_ids is None:
         raise ValueError("with_scores='reduced' needs target_ids [B, 2]")
-    return _decode_steps_impl(params, cfg, cache, prev_logits, lengths,
-                              offset, num_steps, eos_token_id, done,
-                              with_scores, target_ids)
+    with jax.named_scope("decode_steps"):  # profiler attribution (obs/)
+        return _decode_steps_impl(params, cfg, cache, prev_logits, lengths,
+                                  offset, num_steps, eos_token_id, done,
+                                  with_scores, target_ids)
 
 
 @functools.partial(jax.jit, static_argnames=("cfg", "num_steps"))

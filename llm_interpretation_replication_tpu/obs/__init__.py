@@ -1,0 +1,58 @@
+"""Observability: span tracing, phase attribution, and profiler hooks.
+
+The repo's perf story has counters and sample rings
+(:mod:`..utils.telemetry`) but, before this subsystem, no *time
+decomposition*: the full-study sweep runs at a fraction of the binary
+leg's rate and nobody could point at where the per-row wall-clock
+actually goes (ROADMAP item 1).  This package closes that gap with three
+cooperating layers, all measurement-only (no numeric contract changes —
+PARITY.md "Observability"):
+
+- :mod:`.tracer` — a thread-safe nested span tracer on monotonic clocks.
+  The engine hot path (host tokenize/prefetch, prefill — monolithic and
+  chunked — ``extend_prefill``, decode chunks, pooled phase-2 decode,
+  d2h fetch), the sweep shells, and the serve scheduler all open spans
+  tagged by phase, leg, length bucket, and batch.  Spans export as
+  Chrome-trace/Perfetto JSON and stream to a JSONL span log; per-phase
+  SELF-time totals (nested phase spans never double-count) are the
+  ``phases`` block bench records gain.
+- :mod:`.profiler` — windowed ``jax.profiler`` capture (``--profile`` on
+  bench/CLI) plus per-device memory snapshots, for when host spans are
+  not enough and the XLA op timeline is needed.
+- :mod:`.report` — the ``obs report`` CLI over saved traces and the
+  table/JSON renderers bench uses live.
+
+Strict-mode contract: tracing performs NO device→host transfer of its
+own.  The opt-in ``sync`` at span close (``enable(sync=True)``) calls
+``jax.block_until_ready`` inside the strict layer's sanctioned-fetch
+scope, so a traced run under ``LLM_INTERP_STRICT=1`` stays
+``blocked_transfers == 0``.
+"""
+
+from .tracer import (
+    SpanTracer,
+    add_span,
+    disable,
+    enable,
+    enabled,
+    export_chrome,
+    get_tracer,
+    phase_snapshot,
+    phase_totals,
+    phase_totals_since,
+    span,
+)
+
+__all__ = [
+    "SpanTracer",
+    "add_span",
+    "disable",
+    "enable",
+    "enabled",
+    "export_chrome",
+    "get_tracer",
+    "phase_snapshot",
+    "phase_totals",
+    "phase_totals_since",
+    "span",
+]

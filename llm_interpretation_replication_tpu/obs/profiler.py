@@ -1,0 +1,90 @@
+"""Windowed ``jax.profiler`` capture + device-memory snapshots.
+
+Host spans (:mod:`.tracer`) decompose wall-clock per phase; when a phase
+itself needs explaining (which XLA op inside the decode is slow), the
+profiler's device timeline is the next tool down.  ``profile_window``
+wraps one measured window — bench's ``--profile DIR`` captures repeat 0
+of a sweep mode — and the capture is viewable in TensorBoard/Perfetto
+and parseable headlessly by :func:`..utils.profiling.top_device_ops`
+(the analysis that located the round-3 decode relayout loop).
+
+Capture is best-effort by design: a backend without profiler support (or
+a capture already in flight) logs one stderr line and the measured run
+proceeds untraced — a perf measurement must never die on its own
+instrumentation.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import sys
+from typing import Dict, Iterator, List, Optional
+
+from .tracer import get_tracer
+
+
+@contextlib.contextmanager
+def profile_window(log_dir: Optional[str], enabled: bool = True) -> Iterator[bool]:
+    """Capture a ``jax.profiler`` trace into ``log_dir`` for the duration.
+
+    Yields True when a capture actually started (False when disabled or
+    the profiler was unavailable).  Start/stop failures degrade to a
+    stderr note instead of failing the profiled run."""
+    if not enabled or not log_dir:
+        yield False
+        return
+    try:
+        import jax
+
+        jax.profiler.start_trace(log_dir)
+        started = True
+    # graftlint: disable=G05 best-effort capture: a profiler that cannot start must not kill the measured run it was decorating
+    except Exception as err:
+        print(f"# obs: jax.profiler capture unavailable ({err}); "
+              f"window runs unprofiled", file=sys.stderr)
+        yield False
+        return
+    try:
+        yield started
+    finally:
+        try:
+            jax.profiler.stop_trace()
+            print(f"# obs: profiler capture written to {log_dir}",
+                  file=sys.stderr)
+        # graftlint: disable=G05 best-effort capture teardown: a stop failure loses the capture, never the measured result
+        except Exception as err:
+            print(f"# obs: jax.profiler stop failed ({err})",
+                  file=sys.stderr)
+
+
+def device_memory_snapshot(tag: str = "") -> List[Dict]:
+    """Per-device memory stats (``bytes_in_use``/``bytes_limit``/
+    ``peak_bytes_in_use`` where the backend reports them), recorded as a
+    zero-duration ``device_memory`` span when tracing is on.  Returns the
+    snapshot list ([] on backends without stats, e.g. CPU)."""
+    out: List[Dict] = []
+    try:
+        import jax
+
+        for d in jax.local_devices():
+            ms = d.memory_stats() or {}
+            if not ms:
+                continue
+            out.append({
+                "device": f"{d.platform}:{d.id}",
+                "bytes_in_use": int(ms.get("bytes_in_use", 0)),
+                "bytes_limit": int(ms.get("bytes_limit", 0)),
+                "peak_bytes_in_use": int(ms.get("peak_bytes_in_use", 0)),
+            })
+    # graftlint: disable=G05 telemetry probe: a backend without memory stats must never fail the run being observed
+    except Exception:
+        return out
+    tracer = get_tracer()
+    if tracer.enabled and out:
+        import time
+
+        now = time.perf_counter()
+        tracer.add_span("device_memory", now, now, tag=tag,
+                        devices=out,
+                        bytes_in_use=sum(d["bytes_in_use"] for d in out))
+    return out

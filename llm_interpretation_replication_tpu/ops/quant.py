@@ -21,6 +21,7 @@ projection matmuls per block (QKV, out, MLP in/out ≈98% of FLOPs) quantize.
 
 from __future__ import annotations
 
+import jax
 import jax.numpy as jnp
 from jax import lax
 
@@ -66,11 +67,20 @@ def quantize_kv(x: jnp.ndarray):
     Returns ``(q_int8, scale_f32)`` with ``scale`` shaped like ``x`` minus
     the head_dim axis, such that ``x ≈ q * scale[..., None]``.  Pairs with
     :func:`dequantize_kv`; the cache stores both
-    (models/decoder.KVCache.k_scale / v_scale)."""
-    absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1, keepdims=True)
-    scale = jnp.maximum(absmax, 1e-8) / 127.0
-    q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale), -127, 127).astype(jnp.int8)
-    return q, jnp.squeeze(scale, axis=-1)
+    (models/decoder.KVCache.k_scale / v_scale).
+
+    The ``jax.named_scope`` marks these (and the dequant below) carry
+    into the lowered HLO's op metadata, so a ``--profile`` capture
+    (obs/profiler.py) attributes the quantize/dequantize cost by name on
+    the device timeline — host spans cannot see inside a jitted
+    program."""
+    with jax.named_scope("kv_quantize"):
+        absmax = jnp.max(jnp.abs(x.astype(jnp.float32)), axis=-1,
+                         keepdims=True)
+        scale = jnp.maximum(absmax, 1e-8) / 127.0
+        q = jnp.clip(jnp.round(x.astype(jnp.float32) / scale),
+                     -127, 127).astype(jnp.int8)
+        return q, jnp.squeeze(scale, axis=-1)
 
 
 def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
@@ -78,7 +88,8 @@ def dequantize_kv(q: jnp.ndarray, scale: jnp.ndarray, dtype=jnp.float32):
 
     The multiply runs in fp32 (scales are fp32) before the final cast so a
     bf16 target dtype rounds the PRODUCT, not the scale."""
-    return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
+    with jax.named_scope("kv_dequantize"):
+        return (q.astype(jnp.float32) * scale[..., None]).astype(dtype)
 
 
 def int8_matmul(x: jnp.ndarray, w_q: jnp.ndarray, w_scale: jnp.ndarray):

@@ -24,6 +24,7 @@ from typing import Callable, Iterable, Iterator, List, Optional, Sequence, Tuple
 import numpy as np
 
 from ..config import DEFAULT_BUCKETS  # single source of truth (stdlib-only module)
+from ..obs import tracer as obs
 from ..utils.telemetry import record_counter
 
 
@@ -262,7 +263,14 @@ class HostPrefetcher:
             for item in items:
                 if self._stop.is_set():
                     return
-                self._put((None, fn(item)))
+                # tokenize/encode work on the background thread: tagged
+                # host_tokenize so the phases block shows how much host
+                # prep ran OVERLAPPED with device time (coverage over
+                # wall-clock can legitimately exceed 1.0 because of it)
+                with obs.span("prefetch", phase="host_tokenize",
+                              background=True):
+                    result = fn(item)
+                self._put((None, result))
         # graftlint: disable=G05 producer-thread relay: the error is stored and re-raised at the consumer's get (classification still sees it there)
         except BaseException as err:
             self._put((err, None))

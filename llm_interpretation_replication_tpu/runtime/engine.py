@@ -25,6 +25,7 @@ import jax.numpy as jnp
 
 from ..models import decoder as dmod
 from ..models import t5 as t5mod
+from ..obs import tracer as obs
 from ..scoring import yes_no as yn
 from ..scoring.confidence import weighted_confidence_digits
 from ..utils.telemetry import record_counter, record_fault
@@ -416,10 +417,23 @@ class ScoringEngine:
 
         with strict.scoring_guard(type(self).__name__):
             while True:
-                batch = retries.popleft() if retries else next(it, None)
+                if retries:
+                    batch = retries.popleft()
+                else:
+                    # batch formation (the bucketing generator's numpy
+                    # work) is host prep the pipeline cannot overlap
+                    with obs.span("next_batch", phase="host_prep"):
+                        batch = next(it, None)
                 if batch is not None:
                     try:
-                        pending.append((batch, launch(batch)))
+                        # dispatch only — JAX launches are async; the
+                        # device time of in-flight work surfaces in the
+                        # consume span's d2h_fetch below
+                        with obs.span("launch", phase="dispatch",
+                                      bucket=int(batch.bucket_len),
+                                      batch=int(batch.token_ids.shape[0])):
+                            out = launch(batch)
+                            pending.append((batch, out))
                     # graftlint: disable=G05 pipeline handler: handle() re-raises via the _oom_rebatch faults classification
                     except Exception as err:
                         handle(batch, err)
@@ -430,7 +444,9 @@ class ScoringEngine:
                     done, out = pending.popleft()
                     try:
                         with strict.sanctioned_fetch():
-                            consume(done, out)
+                            with obs.span("consume", phase="d2h_fetch",
+                                          bucket=int(done.bucket_len)):
+                                consume(done, out)
                     # graftlint: disable=G05 pipeline handler: handle() re-raises via the _oom_rebatch faults classification
                     except Exception as err:
                         handle(done, err)
@@ -493,13 +509,20 @@ class ScoringEngine:
         computed from static shapes, so no host sync happens inside the
         strict-mode transfer guard."""
         chunk = int(self.ecfg.prefill_chunk or 0)
-        if chunk > 0 and cache_len > chunk:
-            last, cache, n_chunks = dmod.chunked_prefill(
-                self.params, self.cfg, ids, mask, chunk)
-            record_counter("prefill_chunks", n_chunks)
-        else:
-            last, cache = dmod.prefill(self.params, self.cfg, ids, mask,
-                                       cache_len=cache_len)
+        chunked = chunk > 0 and cache_len > chunk
+        with obs.span("chunked_prefill" if chunked else "prefill",
+                      phase="prefill", bucket=int(cache_len),
+                      batch=int(ids.shape[0]),
+                      kv_dtype=self.ecfg.kv_dtype) as sp:
+            if chunked:
+                last, cache, n_chunks = dmod.chunked_prefill(
+                    self.params, self.cfg, ids, mask, chunk)
+                record_counter("prefill_chunks", n_chunks)
+            else:
+                last, cache = dmod.prefill(self.params, self.cfg, ids, mask,
+                                           cache_len=cache_len)
+            if sp is not None:
+                sp["_sync_obj"] = last  # device-time attribution (sync mode)
         if cache.k_scale is not None:
             bf16_bytes = 2 * int(cache.k.size + cache.v.size)
             record_counter("kv_cache_bytes_saved",
@@ -596,8 +619,10 @@ class ScoringEngine:
                 f"{len(pairs[0][1])} suffixes")
         if not pairs:
             return [[] for _ in legs]
-        prefix_encoded, suffix_encoded = batching.encode_prefix_pairs(
-            self.tokenizer, pairs)
+        with obs.span("encode_prefix_pairs", phase="host_tokenize",
+                      rows=len(pairs)):
+            prefix_encoded, suffix_encoded = batching.encode_prefix_pairs(
+                self.tokenizer, pairs)
         if self.is_encoder_decoder:
             # T5 has no decoder-side prompt cache to extend (the encoder
             # re-reads the full prompt every leg anyway): score each leg
@@ -676,7 +701,9 @@ class ScoringEngine:
         ecfg = self.ecfg
         ids_all = self._target_id_rows(prompts, targets)   # [N, 2]
         eos_id = getattr(self.tokenizer, "eos_token_id", None)
-        encoded = batching.encode_prompts(self.tokenizer, prompts)
+        with obs.span("encode_prompts", phase="host_tokenize",
+                      prompts=len(prompts)):
+            encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
         steps, gen_total = self._gen_plan(max_new_tokens)
 
@@ -775,38 +802,43 @@ class ScoringEngine:
             prev, done, offset = last, None, 0
             chunk_toks, scores_dev = [], None
             lag_flag = None  # all-done flag of the PREVIOUS chunk
-            while offset < gen_total:
-                n = min(steps, gen_total - offset)
-                ws = offset == 0 and need_scores
-                toks, sc, cache, prev, done = dmod.decode_steps(
-                    self.params, self.cfg, cache, prev, lengths,
-                    np.int32(offset), n, eos_id, done,
-                    with_scores=("reduced" if reduced else True) if ws else False,
-                    target_ids=jnp.asarray(row_ids) if ws and reduced else None,
-                )
-                if ws:
-                    scores_dev = sc
-                chunk_toks.append(toks)
-                offset += n
-                if eos_id is not None and offset < gen_total:
-                    # EOS early exit with a ONE-CHUNK LAG: reading chunk
-                    # k's `done` flag synchronously would leave the device
-                    # idle for a host round-trip before chunk k+1 could
-                    # dispatch.  Instead the flag is reduced on device,
-                    # its host copy starts immediately, and the LOOP EXIT
-                    # decision for chunk k+2 reads chunk k's flag — by
-                    # then chunk k+1 is already queued, so the device
-                    # pipeline never drains.  Cost: at most one surplus
-                    # chunk whose tokens are EOS-frozen (done rows emit
-                    # eos_id, _completion_text cuts at the first EOS), so
-                    # semantics are unchanged.
-                    if lag_flag is not None and bool(np.asarray(lag_flag)):
-                        break  # every row had emitted EOS — generate stops
-                    lag_flag = done.all()
-                    try:
-                        lag_flag.copy_to_host_async()
-                    except AttributeError:
-                        pass  # non-jax array backends: plain fetch later
+            with obs.span("completion_decode", phase="decode",
+                          gen_total=int(gen_total),
+                          bucket=int(batch.bucket_len)) as dsp:
+                while offset < gen_total:
+                    n = min(steps, gen_total - offset)
+                    ws = offset == 0 and need_scores
+                    toks, sc, cache, prev, done = dmod.decode_steps(
+                        self.params, self.cfg, cache, prev, lengths,
+                        np.int32(offset), n, eos_id, done,
+                        with_scores=("reduced" if reduced else True) if ws else False,
+                        target_ids=jnp.asarray(row_ids) if ws and reduced else None,
+                    )
+                    if ws:
+                        scores_dev = sc
+                    chunk_toks.append(toks)
+                    offset += n
+                    if eos_id is not None and offset < gen_total:
+                        # EOS early exit with a ONE-CHUNK LAG: reading chunk
+                        # k's `done` flag synchronously would leave the device
+                        # idle for a host round-trip before chunk k+1 could
+                        # dispatch.  Instead the flag is reduced on device,
+                        # its host copy starts immediately, and the LOOP EXIT
+                        # decision for chunk k+2 reads chunk k's flag — by
+                        # then chunk k+1 is already queued, so the device
+                        # pipeline never drains.  Cost: at most one surplus
+                        # chunk whose tokens are EOS-frozen (done rows emit
+                        # eos_id, _completion_text cuts at the first EOS), so
+                        # semantics are unchanged.
+                        if lag_flag is not None and bool(np.asarray(lag_flag)):
+                            break  # every row emitted EOS — generate stops
+                        lag_flag = done.all()
+                        try:
+                            lag_flag.copy_to_host_async()
+                        except AttributeError:
+                            pass  # non-jax array backends: plain fetch later
+                if dsp is not None:
+                    dsp["_sync_obj"] = chunk_toks[-1]
             tokens_np = np.concatenate(
                 [np.asarray(t) for t in chunk_toks], axis=1
             )
@@ -932,16 +964,21 @@ class ScoringEngine:
                 row_ids = self._batch_target_rows(ids_all, batch)
                 leg_outs = []
                 for li in range(len(legs)):
-                    sids, smask = _suffix_batch(batch, li)
-                    last, cache, lengths = dmod.extend_prefill(
-                        self.params, self.cfg, pcache, self._put(sids),
-                        self._put(smask), plen)
-                    scan0 = yn.first_token_scan(
-                        last, row_ids[:, 0], row_ids[:, 1],
-                        top_k=ecfg.top_k)
-                    first3 = yn.relative_prob_first_token(
-                        last, row_ids[:, 0], row_ids[:, 1],
-                        ecfg.first_token_top_filter)
+                    with obs.span("extend_prefill", phase="extend_prefill",
+                                  leg=legs[li].name or f"leg{li}",
+                                  bucket=int(batch.bucket_len)) as sp:
+                        sids, smask = _suffix_batch(batch, li)
+                        last, cache, lengths = dmod.extend_prefill(
+                            self.params, self.cfg, pcache, self._put(sids),
+                            self._put(smask), plen)
+                        scan0 = yn.first_token_scan(
+                            last, row_ids[:, 0], row_ids[:, 1],
+                            top_k=ecfg.top_k)
+                        first3 = yn.relative_prob_first_token(
+                            last, row_ids[:, 0], row_ids[:, 1],
+                            ecfg.first_token_top_filter)
+                        if sp is not None:
+                            sp["_sync_obj"] = last
                     leg_outs.append((last, cache, lengths, scan0, first3))
                     if li:  # every leg past the first rides the warm cache
                         pool.hit(n_real)
@@ -959,11 +996,17 @@ class ScoringEngine:
             entry, leg_outs = out
             try:
                 for li in range(len(legs)):
-                    self._consume_scored_batch(
-                        batch, leg_outs[li], ids_all, results[li],
-                        legs[li].with_confidence, plans[li].scan_steps,
-                        plans[li].total_new_tokens, decode_flags[li],
-                        eos_id)
+                    # one d2h_fetch span per LEG so the phases block
+                    # separates where the binary vs confidence fetch
+                    # time goes; nested decode spans inherit the leg
+                    with obs.span("consume_leg", phase="d2h_fetch",
+                                  leg=legs[li].name or f"leg{li}",
+                                  bucket=int(batch.bucket_len)):
+                        self._consume_scored_batch(
+                            batch, leg_outs[li], ids_all, results[li],
+                            legs[li].with_confidence, plans[li].scan_steps,
+                            plans[li].total_new_tokens, decode_flags[li],
+                            eos_id)
             finally:
                 # release exactly once whether the legs consumed clean or
                 # an OOM sends the batch back through the re-bucket ladder
@@ -1230,6 +1273,18 @@ class ScoringEngine:
                 jnp.concatenate([getattr(p, f) for p in parts], axis=1)
                 for f in dmod.ReducedScores._fields))
 
+        with obs.span("scan_decode", phase="decode", steps=int(steps),
+                      rows=int(last_s.shape[0])):
+            return self._scan_decode_loop(
+                sub_cache, last_s, len_s, steps, eos_id, min_steps,
+                real_mask, chunk, reduced, target_ids, cat, yes_id, no_id)
+
+    def _scan_decode_loop(self, sub_cache, last_s, len_s, steps, eos_id,
+                          min_steps, real_mask, chunk, reduced, target_ids,
+                          cat, yes_id, no_id):
+        """Body of :meth:`_scan_decode_chunked` (split so the decode span
+        wraps the whole chunked loop without re-indenting it)."""
+        ecfg = self.ecfg
         sc_parts, tok_parts = [], []
         cur_cache, prev, done = sub_cache, last_s, None
         offset = 0
@@ -1280,7 +1335,9 @@ class ScoringEngine:
         ecfg = self.ecfg
         ids_all = self._target_id_rows(prompts, targets)
         eos_id = getattr(self.tokenizer, "eos_token_id", None)
-        encoded = batching.encode_prompts(self.tokenizer, prompts)
+        with obs.span("encode_prompts", phase="host_tokenize",
+                      prompts=len(prompts)):
+            encoded = batching.encode_prompts(self.tokenizer, prompts)
         results: List[Optional[Dict]] = [None] * len(prompts)
         steps, gen_total = self._gen_plan(max_new_tokens)
 
@@ -1350,7 +1407,9 @@ class ScoringEngine:
         perturbation-sweep hot op.  Returns [N, 3] (yes, no, relative).
         ``targets`` may be per-prompt pairs (see ``_target_id_rows``)."""
         ids_all = self._target_id_rows(prompts, targets)
-        encoded = batching.encode_prompts(self.tokenizer, prompts)
+        with obs.span("encode_prompts", phase="host_tokenize",
+                      prompts=len(prompts)):
+            encoded = batching.encode_prompts(self.tokenizer, prompts)
         out = np.zeros((len(prompts), 3), np.float64)
 
         def launch(batch):
@@ -1594,14 +1653,19 @@ class _Phase2Pool:
         # fp32 tensor (~1.3 GB at the 512-row menu cap) that used to live
         # between the decode and the reduction programs.
         reduced = ecfg.top_k <= dmod.REDUCED_TOPK
-        toks, sc, _, _, _ = dmod.decode_steps(
-            self.engine.params, self.engine.cfg, cache, last, lens,
-            np.int32(0), self.steps, self.eos_id, None,
-            with_scores="reduced" if reduced else True,
-            target_ids=jnp.asarray(ids) if reduced else None,
-        )
-        res = self.engine._scan_results(sc, ids[:, 0], ids[:, 1], toks,
-                                        self.eos_id)
+        with obs.span("pool_flush", phase="pooled_decode",
+                      rows=int(total), padded=int(m),
+                      bucket=int(bucket_len)) as sp:
+            toks, sc, _, _, _ = dmod.decode_steps(
+                self.engine.params, self.engine.cfg, cache, last, lens,
+                np.int32(0), self.steps, self.eos_id, None,
+                with_scores="reduced" if reduced else True,
+                target_ids=jnp.asarray(ids) if reduced else None,
+            )
+            res = self.engine._scan_results(sc, ids[:, 0], ids[:, 1], toks,
+                                            self.eos_id)
+            if sp is not None:
+                sp["_sync_obj"] = toks
         fields = res._asdict()
         for v in fields.values():
             try:
@@ -1639,7 +1703,9 @@ class _Phase2Pool:
     def drain(self):
         """Resolve every dispatched flush into result rows (host fetches)."""
         for layout, fields, first3, _fb in self.deferred:
-            res_np = {k: np.asarray(v) for k, v in fields.items()}
+            with obs.span("pool_drain", phase="d2h_fetch",
+                          flushes=len(self.deferred)):
+                res_np = {k: np.asarray(v) for k, v in fields.items()}
             row = 0
             for rows, n_real, orig in layout:
                 for j in range(n_real):

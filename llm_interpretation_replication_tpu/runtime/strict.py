@@ -43,7 +43,12 @@ import logging
 import os
 from typing import Dict, Iterator, List, Optional
 
-from ..utils.telemetry import counter, record_counter, record_fault
+from ..utils.telemetry import (
+    counter,
+    record_counter,
+    record_fault,
+    sample_ring_report,
+)
 
 STRICT_ENV = "LLM_INTERP_STRICT"
 
@@ -222,9 +227,20 @@ def device_region(label: str = "") -> Iterator[None]:
 
 
 def strict_report() -> Dict:
-    """Snapshot for bench JSON / operator audit."""
-    return {
+    """Snapshot for bench JSON / operator audit.
+
+    ``samples`` carries the sample rings' truncation visibility
+    (``{ring: {total, retained, cap}}`` — utils/telemetry
+    .sample_ring_report): a ring whose ``total`` exceeds ``retained``
+    was truncated, so any percentile computed from it is a tail
+    statistic of the last ``retained`` samples, not a whole-run
+    number."""
+    report = {
         "enabled": _ACTIVE,
         RECOMPILE_COUNTER: int(counter(RECOMPILE_COUNTER)),
         BLOCKED_COUNTER: int(counter(BLOCKED_COUNTER)),
     }
+    samples = sample_ring_report()
+    if samples:
+        report["samples"] = samples
+    return report

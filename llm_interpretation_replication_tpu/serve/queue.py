@@ -38,6 +38,10 @@ class Ticket:
     encoded: Any = None             # token ids (or (prefix_ids, suffix_ids))
     key: Any = None                 # coalescer compatibility key
     degraded: Optional[int] = None  # engine batch override after OOM splits
+    trace_id: Optional[str] = None  # obs/ request-scoped span correlation
+                                    # id (set at submit when tracing is on;
+                                    # threads queue-wait/engine/respond
+                                    # spans and the result row together)
 
     def sort_key(self) -> Tuple[int, int]:
         return (-self.request.priority, self.seq)

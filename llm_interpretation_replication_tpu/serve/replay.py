@@ -21,6 +21,7 @@ from ..utils.telemetry import (
     counters,
     counters_since,
     sample_percentiles,
+    sample_ring_report,
     sample_total,
 )
 from .config import SchedulerConfig
@@ -36,7 +37,12 @@ def _values_equal(a, b) -> bool:
 
 def rows_equal(a: Dict, b: Dict) -> bool:
     """Row-level parity: same keys, same values (NaN == NaN so error rows
-    compare equal to themselves)."""
+    compare equal to themselves).  ``trace_id`` is measurement-only
+    decoration the scheduler attaches when span tracing is armed (obs/) —
+    it is ignored so a traced serve run keeps the same parity contract
+    as an untraced one."""
+    a = {k: v for k, v in a.items() if k != "trace_id"}
+    b = {k: v for k, v in b.items() if k != "trace_id"}
     return (set(a) == set(b)
             and all(_values_equal(a[k], b[k]) for k in a))
 
@@ -126,6 +132,12 @@ def replay(engine, prompts: Sequence, targets=("Yes", "No"),
         "latency_ms": sample_percentiles(
             "serve_latency_ms",
             last=sample_total("serve_latency_ms") - lat_total0),
+        # truncation visibility: when a ring's total exceeds retained,
+        # the bounded ring dropped history and the percentiles above are
+        # tail statistics (utils/telemetry sample-ring semantics)
+        "samples": sample_ring_report(
+            ["serve_queue_wait_ms", "serve_latency_ms",
+             "serve_queue_depth"]),
     }
     if mismatched and require_parity:
         i = mismatched[0]

@@ -44,6 +44,7 @@ import threading
 import time
 from typing import List, Optional
 
+from ..obs import tracer as obs
 from ..runtime import faults
 from ..runtime.engine import LegSpec
 from ..utils.telemetry import record_counter, record_fault, record_sample
@@ -152,6 +153,11 @@ class Scheduler:
             request=request, future=future, seq=seq, enqueue_t=now,
             deadline=None if timeout_s is None else now + timeout_s,
             encoded=coalescer.encode_request(self.engine, request),
+            # request-scoped span correlation: the same id tags this
+            # request's queue-wait span, its micro-batch's engine span,
+            # and (tracing only) a trace_id field on the result row, so
+            # one JSONL answer line joins back to its spans
+            trace_id=f"sv-{seq}" if obs.enabled() else None,
         )
         ticket.key = coalescer.compat_key(self.engine, request,
                                           ticket.encoded)
@@ -171,8 +177,20 @@ class Scheduler:
 
     def _loop(self) -> None:
         while True:
+            t_pop = time.monotonic()
             group, expired = self.queue.pop_group(
                 self._max_batch(), self.config.max_wait_s)
+            if group and obs.enabled():
+                # the admission window: how long the loop held the head
+                # request open for co-batchable traffic (max-wait
+                # policy).  The hold starts when there was both a loop
+                # waiting AND a request to hold — max(pop start, first
+                # enqueue) — NOT at pop start, which on an idle server
+                # would misattribute the whole idle wait as coalescing
+                start = max(t_pop, min(t.enqueue_t for t in group))
+                obs.add_span("coalesce", start, time.monotonic(),
+                             phase="serve_coalesce", batch=len(group),
+                             trace_id=group[0].trace_id)
             for t in expired:
                 record_counter("serve_rejected_deadline")
                 self._reject(t, DeadlineExceeded(
@@ -211,6 +229,11 @@ class Scheduler:
         for t in group:
             record_sample("serve_queue_wait_ms",
                           (now - t.enqueue_t) * 1000.0)
+            if t.trace_id is not None and obs.enabled():
+                # cross-thread span: enqueue happened on the submitting
+                # thread, the pop on this loop thread — manually timed
+                obs.add_span("queue_wait", t.enqueue_t, now,
+                             phase="serve_queue_wait", trace_id=t.trace_id)
         first = group[0].request
         pair_list = [tuple(t.request.targets) for t in group]
         targets = (list(first.targets) if len(set(pair_list)) == 1
@@ -241,8 +264,11 @@ class Scheduler:
 
         try:
             with self._engine_overrides(group):
-                rows = faults.retry_transient(
-                    call, self.config.retry_policy, label="serve")()
+                with obs.span("serve_engine", phase="serve_engine",
+                              batch=len(group),
+                              trace_id=group[0].trace_id):
+                    rows = faults.retry_transient(
+                        call, self.config.retry_policy, label="serve")()
         # graftlint: disable=G05 serve fault boundary: the error IS classified (faults.is_oom routes to the split/re-queue ladder) and everything else lands typed on each request's future — nothing above the scheduler thread could observe a re-raise
         except Exception as err:
             if faults.is_oom(err) and self._split_requeue(group, err):
@@ -254,8 +280,18 @@ class Scheduler:
         done = time.monotonic()
         for t, row in zip(group, rows):
             record_sample("serve_latency_ms", (done - t.enqueue_t) * 1000.0)
+            if t.trace_id is not None:
+                # measurement-only: the trace id rides the answer row so
+                # a JSONL output line joins back to its spans; replay
+                # parity ignores the key (serve/replay.rows_equal)
+                row = dict(row)
+                row["trace_id"] = t.trace_id
             t.future._set_result(row)
         record_counter("serve_completed", len(group))
+        if obs.enabled():
+            obs.add_span("respond", done, time.monotonic(),
+                         phase="serve_respond", batch=len(group),
+                         trace_id=group[0].trace_id)
 
     def _split_requeue(self, group: List[Ticket], err) -> bool:
         """OOM recovery: split the micro-batch down the PR-1 ladder and

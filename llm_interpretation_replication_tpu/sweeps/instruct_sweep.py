@@ -9,6 +9,7 @@ checkpointing and the same CSV contracts.
 from __future__ import annotations
 
 import os
+import time
 from typing import Callable, Dict, Optional, Sequence
 
 import pandas as pd
@@ -80,6 +81,8 @@ def run_instruct_sweep(
     # Preemption safety: SIGTERM/SIGINT saves the completed models'
     # checkpoint before exit, so the resumed sweep loses at most the
     # in-flight model (outputs only gains a key once a model finishes).
+    sweep_t0 = time.perf_counter()
+    scored = 0
     with faults.PreemptionGuard(
             lambda: ck.save({"outputs": outputs, "prompts": fp}),
             label="instruct_sweep"):
@@ -93,6 +96,15 @@ def run_instruct_sweep(
                 engine, model_name, prompts, is_base=False,
                 retry_policy=retry_policy)
             ck.save({"outputs": outputs, "prompts": fp})
+            # heartbeat (obs/): progress, achieved rate, ETA — the
+            # perturbation shell's per-chunk line, at model granularity
+            scored += 1
+            remaining = sum(1 for m in models if m not in outputs)
+            elapsed = time.perf_counter() - sweep_t0
+            rate = scored * len(prompts) / elapsed if elapsed > 0 else 0.0
+            eta = (remaining * len(prompts) / rate) if rate > 0 else 0.0
+            log(f"[heartbeat] {len(outputs)}/{len(models)} models "
+                f"| {rate:.2f} rows/s | ETA {eta:.0f}s")
     df = instruct_comparison_frame(outputs, models)
     os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
     df.to_csv(results_csv, index=False)

@@ -18,11 +18,13 @@ from __future__ import annotations
 import contextlib
 import json
 import os
+import time
 from typing import Dict, List, Optional, Sequence, Tuple
 
 import numpy as np
 import pandas as pd
 
+from ..obs import tracer as obs
 from ..runtime import faults
 from ..scoring.confidence import extract_first_int
 from ..utils.checkpoint import append_jsonl
@@ -149,16 +151,18 @@ def run_model_perturbation_sweep(
             return
         in_flush = True
         try:
-            if pending:
-                append_jsonl(sidelog, pending)
-                all_rows.extend(pending)
-                pending = []
-            if final:
-                write_xlsx(pd.DataFrame(all_rows,
-                                        columns=PERTURBATION_COLUMNS),
-                           output_xlsx)
-                if os.path.exists(sidelog):
-                    os.remove(sidelog)
+            with obs.span("checkpoint_flush", phase="host_write",
+                          rows=len(pending), final=final):
+                if pending:
+                    append_jsonl(sidelog, pending)
+                    all_rows.extend(pending)
+                    pending = []
+                if final:
+                    write_xlsx(pd.DataFrame(all_rows,
+                                            columns=PERTURBATION_COLUMNS),
+                               output_xlsx)
+                    if os.path.exists(sidelog):
+                        os.remove(sidelog)
         finally:
             in_flush = False
 
@@ -309,6 +313,8 @@ def run_model_perturbation_sweep(
     from ..utils.telemetry import counters_since as _counters_since
 
     counters_snap = _counters()
+    sweep_t0 = time.perf_counter()
+    done_rows, total_rows = 0, len(todo_items)
     with faults.PreemptionGuard(flush, label="perturbation"), \
             _closing(prefetcher):
         # _closing: a mid-sweep error (device OOM bubbling to the caller's
@@ -364,27 +370,38 @@ def run_model_perturbation_sweep(
                     conf_values[i] = extract_first_int(row["completion"])
                     weighted[i] = row.get("weighted_confidence")
 
-            for i, (scenario, reph) in enumerate(chunk):
-                t1p, t2p = float(probs[i, 0]), float(probs[i, 1])
-                odds = t1p / t2p if t2p > 0 else float("inf")
-                pending.append(
-                    perturbation_row(
-                        model_name,
-                        scenario,
-                        reph,
-                        response_text=responses[i]["completion"],
-                        confidence_text=conf_texts[i],
-                        logprobs_repr=f"local:first_token_top{TOP_LOGPROBS}",
-                        token_1_prob=t1p,
-                        token_2_prob=t2p,
-                        odds_ratio=odds,
-                        confidence_value=conf_values[i],
-                        weighted_confidence=weighted[i],
+            with obs.span("build_rows", phase="host_rows",
+                          rows=len(chunk)):
+                for i, (scenario, reph) in enumerate(chunk):
+                    t1p, t2p = float(probs[i, 0]), float(probs[i, 1])
+                    odds = t1p / t2p if t2p > 0 else float("inf")
+                    pending.append(
+                        perturbation_row(
+                            model_name,
+                            scenario,
+                            reph,
+                            response_text=responses[i]["completion"],
+                            confidence_text=conf_texts[i],
+                            logprobs_repr=f"local:first_token_top{TOP_LOGPROBS}",
+                            token_1_prob=t1p,
+                            token_2_prob=t2p,
+                            odds_ratio=odds,
+                            confidence_value=conf_values[i],
+                            weighted_confidence=weighted[i],
+                        )
                     )
-                )
-                processed.add((model_name, scenario["original_main"], reph))
-                if len(pending) >= checkpoint_every:
-                    flush()
+                    processed.add((model_name, scenario["original_main"],
+                                   reph))
+                    if len(pending) >= checkpoint_every:
+                        flush()
+            # heartbeat: progress, achieved rate, and ETA per chunk — a
+            # multi-hour sweep is observable from its log stream alone
+            done_rows += len(chunk)
+            elapsed = time.perf_counter() - sweep_t0
+            rate = done_rows / elapsed if elapsed > 0 else 0.0
+            eta = (total_rows - done_rows) / rate if rate > 0 else 0.0
+            log(f"[heartbeat] {model_name}: {done_rows}/{total_rows} rows "
+                f"| {rate:.2f} rows/s | ETA {eta:.0f}s")
         flush(final=True)
     delta = _counters_since(counters_snap)
     if delta.get("kv_cache_bytes_saved") or delta.get("prefill_chunks"):

@@ -93,6 +93,11 @@ def clear_fault_events() -> None:
 # DISTRIBUTIONS go through the bounded sample rings below
 # (``serve_queue_depth``, ``serve_queue_wait_ms``, ``serve_latency_ms``)
 # so percentiles are reportable without unbounded growth.
+#
+# Counters answer "how many / how much"; WHERE THE TIME GOES is the obs/
+# span tracer's job (phase-tagged spans: host_tokenize, host_prep,
+# dispatch, prefill, extend_prefill, decode, pooled_decode, d2h_fetch,
+# host_rows, host_write, serve_* — README "Span / phase names").
 # ---------------------------------------------------------------------------
 
 _COUNTERS: Dict[str, float] = {}
@@ -131,9 +136,20 @@ def counters_since(snapshot: Dict[str, float]) -> Dict[str, float]:
     run, and diff — ``clear_counters`` would destroy concurrent readers'
     baselines.  Counters absent from ``snapshot`` count from 0; counters
     that only exist in ``snapshot`` are omitted (monotones cannot have
-    shrunk)."""
+    shrunk).
+
+    Robust to a :func:`clear_counters` between snapshot and read: a
+    counter whose current value sits BELOW its snapshot was necessarily
+    cleared and restarted, and reports its current value — never a
+    negative number a report would subtract throughput with.  A clear
+    the values cannot reveal (the counter re-accumulated PAST its
+    snapshot) still reports the ordinary difference, so after a
+    mid-window clear every delta is a LOWER bound on what was actually
+    recorded; callers that clear mid-measurement get honest-but-
+    conservative numbers, not corrupt ones."""
     now = counters()
-    return {name: value - snapshot.get(name, 0)
+    return {name: (value - snapshot.get(name, 0)
+                   if value >= snapshot.get(name, 0) else value)
             for name, value in now.items()
             if value != snapshot.get(name, 0)}
 
@@ -143,25 +159,66 @@ def counters_since(snapshot: Dict[str, float]) -> Dict[str, float]:
 #
 # Counters are monotones; distributions (how long did a request WAIT, how
 # deep was the queue WHEN it launched) need samples.  Each named ring keeps
-# the most recent _SAMPLES_CAP values — enough for stable p50/p90/p99 over
-# a serving window, bounded so a week-long server never grows host memory.
+# the most recent ``cap`` values (default _SAMPLES_CAP_DEFAULT,
+# configurable per ring via :func:`set_sample_cap`) — enough for stable
+# p50/p90/p99 over a serving window, bounded so a week-long server never
+# grows host memory.
+#
+# TRUNCATION SEMANTICS (the silent-window footgun, fixed): a ring holds
+# only its most recent ``cap`` samples, so a percentile over a run longer
+# than the cap reflects ONLY THE TAIL — p99 of the last 4096 requests,
+# not of the whole sweep.  Reports must therefore carry ``sample_total``
+# (ever recorded) next to ``sample_count`` (retained); when total >
+# retained the window was truncated and the percentile is a tail
+# statistic.  :func:`sample_ring_report` packages exactly that, and the
+# serve replay / strict reports embed it.  Callers that need whole-run
+# percentiles raise the cap up front (``set_sample_cap``).
 # ---------------------------------------------------------------------------
 
 _SAMPLES: Dict[str, List[float]] = {}
 _SAMPLE_TOTALS: Dict[str, int] = {}   # ever-recorded count per ring, so a
                                       # phase can be measured as "the last
                                       # (total_now - total_then) samples"
-_SAMPLES_CAP = 4096
+_SAMPLES_CAP_DEFAULT = 4096
+_SAMPLE_CAPS: Dict[str, int] = {}     # per-ring overrides (set_sample_cap)
+_SAMPLES_CAP = _SAMPLES_CAP_DEFAULT   # back-compat alias (default cap)
+
+
+def set_sample_cap(cap: int, name: Optional[str] = None) -> None:
+    """Configure ring capacity — for ``name`` only, or the default for
+    every ring without an override (``name=None``).  Raising a cap takes
+    effect on the next record; lowering one trims the ring immediately.
+    A long benchmark that wants whole-run percentiles sets this before
+    recording; the bound exists so a week-long server cannot grow host
+    memory, not to hide history from reports."""
+    global _SAMPLES_CAP
+    cap = max(1, int(cap))
+    with _COUNTERS_LOCK:
+        if name is None:
+            _SAMPLES_CAP = cap
+        else:
+            _SAMPLE_CAPS[name] = cap
+            ring = _SAMPLES.get(name)
+            if ring is not None and len(ring) > cap:
+                del ring[: len(ring) - cap]
+
+
+def sample_cap(name: str) -> int:
+    """Effective capacity of the named ring."""
+    with _COUNTERS_LOCK:
+        return _SAMPLE_CAPS.get(name, _SAMPLES_CAP)
 
 
 def record_sample(name: str, value: float) -> None:
-    """Append one observation to the named bounded sample ring."""
+    """Append one observation to the named bounded sample ring (capacity
+    semantics: module docstring above / :func:`set_sample_cap`)."""
     with _COUNTERS_LOCK:
         ring = _SAMPLES.setdefault(name, [])
         ring.append(float(value))
         _SAMPLE_TOTALS[name] = _SAMPLE_TOTALS.get(name, 0) + 1
-        if len(ring) > _SAMPLES_CAP:
-            del ring[: len(ring) - _SAMPLES_CAP]
+        cap = _SAMPLE_CAPS.get(name, _SAMPLES_CAP)
+        if len(ring) > cap:
+            del ring[: len(ring) - cap]
 
 
 def sample_count(name: str) -> int:
@@ -198,6 +255,27 @@ def sample_percentiles(name: str, pcts: tuple = (50.0, 90.0, 99.0),
                           int(round(p / 100.0 * (len(values) - 1)))))
         out[f"p{p:g}"] = values[rank]
     return out
+
+
+def sample_ring_report(names: Optional[List[str]] = None) -> Dict[str, Dict]:
+    """Truncation-visibility report: ``{ring: {total, retained, cap}}``.
+
+    ``total`` is every sample EVER recorded; ``retained`` is what the
+    bounded ring still holds (what percentiles are computed over).  When
+    ``total > retained`` the window was truncated and any percentile is
+    a TAIL statistic — reports embed this block so a p99 can never
+    silently masquerade as a whole-run number.  ``names=None`` reports
+    every ring that has recorded at least one sample."""
+    with _COUNTERS_LOCK:
+        keys = list(_SAMPLE_TOTALS) if names is None else list(names)
+        return {
+            name: {
+                "total": _SAMPLE_TOTALS.get(name, 0),
+                "retained": len(_SAMPLES.get(name, ())),
+                "cap": _SAMPLE_CAPS.get(name, _SAMPLES_CAP),
+            }
+            for name in keys if _SAMPLE_TOTALS.get(name, 0)
+        }
 
 
 def clear_samples() -> None:

@@ -584,6 +584,51 @@ class TestRepoGate:
         assert not [e for e in entries if e.get("path", "").startswith(
             "llm_interpretation_replication_tpu/serve/")]
 
+    def test_default_paths_cover_obs_package(self):
+        """obs/ lives inside the scanned package dir, so the repo gate
+        lints it on every run — asserted via the gate's own file walker
+        (the serve/ gate's pattern)."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            iter_python_files,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        assert os.path.isdir(os.path.join(pkg, "obs"))
+        scanned = [f.replace(os.sep, "/") for f in iter_python_files([pkg])]
+        assert any("/obs/tracer.py" in f for f in scanned)
+        assert any("/obs/report.py" in f for f in scanned)
+        assert any("/obs/profiler.py" in f for f in scanned)
+
+    def test_obs_package_lint_clean_without_baseline(self):
+        """Satellite (ISSUE 6): obs/ ships lint-clean from day one — zero
+        findings even with NO baseline (G01-G05; its best-effort catches
+        carry disable annotations), and no lint_baseline.json entry
+        grandfathers anything under obs/."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        assert lint_paths([os.path.join(pkg, "obs")]) == []
+        entries = load_baseline(default_baseline_path())
+        assert not [e for e in entries if e.get("path", "").startswith(
+            "llm_interpretation_replication_tpu/obs/")]
+
+    def test_obs_is_in_g05_fault_scope(self):
+        """obs/ spans wrap the engine's launch/consume callbacks, so a
+        broad except that swallows there hides a device error inside the
+        instrumentation — G05 applies (the teeth behind the gate above)."""
+        findings = run("obs/tracer.py", """
+            def close_span(rec):
+                try:
+                    rec.close()
+                except Exception:
+                    pass
+        """)
+        assert rules_of(findings) == ["G05"]
+
     def test_kvcache_touched_modules_carry_no_baseline_entries(self):
         """Satellite (ISSUE 5): the int8-KV-cache / chunked-prefill change
         ships lint-clean — zero new ``lint_baseline.json`` entries for the
@@ -718,8 +763,13 @@ class TestStrictMode:
         strict.activate(sentry=False)
         rep = strict.strict_report()
         assert rep["enabled"] is True
-        assert set(rep) == {"enabled", strict.RECOMPILE_COUNTER,
-                            strict.BLOCKED_COUNTER}
+        # "samples" is the optional ring-truncation visibility block
+        # (ISSUE-6 satellite): present only when sample rings recorded
+        assert set(rep) - {"samples"} == {
+            "enabled", strict.RECOMPILE_COUNTER, strict.BLOCKED_COUNTER}
+        for ring in rep.get("samples", {}).values():
+            assert set(ring) == {"total", "retained", "cap"}
+            assert ring["total"] >= ring["retained"]
 
 
 class TestStrictFusedSweep:

@@ -1,0 +1,406 @@
+"""Observability layer (obs/): span tracer semantics, export formats,
+engine phase coverage, serve trace-id parity, strict-mode tracing, and
+the overhead contract.
+
+Tier-1 (``-m obs``).  The tracer is a process-global singleton, so every
+test runs against a reset tracer (autouse fixture) and leaves it
+disabled."""
+
+import json
+import threading
+import time
+
+import pytest
+
+from llm_interpretation_replication_tpu import obs
+from llm_interpretation_replication_tpu.obs.report import (
+    aggregate_spans,
+    format_phase_table,
+    load_spans,
+    phases_block,
+)
+from llm_interpretation_replication_tpu.obs.report import main as obs_report_main
+from llm_interpretation_replication_tpu.utils import telemetry
+
+from test_runtime import _tiny_engine
+
+pytestmark = pytest.mark.obs
+
+
+@pytest.fixture(autouse=True)
+def _fresh_tracer():
+    obs.disable()
+    obs.get_tracer().reset()
+    yield
+    obs.disable()
+    obs.get_tracer().reset()
+
+
+class TestSpanTracer:
+    def test_nested_phase_self_time_never_double_counts(self):
+        """A phase span nested inside another phase span subtracts from
+        the parent's SELF time; a structural (phase=None) span is
+        transparent — its phase-covered time passes through to the
+        nearest phase-tagged ancestor."""
+        obs.enable()
+        with obs.span("consume", phase="d2h_fetch"):
+            time.sleep(0.02)
+            with obs.span("leg", leg="binary"):       # structural
+                with obs.span("dec", phase="decode"):
+                    time.sleep(0.03)
+        totals = obs.phase_totals(by_leg=True)
+        assert 0.025 <= totals["decode"]["binary"] <= 0.09
+        # the fetch span's self time excludes the nested decode
+        assert 0.015 <= totals["d2h_fetch"][""] <= 0.05
+        flat = obs.phase_totals()
+        assert set(flat) == {"decode", "d2h_fetch"}
+        # the partition property: phases sum to the outer span's duration
+        outer = [s for s in obs.get_tracer().spans()
+                 if s["name"] == "consume"][0]
+        assert abs(sum(flat.values()) - outer["dur"]) < 0.01
+
+    def test_leg_and_trace_id_inherit_from_enclosing_span(self):
+        obs.enable()
+        with obs.span("outer", leg="confidence", trace_id="sv-7"):
+            with obs.span("inner", phase="decode"):
+                pass
+        inner = [s for s in obs.get_tracer().spans()
+                 if s["name"] == "inner"][0]
+        assert inner["leg"] == "confidence"
+        assert inner["trace_id"] == "sv-7"
+        assert obs.phase_totals(by_leg=True)["decode"].keys() == {
+            "confidence"}
+
+    def test_thread_safety_and_per_thread_nesting(self):
+        obs.enable()
+        n_threads, n_each = 8, 50
+        barrier = threading.Barrier(n_threads)
+
+        def hammer():
+            barrier.wait()
+            for _ in range(n_each):
+                with obs.span("outer", phase="a"):
+                    with obs.span("inner", phase="b"):
+                        pass
+
+        threads = [threading.Thread(target=hammer) for _ in range(n_threads)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join()
+        spans = obs.get_tracer().spans()
+        assert len(spans) == n_threads * n_each * 2
+        ids = [s["id"] for s in spans]
+        assert len(set(ids)) == len(ids)          # allocation is atomic
+        # nesting never crossed threads: every inner's parent is an outer
+        by_id = {s["id"]: s for s in spans}
+        for s in spans:
+            if s["name"] == "inner":
+                parent = by_id[s["parent"]]
+                assert parent["name"] == "outer"
+                assert parent["tid"] == s["tid"]
+
+    def test_phase_totals_since_scopes_to_a_window(self):
+        obs.enable()
+        with obs.span("warmup", phase="prefill"):
+            time.sleep(0.01)
+        snap = obs.phase_snapshot()
+        with obs.span("measured", phase="prefill"):
+            time.sleep(0.02)
+        delta = obs.phase_totals_since(snap)
+        assert 0.015 <= delta["prefill"] <= 0.06
+        assert obs.phase_totals()["prefill"] > delta["prefill"]
+
+    def test_chrome_export_is_perfetto_loadable_json(self, tmp_path):
+        obs.enable()
+        with obs.span("work", phase="decode", leg="binary", bucket=64):
+            time.sleep(0.005)
+        path = obs.export_chrome(str(tmp_path / "trace.json"))
+        with open(path) as f:
+            doc = json.load(f)
+        events = doc["traceEvents"]
+        assert len(events) == 1
+        ev = events[0]
+        assert ev["ph"] == "X" and ev["name"] == "work"
+        assert ev["cat"] == "decode"
+        assert ev["dur"] >= 4000          # microseconds
+        assert ev["args"]["leg"] == "binary"
+        assert ev["args"]["bucket"] == 64
+        assert isinstance(ev["ts"], (int, float))
+        assert isinstance(ev["pid"], int) and isinstance(ev["tid"], int)
+
+    def test_jsonl_span_log_streams_valid_lines(self, tmp_path):
+        log = tmp_path / "spans.jsonl"
+        obs.enable(jsonl_path=str(log))
+        with obs.span("a", phase="prefill"):
+            with obs.span("b", phase="decode"):
+                pass
+        obs.disable()
+        lines = [json.loads(line) for line in
+                 log.read_text().strip().splitlines()]
+        assert [s["name"] for s in lines] == ["b", "a"]  # close order
+        for s in lines:
+            assert {"name", "phase", "t0", "t1", "dur", "self",
+                    "tid", "id"} <= set(s)
+
+    def test_jsonl_log_truncates_per_session_and_survives_torn_tail(
+            self, tmp_path, capsys):
+        """Review fixes: (a) a second session on the same path must not
+        append onto the first's spans (doubled totals in obs report);
+        (b) a torn trailing line (hard-killed run) is skipped with a
+        note, not a fatal parse error."""
+        log = tmp_path / "s.jsonl"
+        obs.enable(jsonl_path=str(log))
+        with obs.span("first", phase="prefill"):
+            pass
+        obs.disable()
+        obs.get_tracer().reset()
+        obs.enable(jsonl_path=str(log))          # fresh session, same path
+        with obs.span("second", phase="prefill"):
+            pass
+        obs.disable()
+        spans = load_spans(str(log))
+        assert [s["name"] for s in spans] == ["second"]
+        with open(log, "a") as f:
+            f.write('{"name": "torn", "pha')     # killed mid-write
+        assert [s["name"] for s in load_spans(str(log))] == ["second"]
+        assert "malformed" in capsys.readouterr().err
+
+    def test_spans_share_one_clock_epoch(self):
+        """add_span (time.monotonic timestamps from the serve layer) and
+        context-managed spans must land on one timeline."""
+        obs.enable()
+        t0 = time.monotonic()
+        with obs.span("ctx", phase="prefill"):
+            time.sleep(0.005)
+        obs.add_span("manual", t0, time.monotonic(), phase="decode")
+        ctx, manual = obs.get_tracer().spans()
+        assert abs(ctx["t0"] - manual["t0"]) < 0.5
+        assert manual["t1"] >= ctx["t1"]
+
+    def test_disabled_tracer_is_a_cheap_no_op(self):
+        assert not obs.enabled()
+        t0 = time.perf_counter()
+        for _ in range(20_000):
+            with obs.span("hot", phase="decode", bucket=64) as rec:
+                assert rec is None
+        # generous bound: ~20k no-op spans must stay far under a second
+        assert time.perf_counter() - t0 < 2.0
+        assert obs.phase_totals() == {}
+        assert obs.get_tracer().spans() == []
+
+
+class TestReportRoundtrip:
+    def _record(self, log_path=None):
+        obs.enable(jsonl_path=log_path)
+        with obs.span("consume", phase="d2h_fetch"):
+            time.sleep(0.01)
+            with obs.span("dec", phase="decode", leg="binary"):
+                time.sleep(0.01)
+        obs.disable()
+
+    def test_jsonl_and_chrome_aggregate_to_the_live_totals(self, tmp_path):
+        log = str(tmp_path / "s.jsonl")
+        self._record(log)
+        live = obs.phase_totals(by_leg=True)
+        for path in (log, obs.export_chrome(str(tmp_path / "t.json"))):
+            agg = aggregate_spans(load_spans(path))
+            assert set(agg) == set(live)
+            for phase in live:
+                for leg in live[phase]:
+                    assert agg[phase][leg] == pytest.approx(
+                        live[phase][leg], abs=2e-4)
+
+    def test_phases_block_and_table(self):
+        self._record()
+        block = phases_block(obs.phase_totals(by_leg=True),
+                             wall_s=0.025, rows=10)
+        assert block["coverage"] >= 0.7
+        assert block["per_phase"]["decode"]["legs"]["binary"] > 0
+        assert block["per_phase"]["decode"]["ms_per_row"] > 0
+        table = format_phase_table(block)
+        assert "decode" in table and "d2h_fetch" in table
+        assert "% attributed" in table
+
+    def test_obs_report_cli_over_saved_trace(self, tmp_path, capsys):
+        log = str(tmp_path / "s.jsonl")
+        self._record(log)
+        assert obs_report_main(["report", "--trace", log]) == 0
+        out = capsys.readouterr().out
+        assert "decode" in out and "d2h_fetch" in out
+        assert obs_report_main(
+            ["report", "--trace", log, "--format", "json",
+             "--wall-s", "0.05", "--rows", "4"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["per_phase"]["decode"]["seconds"] > 0
+        assert obs_report_main(
+            ["report", "--trace", str(tmp_path / "missing.jsonl")]) == 2
+
+    def test_cli_obs_routes_before_argparse(self, tmp_path, capsys):
+        log = str(tmp_path / "s.jsonl")
+        self._record(log)
+        from llm_interpretation_replication_tpu.__main__ import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["obs", "report", "--trace", log])
+        assert exc.value.code == 0
+        assert "decode" in capsys.readouterr().out
+
+
+class TestEnginePhaseCoverage:
+    def test_score_prompts_phases_cover_the_call(self):
+        """The tiny-engine acceptance proxy for the bench criterion: the
+        instrumented phases must attribute the large majority of a
+        scoring call's wall-clock (the bench bar on real hardware is
+        >= 90%; the CPU harness asserts a conservative 70% — span
+        machinery and test-host noise weigh more at millisecond
+        scales)."""
+        eng, _, _ = _tiny_engine()
+        prompts = ["Is a tweet a publication? Answer: Yes",
+                   "Is soup a beverage?", "The quick brown fox"] * 2
+        eng.score_prompts(prompts)        # warm: compiles outside the claim
+        obs.enable()
+        t0 = time.perf_counter()
+        rows = eng.score_prompts(prompts)
+        wall = time.perf_counter() - t0
+        totals = obs.phase_totals()
+        assert all(r["success"] for r in rows)
+        assert {"host_tokenize", "prefill", "dispatch",
+                "d2h_fetch"} <= set(totals)
+        assert "decode" in totals         # completions decode by default
+        coverage = sum(totals.values()) / wall
+        assert coverage >= 0.7, (coverage, totals)
+        # spans carry the bucket/batch tags the phases table groups by
+        prefills = [s for s in obs.get_tracer().spans()
+                    if s["phase"] == "prefill"]
+        assert prefills and all(
+            s["args"]["bucket"] > 0 and s["args"]["batch"] > 0
+            for s in prefills)
+
+    def test_fused_two_leg_call_tags_phases_by_leg(self):
+        from llm_interpretation_replication_tpu.runtime.engine import LegSpec
+
+        eng, _, _ = _tiny_engine()
+        pairs = [("Is a tweet a publication?", (" Answer Yes or No.",
+                                                " Confidence 0-100:"))] * 3
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        obs.enable()
+        out = eng.score_prefixed(pairs, legs=legs)
+        assert len(out) == 2 and all(len(rows) == 3 for rows in out)
+        by_leg = obs.phase_totals(by_leg=True)
+        assert set(by_leg["extend_prefill"]) == {"binary", "confidence"}
+        assert set(by_leg["d2h_fetch"]) >= {"binary", "confidence"}
+        # traced run changed nothing numerically vs an untraced one
+        obs.disable()
+        out2 = eng.score_prefixed(pairs, legs=legs)
+        assert out2[0][0]["relative_prob"] == out[0][0]["relative_prob"]
+
+    def test_traced_results_identical_to_untraced(self):
+        eng, _, _ = _tiny_engine()
+        prompts = ["Is a tweet a publication?", "Is soup a beverage?"]
+        plain = eng.score_prompts(prompts)
+        obs.enable(sync=True)             # sync mode must not change rows
+        traced = eng.score_prompts(prompts)
+        for a, b in zip(plain, traced):
+            assert a == b
+
+
+class TestServeRequestSpans:
+    def test_replay_parity_with_trace_ids_in_output(self):
+        """Serve request-span parity: with tracing armed, every answered
+        row carries its trace_id AND row parity with the offline path
+        still holds (rows_equal ignores the measurement-only key)."""
+        from llm_interpretation_replication_tpu.serve.replay import replay
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = ["Is a tweet a publication?", "Is soup a beverage?",
+                   "Is a burrito a sandwich?", "The quick brown fox"]
+        obs.enable()
+        report = replay(eng, prompts)     # require_parity raises on skew
+        assert report["mismatched_rows"] == 0
+        assert all(row["trace_id"].startswith("sv-")
+                   for row in report["serve_rows"])
+        # the request lifecycle spans exist and correlate by trace id
+        spans = obs.get_tracer().spans()
+        phases = {s["phase"] for s in spans}
+        assert {"serve_queue_wait", "serve_engine",
+                "serve_respond"} <= phases
+        waited = {s["trace_id"] for s in spans
+                  if s["phase"] == "serve_queue_wait"}
+        answered = {row["trace_id"] for row in report["serve_rows"]}
+        assert answered <= waited
+
+    def test_untraced_serve_rows_carry_no_trace_id(self):
+        from llm_interpretation_replication_tpu.serve.replay import replay
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        report = replay(eng, ["Is a tweet a publication?",
+                              "Is soup a beverage?"])
+        assert report["mismatched_rows"] == 0
+        assert all("trace_id" not in row for row in report["serve_rows"])
+
+
+class TestStrictModeTracing:
+    def test_traced_strict_sweep_has_zero_blocked_transfers(self):
+        """The tentpole's strict contract: tracing (including the opt-in
+        sync-at-close mode) performs no unsanctioned device->host
+        transfer, so a strict-mode sweep with tracing on stays
+        blocked_transfers == 0."""
+        from llm_interpretation_replication_tpu.runtime import strict
+        from llm_interpretation_replication_tpu.runtime.engine import LegSpec
+
+        eng, _, _ = _tiny_engine()
+        pairs = [("Is a tweet a publication?",
+                  (" Answer Yes or No.",))] * 3
+        obs.enable(sync=True)
+        strict.activate(sentry=False)
+        try:
+            snap = telemetry.counters()
+            out = eng.score_prefixed(pairs, legs=[LegSpec("binary")])
+            assert all(r["success"] for r in out[0])
+            delta = telemetry.counters_since(snap)
+            assert delta.get(strict.BLOCKED_COUNTER, 0) == 0
+        finally:
+            strict.deactivate()
+
+
+class TestOverheadSmoke:
+    def test_traced_tiny_sweep_within_tolerance(self):
+        """Overhead contract proxy: a traced warm scoring pass must stay
+        close to the untraced one.  The bench acceptance bar is <= 2% on
+        real hardware; at tiny-model CPU scales span bookkeeping is a
+        visible fraction of the microsecond-scale batches, so the test
+        bound is deliberately loose (1.6x + 150 ms) and exists to catch
+        an accidentally quadratic or blocking tracer, not to certify the
+        2% number."""
+        eng, _, _ = _tiny_engine()
+        prompts = ["Is a tweet a publication?", "Is soup a beverage?",
+                   "The quick brown fox jumps"] * 4
+        eng.score_prompts(prompts)                 # compile
+        t0 = time.perf_counter()
+        eng.score_prompts(prompts)
+        untraced = time.perf_counter() - t0
+        obs.enable()
+        eng.score_prompts(prompts)                 # traced warm-up
+        t0 = time.perf_counter()
+        eng.score_prompts(prompts)
+        traced = time.perf_counter() - t0
+        assert traced <= untraced * 1.6 + 0.15, (traced, untraced)
+
+
+def test_bench_forwards_trace_and_profile_to_the_child():
+    """Satellite: the sweep-full child re-exec must inherit --trace /
+    --profile (the PR-5 --kv-dtype/--prefill-chunk forwarding list is the
+    template) with child-specific artifact paths."""
+    import os
+
+    bench_src = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")).read()
+    child = bench_src[bench_src.index('"--mode", "sweep-full"'):]
+    child = child[:child.index("subprocess.run")]
+    assert '"--trace"' in child and "sweep-full.json" in child
+    assert '"--profile"' in child
+    assert '"--trace-sync"' in child
+    assert '"--strict"' in child

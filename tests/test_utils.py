@@ -185,6 +185,27 @@ class TestTelemetryCounters:
         # unchanged counters are omitted; a fresh snapshot yields {}
         assert telemetry.counters_since(telemetry.counters()) == {}
 
+    def test_counters_since_robust_to_clear_mid_snapshot(self):
+        """Regression (ISSUE-6 satellite): a clear_counters() between
+        snapshot and read used to yield NEGATIVE deltas (value below the
+        snapshot).  A cleared-and-restarted counter now reports
+        everything recorded since the clear — never a negative."""
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        telemetry.record_counter("a", 5)
+        snap = telemetry.counters()
+        telemetry.clear_counters()
+        telemetry.record_counter("a", 2)
+        delta = telemetry.counters_since(snap)
+        assert delta == {"a": 2}
+        assert all(v >= 0 for v in delta.values())
+        # counters untouched since the clear simply vanish from the delta
+        telemetry.clear_counters()
+        telemetry.record_counter("b", 1)
+        snap2 = telemetry.counters()
+        telemetry.clear_counters()
+        assert telemetry.counters_since(snap2) == {}
+
     def test_thread_safety_under_concurrent_recording(self):
         import threading
 
@@ -219,6 +240,38 @@ class TestTelemetryCounters:
         # shared lock: one chunk count per item, idle time accumulated
         assert telemetry.counter("host_overlap_chunks") == 5
         assert telemetry.counter("host_overlap_idle_ms") >= 0
+
+    def test_sample_ring_cap_configurable_and_truncation_visible(self):
+        """Regression (ISSUE-6 satellite, the silent-window footgun): a
+        bounded ring drops history, so percentiles over a long run are
+        TAIL statistics — the cap is now configurable per ring and the
+        total-vs-retained report makes the truncation visible."""
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        telemetry.clear_samples()
+        try:
+            telemetry.set_sample_cap(8, "ring")
+            assert telemetry.sample_cap("ring") == 8
+            for v in range(20):
+                telemetry.record_sample("ring", float(v))
+            # the ring retains only the tail; the total keeps counting
+            assert telemetry.sample_count("ring") == 8
+            assert telemetry.sample_total("ring") == 20
+            # and the percentile provably reflects ONLY the tail window
+            assert telemetry.sample_percentiles("ring")["p50"] >= 12.0
+            report = telemetry.sample_ring_report(["ring"])
+            assert report["ring"] == {"total": 20, "retained": 8, "cap": 8}
+            # lowering a cap trims immediately; strict_report embeds the
+            # same visibility block for bench JSON / operator audit
+            telemetry.set_sample_cap(4, "ring")
+            assert telemetry.sample_count("ring") == 4
+            from llm_interpretation_replication_tpu.runtime import strict
+
+            assert strict.strict_report()["samples"]["ring"][
+                "retained"] == 4
+        finally:
+            telemetry.clear_samples()
+            telemetry.set_sample_cap(4096, "ring")
 
     def test_strict_mode_counters_flow_through_this_api(self):
         """recompile_events / blocked_transfers are ordinary counters:
