@@ -408,18 +408,23 @@ def run_sweep_mode(args, cfg, params):
     n_total = sum(len(p) for p in prompts_by_scenario)
     tok = _train_sweep_tokenizer([p for ps in prompts_by_scenario for p in ps])
 
+    pool_kw = {}
+    if getattr(args, "pool_max_bytes", 0):
+        pool_kw["phase2_pool_max_bytes"] = args.pool_max_bytes
     engine = ScoringEngine(
         "falcon", cfg, params, tok,
         engine_config=EngineConfig(
             batch_size=args.sweep_batch, decode_completions=False,
             phase2_pool_target=args.pool_target,
+            pooled_confidence=getattr(args, "pooled_confidence", True),
             pipeline_depth=args.pipeline_depth,
-            kv_dtype=getattr(args, "kv_dtype", "bf16"),
-            prefill_chunk=getattr(args, "prefill_chunk", 0),
+            kv_dtype=getattr(args, "kv_dtype", "bf16") or "bf16",
+            prefill_chunk=getattr(args, "prefill_chunk", 0) or 0,
             # the bench MEASURES an operating point: a mid-repeat OOM must
             # step the whole repeat down the ladder visibly (below), never
             # degrade single batches silently inside the engine
             oom_backoff=False,
+            **pool_kw,
         ),
     )
     lens = [len(ids) for ids in tok([p for ps in prompts_by_scenario for p in ps])["input_ids"]]
@@ -618,17 +623,22 @@ def run_sweep_full_mode(args, cfg, params):
         + [f"{r} {s['confidence_format']}" for s in scenarios
            for r in s["rephrasings"]])
 
+    pool_kw = {}
+    if getattr(args, "pool_max_bytes", 0):
+        pool_kw["phase2_pool_max_bytes"] = args.pool_max_bytes
     engine = ScoringEngine(
         "falcon", cfg, params, tok,
         engine_config=EngineConfig(
             batch_size=args.sweep_batch, decode_completions=True,
             phase2_pool_target=args.pool_target,
+            pooled_confidence=getattr(args, "pooled_confidence", True),
             pipeline_depth=args.pipeline_depth,
-            kv_dtype=getattr(args, "kv_dtype", "bf16"),
-            prefill_chunk=getattr(args, "prefill_chunk", 0),
+            kv_dtype=getattr(args, "kv_dtype", "bf16") or "bf16",
+            prefill_chunk=getattr(args, "prefill_chunk", 0) or 0,
             # measured operating point: repeat-level step-down only (the
             # engine's silent per-batch degradation would skew the record)
             oom_backoff=False,
+            **pool_kw,
         ),
     )
     params, measured_rate = _calibrate_decided_rate(
@@ -749,6 +759,13 @@ def run_sweep_full_mode(args, cfg, params):
           f"prefill_chunks={c.get('prefill_chunks', 0):.0f} "
           f"kv_cache_bytes_saved={c.get('kv_cache_bytes_saved', 0):.0f}",
           file=sys.stderr)
+    print(f"# sweep-full pooled confidence: "
+          f"pooled_conf_rows={c.get('pooled_conf_rows', 0):.0f} "
+          f"retired={c.get('pooled_conf_retired_rows', 0):.0f} "
+          f"conf_steps_saved={c.get('conf_steps_saved', 0):.0f} "
+          f"completion_cache_bytes_freed="
+          f"{c.get('completion_cache_bytes_freed', 0):.0f}",
+          file=sys.stderr)
     args.repeat_times = repeat_times
     args.phases_report = _phases_report(
         args, sum(repeat_times), n_total * max(1, len(repeat_times)))
@@ -841,12 +858,26 @@ def _operating_context(args) -> dict:
         "kv_dtype": getattr(args, "kv_dtype", "bf16"),
         "prefill_chunk": getattr(args, "prefill_chunk", 0),
         "planner": getattr(args, "fit_decision", ""),
+        # pool settings ride along so the record is self-describing: a
+        # BENCH_r06 number names the pooled-confidence configuration that
+        # produced it, not just the kv/chunk knobs
+        "phase2_pool_target": getattr(args, "pool_target", 0),
+        "pooled_confidence": bool(getattr(args, "pooled_confidence", True)),
     }
+    if getattr(args, "pool_max_bytes", 0):
+        ctx["phase2_pool_max_bytes"] = int(args.pool_max_bytes)
     if c.get("prefill_chunks"):
         ctx["prefill_chunks"] = int(c["prefill_chunks"])
     if c.get("kv_cache_bytes_saved"):
         ctx["kv_cache_gib_saved"] = round(
             c["kv_cache_bytes_saved"] / 2**30, 2)
+    for name in ("pooled_conf_rows", "pooled_conf_retired_rows",
+                 "conf_steps_saved"):
+        if c.get(name):
+            ctx[name] = int(c[name])
+    if c.get("completion_cache_bytes_freed"):
+        ctx["completion_cache_gib_freed"] = round(
+            c["completion_cache_bytes_freed"] / 2**30, 3)
     return {"context": ctx}
 
 
@@ -862,21 +893,31 @@ def main():
                              "bitsandbytes int8, so int8-vs-int8 is the fair "
                              "comparison; ~0.9997 logit correlation vs bf16)")
     parser.add_argument("--kv-dtype", choices=["bf16", "int8"],
-                        default="bf16",
+                        default=None,
                         help="decode-time KV cache storage dtype: bf16 "
                              "keeps every bit-parity contract; int8 "
                              "(per-head scales, quantize-on-append — "
                              "ops/quant.quantize_kv) nearly halves the "
                              "cache HBM the full-study contract pins, "
                              "lifting the sweep batch off the 224 cliff "
-                             "(tolerance documented in PARITY.md)")
-    parser.add_argument("--prefill-chunk", type=int, default=0, metavar="N",
+                             "(tolerance documented in PARITY.md).  "
+                             "Default: bf16, EXCEPT --mode sweep-full "
+                             "(and the sweep mode's full-study child), "
+                             "which measures the documented int8 + "
+                             "prefill-chunk-128 operating point — the "
+                             "PR-5 planner prediction BENCH_r06 exists "
+                             "to confirm")
+    parser.add_argument("--prefill-chunk", type=int, default=None,
+                        metavar="N",
                         help="> 0: prompts above N tokens prefill in "
                              "N-token chunks through the suffix-extension "
                              "path (models/decoder.chunked_prefill), "
                              "bounding the [B,S,T] attention transients "
                              "the long buckets pay; the budget planner "
-                             "(runtime/plan.py) budgets the chunked bound")
+                             "(runtime/plan.py) budgets the chunked "
+                             "bound.  Default: 0, except --mode "
+                             "sweep-full / the full-study child: 128 "
+                             "(see --kv-dtype)")
     parser.add_argument("--attn", choices=["xla", "flash"], default="xla",
                         help="attention impl: XLA dense (the DecoderConfig "
                              "'xla' value) or the Pallas kernels "
@@ -953,9 +994,25 @@ def main():
     parser.add_argument("--sweep-out", metavar="PATH", default=None,
                         help="sweep mode: output workbook (default: temp dir)")
     parser.add_argument("--pool-target", type=int, default=0, metavar="N",
-                        help="sweep mode: phase-2 cross-batch pool size "
+                        help="sweep modes: phase-2 cross-batch pool size "
                              "(0 = engine default, one pooled decode per "
-                             "batch-size undecided rows)")
+                             "batch-size rows) — shared by the binary "
+                             "undecided-row pool and the confidence-leg "
+                             "pool")
+    parser.add_argument("--pool-max-bytes", type=int, default=0,
+                        metavar="BYTES",
+                        help="sweep modes: HBM cap on K/V held by the "
+                             "cross-batch pools (0 = engine default, "
+                             "512 MiB; EngineConfig.phase2_pool_max_bytes)")
+    parser.add_argument("--pooled-confidence",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="sweep-full mode: route the confidence leg's "
+                             "digit decode through the leg-parameterized "
+                             "cross-batch pool (early-exit row retirement "
+                             "+ per-chunk completion-cache streaming — "
+                             "runtime/engine._Phase2Pool).  "
+                             "--no-pooled-confidence measures the r5 "
+                             "per-batch decode")
     parser.add_argument("--pipeline-depth", type=int, default=None,
                         metavar="N",
                         help="sweep modes: in-flight device batches (host "
@@ -1058,6 +1115,27 @@ def main():
         parser.error("--decided-frac must be within [0, 1]")
     if args.pipeline_depth is None:
         args.pipeline_depth = 2 if args.mode == "sweep-full" else 4
+    # The full-study mode measures the documented PR-5 operating point by
+    # default (int8 KV + 128-token chunked prefill — the planner's
+    # batch >= 320 fit prediction BENCH_r06 exists to confirm); every
+    # other mode keeps the bf16 bit-parity default.  full_* carry the
+    # full-study resolution for the sweep mode's child re-exec, so a
+    # plain `python bench.py` measures its full-study secondary at the
+    # same operating point a direct --mode sweep-full run would.
+    args.full_kv_dtype = args.kv_dtype if args.kv_dtype is not None else "int8"
+    args.full_prefill_chunk = (args.prefill_chunk
+                               if args.prefill_chunk is not None else 128)
+    if args.mode == "sweep-full":
+        if args.kv_dtype is None or args.prefill_chunk is None:
+            print(f"# sweep-full operating point: kv-dtype "
+                  f"{args.full_kv_dtype}, prefill-chunk "
+                  f"{args.full_prefill_chunk} (pass --kv-dtype/"
+                  f"--prefill-chunk to override)", file=sys.stderr)
+        args.kv_dtype = args.full_kv_dtype
+        args.prefill_chunk = args.full_prefill_chunk
+    else:
+        args.kv_dtype = args.kv_dtype or "bf16"
+        args.prefill_chunk = args.prefill_chunk or 0
     if args.mode in ("parity", "sweep") and args.microbatch > 1:
         parser.error("--microbatch applies to the single/decode modes; the "
                      "parity/sweep decode slice is sized from the full batch")
@@ -1095,6 +1173,16 @@ def main():
                   file=sys.stderr)
 
         atexit.register(_export_trace)
+    elif args.mode in ("sweep", "sweep-full"):
+        # phases-by-default: the sweep records' `phases` decomposition
+        # (ISSUE-7 acceptance: BENCH_r06 ships with the block attached)
+        # must not depend on remembering --trace — arm the in-memory span
+        # tracer alone: no JSONL stream, no Chrome export, no per-span
+        # memory snapshots, so the overhead is the no-op-span epsilon the
+        # obs overhead smoke test already bounds
+        from llm_interpretation_replication_tpu import obs as obs_mod
+
+        obs_mod.enable()
 
     def _attach_strict(record):
         """Append the strict-mode audit block (recompile_events /
@@ -1366,6 +1454,15 @@ def main():
                 # activation bound — the planner PREDICTS the int8-KV
                 # operating point instead of discovering it by OOM
                 kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
+                # the pooled-confidence cache term (ISSUE 7): the fit
+                # decision carries the pool's no-retirement worst-case
+                # peak, so the prediction names the configuration the
+                # engine actually runs.  pool_target=None lets the
+                # planner price the pool at whatever batch it FITS —
+                # with no explicit --pool-target the engine pools at its
+                # own (clamped) batch_size, not the requested one
+                pooled_confidence=args.pooled_confidence,
+                pool_target=args.pool_target or None,
             )
         else:
             sweep_plan = resolve_scoring_plan(
@@ -1497,12 +1594,22 @@ def main():
                     "--sweep-repeats", "1",
                     "--sweep-batch", str(args.sweep_batch),
                     "--sweep-rows", str(args.sweep_rows),
+                    # the pool flags forward like --kv-dtype/--prefill-chunk
+                    # (the PR-5 discipline): the child's record must name
+                    # the same pool configuration the parent was asked for
                     "--pool-target", str(args.pool_target),
+                    "--pool-max-bytes", str(args.pool_max_bytes),
+                    "--pooled-confidence" if args.pooled_confidence
+                    else "--no-pooled-confidence",
                     "--decided-frac", str(args.decided_frac),
                     "--checkpoint-every", str(args.checkpoint_every),
                     "--model", args.model, "--quant", args.quant,
-                    "--kv-dtype", args.kv_dtype,
-                    "--prefill-chunk", str(args.prefill_chunk),
+                    # the full-study OPERATING POINT, not the parent sweep's
+                    # bf16 default: a plain `python bench.py` measures its
+                    # full-study secondary at the same int8 + chunk-128
+                    # point a direct --mode sweep-full run would
+                    "--kv-dtype", args.full_kv_dtype,
+                    "--prefill-chunk", str(args.full_prefill_chunk),
                     "--attn", args.attn,
                     "--perturbations", args.perturbations,
                     "--fuse-prefix" if args.fuse_prefix else "--no-fuse-prefix",
@@ -1529,10 +1636,16 @@ def main():
                     raise RuntimeError(
                         f"sweep-full child exited {proc.returncode}")
                 frec = json.loads(proc.stdout.strip().splitlines()[-1])
+                extra = {k: frec[k] for k in ("phases", "context")
+                         if k in frec}
                 record["secondary"].append({
                     "metric": frec["metric"],
                     "value": frec["value"],
                     "unit": frec["unit"],
+                    # the child's phase decomposition + operating context
+                    # ride along: BENCH_r06's full-study row carries the
+                    # per-leg attribution the ISSUE-7 acceptance names
+                    **extra,
                 })
             except Exception as err:
                 print(f"# full-study secondary failed ({err}); headline "

@@ -70,6 +70,16 @@ def _add_run_config_args(p: argparse.ArgumentParser):
                    help="> 0: prompts above N tokens prefill in N-token "
                         "chunks through the suffix-extension path, "
                         "bounding the long buckets' attention transients")
+    p.add_argument("--pooled-confidence",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="route confidence-leg decodes through the "
+                        "leg-parameterized cross-batch pool (early-exit "
+                        "row retirement + per-chunk completion-cache "
+                        "streaming); --no-pooled-confidence keeps the "
+                        "per-batch decode")
+    p.add_argument("--phase2-pool-target", type=int, default=0, metavar="N",
+                   help="rows per pooled phase-2 decode (binary undecided "
+                        "pool AND confidence pool); 0 = batch size")
     p.add_argument("--mesh-model", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=16)
@@ -83,6 +93,8 @@ def _run_config(args):
     return RunConfig(
         device=args.device, dtype=args.dtype, quant=args.quant,
         kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
+        pooled_confidence=getattr(args, "pooled_confidence", True),
+        phase2_pool_target=getattr(args, "phase2_pool_target", 0),
         attention_impl=args.attention_impl,
         mesh_model=args.mesh_model,
         mesh_seq=args.mesh_seq, batch_size=args.batch_size,
@@ -119,6 +131,8 @@ def _engine_factory(run_config):
                 batch_size=run_config.batch_size,
                 kv_dtype=run_config.kv_dtype,
                 prefill_chunk=run_config.prefill_chunk,
+                pooled_confidence=run_config.pooled_confidence,
+                phase2_pool_target=run_config.phase2_pool_target,
             ),
         )
 

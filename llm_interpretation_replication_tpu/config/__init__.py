@@ -132,6 +132,13 @@ class RunConfig:
                                          # on-append — runtime/engine kv_dtype)
     prefill_chunk: int = 0               # > 0: chunked prefill threshold/size
                                          # (models/decoder.chunked_prefill)
+    pooled_confidence: bool = True       # confidence-leg decode through the
+                                         # leg-parameterized cross-batch pool
+                                         # (early-exit retirement + cache
+                                         # streaming — runtime/engine
+                                         # EngineConfig.pooled_confidence)
+    phase2_pool_target: int = 0          # rows per pooled decode (binary +
+                                         # confidence pools); 0 = batch_size
     attention_impl: str = "xla"          # 'xla' | 'flash' | 'auto' (dense up
                                          # to 1k tokens, Pallas kernel beyond
                                          # — models/config.DecoderConfig)

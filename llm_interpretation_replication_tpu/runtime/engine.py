@@ -119,6 +119,21 @@ class EngineConfig:
                                     # exceed it, so pooling can never push a
                                     # budget-fitting sweep into OOM (long
                                     # buckets hold ~3.5 MB/row at 7B)
+    pooled_confidence: bool = True  # route the confidence leg's scored
+                                    # decode through the leg-parameterized
+                                    # cross-batch pool (_Phase2Pool with
+                                    # leg="confidence"): rows gather out of
+                                    # their prefill/extension caches, ONE
+                                    # pooled digit decode runs per ~target
+                                    # rows, and early-exit retirement stops
+                                    # decoding (and frees each row's K/V
+                                    # slice) as soon as positions 0-2 pin a
+                                    # terminated digit answer — most rows
+                                    # need ≪10 of the leg's 10 steps.
+                                    # False = the r5 per-batch decode
+                                    # (engages only when the leg's decode
+                                    # cap fits inside the scored scan and
+                                    # top_k <= ReducedScores' candidates)
     kv_dtype: str = "bf16"          # decode-time KV cache storage dtype:
                                     # "bf16" keeps every bit-parity contract
                                     # (fused-vs-unfused, serve --replay);
@@ -710,6 +725,9 @@ class ScoringEngine:
         if ecfg.phase2_pool and not with_confidence and not ecfg.decode_completions:
             return self._score_decoder_pooled(
                 encoded, ids_all, results, eos_id, steps)
+        if self._conf_pool_eligible(with_confidence, steps, gen_total):
+            return self._score_decoder_conf_pooled(
+                encoded, ids_all, results, eos_id, steps)
 
         def launch(batch):
             ids = self._put(batch.token_ids)
@@ -935,6 +953,20 @@ class ScoringEngine:
         pad_id = self.tokenizer.pad_token_id or 0
         pool = PrefixCachePool()
         self.last_prefix_pool = pool
+        # leg-parameterized cross-batch pools: each eligible confidence
+        # leg's scored digit decode moves out of the per-batch consume and
+        # into ONE pooled decode per ~target rows (early-exit retirement,
+        # per-chunk cache streaming — _Phase2Pool._flush_confidence)
+        conf_pools = {
+            li: self._make_conf_pool(
+                plans[li].scan_steps, eos_id, results[li],
+                leg_name=leg.name or "confidence",
+                completions=decode_flags[li])
+            for li, leg in enumerate(legs)
+            if self._conf_pool_eligible(
+                leg.with_confidence, plans[li].scan_steps,
+                plans[li].total_new_tokens)
+        }
 
         def _suffix_batch(batch, li):
             """[B, suffix_bucket] ids+mask for one leg, aligned with the
@@ -1002,11 +1034,17 @@ class ScoringEngine:
                     with obs.span("consume_leg", phase="d2h_fetch",
                                   leg=legs[li].name or f"leg{li}",
                                   bucket=int(batch.bucket_len)):
-                        self._consume_scored_batch(
-                            batch, leg_outs[li], ids_all, results[li],
-                            legs[li].with_confidence, plans[li].scan_steps,
-                            plans[li].total_new_tokens, decode_flags[li],
-                            eos_id)
+                        if li in conf_pools:
+                            self._pool_confidence_batch(
+                                conf_pools[li], batch, leg_outs[li],
+                                ids_all)
+                        else:
+                            self._consume_scored_batch(
+                                batch, leg_outs[li], ids_all, results[li],
+                                legs[li].with_confidence,
+                                plans[li].scan_steps,
+                                plans[li].total_new_tokens,
+                                decode_flags[li], eos_id)
             finally:
                 # release exactly once whether the legs consumed clean or
                 # an OOM sends the batch back through the re-bucket ladder
@@ -1023,6 +1061,8 @@ class ScoringEngine:
             )
         finally:
             pool.close()
+        for cpool in conf_pools.values():
+            cpool.flush_all()
         return [
             [r if r is not None else _error_row("missing") for r in rows]
             for rows in results
@@ -1218,6 +1258,135 @@ class ScoringEngine:
         )
         pool.flush_all()
         return [r if r is not None else _error_row("missing") for r in results]
+
+    def _conf_pool_eligible(self, with_confidence, steps, gen_total) -> bool:
+        """The confidence leg routes through the leg-parameterized
+        cross-batch pool when (a) pooling is on for the leg, (b) the leg's
+        completion cap fits inside the scored scan — the 10-token
+        confidence contract: the scored decode's greedy tokens ARE the
+        completion, so one pooled decode serves scores and text — and
+        (c) the scan top-k reads from ReducedScores' kept candidates (the
+        pooled decode stacks reduced statistics only).  Anything else
+        keeps the r5 per-batch decode."""
+        ecfg = self.ecfg
+        return (with_confidence and ecfg.phase2_pool
+                and ecfg.pooled_confidence and gen_total <= steps
+                and ecfg.top_k <= dmod.REDUCED_TOPK)
+
+    def _make_conf_pool(self, steps, eos_id, results, leg_name="confidence",
+                        completions=None):
+        ecfg = self.ecfg
+        return _Phase2Pool(
+            self, steps, eos_id,
+            target=ecfg.phase2_pool_target or ecfg.batch_size,
+            results=results, max_bytes=ecfg.phase2_pool_max_bytes,
+            leg=leg_name, confidence=True,
+            completions=(ecfg.decode_completions if completions is None
+                         else completions),
+        )
+
+    @staticmethod
+    def _pool_add_batch(pool, plen, sub_cache, last_s, len_s, count,
+                        orig_idx, row_ids, first3_cols, sel):
+        """Queue one batch's confidence rows (mapped through ``sel``, the
+        slice-row -> batch-row index) on ``pool``, marking any failure
+        ``_no_rebatch``: a pooled decode holds rows popped from MANY
+        earlier batches, so the per-batch OOM re-bucket cannot shrink it
+        and retrying would silently lose the popped rows (see
+        _score_decoder_pooled's consume)."""
+        try:
+            pool.add(plen, sub_cache, last_s, len_s, count,
+                     orig_idx[sel[:count]], row_ids[sel],
+                     first3=np.stack([a[sel] for a in first3_cols], axis=1))
+        except Exception as err:
+            err._no_rebatch = True
+            raise
+
+    def _score_decoder_conf_pooled(self, encoded, ids_all, results, eos_id,
+                                   steps) -> List[Dict]:
+        """Confidence-leg scoring through the cross-batch pool: every
+        valid row needs the scored digit decode, so the prefill program
+        selects ALL rows (``_prefill_select`` with ``select_all`` — the
+        same in-program slice machinery, minus the undecided filter, so
+        the full cache still never materializes as a program output at
+        more than the menu-padded slice) and each batch's rows accumulate
+        in a ``leg="confidence"`` pool; ONE pooled digit decode runs per
+        ``target`` rows with early-exit row retirement
+        (:meth:`_Phase2Pool._flush_confidence`)."""
+        ecfg = self.ecfg
+        pool = self._make_conf_pool(steps, eos_id, results)
+
+        def launch(batch):
+            ids = self._put(batch.token_ids)
+            mask = self._put(batch.attention_mask)
+            row_ids = self._batch_target_rows(ids_all, batch)
+            return _prefill_select(
+                self.params, self.cfg, ids, mask,
+                jnp.asarray(batch.indices >= 0),
+                row_ids[:, 0], row_ids[:, 1],
+                cache_len=batch.bucket_len,
+                slice_m=int(batch.token_ids.shape[0]),
+                top_k=ecfg.top_k, top_filter=ecfg.first_token_top_filter,
+                out_len=_conf_pool_len(batch.bucket_len), select_all=True,
+            )
+
+        def consume(batch, out):
+            scan0, first3, sel, sub_cache, last_s, len_s = out
+            first3 = tuple(np.asarray(a) for a in first3)
+            row_ids = self._batch_target_rows(ids_all, batch)
+            count = int((batch.indices >= 0).sum())
+            if not count:
+                return
+            sel_np = np.asarray(sel)
+            # valid rows sort first under select_all (decided := padding);
+            # shrink partial batches to the tight menu size before pooling
+            m = _pad_slice(count, int(sel_np.shape[0]))
+            if m < sel_np.shape[0]:
+                idx = np.zeros((m,), np.int32)
+                idx[:count] = np.arange(count)
+                sub_cache, last_s, len_s = _gather_rows(
+                    sub_cache, last_s, len_s, jnp.asarray(idx))
+                mapped = sel_np[idx]
+            else:
+                mapped = sel_np
+            self._pool_add_batch(
+                pool, _conf_pool_len(batch.bucket_len), sub_cache, last_s,
+                len_s, count, batch.indices, row_ids, first3, mapped)
+
+        self._run_pipelined(
+            batching.batches_for_prompts(
+                encoded, ecfg.batch_size, ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=ecfg.length_sorted_batches,
+            ),
+            launch, consume, rebatch=self._oom_rebatch(encoded),
+        )
+        pool.flush_all()
+        return [r if r is not None else _error_row("missing") for r in results]
+
+    def _pool_confidence_batch(self, pool, batch, out, ids_all):
+        """Fused-path confidence leg -> pool: gather the batch's valid
+        rows out of the suffix-extended cache, pad the slot axis to the
+        pool's quantized cache length, and queue them — the per-batch
+        decode the r5 consume ran here moves into the pooled flush."""
+        last, cache, lengths, scan0, first3 = out
+        first3 = tuple(np.asarray(a) for a in first3)
+        row_ids = self._batch_target_rows(ids_all, batch)
+        valid = batch.indices >= 0
+        count = int(valid.sum())
+        if not count:
+            return
+        m = _pad_slice(count, int(last.shape[0]))
+        idx = np.zeros((m,), np.int32)
+        idx[: count] = np.flatnonzero(valid)
+        sub_cache, last_s, len_s = _gather_rows(
+            cache, last, lengths, jnp.asarray(idx))
+        cache_len = int(sub_cache.k.shape[2])
+        plen = _conf_pool_len(cache_len)
+        if plen > cache_len:
+            sub_cache = _pad_cache_slots(sub_cache, plen)
+        self._pool_add_batch(pool, plen, sub_cache, last_s, len_s, count,
+                             batch.indices, row_ids, first3, idx)
 
     def _scan_results(self, sc, yes_ids, no_ids, toks, eos_id):
         """Yes/no scan over a chunked decode's scores — full [m, P, V]
@@ -1476,58 +1645,82 @@ def _pad_slice(n: int, cap: int) -> int:
     return cap
 
 
-#: Quantized cache lengths for the phase-2 pool: every prefill's undecided
-#: slice is padded (inert invalid slots) up to the menu entry covering its
-#: bucket, so slices from DIFFERENT length buckets pool and decode together.
-#: Without this the pool fragments per bucket — the step-16 length-sorted
-#: menu touches ~9 buckets on the real perturbation corpus, each holding a
-#: sub-target remnant that flushes padded at end of sweep — and every bucket
-#: costs its own family of decode compiles.  Attention over the extra
-#: invalid slots is negligible: the pooled decode is weight-streaming-bound
-#: (~8.5 ms/step at 7B int8 for ANY slice under a few hundred rows).
-_POOL_LEN_MENU = (256, 512, 1024, 2048)
-
-
-def _pool_len(bucket_len: int) -> int:
-    for t in _POOL_LEN_MENU:
-        if bucket_len <= t:
-            return t
-    return bucket_len
+#: Quantized cache lengths for the phase-2 pools: every pooled slice is
+#: padded (inert invalid slots) up to the menu entry covering its cache
+#: length, so slices from DIFFERENT length buckets pool and decode
+#: together.  Without this the pool fragments per bucket — the step-16
+#: length-sorted menu touches ~9 buckets on the real perturbation corpus,
+#: each holding a sub-target remnant that flushes padded at end of sweep —
+#: and every bucket costs its own family of decode compiles.  Attention
+#: over the extra invalid slots is negligible: the pooled decode is
+#: weight-streaming-bound (~8.5 ms/step at 7B int8 for ANY slice under a
+#: few hundred rows).  The menus live in runtime/plan.py so the budget
+#: model prices the same quantized shapes the engine pools: the binary
+#: pool keeps the coarse r4 menu (finer entries would fragment its
+#: flushes for no HBM win — it holds ~10% of rows), the confidence pool
+#: uses the finer CONF menu (it holds EVERY row; dead slots cost real
+#: HBM).
+_pool_len = plan_mod.pool_len_for
+_conf_pool_len = plan_mod.conf_pool_len_for
 
 
 class _Phase2Pool:
-    """Cross-batch pool of phase-2 (undecided) rows.
+    """Leg-parameterized cross-batch pool of scored-decode rows.
 
     The scored look-ahead decode is weight-streaming-bound: every step
     streams the full weight set from HBM regardless of how few rows decode,
     so a 10-step decode costs nearly the same for 24 rows as for 192.
     Running it once per prefill batch therefore pays the full ~100-300 ms
     decode cost for a handful of rows, every batch.  Instead, each batch's
-    undecided rows are gathered out of its prefill cache (a few MB per row)
-    and accumulate here, keyed by bucket length; ONE pooled decode runs per
-    ``target`` accumulated rows (and at end of sweep), amortizing the
-    per-step weight streaming across ~target/undecided-per-batch batches.
-    Semantics are unchanged — the same rows decode the same tokens from the
-    same caches, just grouped into fewer device programs.
+    rows are gathered out of its prefill cache (a few MB per row) and
+    accumulate here, keyed by quantized cache length; ONE pooled decode
+    runs per ``target`` accumulated rows (and at end of sweep), amortizing
+    the per-step weight streaming across batches.  Semantics are unchanged
+    — the same rows decode the same tokens from the same caches, just
+    grouped into fewer device programs.
+
+    Two legs share the machinery (``leg``/``confidence``):
+
+    - **binary** (default): the undecided slice of each batch pools; the
+      flush is ONE async full-``steps`` decode whose [m]-sized outputs
+      resolve later in :meth:`drain` (the launch loop keeps feeding the
+      device).
+    - **confidence** (``confidence=True``): EVERY row pools (each needs
+      the digit decode); the flush decodes in chunks with per-row
+      EARLY-EXIT RETIREMENT — a row retires at the first step where its
+      completion's first-integer parse can no longer change
+      (:func:`..scoring.confidence.first_int_stable`, never before the 3
+      positions ``weighted_confidence_digits`` reads), retired rows'
+      cache slices compact away per chunk (``completion_cache_bytes_freed``),
+      and the whole flush stops once every real row has retired
+      (``conf_steps_saved``).  Retirement is a pure function of the row's
+      own greedy tokens, so pooled rows are bit-reproducible across batch
+      shapes and pool compositions (serve replay parity holds).
     """
 
     def __init__(self, engine, steps, eos_id, target, results,
-                 max_bytes: int = 512 << 20):
+                 max_bytes: int = 512 << 20, leg: str = "binary",
+                 confidence: bool = False, completions: bool = False):
         self.engine = engine
         self.steps = steps
         self.eos_id = eos_id
         self.target = max(1, int(target))
         self.max_bytes = max(1, int(max_bytes))
         self.results = results
+        self.leg = leg
+        self.confidence = bool(confidence)
+        self.completions = bool(completions)
         self.entries: Dict[int, List] = {}
         self.counts: Dict[int, int] = {}
         self.bytes: Dict[int, int] = {}
-        self.deferred: List = []   # [(layout, fields, first3, fb)] —
+        self.deferred: List = []   # [(layout, fields, first3, parcels)] —
                                    # dispatched flushes awaiting host fetch;
-                                   # fb = K/V bytes the flush pins in HBM
-                                   # until its queued decode EXECUTES
-                                   # (counted against max_bytes, zeroed once
-                                   # the outputs report ready)
+                                   # parcels = mutable [bytes, probe] pairs:
+                                   # the K/V bytes the flush pins in HBM
+                                   # until its queued decode EXECUTES,
+                                   # counted against max_bytes and zeroed
+                                   # PER OUTPUT as each probe reports ready
+                                   # (not whole-flush — see _inflight_bytes)
 
     @staticmethod
     def _entry_bytes(cache) -> int:
@@ -1632,6 +1825,11 @@ class _Phase2Pool:
             lens = jnp.concatenate([e[2] for e in entries], axis=0)
         ids = np.concatenate([e[5] for e in entries], axis=0)   # [m, 2]
         first3 = np.concatenate([e[6] for e in entries], axis=0)  # [m, 3]
+        if self.confidence:
+            layout = [(int(e[1].shape[0]), e[3], e[4]) for e in entries]
+            self._flush_confidence(bucket_len, layout, total, cache, last,
+                                   lens, ids, first3)
+            return
         ecfg = self.engine.ecfg
         # ASYNC flush: dispatch the full scored decode and the on-device
         # yes/no reduction, then return — only the small [m] result arrays
@@ -1653,7 +1851,7 @@ class _Phase2Pool:
         # fp32 tensor (~1.3 GB at the 512-row menu cap) that used to live
         # between the decode and the reduction programs.
         reduced = ecfg.top_k <= dmod.REDUCED_TOPK
-        with obs.span("pool_flush", phase="pooled_decode",
+        with obs.span("pool_flush", phase="pooled_decode", leg=self.leg,
                       rows=int(total), padded=int(m),
                       bucket=int(bucket_len)) as sp:
             toks, sc, _, _, _ = dmod.decode_steps(
@@ -1677,33 +1875,244 @@ class _Phase2Pool:
         # Until the queued decode executes, BOTH the source slices (held by
         # the pending concatenate) and the concatenated copy (held by the
         # decode) are resident, so the pinned accounting is 2x the slices.
+        # The pinned bytes split into one parcel PER OUTPUT so
+        # _inflight_bytes can decrement incrementally as individual
+        # outputs report ready, instead of reaping whole flushes only.
         layout = [(int(e[1].shape[0]), e[3], e[4]) for e in entries]
         fb = 2 * sum(self._entry_bytes(e[0]) for e in entries)
-        self.deferred.append((layout, fields, first3, fb))
+        vals = list(fields.values())
+        share, rem = divmod(fb, len(vals))
+        parcels = [[share + (rem if i == 0 else 0), v]
+                   for i, v in enumerate(vals)]
+        self.deferred.append((layout, fields, first3, parcels))
+
+    def _conf_retired_at(self, toks_row, k: int) -> bool:
+        """Is a confidence row's result frozen after its first ``k``
+        greedy tokens?  True when (a) EOS already landed in the window
+        (the completion is cut there — nothing later exists), (b) the
+        decoded text's first-integer parse is terminated
+        (scoring.confidence.first_int_stable: appended text can neither
+        extend the digits nor introduce an earlier match), or (c) the
+        stripped text already fills the completion_chars truncation.
+
+        A window whose decode ends in U+FFFD NEVER retires: the
+        replacement char marks a byte sequence the window cut mid-token —
+        the next token can complete it into a real character, changing
+        both the text tail and, crucially, the word-boundary structure
+        (U+FFFD is a non-word char, so '8\\ufffd' reads as a terminated
+        integer while the completed '8µ' would not be).  Waiting one more
+        window keeps the parity contract exact; interior U+FFFDs are
+        genuine invalid bytes and stay put."""
+        from ..scoring import confidence as conf_mod
+
+        window = toks_row[:k]
+        if self.eos_id is not None and bool((window == self.eos_id).any()):
+            return True
+        text = self.engine.tokenizer.decode(
+            [int(t) for t in window], skip_special_tokens=True)
+        if text.endswith("�"):
+            return False
+        if len(text.strip()) >= self.engine.ecfg.completion_chars:
+            return True
+        return conf_mod.first_int_stable(text)
+
+    def _flush_confidence(self, bucket_len, layout, total, cache, last,
+                          lens, ids, first3):
+        """One pooled confidence decode with early-exit row retirement
+        and per-chunk completion-cache streaming.
+
+        The decode runs in chunks (3 positions first — the minimum
+        ``weighted_confidence_digits`` reads — then ``scan_chunk``-sized).
+        After each chunk the greedy tokens come back to host and every
+        still-live row's retirement step resolves: ``r*`` = the smallest
+        k >= 3 whose k-token completion prefix is frozen
+        (:meth:`_conf_retired_at`) — a pure function of the row's own
+        tokens, NEVER of pool composition or chunk schedule, so a row's
+        emitted fields are bit-reproducible across batch shapes (the
+        serve-replay contract).  Retired rows' K/V slices are compacted
+        away (menu-padded gather) before the next chunk — the HBM the
+        per-batch path pinned to step 10 frees the moment each row
+        retires (``completion_cache_bytes_freed``) — and the flush stops
+        once every real row has retired (``conf_steps_saved``).
+
+        Emitted fields vs the full 10-step per-batch decode: the
+        weighted confidence (positions 0-2) and the completion's
+        first-integer parse are IDENTICAL by construction; the completion
+        text is the r*-token prefix of the full decode's text; the yes/no
+        scan reads positions < r* (a hit past a row's retirement falls
+        back to position 0 — the PARITY.md pooled-confidence contract)."""
+        engine = self.engine
+        ecfg = engine.ecfg
+        steps = self.steps
+        K = dmod.REDUCED_TOPK
+        m = sum(r for r, _, _ in layout)
+        min_conf = min(3, steps)
+        record_counter("pooled_conf_rows", sum(n for _, n, _ in layout))
+
+        real = np.zeros((m,), bool)
+        row = 0
+        for rows, n_real, _orig in layout:
+            real[row: row + n_real] = True
+            row += rows
+        toks_np = np.zeros((m, steps), np.int32)
+        vals_np = np.zeros((m, steps, K), np.float32)
+        idsk_np = np.zeros((m, steps, K), np.int32)
+        logz_np = np.zeros((m, steps), np.float32)
+        tgt_np = np.zeros((m, steps, 2), np.float32)
+        retire_step = np.full((m,), -1, np.int32)
+        checked_upto = np.full((m,), min_conf - 1, np.int32)
+        decoded_upto = np.zeros((m,), np.int32)
+
+        cache_map = np.arange(m)          # cache row -> flush-layout row
+        cache_real = real.copy()          # cache row holds a live real row
+        cur_cache, prev, cur_lens, done = cache, last, lens, None
+        cur_ids = jnp.asarray(ids)
+        retired_log = []
+        offset = 0
+        with obs.span("pool_flush", phase="pooled_decode", leg=self.leg,
+                      rows=int(total), padded=int(m),
+                      bucket=int(bucket_len)) as sp:
+            while offset < steps:
+                n = min_conf if offset == 0 else min(
+                    max(1, ecfg.scan_chunk), steps - offset)
+                toks_c, sc_c, cur_cache, prev, done = dmod.decode_steps(
+                    engine.params, engine.cfg, cur_cache, prev, cur_lens,
+                    np.int32(offset), n, self.eos_id, done,
+                    with_scores="reduced", target_ids=cur_ids,
+                )
+                for a in (toks_c,) + tuple(sc_c):
+                    try:
+                        a.copy_to_host_async()
+                    except AttributeError:
+                        pass
+                live = np.flatnonzero(cache_real)
+                lr = cache_map[live]
+                toks_np[lr, offset:offset + n] = np.asarray(toks_c)[live]
+                vals_np[lr, offset:offset + n] = \
+                    np.asarray(sc_c.topk_vals)[live]
+                idsk_np[lr, offset:offset + n] = \
+                    np.asarray(sc_c.topk_ids)[live]
+                logz_np[lr, offset:offset + n] = np.asarray(sc_c.logz)[live]
+                tgt_np[lr, offset:offset + n] = \
+                    np.asarray(sc_c.target_logits)[live]
+                decoded_upto[lr] = offset + n
+                offset += n
+                if offset >= steps:
+                    break
+                # retirement: r* resolves from each row's own tokens only
+                newly = 0
+                for r in lr:
+                    if retire_step[r] >= 0:
+                        continue
+                    for k in range(int(checked_upto[r]) + 1, offset + 1):
+                        if self._conf_retired_at(toks_np[r], k):
+                            retire_step[r] = k
+                            newly += 1
+                            break
+                    checked_upto[r] = offset
+                retired_log.append([int(offset), int(newly)])
+                alive = [int(c) for c in live if retire_step[cache_map[c]] < 0]
+                if not alive:
+                    break
+                m2 = _pad_slice(len(alive), int(cache_map.shape[0]))
+                if m2 < cache_map.shape[0]:
+                    # stream the retired rows' K/V back to the allocator:
+                    # gather the live rows into a menu-padded slice and
+                    # drop the wider cache — the next chunk decodes only
+                    # what still needs decoding
+                    idx = np.zeros((m2,), np.int32)
+                    idx[: len(alive)] = alive
+                    freed = _cache_nbytes(cur_cache)
+                    idx_dev = jnp.asarray(idx)
+                    cur_cache, prev, cur_lens = _gather_rows(
+                        cur_cache, prev, cur_lens, idx_dev)
+                    done = done[idx_dev]
+                    cur_ids = cur_ids[idx_dev]
+                    freed -= _cache_nbytes(cur_cache)
+                    record_counter("completion_cache_bytes_freed", freed)
+                    cache_map = cache_map[idx]
+                    cache_real = np.zeros((m2,), bool)
+                    cache_real[: len(alive)] = True
+            if sp is not None:
+                sp["args"]["retired_per_step"] = retired_log
+        saved = int(np.sum(steps - decoded_upto[real]))
+        if saved:
+            record_counter("conf_steps_saved", saved)
+        record_counter("pooled_conf_retired_rows",
+                       int((retire_step[real] >= 0).sum()))
+
+        # r* per row: the retirement step, or everything decoded; the scan
+        # sees positions < min(r*, EOS) — the same yes_no_from_reduced the
+        # per-batch path runs, on bit-identical per-position statistics
+        r_star = np.where(retire_step >= 0, retire_step, decoded_upto)
+        r_star = np.maximum(r_star, 1)
+        vs = r_star.copy()
+        if self.eos_id is not None:
+            for g in np.flatnonzero(real):
+                w = toks_np[g, : r_star[g]]
+                hits = np.flatnonzero(w == self.eos_id)
+                if hits.size:
+                    vs[g] = min(int(vs[g]), int(hits[0]) + 1)
+        res = yn.yes_no_from_reduced(
+            jnp.asarray(vals_np), jnp.asarray(logz_np), jnp.asarray(tgt_np),
+            max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+            valid_steps=jnp.asarray(vs),
+        )
+        res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+        conf_lp = vals_np[:, :min_conf] - logz_np[:, :min_conf, None]
+        conf_idx = idsk_np[:, :min_conf]
+
+        row = 0
+        for rows, n_real, orig in layout:
+            for j in range(n_real):
+                g = row + j
+                completion = ""
+                if self.completions:
+                    # a retired row's window never ends mid-character
+                    # (_conf_retired_at refuses U+FFFD tails), so the
+                    # stored text is a true prefix of the full-decode
+                    # completion as-is
+                    completion = engine._completion_text(
+                        toks_np[g, : r_star[g]], self.eos_id)
+                out = _attach_first_token(
+                    _result_row(
+                        res_np["yes_prob"][g], res_np["no_prob"][g],
+                        res_np["relative_prob"][g], res_np["odds_ratio"][g],
+                        res_np["found"][g], completion,
+                    ), (first3[:, 0], first3[:, 1], first3[:, 2]), g)
+                cands = engine._candidates_from_topk(conf_lp[g], conf_idx[g])
+                out["weighted_confidence"] = weighted_confidence_digits(cands)
+                self.results[int(orig[j])] = out
+            row += rows
 
     def _inflight_bytes(self) -> int:
         """K/V bytes pinned by dispatched-but-unexecuted flush decodes.
 
-        A deferred flush whose outputs report ready has executed — its
-        concatenated caches are already freed on device — so its bytes stop
-        counting (checked NON-blockingly via jax.Array.is_ready, keeping
-        the common case async; only genuinely queued flushes force the
-        drain above)."""
+        Each deferred flush's pinned bytes are split into per-output
+        parcels; a parcel whose probe reports ready stops counting
+        (checked NON-blockingly via jax.Array.is_ready, keeping the
+        common case async; only genuinely queued flushes force the drain
+        above).  Today's binary flush dispatches ONE reduction, so its
+        parcels usually resolve together — the per-output granularity is
+        the accounting CONTRACT (a flush built from several programs, or
+        a backend that materializes outputs independently, decrements
+        incrementally instead of all-or-nothing), not a claim about the
+        current program count.  Confidence flushes resolve synchronously
+        inside :meth:`_flush_confidence` and never reach this list —
+        their retired rows relieve pool pressure immediately via the
+        per-chunk compaction there."""
         total = 0
-        for i, (layout, fields, first3, fb) in enumerate(self.deferred):
-            if not fb:
-                continue
-            if all(getattr(v, "is_ready", lambda: True)()
-                   for v in fields.values()):
-                self.deferred[i] = (layout, fields, first3, 0)
-            else:
-                total += fb
+        for _layout, _fields, _first3, parcels in self.deferred:
+            for p in parcels:
+                if p[0] and getattr(p[1], "is_ready", lambda: True)():
+                    p[0] = 0
+                total += p[0]
         return total
 
     def drain(self):
         """Resolve every dispatched flush into result rows (host fetches)."""
-        for layout, fields, first3, _fb in self.deferred:
-            with obs.span("pool_drain", phase="d2h_fetch",
+        for layout, fields, first3, _parcels in self.deferred:
+            with obs.span("pool_drain", phase="d2h_fetch", leg=self.leg,
                           flushes=len(self.deferred)):
                 res_np = {k: np.asarray(v) for k, v in fields.items()}
             row = 0
@@ -1724,10 +2133,11 @@ class _Phase2Pool:
 @functools.partial(
     jax.jit,
     static_argnames=("cfg", "cache_len", "slice_m", "top_k", "top_filter",
-                     "out_len"))
+                     "out_len", "select_all"))
 def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
                     cache_len: int, slice_m: int, top_k: int,
-                    top_filter: int = 20, out_len: int = 0):
+                    top_filter: int = 20, out_len: int = 0,
+                    select_all: bool = False):
     """Prefill + position-0 scan + IN-PROGRAM phase-2 row selection.
 
     Selecting the undecided rows INSIDE the program — undecided-first
@@ -1745,11 +2155,17 @@ def _prefill_select(params, cfg, ids, mask, valid_rows, yes_ids, no_ids,
     Returns (scan0, first3 [top-filtered position-0 (yes, no, relative)],
     sel [slice_m] original batch row per slice row, sub_cache, last_sel,
     len_sel).  Callers must fall back to :func:`models.decoder.prefill`
-    when more than ``slice_m`` rows are undecided."""
+    when more than ``slice_m`` rows are undecided.
+
+    ``select_all`` (the pooled-confidence leg): EVERY valid row needs the
+    scored digit decode, so the undecided filter drops out — the sort key
+    is just batch-padding-last and the slice (``slice_m`` = the batch
+    size) carries all valid rows, still menu-padded to ``out_len`` so
+    cross-bucket pooling holds."""
     last, cache = dmod.prefill(params, cfg, ids, mask, cache_len=cache_len)
     lengths = jnp.sum(mask, axis=-1)
     scan0 = yn.first_token_scan(last, yes_ids, no_ids, top_k=top_k)
-    decided = scan0[4] | ~valid_rows
+    decided = (~valid_rows) if select_all else (scan0[4] | ~valid_rows)
     sel = jnp.argsort(decided, stable=True)[:slice_m]   # undecided first
     sub = dmod.cache_kv_map(
         cache, lambda a: a[:, sel],
@@ -1789,6 +2205,26 @@ def _gather_rows(cache, last, lengths, idx):
         positions=cache.positions[idx], valid=cache.valid[idx],
     )
     return sub, last[idx], lengths[idx]
+
+
+@functools.partial(jax.jit, static_argnames=("out_len",))
+def _pad_cache_slots(cache, out_len: int):
+    """Pad a cache's slot axis to ``out_len`` with inert invalid slots —
+    the host-dispatched twin of _prefill_select's in-program padding, for
+    caches that already exist (the fused confidence leg's suffix-extended
+    cache): zero K/V the attention bias masks out (zero int8 codes decode
+    to zero under any scale), ``valid=False``, position 0."""
+    pad_t = out_len - cache.k.shape[2]  # static: shape entries are ints
+
+    def pad_slots(a):   # k/v are [L, m, T, G, D]; scales [L, m, T, G]
+        widths = ((0, 0), (0, 0), (0, pad_t)) + ((0, 0),) * (a.ndim - 3)
+        return jnp.pad(a, widths)
+
+    return dmod.cache_kv_map(
+        cache, pad_slots,
+        positions=jnp.pad(cache.positions, ((0, 0), (0, pad_t))),
+        valid=jnp.pad(cache.valid, ((0, 0), (0, pad_t))),
+    )
 
 
 def _attach_first_token(row: Dict, first3, i: int) -> Dict:

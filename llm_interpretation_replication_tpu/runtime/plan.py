@@ -106,6 +106,54 @@ def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
     return batch * seq * cfg.num_heads * cfg.head_dim * 4
 
 
+#: Quantized cache lengths for the cross-batch phase-2 pools
+#: (runtime/engine._Phase2Pool): every pooled slice is padded (inert
+#: invalid slots) up to the menu entry covering its cache length, so
+#: slices from DIFFERENT length buckets pool and decode together.  Lives
+#: HERE (not in engine) so the budget model prices the same quantized
+#: shapes the engine actually pools.  TWO menus: the binary undecided-row
+#: pool keeps the coarse r4 menu (coalescing 257-512-token buckets under
+#: ONE key — finer entries would fragment its flushes and compile extra
+#: decode-shape families for a pool that holds only ~10% of rows), while
+#: the confidence pool — which holds EVERY row, so dead slots cost real
+#: HBM — gets 320/384 entries covering the fused leg's prefix-bucket +
+#: format-suffix cache lengths (a 256-token bucket + 16-token suffix used
+#: to quantize all the way up to 512, doubling the pooled bytes).
+POOL_LEN_MENU = (256, 512, 1024, 2048)
+CONF_POOL_LEN_MENU = (256, 320, 384, 512, 1024, 2048)
+
+
+def pool_len_for(cache_len: int, menu=POOL_LEN_MENU) -> int:
+    """Smallest pool-menu cache length covering ``cache_len``."""
+    for t in menu:
+        if cache_len <= t:
+            return t
+    return cache_len
+
+
+def conf_pool_len_for(cache_len: int) -> int:
+    """Confidence-pool quantized cache length (the finer menu)."""
+    return pool_len_for(cache_len, CONF_POOL_LEN_MENU)
+
+
+def pooled_confidence_extra_bytes(cfg, target: int, seq: int,
+                                  suffix_len: int = 64,
+                                  score_steps: int = 10,
+                                  kv_dtype: str = "bf16") -> int:
+    """Peak K/V the pooled confidence decode pins beyond the per-batch
+    live set (runtime/engine._Phase2Pool with ``leg="confidence"``): up to
+    ``target`` gathered row slices at the pool's quantized cache length
+    (prefix bucket + format suffix, :func:`pool_len_for`), grown by the
+    scored-decode steps, TWICE — the source slices and the flush's
+    concatenated copy coexist until the decode executes (the pool's own
+    2x ``_inflight_bytes`` accounting rule).  This is a *time-varying*
+    peak: early-exit retirement compacts retired rows' slices away per
+    decode chunk, so the figure here is the no-retirement worst case the
+    fit decision must survive."""
+    pool_len = conf_pool_len_for(seq + suffix_len)
+    return 2 * kv_cache_bytes(cfg, target, pool_len + score_steps, kv_dtype)
+
+
 def completions_extra_bytes(cfg, batch: int, seq: int,
                             gen_tokens: int = 50, score_steps: int = 10,
                             pipeline_depth: int = 2,
@@ -303,7 +351,9 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
                             requested_impl: Optional[str] = None,
                             top_k: Optional[int] = None,
                             kv_dtype: str = "bf16",
-                            prefill_chunk: int = 0) -> ScoringPlan:
+                            prefill_chunk: int = 0,
+                            pooled_confidence: bool = False,
+                            pool_target: Optional[int] = None) -> ScoringPlan:
     """Route the FULL-STUDY sweep (binary leg with completions + confidence
     leg): resolve the attention impl like a binary sweep, then shrink the
     batch (steps of 32) until the live set INCLUDING the completion path's
@@ -319,7 +369,14 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
     transients, so the planner PREDICTS the full-study fit back at batch
     >= 320 (int8 KV + 128-token chunks) instead of clamping to the
     measured bf16 224 cliff — with the PR-1 OOM ladder as the safety net
-    if the prediction is wrong on hardware."""
+    if the prediction is wrong on hardware.
+
+    ``pooled_confidence`` budgets the ISSUE-7 confidence pool: the
+    engine's leg-parameterized cross-batch pool gathers every confidence
+    row's cache slice and runs one pooled digit decode per
+    ``pool_target`` rows (default: the batch size), so the fit decision
+    must carry :func:`pooled_confidence_extra_bytes` — the no-retirement
+    worst-case pool peak — on top of the per-batch live set."""
     from ..models.decoder import REDUCED_TOPK
 
     reduced_scores = top_k is None or top_k <= REDUCED_TOPK
@@ -334,6 +391,13 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
     # of allocator working space beyond the ordinary reserve.
     budget = hbm_bytes - RESERVE_BYTES - THRASH_HEADROOM_BYTES
 
+    def conf_pool(b):
+        if not pooled_confidence:
+            return 0
+        return pooled_confidence_extra_bytes(
+            cfg, pool_target or b, seq, score_steps=score_steps,
+            kv_dtype=kv_dtype)
+
     def need(b):
         attn = (flash_workspace_bytes(cfg, b, seq)
                 if base.attention_impl == "flash"
@@ -341,19 +405,26 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
         return (wb + attn + activation_bytes(cfg, b, seq, prefill_chunk)
                 + completions_extra_bytes(cfg, b, seq, gen_tokens,
                                           score_steps, pipeline_depth,
-                                          reduced_scores, kv_dtype))
+                                          reduced_scores, kv_dtype)
+                + conf_pool(b))
 
     b = min(batch, base.batch)
     if need(b) > budget:
         b = max(32, (b // 32) * 32)     # step through multiples of 32:
         while b > 32 and need(b) > budget:  # batches stay sublane-aligned
             b -= 32
+    # the tag prices the pool at the FITTED batch: with no explicit
+    # pool_target the engine pools at its own batch_size, which is the
+    # clamped batch the caller will actually run
+    pool_tag = (f" + pooled-conf pool {conf_pool(b) / 2**30:.1f} GiB "
+                f"({pool_target or b} rows)" if pooled_confidence else "")
     if b == base.batch:
         # no full-study clamp: still report the full-study fit decision
         # (bench records this string per operating point)
         return dataclasses.replace(base, reason=(
             f"full-study fits at batch {b} with {kv_dtype} KV"
             + (f" + prefill chunk {prefill_chunk}" if prefill_chunk else "")
+            + pool_tag
             + f": {need(b) / 2**30:.1f} GiB of {budget / 2**30:.1f}"
             + f" [{base.reason}]"))
     return ScoringPlan(
@@ -362,5 +433,6 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
         f"of {kv_dtype} KV completion caches/scores at depth "
         f"{pipeline_depth}"
         + (f" (prefill chunk {prefill_chunk})" if prefill_chunk else "")
+        + pool_tag
         + f"; batch {batch} -> {b} to fit {budget / 2**30:.1f} GiB",
     )

@@ -40,6 +40,22 @@ def extract_first_int(text: str) -> Optional[int]:
         return None
 
 
+def first_int_stable(text: str) -> bool:
+    """Can :func:`extract_first_int` of ``text`` still change if more text
+    is APPENDED?  False means yes (keep decoding), True means the parse is
+    frozen: the first ``\\b``-delimited integer ends strictly before the
+    end of the string, so the character after it is a non-word boundary —
+    appended text can neither extend those digits nor introduce an
+    earlier match.  A trailing integer ("...about 8") is NOT stable: the
+    next token could extend it ("...about 85").  The pooled confidence
+    decode's early-exit retirement rests on this predicate
+    (runtime/engine._Phase2Pool._flush_confidence)."""
+    if not text:
+        return False
+    m = re.search(r"\b(\d+)\b", text)
+    return bool(m) and m.end() < len(text)
+
+
 def weighted_confidence_single_tokens(
     positions: Sequence[Sequence[Candidate]],
 ) -> Optional[float]:

@@ -423,6 +423,70 @@ class TestBudgetModelAnchors:
         with pytest.raises(ValueError):
             kv_cache_bytes(f7, 1, 1, "fp8")
 
+    def test_pool_len_menu_quantization(self):
+        """The pool-length menus live in plan.py (the engine aliases
+        them) so the budget model prices the exact quantized shapes the
+        engine pools.  The binary pool keeps the coarse r4 menu (one key
+        coalesces 257-512-token buckets — finer entries would fragment
+        its flushes); the confidence pool's 320/384 entries keep the
+        fused leg's prefix+suffix cache lengths off the 512 entry."""
+        from llm_interpretation_replication_tpu.runtime import engine as em
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            conf_pool_len_for,
+            pool_len_for,
+        )
+
+        assert em._pool_len is pool_len_for
+        assert em._conf_pool_len is conf_pool_len_for
+        # binary: unchanged r4 quantization
+        assert [pool_len_for(x) for x in (64, 256, 272, 432)] \
+            == [256, 256, 512, 512]
+        # confidence: finer, for the every-row pool
+        assert [conf_pool_len_for(x) for x in (64, 256, 272, 320, 384,
+                                               432)] \
+            == [256, 256, 320, 320, 384, 512]
+
+    def test_pooled_confidence_cache_term_anchor(self):
+        """Satellite (ISSUE 7): the pooled-confidence cache term is
+        PINNED so the estimator can't drift (the PR-5 anchor-pin
+        pattern): 2x (source slices + flush concat) of target rows at
+        pool_len(seq + suffix) + score_steps slots, dtype-aware."""
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            pooled_confidence_extra_bytes,
+        )
+
+        f7 = _falcon7b()
+        # 320 rows, 256-token sweep bucket -> pool len 320 (+64 suffix),
+        # +10 decode slots: exact byte pins, bf16 and int8
+        assert pooled_confidence_extra_bytes(f7, 320, 256) == 1730150400
+        assert pooled_confidence_extra_bytes(
+            f7, 320, 256, kv_dtype="int8") == 919142400
+        with pytest.raises(ValueError):
+            pooled_confidence_extra_bytes(f7, 320, 256, kv_dtype="fp8")
+
+    def test_full_study_fit_survives_the_pooled_confidence_term(self):
+        """THE ISSUE-7 planner acceptance: with the pooled-confidence
+        pool budgeted on top of the completion caches, the int8-KV +
+        chunk-128 full-study prediction still lands at batch >= 320, and
+        the fit-decision string names the pool so BENCH_r06 is
+        self-describing."""
+        from llm_interpretation_replication_tpu.runtime.plan import (
+            resolve_full_sweep_plan,
+        )
+
+        f7 = _falcon7b()
+        p = resolve_full_sweep_plan(f7, "int8", 320, 256, pipeline_depth=2,
+                                    kv_dtype="int8", prefill_chunk=128,
+                                    pooled_confidence=True)
+        assert p.batch == 320
+        assert "pooled-conf pool" in p.reason
+        # bf16 KV cannot carry the pool at sweep batches — the planner
+        # says so instead of OOMing on hardware
+        bf = resolve_full_sweep_plan(f7, "int8", 320, 256, pipeline_depth=2,
+                                     pooled_confidence=True)
+        assert bf.batch == 192
+        assert "pooled-conf pool" in bf.reason
+
 
 # ---------------------------------------------------------------------------
 # Serve replay parity with chunked prefill (bf16 contract untouched)

@@ -648,6 +648,24 @@ class TestRepoGate:
                      if e.get("path", "").endswith(touched)]
         assert not offenders, offenders
 
+    def test_pooled_conf_touched_modules_carry_no_baseline_entries(self):
+        """Satellite (ISSUE 7): the pooled-confidence-decode change ships
+        lint-clean — zero new ``lint_baseline.json`` entries for every
+        module it touches (engine pool + gate, plan term, confidence
+        stability predicate, CLI/config plumbing, bench)."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        touched = ("runtime/engine.py", "runtime/plan.py",
+                   "scoring/confidence.py", "config/__init__.py",
+                   "llm_interpretation_replication_tpu/__main__.py",
+                   "bench.py")
+        entries = load_baseline(default_baseline_path())
+        offenders = [e for e in entries
+                     if e.get("path", "").endswith(touched)]
+        assert not offenders, offenders
+
     def test_gate_would_catch_an_injected_violation(self, tmp_path):
         """End-to-end teeth check: copy one real hot-path file, inject a
         G01 `.item()` into it, and confirm the same entry point that the

@@ -1,0 +1,326 @@
+"""Pooled confidence-leg decode + streamed completion caches (ISSUE 7,
+``-m pooledconf``, tier-1).
+
+Pins the four contracts of the leg-parameterized ``_Phase2Pool``:
+
+- **pooled == per-batch at bf16**: the confidence scores the sweep
+  consumes — ``weighted_confidence`` (positions 0-2) and the completion's
+  first-integer parse — are BIT-IDENTICAL between the default pooled path
+  and the r5 per-batch decode (``pooled_confidence=False``), on both the
+  plain and the fused two-leg path; the binary leg is untouched
+  bit-for-bit.  The pooled completion is a prefix of the per-batch text
+  (full equality when no row retires early).
+- **int8 KV stays within the documented tolerance** (PARITY.md: the
+  kvcache contract extends to the pooled path).
+- **early-exit retirement ≡ the full 10-step decode on decided rows**:
+  rows forced to retire at the minimum step still emit the exact
+  weighted confidence and first-integer value the full decode emits,
+  while ``conf_steps_saved`` / ``completion_cache_bytes_freed`` prove
+  steps were actually skipped and caches actually streamed.  Retirement
+  is a pure function of each row's own tokens, so results are identical
+  across batch shapes / pool compositions (the serve-replay contract).
+- **strict mode holds**: a pooled-confidence sweep under the transfer
+  guard keeps ``blocked_transfers == 0`` (every pool fetch happens inside
+  the sanctioned consume scope).
+"""
+
+import dataclasses
+import itertools
+
+import numpy as np
+import pytest
+
+from test_runtime import _tiny_engine
+
+from llm_interpretation_replication_tpu.runtime import engine as emod
+from llm_interpretation_replication_tpu.runtime.engine import (
+    LegSpec,
+    ScoringEngine,
+)
+from llm_interpretation_replication_tpu.scoring.confidence import (
+    extract_first_int,
+    first_int_stable,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.pooledconf
+
+EXACT_FIELDS = ("first_token_yes_prob", "first_token_no_prob",
+                "first_token_relative_prob")
+PROB_FIELDS = ("yes_prob", "no_prob", "relative_prob")
+INT8_KV_ATOL = 0.05          # the PARITY.md kvcache tolerance
+
+CONF_PROMPTS = [f"How confident are you about rule {i}, 0-100?"
+                for i in range(16)]
+PAIRS = [(f"Scenario {i}: the bylaw covers bicycles in the park.",
+          (" Answer Yes or No.", " How confident, 0-100?"))
+         for i in range(6)]
+LEGS = [LegSpec("binary"),
+        LegSpec("confidence", with_confidence=True, max_new_tokens=10)]
+
+
+def _clone(eng, tok, **kw):
+    return ScoringEngine(eng.family, eng.cfg, eng.params, tok,
+                         engine_config=dataclasses.replace(eng.ecfg, **kw))
+
+
+def _clean_cut(pool, toks, k):
+    """Test-predicate guard mirroring the real retirement rule's one hard
+    invariant: never retire on a window whose decode ends mid-character
+    (U+FFFD tail) — the prefix/parse contracts only hold for clean cuts."""
+    text = pool.engine.tokenizer.decode(
+        [int(t) for t in toks[:k]], skip_special_tokens=True)
+    return not text.endswith("�")
+
+
+def _assert_conf_scores_equal(pooled_row, batch_row):
+    """The pooled-confidence equivalence contract (PARITY.md): weighted
+    confidence and first-integer parse bit-identical; completion a prefix;
+    position-0 fields untouched."""
+    assert pooled_row["weighted_confidence"] == \
+        batch_row["weighted_confidence"]
+    assert extract_first_int(pooled_row["completion"]) == \
+        extract_first_int(batch_row["completion"])
+    assert batch_row["completion"].startswith(pooled_row["completion"])
+    for f in EXACT_FIELDS:
+        assert pooled_row[f] == batch_row[f], f
+
+
+class TestPooledConfParity:
+    def test_plain_path_bf16_bit_parity(self):
+        eng, _, tok = _tiny_engine(batch_size=4)
+        telemetry.clear_counters()
+        pooled = _clone(eng, tok)       # pooled_confidence defaults ON
+        rows_p = pooled.score_prompts(CONF_PROMPTS, with_confidence=True,
+                                      max_new_tokens=10)
+        assert telemetry.counter("pooled_conf_rows") >= len(CONF_PROMPTS)
+        rows_b = _clone(eng, tok, pooled_confidence=False).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        for a, b in zip(rows_p, rows_b):
+            assert a["success"] and b["success"]
+            _assert_conf_scores_equal(a, b)
+            # no row retires on this model (garbage completions carry no
+            # terminated integer): the full completion text is identical,
+            # and the scan fields agree to reduction-order noise — the
+            # pooled decode's chunk boundaries (3/5/2 vs one 10-step
+            # chunk) split the two-block softmax sums differently past
+            # position 2, the same tolerance class the chunked-prefill
+            # equivalence pins (PARITY.md)
+            for f in PROB_FIELDS:
+                np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                           atol=1e-9, err_msg=f)
+            assert a["completion"] == b["completion"]
+
+    def test_fused_two_leg_bf16_and_binary_leg_untouched(self):
+        eng, _, tok = _tiny_engine(batch_size=4)
+        telemetry.clear_counters()
+        rows_p = _clone(eng, tok).score_prefixed(PAIRS, legs=LEGS)
+        assert telemetry.counter("pooled_conf_rows") >= len(PAIRS)
+        rows_b = _clone(eng, tok, pooled_confidence=False).score_prefixed(
+            PAIRS, legs=LEGS)
+        # binary leg: the pool must not perturb it in any way
+        for a, b in zip(rows_p[0], rows_b[0]):
+            for f in PROB_FIELDS + EXACT_FIELDS + ("odds_ratio",
+                                                   "completion"):
+                assert a[f] == b[f], f
+        for a, b in zip(rows_p[1], rows_b[1]):
+            _assert_conf_scores_equal(a, b)
+
+    def test_int8_kv_within_documented_tolerance(self):
+        eng, _, tok = _tiny_engine(batch_size=4)
+        rows_bf = _clone(eng, tok, pooled_confidence=False).score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        rows_i8 = _clone(eng, tok, kv_dtype="int8").score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        for a, b in zip(rows_i8, rows_bf):
+            assert a["success"]
+            for f in PROB_FIELDS:
+                assert abs(a[f] - b[f]) <= INT8_KV_ATOL, (f, a[f], b[f])
+        # pooled-int8 vs per-batch-int8: same dequantized cache values in,
+        # the pooled scores must track the per-batch ones within the same
+        # bound (they are bit-identical on this harness; the tolerance
+        # absorbs backend reduction-order variation at real shapes)
+        rows_i8b = _clone(eng, tok, kv_dtype="int8",
+                          pooled_confidence=False).score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        for a, b in zip(rows_i8, rows_i8b):
+            wa, wb = a["weighted_confidence"], b["weighted_confidence"]
+            assert (wa is None) == (wb is None)
+            if wa is not None:
+                assert abs(wa - wb) <= INT8_KV_ATOL, (wa, wb)
+
+    def test_pool_composition_never_changes_a_row(self):
+        """Retirement (and therefore every emitted field) is a function
+        of each row's own tokens: scoring the same prompts at different
+        batch sizes — different pool compositions and flush shapes — must
+        emit identical confidence rows (the serve-replay contract)."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        small = _clone(eng, tok).score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        big = _clone(eng, tok, batch_size=16).score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        for a, b in zip(small, big):
+            assert a["weighted_confidence"] == b["weighted_confidence"]
+            assert a["completion"] == b["completion"]
+
+
+class TestEarlyExitRetirement:
+    def test_forced_retirement_matches_full_decode_and_saves_steps(self):
+        """Early-exit retirement ≡ the full 10-step decode on decided
+        rows: rows retired at the minimum step (positions 0-2 decoded)
+        emit the exact weighted confidence and first-integer value of the
+        full decode, and the skipped steps land in ``conf_steps_saved``."""
+        eng, _, tok = _tiny_engine(batch_size=8)
+        rows_b = _clone(eng, tok, pooled_confidence=False).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        orig = emod._Phase2Pool._conf_retired_at
+        emod._Phase2Pool._conf_retired_at = \
+            lambda self, toks, k: _clean_cut(self, toks, k)
+        telemetry.clear_counters()
+        try:
+            rows_p = _clone(eng, tok).score_prompts(
+                CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        finally:
+            emod._Phase2Pool._conf_retired_at = orig
+        c = telemetry.counters()
+        assert c.get("conf_steps_saved", 0) > 0
+        assert c.get("pooled_conf_retired_rows", 0) > 0
+        for a, b in zip(rows_p, rows_b):
+            _assert_conf_scores_equal(a, b)
+
+    def test_staggered_retirement_streams_caches_per_chunk(self):
+        """Rows retiring at different steps compact the pooled cache
+        between chunks: retired rows' K/V slices free mid-flush
+        (``completion_cache_bytes_freed``) and the SURVIVING rows' score
+        math stays correct through the gathers — the weighted confidence
+        (positions 0-2, recorded before any compaction and independent
+        of where the text is cut) must match the full decode per row,
+        proving the row mapping never skews.  The predicate here retires
+        on a fixed cadence regardless of text (the real predicate's
+        clean-cut rule is pinned separately), so only the text-dependent
+        fields are exempt from comparison."""
+        eng, _, tok = _tiny_engine(batch_size=16)
+        rows_b = _clone(eng, tok, pooled_confidence=False).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        counter = itertools.count()
+        orig = emod._Phase2Pool._conf_retired_at
+        emod._Phase2Pool._conf_retired_at = \
+            lambda self, toks, k: next(counter) % 3 == 0
+        telemetry.clear_counters()
+        try:
+            rows_p = _clone(eng, tok).score_prompts(
+                CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        finally:
+            emod._Phase2Pool._conf_retired_at = orig
+        c = telemetry.counters()
+        assert c.get("completion_cache_bytes_freed", 0) > 0
+        assert c.get("conf_steps_saved", 0) > 0
+        for a, b in zip(rows_p, rows_b):
+            assert a["weighted_confidence"] == b["weighted_confidence"]
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+
+    def test_natural_retirement_on_digit_completions(self):
+        """A row whose greedy completion carries a terminated integer
+        retires through the REAL predicate (no monkeypatch): feed the
+        retirement check token streams that decode to digit answers."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        pool = emod._Phase2Pool(eng, steps=10, eos_id=None, target=4,
+                                results=[None] * 4, confidence=True)
+        # token ids whose decoded text is a digit answer + terminator
+        ids_85 = tok("85 okay", add_special_tokens=False)["input_ids"]
+        assert pool._conf_retired_at(np.asarray(ids_85), len(ids_85))
+        # a TRAILING integer is not stable (the next token could extend it)
+        ids_8 = tok("about 8", add_special_tokens=False)["input_ids"]
+        assert not pool._conf_retired_at(np.asarray(ids_8), len(ids_8))
+        # EOS freezes the completion regardless
+        pool_eos = emod._Phase2Pool(eng, steps=10, eos_id=7, target=4,
+                                    results=[None] * 4, confidence=True)
+        assert pool_eos._conf_retired_at(np.asarray([5, 7, 3]), 3)
+
+
+class TestFirstIntStable:
+    @pytest.mark.parametrize("text,stable", [
+        ("", False),
+        ("no digits at all", False),
+        ("85", False),              # could extend to 850
+        ("I am 85", False),         # trailing integer
+        ("85 percent", True),       # terminated
+        ("85%", True),              # boundary char terminates
+        ("about 40, maybe", True),
+        ("x85x", False),            # \b never matches inside a word
+    ])
+    def test_cases(self, text, stable):
+        assert first_int_stable(text) is stable
+
+    def test_stability_is_append_proof(self):
+        """The predicate's whole contract: once stable, NO appended text
+        can change extract_first_int."""
+        base = "confidence: 85 "
+        assert first_int_stable(base)
+        v = extract_first_int(base)
+        for tail in ("9", "99", " 12", "x", ".5", "000"):
+            assert extract_first_int(base + tail) == v, tail
+
+
+class TestStrictAndConfig:
+    def test_strict_pooled_confidence_sweep_no_blocked_transfers(self):
+        """Acceptance: every pool fetch (chunk tokens, retirement reads)
+        happens inside the sanctioned consume scope, so a strict-mode
+        pooled-confidence sweep holds ``blocked_transfers == 0``."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng, _, tok = _tiny_engine(batch_size=4)
+        pooled = _clone(eng, tok, kv_dtype="int8", prefill_chunk=16)
+        strict.activate()
+        try:
+            snap = telemetry.counters()
+            rows = pooled.score_prefixed(PAIRS, legs=LEGS)
+            delta = telemetry.counters_since(snap)
+            assert delta.get(strict.BLOCKED_COUNTER, 0) == 0
+            assert delta.get("pooled_conf_rows", 0) >= len(PAIRS)
+            assert all(r["success"] for leg in rows for r in leg)
+        finally:
+            strict.deactivate()
+
+    def test_per_batch_path_reachable_via_config(self):
+        """Acceptance: ``pooled_confidence=False`` keeps the r5 per-batch
+        decode — no pooled-confidence counters fire."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        telemetry.clear_counters()
+        rows = _clone(eng, tok, pooled_confidence=False).score_prompts(
+            CONF_PROMPTS[:6], with_confidence=True, max_new_tokens=10)
+        assert telemetry.counter("pooled_conf_rows") == 0
+        assert all(r["success"] for r in rows)
+
+    def test_oversized_cap_keeps_per_batch_path(self):
+        """A confidence leg whose completion cap exceeds the scored scan
+        (gen_total > steps) cannot ride the pool (the pooled decode IS
+        the completion) and must fall back per batch."""
+        eng, _, tok = _tiny_engine(batch_size=4)
+        telemetry.clear_counters()
+        rows = _clone(eng, tok).score_prompts(
+            CONF_PROMPTS[:6], with_confidence=True, max_new_tokens=20)
+        assert telemetry.counter("pooled_conf_rows") == 0
+        assert all(r["success"] for r in rows)
+
+    def test_pooled_decode_spans_carry_the_confidence_leg(self):
+        """Satellite: ``pooled_decode`` phase totals attribute the two
+        legs separately — the confidence pool tags its flush spans with
+        its own leg, next to the binary pool's."""
+        from llm_interpretation_replication_tpu.obs import tracer as obs
+
+        eng, _, tok = _tiny_engine(batch_size=4)
+        tracer = obs.get_tracer()
+        tracer.reset()
+        obs.enable()
+        try:
+            _clone(eng, tok).score_prefixed(PAIRS, legs=LEGS)
+            _clone(eng, tok, decode_completions=False).score_prompts(
+                ["Is item one a vehicle?"] * 6)
+            totals = obs.phase_totals(by_leg=True)
+        finally:
+            obs.disable()
+            tracer.reset()
+        assert "confidence" in totals.get("pooled_decode", {})
+        assert "binary" in totals.get("pooled_decode", {})
