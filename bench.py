@@ -111,19 +111,11 @@ import numpy as np
 
 A100_BASELINE_PROMPTS_PER_SEC = 1.0
 
-FALCON_7B = dict(
-    vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71,
-    num_kv_heads=1, intermediate_size=18176, parallel_residual=True,
-    shared_layernorm=True, qkv_bias=False, out_bias=False, mlp_bias=False,
-    position_embedding="rotary", tie_word_embeddings=True,
-    max_position_embeddings=2048,
-)
-
-SMALL_1B = dict(
-    vocab_size=50304, hidden_size=2048, num_layers=16, num_heads=16,
-    intermediate_size=8192, parallel_residual=True, qkv_bias=True,
-    out_bias=True, mlp_bias=True, position_embedding="rotary", rotary_pct=0.25,
-    max_position_embeddings=2048,
+# One spelling of the bench geometries, shared with the auto-parallel plan
+# search (models/config.py BENCH_GEOMETRIES).
+from llm_interpretation_replication_tpu.models.config import (  # noqa: E402
+    FALCON_7B_GEOMETRY as FALCON_7B,
+    SMALL_1B_GEOMETRY as SMALL_1B,
 )
 
 
@@ -1045,6 +1037,19 @@ def main():
                              "side-log every N rows (the sweep shells' "
                              "resume checkpoint; the xlsx renders once at "
                              "end of sweep)")
+    parser.add_argument("--plan-search", action="store_true",
+                        help="sweep modes: replace the fixed operating "
+                             "point with the auto-parallel plan search "
+                             "(runtime/plan_search.py) — enumerate batch x "
+                             "kv-dtype x prefill-chunk x pool-target "
+                             "candidates against the HBM budget model, "
+                             "rank by predicted rows/s, run the chosen "
+                             "plan, and attach a 'plan_search' block "
+                             "(chosen plan + ranked runner-up table with "
+                             "per-candidate fit/reject reasons) to the "
+                             "JSON record.  The PR-1 OOM ladder stays "
+                             "armed as the safety net when the prediction "
+                             "misses on hardware")
     parser.add_argument("--serve-replay", action="store_true",
                         help="sweep mode: after the offline repeats, push "
                              "the same workload through the serve/ "
@@ -1434,7 +1439,55 @@ def main():
         # plan THAT operating point, not the parity mode's 432-token one.
         # The full-study mode plans with the completion path's pinned
         # caches/score buffers included (measured: batch 256 OOMs there).
-        if args.mode == "sweep-full":
+        if args.plan_search:
+            # the auto-parallel search replaces the fixed operating point:
+            # the CHOSEN candidate's batch/kv-dtype/chunk/pool override the
+            # flags, the ranked runner-up table lands in the record, and a
+            # prediction miss on hardware falls down the PR-1 OOM ladder
+            # like any other wrong prediction (_sweep_oom_action)
+            from llm_interpretation_replication_tpu.runtime.plan_search import (
+                chosen_plan,
+                format_candidate_table,
+                plan_search_record,
+                search_plans,
+            )
+
+            workload = "full" if args.mode == "sweep-full" else "binary"
+            ranked = search_plans(
+                cfg, args.quant, n_devices=1, seq=256, workload=workload,
+                batches=tuple(range(32, max(512, args.sweep_batch) + 1,
+                                    32)),
+                pipeline_depth=args.pipeline_depth,
+                # a --attn flash run must be priced as flash (the fp32
+                # output workspace), not as the dense score tensor the
+                # flash kernel never materializes
+                attention_impl=args.attn)
+            best = chosen_plan(ranked)
+            print(format_candidate_table(ranked), file=sys.stderr)
+            if best is None:
+                print("# plan search: no candidate fits; falling back to "
+                      "the fixed operating point", file=sys.stderr)
+            else:
+                args.plan_search_report = plan_search_record(ranked)
+                args.sweep_batch = best.batch
+                args.kv_dtype = best.kv_dtype
+                args.prefill_chunk = best.prefill_chunk
+                # unconditional: pool_target 0 IS part of the chosen plan
+                # (pool at batch size) — letting a user flag survive here
+                # would run a different pool than the record names
+                args.pool_target = best.pool_target
+                args.fit_decision = best.reason
+                args.predicted_batch = best.batch
+                print(f"# plan search: running chosen plan batch "
+                      f"{best.batch} kv {best.kv_dtype} chunk "
+                      f"{best.prefill_chunk} pool "
+                      f"{best.pool_target or 'batch'} "
+                      f"({best.predicted_rows_per_s:.1f} predicted "
+                      f"rows/s)", file=sys.stderr)
+        sweep_plan = None
+        if getattr(args, "plan_search_report", None):
+            pass  # operating point chosen above; skip the fixed resolve
+        elif args.mode == "sweep-full":
             from llm_interpretation_replication_tpu.runtime.engine import (
                 EngineConfig,
             )
@@ -1479,18 +1532,19 @@ def main():
         # batch land in the JSON record's context block, and the OOM
         # ladder prints predicted-vs-actual when the prediction was wrong
         # on hardware (_sweep_oom_action)
-        args.fit_decision = sweep_plan.reason
-        args.predicted_batch = sweep_plan.batch
-        if sweep_plan.batch != args.sweep_batch or (
-                sweep_plan.attention_impl != args.attn):
-            print(f"# sweep plan: {sweep_plan.reason}; batch "
-                  f"{args.sweep_batch} -> {sweep_plan.batch}, attn "
-                  f"{args.attn} -> {sweep_plan.attention_impl}",
-                  file=sys.stderr)
-            args.sweep_batch = sweep_plan.batch
-            if sweep_plan.attention_impl != args.attn:
-                args.attn = sweep_plan.attention_impl
-                cfg = DecoderConfig(**geometry, attention_impl=args.attn)
+        if sweep_plan is not None:
+            args.fit_decision = sweep_plan.reason
+            args.predicted_batch = sweep_plan.batch
+            if sweep_plan.batch != args.sweep_batch or (
+                    sweep_plan.attention_impl != args.attn):
+                print(f"# sweep plan: {sweep_plan.reason}; batch "
+                      f"{args.sweep_batch} -> {sweep_plan.batch}, attn "
+                      f"{args.attn} -> {sweep_plan.attention_impl}",
+                      file=sys.stderr)
+                args.sweep_batch = sweep_plan.batch
+                if sweep_plan.attention_impl != args.attn:
+                    args.attn = sweep_plan.attention_impl
+                    cfg = DecoderConfig(**geometry, attention_impl=args.attn)
         if args.mode == "sweep-full":
             rps, rate, out_path = run_sweep_full_mode(args, cfg, params)
             print(f"# sweep-full workbook: "
@@ -1518,6 +1572,8 @@ def main():
             }
             record.update(_repeat_report(args))
             record.update(_operating_context(args))
+            if getattr(args, "plan_search_report", None):
+                record["plan_search"] = args.plan_search_report
             record.update(getattr(args, "phases_report", None) or {})
             print(json.dumps(_attach_strict(record)))
             return
@@ -1539,6 +1595,8 @@ def main():
         }
         record.update(_repeat_report(args))
         record.update(_operating_context(args))
+        if getattr(args, "plan_search_report", None):
+            record["plan_search"] = args.plan_search_report
         record.update(getattr(args, "phases_report", None) or {})
         if getattr(args, "serve_report", None):
             record["serve"] = args.serve_report
@@ -1620,6 +1678,12 @@ def main():
                 # parent must not silently run its full-study child
                 # uninstrumented — the child gets its own artifact paths
                 # so it never clobbers the parent's trace
+                if args.plan_search:
+                    # the child searches its OWN (full-study) operating
+                    # point: the parent's binary-workload choice does not
+                    # transfer across workloads, and the child's record
+                    # carries its own plan_search block either way
+                    cmd += ["--plan-search"]
                 if args.trace:
                     cmd += ["--trace", args.trace + ".sweep-full.json"]
                     if args.trace_sync:
@@ -1636,7 +1700,8 @@ def main():
                     raise RuntimeError(
                         f"sweep-full child exited {proc.returncode}")
                 frec = json.loads(proc.stdout.strip().splitlines()[-1])
-                extra = {k: frec[k] for k in ("phases", "context")
+                extra = {k: frec[k] for k in ("phases", "context",
+                                              "plan_search")
                          if k in frec}
                 record["secondary"].append({
                     "metric": frec["metric"],

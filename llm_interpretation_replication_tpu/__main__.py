@@ -80,6 +80,14 @@ def _add_run_config_args(p: argparse.ArgumentParser):
     p.add_argument("--phase2-pool-target", type=int, default=0, metavar="N",
                    help="rows per pooled phase-2 decode (binary undecided "
                         "pool AND confidence pool); 0 = batch size")
+    p.add_argument("--plan-search", action="store_true",
+                   help="auto-parallel plan search (runtime/plan_search.py)"
+                        ": enumerate mesh x batch x kv-dtype x "
+                        "prefill-chunk candidates against the HBM budget "
+                        "model and run the predicted-rows/s winner instead "
+                        "of the batch/kv/mesh flags; the engine's OOM "
+                        "back-off ladder stays armed as the safety net "
+                        "('plan search' prints the same table standalone)")
     p.add_argument("--mesh-model", type=int, default=1)
     p.add_argument("--mesh-seq", type=int, default=1)
     p.add_argument("--batch-size", type=int, default=16)
@@ -95,6 +103,7 @@ def _run_config(args):
         kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
         pooled_confidence=getattr(args, "pooled_confidence", True),
         phase2_pool_target=getattr(args, "phase2_pool_target", 0),
+        plan_search=getattr(args, "plan_search", False),
         attention_impl=args.attention_impl,
         mesh_model=args.mesh_model,
         mesh_seq=args.mesh_seq, batch_size=args.batch_size,
@@ -119,24 +128,84 @@ def _engine_factory(run_config):
 
     def factory(model_name: str) -> ScoringEngine:
         path = run_config.snapshot_path(model_name)
+        rc, factory_mesh, plan_note = run_config, mesh, None
+        if rc.plan_search:
+            rc, factory_mesh, plan_note = _searched_run_config(rc, path,
+                                                              mesh)
         family, cfg, params = load_model(
-            path, dtype=run_config.resolve_dtype(), mesh=mesh,
-            quant=run_config.quant,
-            attention_impl=run_config.attention_impl,
+            path, dtype=rc.resolve_dtype(), mesh=factory_mesh,
+            quant=rc.quant,
+            attention_impl=rc.attention_impl,
         )
         tokenizer = load_tokenizer(path)
-        return ScoringEngine(
-            family, cfg, params, tokenizer, mesh=mesh,
+        engine = ScoringEngine(
+            family, cfg, params, tokenizer, mesh=factory_mesh,
+            # NOTE: oom_backoff keeps its armed default here — when the
+            # plan search chose this operating point, the PR-1 in-place
+            # re-bucket ladder is the safety net for a prediction miss
             engine_config=EngineConfig(
-                batch_size=run_config.batch_size,
-                kv_dtype=run_config.kv_dtype,
-                prefill_chunk=run_config.prefill_chunk,
-                pooled_confidence=run_config.pooled_confidence,
-                phase2_pool_target=run_config.phase2_pool_target,
+                batch_size=rc.batch_size,
+                kv_dtype=rc.kv_dtype,
+                prefill_chunk=rc.prefill_chunk,
+                pooled_confidence=rc.pooled_confidence,
+                phase2_pool_target=rc.phase2_pool_target,
             ),
         )
+        engine.plan_decision = plan_note
+        return engine
 
     return factory
+
+
+def _searched_run_config(rc, path, mesh):
+    """Apply the auto-parallel plan search to one model's engine
+    construction: read the snapshot's geometry (config.json only — no
+    weights), search mesh x batch x kv-dtype x prefill-chunk over the
+    visible devices, and rewrite the RunConfig fields (plus the mesh) to
+    the chosen plan.  Returns (run_config, mesh, decision_note); falls
+    back to the flags unchanged — with a stderr note — for geometries the
+    budget model cannot price (T5-family encoders)."""
+    import dataclasses
+
+    import jax
+
+    from .models.config import from_hf_config
+    from .parallel import make_mesh
+    from .runtime.loader import load_hf_config
+    from .runtime.plan_search import (
+        chosen_plan,
+        format_candidate_table,
+        search_plans,
+    )
+
+    try:
+        _family, dcfg = from_hf_config(load_hf_config(path))
+        ranked = search_plans(dcfg, rc.quant, len(jax.devices()),
+                              workload="full")
+    except (ValueError, AttributeError, TypeError, OSError) as err:
+        print(f"# plan search skipped for {path}: {err}; running the "
+              f"configured flags", file=sys.stderr)
+        return rc, mesh, None
+    best = chosen_plan(ranked)
+    if best is None:
+        print("# plan search: no candidate fits; running the configured "
+              "flags", file=sys.stderr)
+        return rc, mesh, None
+    print(format_candidate_table(ranked, top=4), file=sys.stderr)
+    rc = dataclasses.replace(
+        rc, batch_size=best.batch, kv_dtype=best.kv_dtype,
+        prefill_chunk=best.prefill_chunk,
+        # unconditional: pool_target 0 IS the chosen plan's pool-at-batch
+        # configuration, not "keep the flag"
+        phase2_pool_target=best.pool_target,
+        mesh_model=best.model)
+    if best.data * best.model > 1:
+        mesh = make_mesh(data=best.data, model=best.model)
+    note = (f"plan search chose mesh dp{best.data}xtp{best.model} batch "
+            f"{best.batch} kv {best.kv_dtype} chunk {best.prefill_chunk} "
+            f"({best.reason})")
+    print(f"# {note}", file=sys.stderr)
+    return rc, mesh, note
 
 
 def cmd_run_100q(args):
@@ -1010,6 +1079,16 @@ def cmd_verify_replication(args):
         raise SystemExit(1)
 
 
+def cmd_plan(args):
+    """``plan search``: the auto-parallel strategy search (runtime/
+    plan_search.py).  Like ``lint``/``obs``, in practice UNREACHABLE —
+    ``main()`` routes ``plan`` pre-argparse; the subparser exists so the
+    subcommand shows up in ``--help``."""
+    from .runtime.plan_search import main as plan_main
+
+    raise SystemExit(plan_main(args.plan_args))
+
+
 def cmd_obs(args):
     """``obs report``: phase-attribution table over a saved span trace.
 
@@ -1038,6 +1117,13 @@ def main(argv=None):
         from .obs.report import main as obs_main
 
         raise SystemExit(obs_main(argv[1:]))
+    if argv and argv[0] == "plan":
+        # same pre-argparse routing as lint/obs: the plan-search CLI is
+        # pure host arithmetic and must not pay (or trigger) the parent
+        # parser's run-config machinery or a JAX backend init
+        from .runtime.plan_search import main as plan_main
+
+        raise SystemExit(plan_main(argv[1:]))
     parser = argparse.ArgumentParser(prog="llm_interpretation_replication_tpu")
     sub = parser.add_subparsers(dest="command", required=True)
 
@@ -1305,6 +1391,20 @@ def main(argv=None):
                         "text|json, --baseline PATH, --no-baseline, "
                         "--write-baseline, --explain RULE|all")
     p.set_defaults(fn=cmd_lint)
+
+    p = sub.add_parser("plan",
+                       help="auto-parallel plan search: 'plan search' "
+                            "enumerates mesh x batch x kv-dtype x "
+                            "prefill-chunk candidates against the HBM "
+                            "budget model and ranks them by predicted "
+                            "rows/s ('plan search --dryrun' proves the "
+                            "choice vs the hand-picked MULTICHIP points "
+                            "on the virtual 8-device mesh)")
+    p.add_argument("plan_args", nargs=argparse.REMAINDER,
+                   help="forwarded: search [--model ...] [--devices N] "
+                        "[--workload full|binary] [--dryrun] "
+                        "[--format table|json]")
+    p.set_defaults(fn=cmd_plan)
 
     p = sub.add_parser("obs",
                        help="observability reports: 'obs report --trace "

@@ -139,6 +139,13 @@ class RunConfig:
                                          # EngineConfig.pooled_confidence)
     phase2_pool_target: int = 0          # rows per pooled decode (binary +
                                          # confidence pools); 0 = batch_size
+    plan_search: bool = False            # auto-parallel plan search (runtime/
+                                         # plan_search.py): pick batch/
+                                         # kv-dtype/prefill-chunk/mesh from
+                                         # the budget + cost model instead of
+                                         # the flags; the engine's OOM
+                                         # back-off ladder stays armed as the
+                                         # safety net when prediction misses
     attention_impl: str = "xla"          # 'xla' | 'flash' | 'auto' (dense up
                                          # to 1k tokens, Pallas kernel beyond
                                          # — models/config.DecoderConfig)

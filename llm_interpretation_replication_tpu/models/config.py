@@ -527,3 +527,42 @@ def from_hf_config(hf) -> Tuple[str, object]:
         raise ValueError(f"unsupported model_type {mt!r}")
     family, translate = FAMILY_BY_MODEL_TYPE[mt]
     return family, translate(hf)
+
+
+# ---------------------------------------------------------------------------
+# Benchmark geometries
+# ---------------------------------------------------------------------------
+# The two synthetic-weight geometries the bench and the auto-parallel plan
+# search price (bench.py initializes them randomly on device — zero-egress
+# image, throughput is architecture-bound).  Living HERE keeps bench.py and
+# runtime/plan_search.py agreeing on what "falcon-7b" means geometrically.
+FALCON_7B_GEOMETRY = dict(
+    vocab_size=65024, hidden_size=4544, num_layers=32, num_heads=71,
+    num_kv_heads=1, intermediate_size=18176, parallel_residual=True,
+    shared_layernorm=True, qkv_bias=False, out_bias=False, mlp_bias=False,
+    position_embedding="rotary", tie_word_embeddings=True,
+    max_position_embeddings=2048,
+)
+
+SMALL_1B_GEOMETRY = dict(
+    vocab_size=50304, hidden_size=2048, num_layers=16, num_heads=16,
+    intermediate_size=8192, parallel_residual=True, qkv_bias=True,
+    out_bias=True, mlp_bias=True, position_embedding="rotary",
+    rotary_pct=0.25, max_position_embeddings=2048,
+)
+
+BENCH_GEOMETRIES = {"falcon-7b": FALCON_7B_GEOMETRY,
+                    "small-1b": SMALL_1B_GEOMETRY}
+
+#: Compile-check-scale Falcon architecture (MQA + parallel attention +
+#: shared LN) — the geometry the multichip dryrun trains/scores
+#: (__graft_entry__) and the plan-search dryrun prices; one spelling so
+#: the acceptance leg can never price a different model than the dryrun
+#: engine runs.
+FLAGSHIP_SMALL_GEOMETRY = dict(
+    vocab_size=1024, hidden_size=256, num_layers=4, num_heads=8,
+    num_kv_heads=1, intermediate_size=1024, parallel_residual=True,
+    shared_layernorm=True, qkv_bias=False, out_bias=False, mlp_bias=False,
+    position_embedding="rotary", tie_word_embeddings=True,
+    max_position_embeddings=512,
+)

@@ -4,6 +4,7 @@ from .mesh import (
     PIPE_AXIS,
     SEQ_AXIS,
     data_sharded,
+    enumerate_mesh_shapes,
     initialize_distributed,
     make_mesh,
     mesh_shape_for,
@@ -34,6 +35,7 @@ __all__ = [
     "split_stage_params",
     "data_sharded",
     "initialize_distributed",
+    "enumerate_mesh_shapes",
     "make_mesh",
     "mesh_shape_for",
     "replicated",

@@ -47,6 +47,38 @@ def make_mesh(
     return Mesh(arr, (DATA_AXIS, PIPE_AXIS, MODEL_AXIS, SEQ_AXIS))
 
 
+def enumerate_mesh_shapes(
+    n_devices: int,
+    max_model: Optional[int] = None,
+    max_pipe: int = 1,
+) -> Tuple[Tuple[int, int, int], ...]:
+    """Every (data, pipe, model) factorization of ``n_devices`` (seq=1).
+
+    The auto-parallel plan search (runtime/plan_search.py) enumerates these
+    as its mesh axis of the candidate space; listing them HERE, next to
+    :func:`make_mesh`, keeps the enumeration and the constructor agreeing on
+    what a legal mesh is (every returned shape satisfies
+    ``data * pipe * model == n_devices`` and builds without error).
+    ``max_model``/``max_pipe`` bound the model/pipe degrees (a tp or pp
+    degree beyond the caller's interconnect or layer count is never a
+    candidate worth pricing); shapes are ordered data-major (pure dp first).
+    """
+    if n_devices < 1:
+        raise ValueError(f"n_devices must be >= 1, got {n_devices}")
+    shapes = []
+    for pipe in range(1, max(1, max_pipe) + 1):
+        if n_devices % pipe:
+            continue
+        rem = n_devices // pipe
+        for model in range(1, rem + 1):
+            if rem % model:
+                continue
+            if max_model is not None and model > max_model:
+                continue
+            shapes.append((rem // model, pipe, model))
+    return tuple(sorted(set(shapes), key=lambda s: (-s[0], s[1], s[2])))
+
+
 def mesh_shape_for(n_devices: int, want_model: int = 1, want_seq: int = 1) -> Tuple[int, int, int]:
     """Largest data axis given desired model/seq parallelism, shrinking model
     then seq until they divide the device count."""

@@ -315,6 +315,11 @@ class ScoringEngine:
         self._plan_cache: Dict[Tuple, plan_mod.GenerationPlan] = {}
         # audit trail of the most recent score_prefixed call's prefix pool
         self.last_prefix_pool: Optional[PrefixCachePool] = None
+        # the auto-parallel plan search's decision note when this engine's
+        # operating point was chosen by search (runtime/plan_search.py via
+        # the CLI engine factory); None = hand-configured.  Sweep shells
+        # log it so every run names how its operating point was picked.
+        self.plan_decision: Optional[str] = None
 
     # -- helpers ---------------------------------------------------------
 

@@ -106,6 +106,59 @@ def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
     return batch * seq * cfg.num_heads * cfg.head_dim * 4
 
 
+# ---------------------------------------------------------------------------
+# Fit-decision formatting — ONE spelling for every budget audit
+# ---------------------------------------------------------------------------
+# resolve_scoring_plan, resolve_full_sweep_plan, bench.py's stderr lines and
+# the plan-search candidate table all print "how much of the budget does this
+# configuration need".  Routing every one of them through these helpers is
+# the guarantee that the JSON record's ``context`` block and the stderr
+# diagnostics can never spell the same decision differently (ISSUE 8
+# satellite).
+
+def budget_audit(need_bytes: int, budget_bytes: int) -> str:
+    """``"{need} GiB of {budget}"`` — the budget-audit fragment."""
+    return f"{need_bytes / 2**30:.1f} GiB of {budget_bytes / 2**30:.1f}"
+
+
+def budget_reject(need_bytes: int, budget_bytes: int) -> str:
+    """``"{need} GiB > budget {budget}"`` — the over-budget fragment."""
+    return (f"{need_bytes / 2**30:.1f} GiB > budget "
+            f"{budget_bytes / 2**30:.1f}")
+
+
+def pooled_conf_tag(pool_bytes: int, pool_rows: int) -> str:
+    """The pooled-confidence annotation appended to full-study reasons."""
+    return (f" + pooled-conf pool {pool_bytes / 2**30:.1f} GiB "
+            f"({pool_rows} rows)")
+
+
+def full_study_fit_reason(batch: int, kv_dtype: str, prefill_chunk: int,
+                          pool_tag: str, need_bytes: int, budget_bytes: int,
+                          base_reason: str) -> str:
+    """Reason string for a full-study operating point that fits as asked."""
+    return (f"full-study fits at batch {batch} with {kv_dtype} KV"
+            + (f" + prefill chunk {prefill_chunk}" if prefill_chunk else "")
+            + pool_tag
+            + f": {budget_audit(need_bytes, budget_bytes)}"
+            + f" [{base_reason}]")
+
+
+def full_study_clamp_reason(requested_batch: int, batch: int,
+                            completions_bytes: int, kv_dtype: str,
+                            pipeline_depth: int, prefill_chunk: int,
+                            pool_tag: str, budget_bytes: int) -> str:
+    """Reason string for a full-study batch clamped to fit the budget."""
+    return (f"full-study row contract pins "
+            f"{completions_bytes / 2**30:.1f} GiB "
+            f"of {kv_dtype} KV completion caches/scores at depth "
+            f"{pipeline_depth}"
+            + (f" (prefill chunk {prefill_chunk})" if prefill_chunk else "")
+            + pool_tag
+            + f"; batch {requested_batch} -> {batch} to fit "
+              f"{budget_bytes / 2**30:.1f} GiB")
+
+
 #: Quantized cache lengths for the cross-batch phase-2 pools
 #: (runtime/engine._Phase2Pool): every pooled slice is padded (inert
 #: invalid slots) up to the menu entry covering its cache length, so
@@ -278,6 +331,44 @@ def prefix_cache_extra_bytes(cfg, batch: int, prefix_len: int,
     return pipeline_depth * (shared + legs)
 
 
+def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
+                          batch: int, seq: int, gen_tokens: int = 50,
+                          score_steps: int = 10, pipeline_depth: int = 2,
+                          reduced_scores: bool = True,
+                          kv_dtype: str = "bf16", prefill_chunk: int = 0,
+                          pooled_confidence: bool = False,
+                          pool_target: Optional[int] = None) -> dict:
+    """Per-term HBM breakdown of the full-study live set at one operating
+    point — the exact terms :func:`resolve_full_sweep_plan`'s ``need()``
+    sums.  Exposed as a dict so the auto-parallel search
+    (runtime/plan_search.py) can divide each term across the mesh axis
+    that actually shards it (weights across tp·pp, batch-leading terms
+    across dp, KV terms across tp only when the kv heads divide) instead
+    of re-deriving the budget model.
+
+    Keys: ``weights``, ``attn`` (score tensor / flash workspace),
+    ``act`` (activation live set), ``completions`` (pinned completion
+    caches + logits/scores), ``conf_pool`` (pooled-confidence worst-case
+    peak; 0 unless ``pooled_confidence``)."""
+    attn = (flash_workspace_bytes(cfg, batch, seq)
+            if attention_impl == "flash"
+            else dense_attention_bytes(cfg, batch, seq, prefill_chunk))
+    conf_pool = 0
+    if pooled_confidence:
+        conf_pool = pooled_confidence_extra_bytes(
+            cfg, pool_target or batch, seq, score_steps=score_steps,
+            kv_dtype=kv_dtype)
+    return {
+        "weights": weight_b,
+        "attn": attn,
+        "act": activation_bytes(cfg, batch, seq, prefill_chunk),
+        "completions": completions_extra_bytes(
+            cfg, batch, seq, gen_tokens, score_steps, pipeline_depth,
+            reduced_scores, kv_dtype),
+        "conf_pool": conf_pool,
+    }
+
+
 @dataclasses.dataclass
 class ScoringPlan:
     attention_impl: str        # "xla" (dense) or "flash"
@@ -318,8 +409,7 @@ def resolve_scoring_plan(cfg, quant: str, batch: int, seq: int,
     fits_dense = dense_need <= budget
     if fits_dense and requested_impl != "flash":
         return ScoringPlan("xla", batch, True, wb,
-                           f"dense fits: {dense_need / 2**30:.1f} GiB of "
-                           f"{budget / 2**30:.1f}"
+                           f"dense fits: {budget_audit(dense_need, budget)}"
                            + (f" (prefill chunk {prefill_chunk})"
                               if prefill_chunk else ""))
 
@@ -338,8 +428,8 @@ def resolve_scoring_plan(cfg, quant: str, batch: int, seq: int,
     impl = "flash" if not fits_dense or requested_impl == "flash" else "xla"
     return ScoringPlan(
         impl, clamped, fits_dense, wb,
-        f"dense needs {dense_need / 2**30:.1f} GiB > budget "
-        f"{budget / 2**30:.1f}; flash at batch {clamped}"
+        f"dense needs {budget_reject(dense_need, budget)}; "
+        f"flash at batch {clamped}"
         if not fits_dense else f"flash requested; batch {clamped}",
     )
 
@@ -391,22 +481,14 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
     # of allocator working space beyond the ordinary reserve.
     budget = hbm_bytes - RESERVE_BYTES - THRASH_HEADROOM_BYTES
 
-    def conf_pool(b):
-        if not pooled_confidence:
-            return 0
-        return pooled_confidence_extra_bytes(
-            cfg, pool_target or b, seq, score_steps=score_steps,
-            kv_dtype=kv_dtype)
+    def terms(b):
+        return full_study_need_terms(
+            cfg, wb, base.attention_impl, b, seq, gen_tokens, score_steps,
+            pipeline_depth, reduced_scores, kv_dtype, prefill_chunk,
+            pooled_confidence, pool_target)
 
     def need(b):
-        attn = (flash_workspace_bytes(cfg, b, seq)
-                if base.attention_impl == "flash"
-                else dense_attention_bytes(cfg, b, seq, prefill_chunk))
-        return (wb + attn + activation_bytes(cfg, b, seq, prefill_chunk)
-                + completions_extra_bytes(cfg, b, seq, gen_tokens,
-                                          score_steps, pipeline_depth,
-                                          reduced_scores, kv_dtype)
-                + conf_pool(b))
+        return sum(terms(b).values())
 
     b = min(batch, base.batch)
     if need(b) > budget:
@@ -416,23 +498,18 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
     # the tag prices the pool at the FITTED batch: with no explicit
     # pool_target the engine pools at its own batch_size, which is the
     # clamped batch the caller will actually run
-    pool_tag = (f" + pooled-conf pool {conf_pool(b) / 2**30:.1f} GiB "
-                f"({pool_target or b} rows)" if pooled_confidence else "")
+    fitted = terms(b)
+    pool_tag = (pooled_conf_tag(fitted["conf_pool"], pool_target or b)
+                if pooled_confidence else "")
     if b == base.batch:
         # no full-study clamp: still report the full-study fit decision
         # (bench records this string per operating point)
-        return dataclasses.replace(base, reason=(
-            f"full-study fits at batch {b} with {kv_dtype} KV"
-            + (f" + prefill chunk {prefill_chunk}" if prefill_chunk else "")
-            + pool_tag
-            + f": {need(b) / 2**30:.1f} GiB of {budget / 2**30:.1f}"
-            + f" [{base.reason}]"))
+        return dataclasses.replace(base, reason=full_study_fit_reason(
+            b, kv_dtype, prefill_chunk, pool_tag, need(b), budget,
+            base.reason))
     return ScoringPlan(
         base.attention_impl, b, base.fits_dense, wb,
-        f"full-study row contract pins {completions_extra_bytes(cfg, b, seq, gen_tokens, score_steps, pipeline_depth, reduced_scores, kv_dtype) / 2**30:.1f} GiB "
-        f"of {kv_dtype} KV completion caches/scores at depth "
-        f"{pipeline_depth}"
-        + (f" (prefill chunk {prefill_chunk})" if prefill_chunk else "")
-        + pool_tag
-        + f"; batch {batch} -> {b} to fit {budget / 2**30:.1f} GiB",
+        full_study_clamp_reason(batch, b, fitted["completions"], kv_dtype,
+                                pipeline_depth, prefill_chunk, pool_tag,
+                                budget),
     )

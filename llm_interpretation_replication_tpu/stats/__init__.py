@@ -14,6 +14,7 @@ from .compliance import (
 )
 from .correlations import (
     cohens_kappa,
+    compare_correlation_distributions,
     correlation_summary_bootstrap,
     fisher_z_pvalue,
     pairwise_correlations,

@@ -129,6 +129,11 @@ def run_model_perturbation_sweep(
     Engines without the fused API (older/foreign engines, API fakes) keep
     the legacy two-full-string path bit-for-bit."""
     log = log or SessionLogger()
+    if getattr(engine, "plan_decision", None):
+        # the operating point was chosen by the auto-parallel plan search
+        # (runtime/plan_search.py) — name the decision in the sweep log so
+        # the run is auditable the way bench records are
+        log(f"[plan] {engine.plan_decision}")
     all_rows, processed = load_existing_rows(output_xlsx)
     pending: List[Dict] = []
     os.makedirs(os.path.dirname(os.path.abspath(output_xlsx)), exist_ok=True)

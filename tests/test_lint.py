@@ -666,6 +666,52 @@ class TestRepoGate:
                      if e.get("path", "").endswith(touched)]
         assert not offenders, offenders
 
+    def test_plan_search_is_in_g05_scope(self):
+        """Satellite (ISSUE 8): the plan search sits between the budget
+        model and the engine factory — a broad except swallowing there
+        turns a mis-priced candidate into a silent wrong operating point,
+        so G05 applies to runtime/plan_search.py like every other runtime
+        module (the default-paths walker already scans it; this is the
+        teeth check)."""
+        findings = run("runtime/plan_search.py", """
+            def pick(candidates):
+                try:
+                    return candidates[0]
+                except Exception:
+                    return None
+        """)
+        assert rules_of(findings) == ["G05"]
+
+    def test_plan_search_module_is_scanned_by_the_gate(self):
+        from llm_interpretation_replication_tpu.lint.cli import (
+            iter_python_files,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        scanned = [f.replace(os.sep, "/") for f in iter_python_files([pkg])]
+        assert any("/runtime/plan_search.py" in f for f in scanned)
+
+    def test_plan_search_touched_modules_carry_no_baseline_entries(self):
+        """Satellite (ISSUE 8): the auto-parallel-search change ships
+        lint-clean — zero new ``lint_baseline.json`` entries for every
+        module it touches (search + budget helpers, mesh enumeration,
+        stats comparison, CLI/config plumbing, sweeps logging, bench)."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        touched = ("runtime/plan_search.py", "runtime/plan.py",
+                   "parallel/mesh.py", "models/config.py",
+                   "stats/correlations.py", "sweeps/perturbation.py",
+                   "config/__init__.py",
+                   "llm_interpretation_replication_tpu/__main__.py",
+                   "bench.py")
+        entries = load_baseline(default_baseline_path())
+        offenders = [e for e in entries
+                     if e.get("path", "").endswith(touched)]
+        assert not offenders, offenders
+
     def test_gate_would_catch_an_injected_violation(self, tmp_path):
         """End-to-end teeth check: copy one real hot-path file, inject a
         G01 `.item()` into it, and confirm the same entry point that the

@@ -191,6 +191,83 @@ class TestCorrelations:
         assert fisher_z_pvalue(0.0, 100) == pytest.approx(1.0)
 
 
+class TestCompareCorrelationDistributions:
+    """The 57th coverage row (VERDICT Missing #2): the reference's
+    compare_distributions (calculate_correlation_pvalues.py:138-205) —
+    Mann-Whitney/KS/t-test/Cohen's d over two correlation samples plus the
+    proportion of significant correlations."""
+
+    def _samples(self):
+        rng = np.random.default_rng(7)
+        within = np.clip(rng.normal(0.75, 0.08, 60), -1, 1)
+        between = np.clip(rng.normal(0.45, 0.12, 80), -1, 1)
+        return within, between
+
+    def test_separated_distributions_all_tests_agree(self):
+        from llm_interpretation_replication_tpu.stats import (
+            compare_correlation_distributions,
+        )
+
+        within, between = self._samples()
+        out = compare_correlation_distributions(
+            within, between, labels=("within", "between"))
+        assert out["mannwhitney_p"] < 1e-6
+        assert out["ks_p"] < 1e-6
+        assert out["t_p"] < 1e-6
+        assert out["cohens_d"] > 1.0  # large standardized effect
+        assert out["within"]["n"] == 60 and out["between"]["n"] == 80
+        assert out["within"]["mean"] > out["between"]["mean"]
+
+    def test_identical_distributions_null_holds(self):
+        from llm_interpretation_replication_tpu.stats import (
+            compare_correlation_distributions,
+        )
+
+        rng = np.random.default_rng(8)
+        a = rng.normal(0.5, 0.1, 200)
+        b = rng.normal(0.5, 0.1, 200)
+        out = compare_correlation_distributions(a, b)
+        assert out["mannwhitney_p"] > 0.01
+        assert out["ks_p"] > 0.01
+        assert abs(out["cohens_d"]) < 0.25
+
+    def test_cohens_d_known_value(self):
+        """Two point-mass-ish samples with unit pooled std: d = mean gap."""
+        from llm_interpretation_replication_tpu.stats import (
+            compare_correlation_distributions,
+        )
+
+        a = np.array([0.0, 2.0] * 50)   # mean 1, var ~1.01
+        b = np.array([1.0, 3.0] * 50)   # mean 2, same spread
+        out = compare_correlation_distributions(a, b)
+        assert out["cohens_d"] == pytest.approx(-1.0, abs=0.01)
+
+    def test_proportion_significant_and_nan_policy(self):
+        from llm_interpretation_replication_tpu.stats import (
+            compare_correlation_distributions,
+        )
+
+        within, between = self._samples()
+        out = compare_correlation_distributions(
+            np.concatenate([within, [np.nan]]), between,
+            labels=("w", "b"),
+            p_values_a=[0.01] * 45 + [0.5] * 15,
+            p_values_b=[0.2] * 80,
+            alpha=0.05,
+        )
+        assert out["w"]["n"] == 60  # the NaN correlation dropped
+        assert out["w"]["proportion_significant"] == pytest.approx(0.75)
+        assert out["b"]["proportion_significant"] == 0.0
+
+    def test_too_few_finite_values_raises(self):
+        from llm_interpretation_replication_tpu.stats import (
+            compare_correlation_distributions,
+        )
+
+        with pytest.raises(ValueError):
+            compare_correlation_distributions([0.5], [0.1, 0.2, 0.3])
+
+
 class TestCompliance:
     def test_first_and_full(self):
         exp = {
