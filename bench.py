@@ -436,6 +436,12 @@ def run_sweep_mode(args, cfg, params):
     out_path = args.sweep_out or os.path.join(
         tempfile.mkdtemp(prefix="bench_sweep_"), "results.xlsx")
     sidelog = _sidelog_path(out_path)
+    # flight recorder (obs/flight.py) armed at the workbook dir: a
+    # mid-repeat OOM-ladder step or retry exhaustion leaves a
+    # flightrec-*.json next to the bench artifacts
+    from llm_interpretation_replication_tpu.obs import flight as obs_flight
+
+    obs_flight.enable(os.path.dirname(os.path.abspath(out_path)))
     all_rows, pending = [], []
 
     def flush(final=False):
@@ -535,6 +541,7 @@ def run_sweep_mode(args, cfg, params):
         repeat_times.append(dt)
         last_ok_rows = len(all_rows)
         rep += 1
+        _metrics_repeat_sample(args)
     assert last_ok_rows == n_total, (last_ok_rows, n_total)
     args.repeat_times = repeat_times  # warm-vs-cold report (main())
 
@@ -742,6 +749,7 @@ def run_sweep_full_mode(args, cfg, params):
         repeat_times.append(dt)
         last_ok_path = out_path
         rep += 1
+        _metrics_repeat_sample(args)
     from llm_interpretation_replication_tpu.utils.telemetry import counters
 
     c = counters()
@@ -770,6 +778,17 @@ def run_sweep_full_mode(args, cfg, params):
               f"report", file=sys.stderr)
         last_ok_path = None
     return n_total / best_dt, measured_rate, last_ok_path
+
+
+def _metrics_repeat_sample(args):
+    """One metrics-registry sample per finished repeat (``--metrics``):
+    the binary sweep mode has no per-chunk heartbeat (one engine call
+    covers the corpus), so the repeat boundary is its sampling point."""
+    if not getattr(args, "metrics", None):
+        return
+    from llm_interpretation_replication_tpu.obs import metrics as obs_metrics
+
+    obs_metrics.get_registry().sample()
 
 
 def _obs_phase_snap(args):
@@ -1092,6 +1111,16 @@ def main():
                              "modes into DIR (TensorBoard/Perfetto "
                              "viewable; headless analysis via "
                              "utils/profiling.top_device_ops)")
+    parser.add_argument("--metrics", nargs="?", const="bench_metrics.jsonl",
+                        default=None, metavar="PATH",
+                        help="streaming JSONL metrics log (obs/"
+                             "metrics.py): one sample per sweep heartbeat "
+                             "+ one per finished repeat — telemetry "
+                             "counters (raw + since-start delta), "
+                             "sample-ring percentiles, progress gauges — "
+                             "to PATH (default bench_metrics.jsonl); "
+                             "forwarded to the sweep-full child with a "
+                             "child-specific path like --trace")
     parser.add_argument("--microbatch", type=int, default=1, metavar="N",
                         help="split the batch into N independent chunks "
                              "inside the jit so XLA can overlap one chunk's "
@@ -1157,6 +1186,18 @@ def main():
         strict_mod.activate()
     else:
         strict_mod.activate_from_env()
+
+    if args.metrics:
+        # streaming metrics log (obs/metrics.py): one JSON sample per
+        # sweep heartbeat / finished repeat; a crashed run keeps every
+        # line already flushed, like the span log
+        from llm_interpretation_replication_tpu.obs import (
+            metrics as metrics_mod,
+        )
+
+        metrics_mod.enable_jsonl(args.metrics)
+        print(f"# obs: metrics log streaming to {args.metrics}",
+              file=sys.stderr)
 
     if args.trace:
         # span tracing (obs/): armed for the whole run; the Chrome trace
@@ -1688,6 +1729,13 @@ def main():
                     cmd += ["--trace", args.trace + ".sweep-full.json"]
                     if args.trace_sync:
                         cmd += ["--trace-sync"]
+                if args.metrics:
+                    # child-specific path, same discipline as --trace: a
+                    # metered parent must not run its full-study child
+                    # unmetered, and the child must not clobber the
+                    # parent's metrics log
+                    cmd += ["--metrics",
+                            args.metrics + ".sweep-full.jsonl"]
                 if args.profile:
                     cmd += ["--profile",
                             os.path.join(args.profile, "sweep-full")]

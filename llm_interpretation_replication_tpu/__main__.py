@@ -50,6 +50,16 @@ def _add_run_config_args(p: argparse.ArgumentParser):
                    help="windowed jax.profiler capture into DIR for the "
                         "command's run (obs/profiler.py; headless "
                         "analysis: utils/profiling.top_device_ops)")
+    p.add_argument("--metrics", nargs="?", const="metrics.jsonl",
+                   default=None, metavar="PATH",
+                   help="streaming JSONL metrics log (obs/metrics.py): "
+                        "one sample per sweep heartbeat — telemetry "
+                        "counters (raw + since-start delta), sample-ring "
+                        "percentiles with truncation visibility, and "
+                        "progress gauges — to PATH (default "
+                        "metrics.jsonl).  Off by default; the live HTTP "
+                        "endpoint is the serve subcommand's "
+                        "--metrics-port")
     p.add_argument("--device", choices=["tpu", "cpu"], default="tpu")
     p.add_argument("--dtype", default="bfloat16")
     p.add_argument("--quant", choices=["none", "int8"], default="none",
@@ -1090,7 +1100,9 @@ def cmd_plan(args):
 
 
 def cmd_obs(args):
-    """``obs report``: phase-attribution table over a saved span trace.
+    """``obs report`` / ``obs bench-diff``: phase-attribution table over
+    a saved span trace, and the bench-trajectory regression analyzer
+    over BENCH_r*.json records.
 
     Like ``lint``, in practice UNREACHABLE — ``main()`` routes ``obs`` to
     :mod:`.obs.report` before argparse runs (REMAINDER cannot accept
@@ -1374,6 +1386,12 @@ def main(argv=None):
                    help="default per-request deadline (expired requests "
                         "are rejected with a typed DeadlineExceeded, "
                         "never silently dropped)")
+    p.add_argument("--metrics-port", type=int, default=0, metavar="PORT",
+                   help="host /metrics (Prometheus text exposition over "
+                        "the telemetry counters + serve sample-ring "
+                        "percentiles) and /healthz (scheduler liveness + "
+                        "queue depth) on this port while the driver "
+                        "runs (obs/metrics.py; 0 = off)")
     p.add_argument("--replay", metavar="PERTURBATIONS", default=None,
                    help="replay mode: push the perturbation sweep "
                         "workload through the scheduler, assert "
@@ -1409,10 +1427,15 @@ def main(argv=None):
     p = sub.add_parser("obs",
                        help="observability reports: 'obs report --trace "
                             "PATH' aggregates a saved span trace (JSONL "
-                            "log or Chrome-trace JSON) per phase/leg")
+                            "log or Chrome-trace JSON) per phase/leg; "
+                            "'obs bench-diff BENCH_r04.json "
+                            "BENCH_r05.json' aligns bench records into a "
+                            "regression table (exit 1 past --threshold)")
     p.add_argument("obs_args", nargs=argparse.REMAINDER,
                    help="forwarded: report --trace PATH [--wall-s S] "
-                        "[--rows N] [--format table|json]")
+                        "[--rows N] [--format table|json], or bench-diff "
+                        "RECORD... [--threshold PCT] [--format "
+                        "table|json] [--no-fail]")
     p.set_defaults(fn=cmd_obs)
 
     p = sub.add_parser("repair-batch",
@@ -1548,7 +1571,14 @@ def main(argv=None):
     # Observability (obs/): --trace arms the span tracer for the whole
     # command (JSONL streams as spans close; the Chrome trace exports on
     # the way out, success or failure), --profile wraps the command in a
-    # jax.profiler capture window.  Both are measurement-only.
+    # jax.profiler capture window, --metrics streams the JSONL metrics
+    # log (one sample per sweep heartbeat).  All measurement-only.
+    if getattr(args, "metrics", None):
+        from .obs import metrics as obs_metrics
+
+        obs_metrics.enable_jsonl(args.metrics)
+        print(f"# obs: metrics log streaming to {args.metrics}",
+              file=sys.stderr)
     trace_path = getattr(args, "trace", None)
     profile_dir = getattr(args, "profile", None)
     if not trace_path and not profile_dir:

@@ -22,6 +22,22 @@ PARITY.md "Observability"):
 - :mod:`.report` — the ``obs report`` CLI over saved traces and the
   table/JSON renderers bench uses live.
 
+The LIVE/longitudinal run-health layer sits next to the tracer (all
+measurement-only too):
+
+- :mod:`.metrics` — metrics registry + Prometheus text exposition +
+  stdlib ``/metrics``+``/healthz`` HTTP endpoint (``serve
+  --metrics-port``) + streaming JSONL metrics log (``--metrics``), and
+  the sweep shells' single :func:`~.metrics.heartbeat` code path (log
+  line AND gauges from one place).
+- :mod:`.flight` — flight recorder (bounded recent-activity ring dumped
+  as a ``flightrec-*.json`` triage artifact on OOM-ladder engagement,
+  transient-retry exhaustion, preemption, or watchdog trip) and the
+  stall watchdog (warn + dump when a sweep stops progressing; never
+  kills).
+- :mod:`.benchdiff` — the ``obs bench-diff`` trajectory analyzer over
+  ``BENCH_r*.json`` records (regression table with thresholds).
+
 Strict-mode contract: tracing performs NO device→host transfer of its
 own.  The opt-in ``sync`` at span close (``enable(sync=True)``) calls
 ``jax.block_until_ready`` inside the strict layer's sanctioned-fetch

@@ -1,0 +1,296 @@
+"""``obs bench-diff``: the bench-trajectory regression analyzer.
+
+The repo's perf history lives in the driver's ``BENCH_r*.json`` records
+(r01 38.15 → r05 120.15 p/s), but every round the trajectory was
+compared BY HAND and transcribed into ROADMAP prose.  This subcommand
+makes the comparison a checked artifact: read two or more records, align
+their headline, secondary metrics, phase decomposition, and operating-
+context counters across rounds, and print a regression table — exit 1
+when any throughput metric fell by more than the threshold, so a CI step
+(or the next round's author) catches a regression the moment the record
+lands instead of five rounds later.
+
+Record shapes accepted, newest-field-tolerant:
+
+- the driver wrapper ``{"n", "cmd", "rc", "tail", "parsed": {...}}`` —
+  the checked-in ``BENCH_r*.json`` files;
+- a bare bench record (the one JSON line ``bench.py`` prints):
+  ``{"metric", "value", "unit", "secondary": [...], "phases": {...},
+  "context": {...}}``.
+
+Metric alignment: the headline rows align positionally ("headline" key);
+secondary rows align by a STABLE KEY derived from the metric description
+(workload class + prompt-token length + batch-independent tags), because
+the free-text metric strings legitimately drift round over round (batch
+sizes, hit rates).  Rows present in only one record report as ``new`` /
+``gone`` instead of silently vanishing from the table.
+
+Regression semantics: every metric this bench records is throughput
+(prompts/sec, rows/sec — higher is better), so a drop beyond
+``--threshold`` percent is a REGRESSION; phase rows compare
+``ms_per_row`` (lower is better) when both records carry a ``phases``
+block.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Tuple
+
+#: units where larger values are better (everything bench records today).
+_HIGHER_IS_BETTER_UNITS = ("prompts/sec", "rows/sec")
+
+
+def load_bench_record(path: str) -> Dict:
+    """One record, unwrapped from the driver shape when present, with a
+    ``label`` derived from the filename (``BENCH_r04.json`` → ``r04``)."""
+    with open(path, encoding="utf-8") as f:
+        doc = json.load(f)
+    rec = doc.get("parsed") if isinstance(doc.get("parsed"), dict) else doc
+    if not isinstance(rec, dict) or "value" not in rec:
+        raise ValueError(f"{path}: not a bench record (no 'value' field)")
+    stem = os.path.splitext(os.path.basename(path))[0]
+    m = re.search(r"(r\d+)$", stem)
+    rec = dict(rec)
+    rec["label"] = m.group(1) if m else stem
+    return rec
+
+
+def metric_key(metric: str, unit: str) -> str:
+    """Stable cross-round identity for a metric row.
+
+    The free-text descriptions drift (batch sizes, measured hit rates,
+    attn impl), so the key keeps only what identifies the WORKLOAD:
+    the mode class, the prompt-token length when named, and the unit."""
+    text = metric.lower()
+    if "full-study" in text or "full row contract" in text:
+        mode = "full-study"
+    elif "end-to-end" in text:
+        mode = "e2e-sweep"
+    elif "single forward" in text:
+        mode = "single"
+    elif "decode, all rows" in text or "all rows" in text:
+        mode = "decode-all"
+    elif "two-phase" in text:
+        mode = "parity"
+    else:
+        mode = "other"
+    tags = []
+    m = re.search(r"(\d+)-token prompts", text)
+    if m:
+        tags.append(f"{m.group(1)}tok")
+    if "sweep operating point" in text:
+        tags.append("sweep-point")
+    key = mode + (("@" + "+".join(tags)) if tags else "")
+    return f"{key} [{unit}]"
+
+
+def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
+    """``{aligned key: {"value", "unit", "metric"}}`` for the headline +
+    every secondary row.  Key collisions (two secondaries of one class)
+    disambiguate by index."""
+    out: Dict[str, Dict] = {
+        "headline": {"value": rec["value"], "unit": rec.get("unit", ""),
+                     "metric": rec.get("metric", "")},
+    }
+    for entry in rec.get("secondary", ()) or ():
+        key = metric_key(entry.get("metric", ""), entry.get("unit", ""))
+        base, n = key, 2
+        while key in out:
+            key = f"{base} #{n}"
+            n += 1
+        out[key] = {"value": entry.get("value"),
+                    "unit": entry.get("unit", ""),
+                    "metric": entry.get("metric", "")}
+    return out
+
+
+def _pct(old: Optional[float], new: Optional[float]) -> Optional[float]:
+    if old is None or new is None or not old:
+        return None
+    return (new - old) / old * 100.0
+
+
+def diff_records(records: Sequence[Dict],
+                 threshold_pct: float = 5.0) -> Dict:
+    """Align ``records`` (round order) and classify every metric row.
+
+    Returns ``{"labels", "metrics": [row...], "phases": [row...],
+    "context": [row...], "regressions": [...]}`` where each metric row is
+    ``{key, values, delta_pct, verdict}`` over the FIRST→LAST pair (the
+    middle rounds print for trajectory context)."""
+    labels = [r["label"] for r in records]
+    flats = [flatten_metrics(r) for r in records]
+    keys: List[str] = []
+    for flat in flats:
+        for k in flat:
+            if k not in keys:
+                keys.append(k)
+    metrics, regressions = [], []
+    for key in keys:
+        values = [flat.get(key, {}).get("value") for flat in flats]
+        unit = next((flat[key]["unit"] for flat in flats if key in flat), "")
+        first = next((v for v in values if v is not None), None)
+        last = next((v for v in reversed(values) if v is not None), None)
+        delta = _pct(first, last)
+        if values[0] is None:
+            verdict, delta = "new", None
+        elif values[-1] is None:
+            verdict, delta = "gone", None
+        elif delta is None:
+            verdict = "n/a"
+        elif unit in _HIGHER_IS_BETTER_UNITS and delta < -threshold_pct:
+            verdict = "REGRESSION"
+        elif unit in _HIGHER_IS_BETTER_UNITS and delta > threshold_pct:
+            verdict = "improved"
+        else:
+            verdict = "ok"
+        row = {"key": key, "unit": unit, "values": values,
+               "delta_pct": None if delta is None else round(delta, 2),
+               "verdict": verdict}
+        metrics.append(row)
+        if verdict == "REGRESSION":
+            regressions.append(row)
+
+    phases = []
+    phase_blocks = [r.get("phases") or {} for r in records]
+    if sum(1 for b in phase_blocks if b.get("per_phase")) >= 2:
+        names: List[str] = []
+        for block in phase_blocks:
+            for name in block.get("per_phase", {}):
+                if name not in names:
+                    names.append(name)
+        for name in names:
+            values = [
+                (block.get("per_phase", {}).get(name) or {}).get(
+                    "ms_per_row",
+                    (block.get("per_phase", {}).get(name) or {}).get(
+                        "seconds"))
+                for block in phase_blocks
+            ]
+            first = next((v for v in values if v is not None), None)
+            last = next((v for v in reversed(values) if v is not None),
+                        None)
+            delta = _pct(first, last)
+            # phase cost: LOWER is better
+            if values[0] is None:
+                verdict, delta = "new", None
+            elif values[-1] is None:
+                verdict, delta = "gone", None
+            elif delta is not None and delta > threshold_pct:
+                verdict = "REGRESSION"
+            elif delta is not None and delta < -threshold_pct:
+                verdict = "improved"
+            else:
+                verdict = "ok"
+            row = {"key": f"phase:{name}", "unit": "ms/row",
+                   "values": values,
+                   "delta_pct": None if delta is None else round(delta, 2),
+                   "verdict": verdict}
+            phases.append(row)
+            if verdict == "REGRESSION":
+                regressions.append(row)
+
+    context = []
+    ctx_blocks = [r.get("context") or {} for r in records]
+    if sum(1 for b in ctx_blocks if b) >= 2:
+        names = []
+        for block in ctx_blocks:
+            for name in block:
+                if name not in names:
+                    names.append(name)
+        for name in names:
+            values = [block.get(name) for block in ctx_blocks]
+            if all(v == values[0] for v in values):
+                continue                    # unchanged context is noise
+            context.append({"key": f"context:{name}", "values": values})
+
+    return {"labels": labels, "threshold_pct": threshold_pct,
+            "metrics": metrics, "phases": phases, "context": context,
+            "regressions": regressions}
+
+
+def format_diff_table(diff: Dict) -> str:
+    """The aligned regression table (stdout)."""
+    labels = diff["labels"]
+    width = max([len("metric")] + [len(r["key"])
+                                   for r in diff["metrics"] + diff["phases"]
+                                   + diff["context"]])
+
+    def fmt(v):
+        if v is None:
+            return "-"
+        if isinstance(v, float):
+            return f"{v:.2f}"
+        return str(v)
+
+    lines = [
+        f"# bench trajectory: {' -> '.join(labels)} "
+        f"(threshold {diff['threshold_pct']:g}%)",
+        "  " + "metric".ljust(width) + "  "
+        + "  ".join(f"{lab:>10}" for lab in labels)
+        + f"  {'delta':>9}  verdict",
+    ]
+    for row in diff["metrics"] + diff["phases"]:
+        delta = ("" if row["delta_pct"] is None
+                 else f"{row['delta_pct']:+8.2f}%")
+        lines.append(
+            "  " + row["key"].ljust(width) + "  "
+            + "  ".join(f"{fmt(v):>10}" for v in row["values"])
+            + f"  {delta:>9}  {row['verdict']}")
+    for row in diff["context"]:
+        lines.append(
+            "  " + row["key"].ljust(width) + "  "
+            + "  ".join(f"{fmt(v):>10}" for v in row["values"]))
+    n_reg = len(diff["regressions"])
+    lines.append(f"  {n_reg} regression(s) beyond "
+                 f"{diff['threshold_pct']:g}%"
+                 + ("" if not n_reg else ": "
+                    + ", ".join(r["key"] for r in diff["regressions"])))
+    return "\n".join(lines)
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    """``obs bench-diff`` CLI body (routed from obs/report.py)."""
+    parser = argparse.ArgumentParser(
+        prog="llm_interpretation_replication_tpu obs bench-diff",
+        description="align two or more BENCH_r*.json records and print a "
+                    "regression table over the perf trajectory")
+    parser.add_argument("records", nargs="+", metavar="BENCH.json",
+                        help="two or more bench records, oldest first "
+                             "(driver wrapper or bare bench JSON line)")
+    parser.add_argument("--threshold", type=float, default=5.0,
+                        metavar="PCT",
+                        help="regression threshold in percent (throughput "
+                             "drop / phase ms-per-row growth beyond this "
+                             "fails; default 5)")
+    parser.add_argument("--format", choices=["table", "json"],
+                        default="table")
+    parser.add_argument("--no-fail", action="store_true",
+                        help="always exit 0 (report-only mode; default "
+                             "exits 1 when any regression exceeds the "
+                             "threshold)")
+    args = parser.parse_args(argv)
+    if len(args.records) < 2:
+        parser.error("need at least two records to diff")
+    try:
+        records = [load_bench_record(p) for p in args.records]
+    except (OSError, ValueError) as err:
+        print(f"obs bench-diff: {err}", file=sys.stderr)
+        return 2
+    diff = diff_records(records, threshold_pct=args.threshold)
+    if args.format == "json":
+        print(json.dumps(diff, indent=2))
+    else:
+        print(format_diff_table(diff))
+    if diff["regressions"] and not args.no_fail:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -151,14 +151,27 @@ def aggregate_spans(spans: Sequence[Dict]) -> Dict[str, Dict[str, float]]:
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
-    """``obs report`` CLI body (routed from __main__ like ``lint``)."""
+    """``obs`` CLI body (routed from __main__ like ``lint``): ``obs
+    report`` aggregates a saved span trace; ``obs bench-diff`` runs the
+    bench-trajectory regression analyzer (:mod:`.benchdiff`)."""
+    argv = list(sys.argv[1:] if argv is None else argv)
+    if argv[:1] == ["bench-diff"]:
+        # dispatched before this parser: bench-diff has its own argument
+        # surface (positional records + thresholds)
+        from .benchdiff import main as benchdiff_main
+
+        return benchdiff_main(argv[1:])
     parser = argparse.ArgumentParser(
         prog="llm_interpretation_replication_tpu obs",
-        description="phase-attribution report over a saved span trace "
-                    "(JSONL span log or Chrome-trace/Perfetto JSON)")
-    parser.add_argument("action", choices=["report"],
+        description="observability reports: 'report' aggregates a saved "
+                    "span trace (JSONL span log or Chrome-trace/Perfetto "
+                    "JSON) per phase/leg; 'bench-diff' aligns BENCH_r*."
+                    "json records into a regression table")
+    parser.add_argument("action", choices=["report", "bench-diff"],
                         help="'report': aggregate a saved trace per "
-                             "phase/leg and print the table")
+                             "phase/leg and print the table; "
+                             "'bench-diff': compare bench records "
+                             "(handled by obs/benchdiff.py)")
     parser.add_argument("--trace", required=True, metavar="PATH",
                         help="saved trace: the --trace JSONL span log or "
                              "the exported Chrome-trace JSON")

@@ -268,21 +268,38 @@ def retry_transient(fn: Callable, policy: Optional[RetryPolicy] = None,
                     label: str = "") -> Callable:
     """Wrap ``fn`` so transient errors retry per ``policy`` (default
     :func:`default_transient_policy`), recording a telemetry fault event
-    per retried error.  Non-transient errors propagate immediately."""
+    per retried error.  Non-transient errors propagate immediately.
+
+    A transient error that STILL propagates means the retry budget is
+    exhausted — the run is about to lose work — so that case records a
+    distinct ``transient_exhausted`` fault event (an obs/ flight-recorder
+    trigger) before re-raising."""
     import dataclasses as dc
 
     policy = policy or default_transient_policy()
     inner = policy.retry_predicate or is_transient
+    name = label or getattr(fn, "__name__", "")
 
     def recording_predicate(err: BaseException) -> bool:
         if not inner(err):
             return False
-        record_fault("transient_retry", label=label or getattr(fn, "__name__", ""),
-                     error=oom_detail(err))
+        record_fault("transient_retry", label=name, error=oom_detail(err))
         return True
 
     policy = dc.replace(policy, retry_predicate=recording_predicate)
-    return retry_with_exponential_backoff(policy)(fn)
+    retrying = retry_with_exponential_backoff(policy)(fn)
+
+    def run(*args, **kwargs):
+        try:
+            return retrying(*args, **kwargs)
+        except Exception as err:
+            if inner(err):
+                record_fault("transient_exhausted", label=name,
+                             retries=policy.max_retries,
+                             error=oom_detail(err))
+            raise
+
+    return run
 
 
 # ---------------------------------------------------------------------------

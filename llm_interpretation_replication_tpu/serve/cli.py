@@ -47,34 +47,72 @@ def parse_request_line(obj: Dict) -> ScoreRequest:
     return req
 
 
+def _metrics_endpoint(sched, port: int):
+    """``/metrics`` + ``/healthz`` for a live scheduler (obs/metrics.py):
+    the Prometheus exposition over the telemetry counters and serve
+    sample rings, plus a periodic sampler feeding the registry's
+    time-series.  Returns the started server (caller closes), or None
+    when ``port`` is falsy."""
+    if not port:
+        return None
+    from ..obs import metrics as obs_metrics
+
+    registry = obs_metrics.get_registry()
+    registry.start_sampler()
+
+    def health():
+        return {"scheduler": "closed" if sched._closed else "running",
+                "queue_depth": len(sched.queue)}
+
+    server = obs_metrics.MetricsServer(registry, port,
+                                       healthz_fn=health).start()
+    print(f"# serve: metrics on :{server.port}/metrics, health on "
+          f"/healthz", file=sys.stderr)
+    return server
+
+
 def run_jsonl_driver(engine, in_stream, out_stream,
-                     config: Optional[SchedulerConfig] = None) -> Dict:
+                     config: Optional[SchedulerConfig] = None,
+                     metrics_port: int = 0) -> Dict:
     """Read JSONL requests, serve them, write JSONL results in input
     order.  Returns ``{"requests": N, "errors": M}``."""
     entries = []  # (id, future-or-None, error-or-None)
-    with Scheduler(engine, config) as sched:
-        for i, line in enumerate(in_stream):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                future = sched.submit(parse_request_line(json.loads(line)))
-                entries.append((i, future, None))
-            except (ValueError, KeyError, TypeError, ServeError) as err:
-                # malformed line, OR a typed admission rejection
-                # (QueueFull backpressure / SchedulerClosed): this line
-                # gets its error answer and the driver keeps going —
-                # already-admitted requests must still be served
-                entries.append((i, None, err))
-        results = []
-        for i, future, parse_err in entries:
-            if parse_err is not None:
-                results.append((i, None, parse_err))
-                continue
-            try:
-                results.append((i, future.result(timeout=None), None))
-            except Exception as err:  # graftlint: disable=G05 CLI result relay: every per-request failure (typed rejection or engine error) becomes that request's JSON error line; the driver must answer the remaining lines
-                results.append((i, None, err))
+    metrics_server = None
+    try:
+        with Scheduler(engine, config) as sched:
+            metrics_server = _metrics_endpoint(sched, metrics_port)
+            for i, line in enumerate(in_stream):
+                line = line.strip()
+                if not line:
+                    continue
+                try:
+                    future = sched.submit(
+                        parse_request_line(json.loads(line)))
+                    entries.append((i, future, None))
+                except (ValueError, KeyError, TypeError, ServeError) as err:
+                    # malformed line, OR a typed admission rejection
+                    # (QueueFull backpressure / SchedulerClosed): this line
+                    # gets its error answer and the driver keeps going —
+                    # already-admitted requests must still be served
+                    entries.append((i, None, err))
+            results = []
+            for i, future, parse_err in entries:
+                if parse_err is not None:
+                    results.append((i, None, parse_err))
+                    continue
+                try:
+                    results.append((i, future.result(timeout=None), None))
+                except Exception as err:  # graftlint: disable=G05 CLI result relay: every per-request failure (typed rejection or engine error) becomes that request's JSON error line; the driver must answer the remaining lines
+                    results.append((i, None, err))
+    finally:
+        if metrics_server is not None:
+            metrics_server.close()
+            # the periodic sampler _metrics_endpoint started must die
+            # with the endpoint, or it keeps accumulating series for a
+            # scraper that no longer exists
+            from ..obs import metrics as obs_metrics
+
+            obs_metrics.get_registry().stop_sampler()
     errors = 0
     for i, row, err in results:
         if err is not None:
@@ -133,7 +171,9 @@ def main(engine, args) -> int:
     out_stream = sys.stdout if args.output == "-" else open(
         args.output, "w", encoding="utf-8")
     try:
-        summary = run_jsonl_driver(engine, in_stream, out_stream, config)
+        summary = run_jsonl_driver(engine, in_stream, out_stream, config,
+                                   metrics_port=getattr(
+                                       args, "metrics_port", 0) or 0)
     finally:
         if in_stream is not sys.stdin:
             in_stream.close()

@@ -117,6 +117,7 @@ class Scheduler:
             if not leftover:
                 break
             for t in leftover:
+                record_counter("serve_rejected_closed")
                 self._reject(t, SchedulerClosed(
                     "scheduler shut down before the request launched"))
         # the prefix pool's close() is idempotent (safe double-close): the
@@ -141,6 +142,9 @@ class Scheduler:
         with :class:`DeadlineExceeded` (counted, never dropped)."""
         request.validate()
         if self._closed:
+            # typed rejection, counted like its QueueFull/DeadlineExceeded
+            # siblings so the serve_rejected_* split stays complete
+            record_counter("serve_rejected_closed")
             raise SchedulerClosed("scheduler is shut down")
         now = time.monotonic()
         timeout_s = (request.timeout_s if request.timeout_s is not None

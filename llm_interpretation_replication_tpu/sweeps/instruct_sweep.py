@@ -15,6 +15,7 @@ from typing import Callable, Dict, Optional, Sequence
 import pandas as pd
 
 from ..config import instruct_sweep_models, model_pairs_word_meaning
+from ..obs import metrics as obs_metrics
 from ..runtime import faults
 from ..scoring.prompts import format_instruct_prompt, format_prompt
 from ..utils.checkpoint import CheckpointFile
@@ -96,15 +97,19 @@ def run_instruct_sweep(
                 engine, model_name, prompts, is_base=False,
                 retry_policy=retry_policy)
             ck.save({"outputs": outputs, "prompts": fp})
-            # heartbeat (obs/): progress, achieved rate, ETA — the
-            # perturbation shell's per-chunk line, at model granularity
+            # heartbeat (obs/metrics.py): progress, achieved rate, ETA —
+            # the perturbation shell's per-chunk line at model
+            # granularity, through the SAME code path, so the line and
+            # the metrics-registry gauges agree by construction
             scored += 1
             remaining = sum(1 for m in models if m not in outputs)
             elapsed = time.perf_counter() - sweep_t0
             rate = scored * len(prompts) / elapsed if elapsed > 0 else 0.0
-            eta = (remaining * len(prompts) / rate) if rate > 0 else 0.0
-            log(f"[heartbeat] {len(outputs)}/{len(models)} models "
-                f"| {rate:.2f} rows/s | ETA {eta:.0f}s")
+            obs_metrics.heartbeat(
+                "instruct_sweep", len(outputs), len(models), elapsed,
+                log=log, unit="models", rate=rate, rate_unit="rows",
+                eta_s=(remaining * len(prompts) / rate) if rate > 0
+                else 0.0)
     df = instruct_comparison_frame(outputs, models)
     os.makedirs(os.path.dirname(os.path.abspath(results_csv)), exist_ok=True)
     df.to_csv(results_csv, index=False)

@@ -24,6 +24,8 @@ from typing import Dict, List, Optional, Sequence, Tuple
 import numpy as np
 import pandas as pd
 
+from ..obs import flight as obs_flight
+from ..obs import metrics as obs_metrics
 from ..obs import tracer as obs
 from ..runtime import faults
 from ..scoring.confidence import extract_first_int
@@ -320,8 +322,17 @@ def run_model_perturbation_sweep(
     counters_snap = _counters()
     sweep_t0 = time.perf_counter()
     done_rows, total_rows = 0, len(todo_items)
+    # Run-health instrumentation (obs/flight.py): the flight recorder is
+    # armed at the workbook's directory, so an OOM-ladder walk, retry
+    # exhaustion, preemption, or watchdog trip leaves a flightrec-*.json
+    # triage artifact next to the sweep's own outputs; the stall watchdog
+    # is fed by the heartbeat below and WARNS (never kills) when no chunk
+    # completes within k x the trailing median chunk time.
+    obs_flight.enable(os.path.dirname(os.path.abspath(output_xlsx)))
+    watchdog = obs_flight.StallWatchdog(
+        label=f"perturbation:{model_name}")
     with faults.PreemptionGuard(flush, label="perturbation"), \
-            _closing(prefetcher):
+            _closing(prefetcher), watchdog:
         # _closing: a mid-sweep error (device OOM bubbling to the caller's
         # retry policy, preemption exit) must stop the prefetcher's worker
         # thread, or it keeps tokenizing the remaining corpus for a sweep
@@ -399,14 +410,15 @@ def run_model_perturbation_sweep(
                                    reph))
                     if len(pending) >= checkpoint_every:
                         flush()
-            # heartbeat: progress, achieved rate, and ETA per chunk — a
-            # multi-hour sweep is observable from its log stream alone
+            # heartbeat: progress, achieved rate, and ETA per chunk.  ONE
+            # code path (obs/metrics.heartbeat) produces the log line AND
+            # the metrics-registry gauges (+ a JSONL metrics sample when
+            # --metrics is armed) AND beats the stall watchdog — a
+            # multi-hour sweep is observable from its log stream or from
+            # the metrics surface, without scraping stderr.
             done_rows += len(chunk)
-            elapsed = time.perf_counter() - sweep_t0
-            rate = done_rows / elapsed if elapsed > 0 else 0.0
-            eta = (total_rows - done_rows) / rate if rate > 0 else 0.0
-            log(f"[heartbeat] {model_name}: {done_rows}/{total_rows} rows "
-                f"| {rate:.2f} rows/s | ETA {eta:.0f}s")
+            obs_metrics.heartbeat(model_name, done_rows, total_rows,
+                                  time.perf_counter() - sweep_t0, log=log)
         flush(final=True)
     delta = _counters_since(counters_snap)
     if delta.get("kv_cache_bytes_saved") or delta.get("prefill_chunks"):

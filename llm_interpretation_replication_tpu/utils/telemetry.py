@@ -29,6 +29,24 @@ from typing import Dict, List, Optional
 
 _FAULT_EVENTS: List[Dict] = []
 _FAULT_EVENTS_CAP = 1000
+_FAULT_LISTENERS: List = []   # called with each event as it is recorded —
+                              # the obs/ flight recorder's trigger path
+
+
+def add_fault_listener(fn) -> None:
+    """Register ``fn(event_dict)`` to run on every :func:`record_fault`
+    (idempotent per callable).  Listeners must be fast and must not
+    raise; a raising listener is swallowed so the fault path — which is
+    already handling an error — can never be broken by its observer."""
+    if fn not in _FAULT_LISTENERS:
+        _FAULT_LISTENERS.append(fn)
+
+
+def remove_fault_listener(fn) -> None:
+    try:
+        _FAULT_LISTENERS.remove(fn)
+    except ValueError:
+        pass
 
 
 def record_fault(kind: str, **info) -> Dict:
@@ -37,6 +55,11 @@ def record_fault(kind: str, **info) -> Dict:
     _FAULT_EVENTS.append(event)
     if len(_FAULT_EVENTS) > _FAULT_EVENTS_CAP:
         del _FAULT_EVENTS[: len(_FAULT_EVENTS) - _FAULT_EVENTS_CAP]
+    for fn in list(_FAULT_LISTENERS):
+        try:
+            fn(event)
+        except Exception:  # a listener can never break the fault path
+            pass
     return event
 
 

@@ -599,6 +599,10 @@ class TestRepoGate:
         assert any("/obs/tracer.py" in f for f in scanned)
         assert any("/obs/report.py" in f for f in scanned)
         assert any("/obs/profiler.py" in f for f in scanned)
+        # ISSUE-9: the run-health layer's modules join the same gate
+        assert any("/obs/metrics.py" in f for f in scanned)
+        assert any("/obs/flight.py" in f for f in scanned)
+        assert any("/obs/benchdiff.py" in f for f in scanned)
 
     def test_obs_package_lint_clean_without_baseline(self):
         """Satellite (ISSUE 6): obs/ ships lint-clean from day one — zero
@@ -628,6 +632,22 @@ class TestRepoGate:
                     pass
         """)
         assert rules_of(findings) == ["G05"]
+
+    def test_obs_metrics_and_flight_are_in_g05_fault_scope(self):
+        """ISSUE-9 satellite: the run-health modules sit on the fault
+        path (the flight recorder runs INSIDE fault handling), so a
+        swallowing broad except there is exactly the bug G05 exists to
+        catch — fires for the new modules like any runtime/ file."""
+        for path in ("obs/metrics.py", "obs/flight.py",
+                     "obs/benchdiff.py"):
+            findings = run(path, """
+                def sample_tick(reg):
+                    try:
+                        reg.sample()
+                    except Exception:
+                        pass
+            """)
+            assert rules_of(findings) == ["G05"], path
 
     def test_kvcache_touched_modules_carry_no_baseline_entries(self):
         """Satellite (ISSUE 5): the int8-KV-cache / chunked-prefill change

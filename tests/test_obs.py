@@ -392,8 +392,8 @@ class TestOverheadSmoke:
 
 def test_bench_forwards_trace_and_profile_to_the_child():
     """Satellite: the sweep-full child re-exec must inherit --trace /
-    --profile (the PR-5 --kv-dtype/--prefill-chunk forwarding list is the
-    template) with child-specific artifact paths."""
+    --profile / --metrics (the PR-5 --kv-dtype/--prefill-chunk forwarding
+    list is the template) with child-specific artifact paths."""
     import os
 
     bench_src = open(os.path.join(os.path.dirname(os.path.dirname(
@@ -404,3 +404,6 @@ def test_bench_forwards_trace_and_profile_to_the_child():
     assert '"--profile"' in child
     assert '"--trace-sync"' in child
     assert '"--strict"' in child
+    # ISSUE-9 satellite: a metered parent must not run its full-study
+    # child unmetered, and the child's JSONL log gets its own path
+    assert '"--metrics"' in child and "sweep-full.jsonl" in child

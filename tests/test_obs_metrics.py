@@ -1,0 +1,540 @@
+"""Live run-health layer (obs/metrics.py, obs/flight.py,
+obs/benchdiff.py): Prometheus exposition correctness, the /metrics +
+/healthz endpoint, the JSONL metrics log, the one-code-path heartbeat,
+the stall watchdog (no-false-positive guard + trip semantics), the
+flight recorder (including the injected-FaultyEngine-OOM dump), the
+typed serve_rejected_* split, and the ``obs bench-diff`` trajectory
+analyzer against the checked-in BENCH records.
+
+Tier-1 (``-m obsmetrics``).  The metrics registry and flight recorder
+are process-global singletons; every test runs against reset state
+(autouse fixture) and unique telemetry names where global counters
+cannot be reset safely.
+"""
+
+import json
+import os
+import urllib.error
+import urllib.request
+
+import pytest
+
+from llm_interpretation_replication_tpu.obs import benchdiff, flight, metrics
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.obsmetrics
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+@pytest.fixture(autouse=True)
+def _fresh_state():
+    flight.get_recorder().wait()
+    flight.disable()
+    metrics.get_registry().reset()
+    yield
+    flight.get_recorder().wait()
+    flight.disable()
+    metrics.get_registry().reset()
+
+
+# ---------------------------------------------------------------------------
+# Prometheus text exposition
+# ---------------------------------------------------------------------------
+
+class TestPrometheusExposition:
+    def test_counters_typed_as_counters(self):
+        telemetry.record_counter("texpo_hits", 3)
+        text = metrics.prometheus_text()
+        lines = text.splitlines()
+        assert "# TYPE llm_interp_texpo_hits counter" in lines
+        assert "llm_interp_texpo_hits 3" in lines
+
+    def test_gauges_typed_as_gauges_with_label_escaping(self):
+        reg = metrics.MetricsRegistry()
+        reg.set_gauge("texpo_gauge", 1.5,
+                      labels={"model": 'fal"con\\7b\nx'})
+        text = reg.prometheus_text()
+        assert "# TYPE llm_interp_texpo_gauge gauge" in text
+        # backslash, double quote, and newline all escaped per the
+        # exposition format — a model path can contain any of them
+        assert ('llm_interp_texpo_gauge{model="fal\\"con\\\\7b\\nx"} 1.5'
+                in text)
+
+    def test_ring_percentiles_export_as_summary(self):
+        for v in (1.0, 2.0, 3.0, 4.0, 100.0):
+            telemetry.record_sample("texpo_ring_ms", v)
+        text = metrics.prometheus_text()
+        assert "# TYPE llm_interp_texpo_ring_ms summary" in text
+        assert 'llm_interp_texpo_ring_ms{quantile="0.5"} 3' in text
+        assert 'llm_interp_texpo_ring_ms{quantile="0.99"} 100' in text
+        assert "llm_interp_texpo_ring_ms_count 5" in text
+        assert "llm_interp_texpo_ring_ms_retained 5" in text
+
+    def test_empty_ring_yields_no_bogus_series(self):
+        # never-recorded ring: no series at all (a fabricated 0-quantile
+        # would read as "p99 latency is zero" on a dashboard)
+        assert "texpo_never_recorded" not in metrics.prometheus_text()
+
+    def test_metric_names_sanitized(self):
+        telemetry.record_counter("texpo.weird-name/x", 1)
+        text = metrics.prometheus_text()
+        assert "llm_interp_texpo_weird_name_x 1" in text
+        assert "texpo.weird-name/x" not in text
+
+    def test_name_helpers(self):
+        assert metrics.sanitize_metric_name("a.b-c/d") == "a_b_c_d"
+        assert metrics.sanitize_metric_name("9lead") == "_9lead"
+        assert metrics.escape_label_value('a"b\\c\nd') == 'a\\"b\\\\c\\nd'
+
+
+# ---------------------------------------------------------------------------
+# Registry sampling + JSONL metrics log
+# ---------------------------------------------------------------------------
+
+class TestRegistrySampling:
+    def test_sample_records_typed_series_and_since_enable_deltas(self):
+        telemetry.record_counter("tsamp_ctr", 5)
+        reg = metrics.MetricsRegistry()      # baselines AFTER the 5
+        telemetry.record_counter("tsamp_ctr", 2)
+        telemetry.record_sample("tsamp_ring", 7.0)
+        doc = reg.sample()
+        assert doc["counters"]["tsamp_ctr"] == 7          # raw monotone
+        assert doc["counters_delta"]["tsamp_ctr"] == 2    # counters_since
+        assert doc["rings"]["tsamp_ring"]["p50"] == 7.0
+        assert doc["rings"]["tsamp_ring"]["total"] == 1   # truncation block
+        assert reg.series_type("tsamp_ctr") == "counter"
+        assert reg.series_type("tsamp_ring_p50") == "gauge"
+        assert [v for _, v in reg.series("tsamp_ctr")] == [7]
+
+    def test_jsonl_stream_appends_one_valid_line_per_sample(self, tmp_path):
+        reg = metrics.MetricsRegistry()
+        path = str(tmp_path / "metrics.jsonl")
+        reg.enable_jsonl(path)
+        telemetry.record_counter("tjsonl_ctr", 1)
+        reg.sample()
+        reg.sample()
+        reg.disable_jsonl()
+        lines = [json.loads(line) for line in
+                 open(path).read().strip().splitlines()]
+        assert len(lines) == 2
+        for doc in lines:
+            assert {"t", "uptime_s", "counters", "counters_delta",
+                    "rings", "gauges"} <= set(doc)
+        assert lines[-1]["counters"]["tjsonl_ctr"] == 1
+
+
+class TestMetricsServer:
+    def test_metrics_and_healthz_endpoints(self):
+        telemetry.record_counter("tsrv_ctr", 1)
+        reg = metrics.MetricsRegistry()
+        health = {"queue_depth": 3}
+        with metrics.MetricsServer(reg, 0, host="127.0.0.1",
+                                   healthz_fn=lambda: health) as srv:
+            url = f"http://127.0.0.1:{srv.port}"
+            resp = urllib.request.urlopen(url + "/metrics")
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith(
+                "text/plain; version=0.0.4")
+            assert "llm_interp_tsrv_ctr" in resp.read().decode()
+            doc = json.loads(urllib.request.urlopen(
+                url + "/healthz").read())
+            assert doc["status"] == "ok"
+            assert doc["queue_depth"] == 3
+            assert doc["uptime_s"] >= 0
+            with pytest.raises(urllib.error.HTTPError) as exc:
+                urllib.request.urlopen(url + "/nope")
+            assert exc.value.code == 404
+
+    def test_healthz_degrades_instead_of_500(self):
+        reg = metrics.MetricsRegistry()
+
+        def broken():
+            raise RuntimeError("scheduler introspection failed")
+
+        with metrics.MetricsServer(reg, 0, host="127.0.0.1",
+                                   healthz_fn=broken) as srv:
+            doc = json.loads(urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz").read())
+        assert doc["status"] == "degraded"
+        assert "introspection" in doc["error"]
+
+
+# ---------------------------------------------------------------------------
+# Heartbeat: one code path -> log line + gauges (+ watchdog beat)
+# ---------------------------------------------------------------------------
+
+class TestHeartbeat:
+    def test_line_format_and_gauges_from_one_call(self):
+        lines = []
+        out = metrics.heartbeat("falcon-7b", 40, 100, 2.0,
+                                log=lines.append)
+        assert lines == [out]
+        # the exact PR-6 stderr contract, unchanged
+        assert out == ("[heartbeat] falcon-7b: 40/100 rows "
+                       "| 20.00 rows/s | ETA 3s")
+        text = metrics.prometheus_text()
+        assert ('llm_interp_sweep_progress_rows{label="falcon-7b"} 40'
+                in text)
+        assert ('llm_interp_sweep_rows_per_s{label="falcon-7b"} 20'
+                in text)
+
+    def test_heartbeat_beats_the_active_watchdog(self):
+        wd = flight.StallWatchdog(label="hb")
+        flight._set_active_watchdog(wd)
+        try:
+            for i in range(3):
+                metrics.heartbeat("m", i + 1, 10, 1.0 + i)
+            assert wd._last_beat is not None
+            assert len(wd._intervals) == 2
+        finally:
+            flight._clear_active_watchdog(wd)
+
+    def test_sweep_shell_routes_progress_through_the_registry(self, tmp_path):
+        """Satellite: the perturbation sweep's [heartbeat] lines and the
+        metrics gauges come from ONE code path — running the shell
+        updates the registry without any stderr scraping."""
+        from llm_interpretation_replication_tpu.sweeps import (
+            run_model_perturbation_sweep,
+        )
+
+        from test_faults import _scenarios
+        from test_sweeps import FakeEngine
+
+        logged = []
+        df = run_model_perturbation_sweep(
+            FakeEngine("fake/hb-7b"), "fake/hb-7b", _scenarios(),
+            str(tmp_path / "out.xlsx"), confidence=False, score_chunk=4,
+            log=logged.append)
+        assert len(df) == 12
+        beats = [l for l in logged if l.startswith("[heartbeat]")]
+        assert len(beats) == 3            # one per 4-row chunk
+        assert beats[-1].startswith("[heartbeat] fake/hb-7b: 12/12 rows")
+        text = metrics.prometheus_text()
+        assert ('llm_interp_sweep_progress_rows{label="fake/hb-7b"} 12'
+                in text)
+        assert ('llm_interp_sweep_progress_total{label="fake/hb-7b"} 12'
+                in text)
+
+
+# ---------------------------------------------------------------------------
+# Stall watchdog
+# ---------------------------------------------------------------------------
+
+class TestStallWatchdog:
+    def _fed(self, intervals, **kw):
+        clk = {"t": 0.0}
+        wd = flight.StallWatchdog(label="wd-test",
+                                  clock=lambda: clk["t"], **kw)
+        wd.beat(0)
+        for i, dt in enumerate(intervals):
+            clk["t"] += dt
+            wd.beat(i + 1)
+        return wd, clk
+
+    def test_no_false_positive_on_slow_but_progressing_sweep(self):
+        """A sweep whose chunks take 10s each — slow, irregular, but
+        progressing — must never trip a watchdog calibrated to its own
+        trailing median."""
+        wd, clk = self._fed([8.0, 12.0, 10.0, 9.0, 11.0], floor_s=1.0)
+        snap = len(telemetry.fault_events("watchdog_stall"))
+        for idle in (5.0, 15.0, 35.0):    # all below 4 x median(10) = 40
+            assert wd.check(now=clk["t"] + idle) is False
+        assert wd.trips == 0
+        assert len(telemetry.fault_events("watchdog_stall")) == snap
+
+    def test_startup_compile_time_never_trips(self):
+        # fewer than min_beats intervals: no median, no threshold, no trip
+        wd, clk = self._fed([2.0], floor_s=0.1)
+        assert wd.threshold_s() is None
+        assert wd.check(now=clk["t"] + 9999.0) is False
+
+    def test_trip_warns_once_records_fault_and_resets_on_beat(self, capsys):
+        wd, clk = self._fed([1.0, 1.0, 1.0, 1.0], floor_s=1.0)
+        snap = len(telemetry.fault_events("watchdog_stall"))
+        assert wd.check(now=clk["t"] + 10.0) is True     # > 4 x 1s
+        assert wd.check(now=clk["t"] + 20.0) is False    # once per stall
+        events = telemetry.fault_events("watchdog_stall")[snap:]
+        assert len(events) == 1 and events[0]["label"] == "wd-test"
+        assert events[0]["threshold_s"] == 4.0
+        assert "no progress" in capsys.readouterr().err
+        clk["t"] += 30.0
+        wd.beat(99)                                      # progress resumed
+        clk["t"] += 1.0
+        wd.beat(100)
+        assert wd.check(now=clk["t"] + 0.5) is False
+        assert wd.trips == 1
+
+    def test_floor_absorbs_fast_test_scale_chunks(self):
+        # millisecond chunks: threshold is the floor, not 4 x 1ms
+        wd, clk = self._fed([0.001] * 5, floor_s=5.0)
+        assert wd.threshold_s() == 5.0
+        assert wd.check(now=clk["t"] + 1.0) is False
+
+
+# ---------------------------------------------------------------------------
+# Flight recorder
+# ---------------------------------------------------------------------------
+
+class TestFlightRecorder:
+    def test_dump_on_injected_faulty_engine_oom(self, tmp_path):
+        """Satellite acceptance: an injected FaultyEngine OOM that
+        engages the engine's back-off ladder leaves a flightrec-*.json
+        triage artifact with the trigger event, counters, and rings."""
+        import dataclasses as dc
+
+        from llm_interpretation_replication_tpu.utils.testing import (
+            Fault,
+            FaultyEngine,
+        )
+
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        eng.ecfg = dc.replace(eng.ecfg, oom_backoff=True,
+                              oom_batch_ladder=(2,), oom_batch_floor=1)
+        flight.enable(str(tmp_path))
+        faulty = FaultyEngine(eng, [Fault("oom", at_batch=1)])
+        rows = faulty.score_prompts(
+            [f"Is item {i} a vehicle?" for i in range(6)])
+        assert len(rows) == 6 and all(r["success"] for r in rows)
+        flight.get_recorder().wait()      # dumps write on a worker thread
+        dumps = sorted(tmp_path.glob("flightrec-engine_oom_backoff-*.json"))
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["reason"] == "engine_oom_backoff"
+        assert doc["trigger"]["new_batch"] == 2
+        assert doc["fault_events"][-1]["kind"] == "engine_oom_backoff"
+        assert "counters" in doc and "rings" in doc and "memory" in doc
+
+    def test_preempted_sweep_leaves_artifact_next_to_workbook(self, tmp_path):
+        """The sweep SIGTERM shell hook: a preempted perturbation sweep
+        dumps a flight record into the workbook's directory before the
+        Preempted exit propagates."""
+        from llm_interpretation_replication_tpu.runtime.faults import (
+            Preempted,
+        )
+        from llm_interpretation_replication_tpu.sweeps import (
+            run_model_perturbation_sweep,
+        )
+        from llm_interpretation_replication_tpu.utils.testing import (
+            Fault,
+            FaultyEngine,
+        )
+
+        from test_faults import _scenarios
+        from test_sweeps import FakeEngine
+
+        # call 3 = chunk 2's binary leg: chunk 1 finished (and emitted
+        # its heartbeat frame) before the preemption lands
+        faulty = FaultyEngine(FakeEngine("fake/pre-7b"),
+                              [Fault("preempt", at_call=3)])
+        with pytest.raises(Preempted):
+            run_model_perturbation_sweep(
+                faulty, "fake/pre-7b", _scenarios(),
+                str(tmp_path / "out.xlsx"), confidence=False,
+                score_chunk=4, log=lambda *a, **k: None)
+        flight.get_recorder().wait()
+        dumps = sorted(tmp_path.glob("flightrec-preempted-*.json"))
+        assert len(dumps) == 1
+        doc = json.loads(dumps[0].read_text())
+        assert doc["trigger"]["kind"] == "preempted"
+        # the heartbeat frames captured before the preemption ride along
+        assert any(f["kind"] == "heartbeat" for f in doc["frames"])
+
+    def test_transient_exhaustion_is_a_trigger(self, tmp_path):
+        from llm_interpretation_replication_tpu.runtime.faults import (
+            TransientError,
+            retry_transient,
+        )
+        from llm_interpretation_replication_tpu.utils.retry import (
+            RetryPolicy,
+        )
+
+        flight.enable(str(tmp_path))
+
+        def always():
+            raise TransientError("injected transient")
+
+        fast = RetryPolicy(max_retries=2, initial_delay=0.001,
+                           max_delay=0.002)
+        with pytest.raises(TransientError):
+            retry_transient(always, fast, label="texh")()
+        events = telemetry.fault_events("transient_exhausted")
+        assert events and events[-1]["label"] == "texh"
+        assert events[-1]["retries"] == 2
+        flight.get_recorder().wait()
+        assert sorted(tmp_path.glob("flightrec-transient_exhausted-*.json"))
+
+    def test_cooldown_rate_limits_dump_storms(self, tmp_path):
+        rec = flight.FlightRecorder(cooldown_s=60.0)
+        rec.enable(str(tmp_path))
+        try:
+            assert rec.dump("watchdog_stall") is not None
+            assert rec.dump("watchdog_stall") is None       # cooldown
+            assert rec.dump("preempted") is not None        # per-kind
+        finally:
+            rec.disable()
+        assert len(list(tmp_path.glob("flightrec-*.json"))) == 2
+
+    def test_disarmed_recorder_is_inert(self, tmp_path):
+        rec = flight.FlightRecorder()
+        assert rec.dump("preempted") is None
+        rec.note("heartbeat", done=1)
+        assert rec._frames == []
+
+
+# ---------------------------------------------------------------------------
+# Measurement-only contract (acceptance): metering changes nothing
+# ---------------------------------------------------------------------------
+
+class TestMeteredStrictParity:
+    def test_traced_metered_strict_sweep_rows_identical_and_clean(
+            self, tmp_path):
+        """Acceptance: a strict-mode traced+metered scoring pass reports
+        blocked_transfers == 0 and returns BIT-IDENTICAL rows vs the
+        metrics-off run — the whole layer is measurement-only."""
+        from llm_interpretation_replication_tpu import obs
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        from test_runtime import _tiny_engine
+
+        eng, _, _ = _tiny_engine()
+        prompts = ["Is a tweet a publication?", "Is soup a beverage?",
+                   "The quick brown fox"] * 2
+        plain = eng.score_prompts(prompts)           # metrics off
+        reg = metrics.get_registry()
+        reg.enable_jsonl(str(tmp_path / "m.jsonl"))
+        flight.enable(str(tmp_path))
+        obs.enable()
+        strict.activate(sentry=False)
+        try:
+            snap = telemetry.counters()
+            metered = eng.score_prompts(prompts)
+            metrics.heartbeat("parity", len(metered), len(metered), 1.0)
+            reg.sample()
+            delta = telemetry.counters_since(snap)
+            assert delta.get(strict.BLOCKED_COUNTER, 0) == 0
+        finally:
+            strict.deactivate()
+            obs.disable()
+            obs.get_tracer().reset()
+        for a, b in zip(plain, metered):
+            assert a == b
+        # the metrics log captured the run without touching it
+        lines = (tmp_path / "m.jsonl").read_text().strip().splitlines()
+        assert lines and json.loads(lines[-1])["counters"]
+
+
+# ---------------------------------------------------------------------------
+# Typed serve rejection split
+# ---------------------------------------------------------------------------
+
+class TestServeRejectionSplit:
+    def test_submit_after_close_counts_serve_rejected_closed(self):
+        from llm_interpretation_replication_tpu.serve.request import (
+            SchedulerClosed,
+            ScoreRequest,
+        )
+        from llm_interpretation_replication_tpu.serve.scheduler import (
+            Scheduler,
+        )
+
+        sched = Scheduler(engine=object())
+        sched.close()
+        snap = telemetry.counters()
+        with pytest.raises(SchedulerClosed):
+            sched.submit(ScoreRequest(prompt="Is soup a beverage?"))
+        delta = telemetry.counters_since(snap)
+        assert delta.get("serve_rejected_closed") == 1
+        # the split is complete: full/deadline/closed are distinct names
+        assert delta.get("serve_rejected_full") is None
+        assert delta.get("serve_rejected_deadline") is None
+
+
+# ---------------------------------------------------------------------------
+# obs bench-diff
+# ---------------------------------------------------------------------------
+
+class TestBenchDiff:
+    R04 = os.path.join(REPO_ROOT, "BENCH_r04.json")
+    R05 = os.path.join(REPO_ROOT, "BENCH_r05.json")
+
+    def test_reproduces_the_known_r04_r05_delta(self, capsys):
+        """Acceptance: the checked-in records diff to the known 91.89 ->
+        120.15 p/s headline improvement, exit 0 (no regression)."""
+        assert benchdiff.main([self.R04, self.R05]) == 0
+        out = capsys.readouterr().out
+        assert "91.89" in out and "120.15" in out
+        assert "+30.75%" in out
+        assert "improved" in out
+        assert "0 regression(s)" in out
+
+    def test_reversed_order_flags_the_regression_and_exits_1(self, capsys):
+        assert benchdiff.main([self.R05, self.R04]) == 1
+        out = capsys.readouterr().out
+        assert "REGRESSION" in out
+        assert "1 regression(s)" in out
+        assert benchdiff.main([self.R05, self.R04, "--no-fail"]) == 0
+
+    def test_threshold_is_configurable(self):
+        # at a 30% threshold the 23.5% drop is tolerated
+        assert benchdiff.main([self.R05, self.R04,
+                               "--threshold", "30"]) == 0
+
+    def test_json_format_aligns_secondary_metrics_by_stable_key(
+            self, capsys):
+        assert benchdiff.main([self.R04, self.R05, "--format",
+                               "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        assert doc["labels"] == ["r04", "r05"]
+        rows = {r["key"]: r for r in doc["metrics"]}
+        head = rows["headline"]
+        assert head["values"] == [91.89, 120.15]
+        assert head["delta_pct"] == pytest.approx(30.75, abs=0.01)
+        # the 430-token parity/single rows align despite free-text drift
+        assert "parity@430tok [prompts/sec]" in rows
+        assert "single@430tok [prompts/sec]" in rows
+        # r05's full-study row has no r04 counterpart: new, not dropped
+        fs = rows["full-study [rows/sec]"]
+        assert fs["verdict"] == "new" and fs["values"][0] is None
+
+    def test_three_round_trajectory(self, capsys):
+        r03 = os.path.join(REPO_ROOT, "BENCH_r03.json")
+        assert benchdiff.main([r03, self.R04, self.R05]) == 0
+        out = capsys.readouterr().out
+        assert "r03 -> r04 -> r05" in out
+
+    def test_phases_and_context_blocks_align(self, tmp_path, capsys):
+        a = {"metric": "m", "value": 100.0, "unit": "rows/sec",
+             "phases": {"per_phase": {"decode": {"seconds": 10.0,
+                                                 "ms_per_row": 1.0}},
+                        "total_s": 10.0},
+             "context": {"kv_dtype": "bf16", "prefill_chunks": 3}}
+        b = {"metric": "m", "value": 101.0, "unit": "rows/sec",
+             "phases": {"per_phase": {"decode": {"seconds": 30.0,
+                                                 "ms_per_row": 3.0}},
+                        "total_s": 30.0},
+             "context": {"kv_dtype": "int8", "prefill_chunks": 3}}
+        pa, pb = tmp_path / "BENCH_x01.json", tmp_path / "BENCH_x02.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        assert benchdiff.main([str(pa), str(pb)]) == 1   # 3x ms/row
+        out = capsys.readouterr().out
+        assert "phase:decode" in out and "REGRESSION" in out
+        assert "context:kv_dtype" in out        # changed context surfaces
+        assert "prefill_chunks" not in out      # unchanged context is noise
+
+    def test_rejects_non_records(self, tmp_path, capsys):
+        bad = tmp_path / "nope.json"
+        bad.write_text('{"no": "value"}')
+        assert benchdiff.main([str(bad), self.R05]) == 2
+        assert "not a bench record" in capsys.readouterr().err
+
+    def test_cli_routes_obs_bench_diff_before_argparse(self, capsys):
+        from llm_interpretation_replication_tpu.__main__ import main
+
+        with pytest.raises(SystemExit) as exc:
+            main(["obs", "bench-diff", self.R04, self.R05])
+        assert exc.value.code == 0
+        assert "120.15" in capsys.readouterr().out
