@@ -312,11 +312,30 @@ def cmd_run_perturbation(args):
 
     from .config import legal_scenarios
     from .gen.rephrase import load_perturbations
-    from .sweeps import run_model_perturbation_sweep
+    from .sweeps import (
+        run_model_perturbation_sweep,
+        run_packed_perturbation_sweep,
+    )
 
     rc = _run_config(args)
     scenarios = load_perturbations(args.perturbations, expected_scenarios=legal_scenarios())
     engine = _engine_factory(rc)(args.model)
+    if getattr(args, "packed", 0):
+        # packed multi-question batching (scoring/packed.py): Q rephrasings
+        # per prefill, anchor-gathered binary leg, measured-drift contract
+        df, drift = run_packed_perturbation_sweep(
+            engine, args.model, scenarios,
+            output_xlsx=os.path.join(rc.output_dir,
+                                     "perturbation_results_packed.xlsx"),
+            packing=args.packed,
+            drift_parity=getattr(args, "packed_parity", True),
+            max_rephrasings=args.max_rephrasings,
+            score_chunk=args.score_chunk,
+        )
+        print(f"{len(df)} rows (packed Q={args.packed})")
+        if drift is not None:
+            print(json.dumps({"packed_drift": drift}))
+        return
     df = run_model_perturbation_sweep(
         engine, args.model, scenarios,
         output_xlsx=os.path.join(rc.output_dir, "perturbation_results.xlsx"),
@@ -1207,6 +1226,21 @@ def main(argv=None):
                         "the first integer).  0 = the engine's full "
                         "max_new_tokens (50-token confidence completions "
                         "in the workbook)")
+    p.add_argument("--packed", type=int, default=0, metavar="Q",
+                   help="> 0: packed multi-question batching (Auto-Demo, "
+                        "scoring/packed.py) — Q rephrasings + their "
+                        "demonstration answers concatenate into one row, "
+                        "prefill once, and the binary leg reads anchor-"
+                        "gathered logits (no decode, no confidence leg; "
+                        "measured-drift contract, PARITY.md).  Output "
+                        "lands in perturbation_results_packed.xlsx")
+    p.add_argument("--packed-parity",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="with --packed: score the same rows isolated "
+                        "first and print the drift block (per-question "
+                        "|Δ relative_prob| distribution + flip rate); the "
+                        "isolated answers double as the Auto-Demo "
+                        "demonstrations")
     p.set_defaults(fn=cmd_run_perturbation)
 
     p = sub.add_parser("run-api-perturbation",

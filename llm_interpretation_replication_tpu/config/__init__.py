@@ -81,6 +81,15 @@ def power_pilot_results() -> dict:
     return _load("power_pilot_results.json")
 
 
+def decided_rate_calibration() -> dict:
+    """Empirical position-0 decided-rate evidence behind the bench's
+    synthetic-weight calibration targets (ROADMAP item 4): the reference
+    workbooks' answer-start floor, the checked-in rounds' measured
+    calibrated rates, and the [0.87, 0.92] target bracket the EOS-typical
+    decode bracket reuses (bench.DECIDED_RATE_TARGETS)."""
+    return _load("decided_rate_calibration.json")
+
+
 def ordinary_meaning_questions() -> List[str]:
     """The 100 ordinary-meaning questions (survey 1 + survey 2 —
     run_base_vs_instruct_100q.py:120-231)."""

@@ -51,9 +51,9 @@ HOT_PATH_MARKERS = (
 #: catches — nothing there handles device errors.
 FAULT_PATH_MARKERS = (
     "/runtime/", "/ops/", "/models/", "/sweeps/", "/parallel/", "/native/",
-    "/serve/", "/obs/",
+    "/serve/", "/obs/", "/scoring/",
     "runtime/", "ops/", "models/", "sweeps/", "parallel/", "native/",
-    "serve/", "obs/",
+    "serve/", "obs/", "scoring/",
 )
 
 

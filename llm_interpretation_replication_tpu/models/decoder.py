@@ -521,6 +521,25 @@ def forward_last_logits(params, cfg: DecoderConfig, token_ids, attention_mask):
     return _unembed(cfg, params, last)[:, 0, :]
 
 
+@functools.partial(jax.jit, static_argnames=("cfg",))
+def forward_anchor_logits(params, cfg: DecoderConfig, token_ids,
+                          attention_mask, anchors):
+    """fp32 logits at K anchor positions per row — [B, K, V].
+
+    The packed-batch-prompting hot op (scoring/packed.py): one packed row
+    carries Q questions, each ending at an anchor token whose next-token
+    logits score its answer.  Gathering the hidden states at the anchors
+    and unembedding ONLY those K positions keeps the logit transient at
+    [B, K, V] — the [B, S, V] full-sequence unembed would be ~1 GB at
+    sweep shapes, and :func:`forward_last_logits` can only read one
+    position per row.  ``anchors``: [B, K] int32 token indices (within
+    each row's real length; padded anchor slots may duplicate a real
+    anchor — callers mask them host-side)."""
+    x, _ = _trunk(params, cfg, token_ids, attention_mask, None)
+    h = jnp.take_along_axis(x, anchors[:, :, None], axis=1)   # [B, K, H]
+    return _unembed(cfg, params, h)
+
+
 def _prefill_impl(params, cfg: DecoderConfig, token_ids, attention_mask, cache_len):
     """Prompt forward with KV cache; logits at each row's last real token."""
     x, cache = _trunk(params, cfg, token_ids, attention_mask, cache_len)

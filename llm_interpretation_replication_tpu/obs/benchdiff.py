@@ -65,9 +65,17 @@ def metric_key(metric: str, unit: str) -> str:
 
     The free-text descriptions drift (batch sizes, measured hit rates,
     attn impl), so the key keeps only what identifies the WORKLOAD:
-    the mode class, the prompt-token length when named, and the unit."""
+    the mode class, the prompt-token length when named, and the unit —
+    plus the decode bracket and packing factor (ISSUE 10): an
+    EOS-typical bracket row and a packed row are DIFFERENT workload
+    shapes from their no-EOS / isolated twins, and cross-comparing them
+    would report the bracket span as a regression.  The no-EOS /
+    isolated spellings stay untagged so legacy records (r01-r05, which
+    never name a bracket) keep aligning with their successors."""
     text = metric.lower()
-    if "full-study" in text or "full row contract" in text:
+    if "packed" in text:
+        mode = "packed"
+    elif "full-study" in text or "full row contract" in text:
         mode = "full-study"
     elif "end-to-end" in text:
         mode = "e2e-sweep"
@@ -85,19 +93,53 @@ def metric_key(metric: str, unit: str) -> str:
         tags.append(f"{m.group(1)}tok")
     if "sweep operating point" in text:
         tags.append("sweep-point")
+    tags.extend(_shape_tags(text))
     key = mode + (("@" + "+".join(tags)) if tags else "")
     return f"{key} [{unit}]"
 
 
+def _shape_tags(text: str) -> List[str]:
+    """The workload-SHAPE tags (decode bracket, packing factor) that must
+    never cross-compare — shared by :func:`metric_key` and the headline
+    key, which is otherwise positional.  No-EOS / isolated spellings stay
+    untagged so legacy records keep aligning."""
+    tags = []
+    if "eos-typical" in text:
+        tags.append("eos-typical")
+    m = re.search(r"(?:q=|packing )(\d+)", text)
+    if m:
+        tags.append(f"q{m.group(1)}")
+    return tags
+
+
 def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
     """``{aligned key: {"value", "unit", "metric"}}`` for the headline +
-    every secondary row.  Key collisions (two secondaries of one class)
-    disambiguate by index."""
+    every secondary row, plus the ISSUE-10 blocks: ``brackets`` rows
+    (keyed with their eos-mode tag, so a no-EOS row can never
+    cross-compare with an EOS-typical one) and the ``packed`` companion
+    record.  Key collisions (two secondaries of one class) disambiguate
+    by index."""
+    # the headline key is positional, EXCEPT for the workload-shape tags:
+    # an --eos-mode typical (or packed) headline is a different workload
+    # from the default bracket's and must report new/gone, not a verdict
+    shape = _shape_tags(rec.get("metric", "").lower())
+    head_key = "headline" + (("@" + "+".join(shape)) if shape else "")
     out: Dict[str, Dict] = {
-        "headline": {"value": rec["value"], "unit": rec.get("unit", ""),
-                     "metric": rec.get("metric", "")},
+        head_key: {"value": rec["value"], "unit": rec.get("unit", ""),
+                   "metric": rec.get("metric", "")},
     }
-    for entry in rec.get("secondary", ()) or ():
+    extra_rows = list(rec.get("secondary", ()) or ())
+    for holder in [rec] + [e for e in extra_rows if isinstance(e, dict)]:
+        # bracket rows ride top-level on a direct sweep-full record and
+        # NESTED on the parent sweep record's full-study child secondary
+        # (the bench child-extras forwarding) — flatten both
+        for entry in holder.get("brackets", ()) or ():
+            extra_rows.append(dict(entry, metric=entry.get(
+                "metric",
+                f"({entry.get('eos_mode', '?')} decode bracket)")))
+    if isinstance(rec.get("packed"), dict) and "value" in rec["packed"]:
+        extra_rows.append(rec["packed"])
+    for entry in extra_rows:
         key = metric_key(entry.get("metric", ""), entry.get("unit", ""))
         base, n = key, 2
         while key in out:

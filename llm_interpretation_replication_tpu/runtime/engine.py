@@ -862,6 +862,14 @@ class ScoringEngine:
                             pass  # non-jax array backends: plain fetch later
                 if dsp is not None:
                     dsp["_sync_obj"] = chunk_toks[-1]
+            if eos_id is not None and offset < gen_total:
+                # EOS early stop actually saved decode work: the remaining
+                # chunks were never launched because every row had emitted
+                # EOS.  Static shapes only (no host sync inside the strict
+                # guard) — the ISSUE-10 measured number that was always 0
+                # under the no-EOS synthetic weights.
+                record_counter("decode_steps_saved",
+                               (gen_total - offset) * int(valid.sum()))
             tokens_np = np.concatenate(
                 [np.asarray(t) for t in chunk_toks], axis=1
             )
@@ -1572,6 +1580,114 @@ class ScoringEngine:
             launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return [r if r is not None else _error_row("missing") for r in results]
+
+    def score_packed(
+        self,
+        packs: Sequence,
+        targets: Sequence,
+        top_filter: Optional[int] = None,
+    ) -> List[Dict]:
+        """Packed multi-question scoring (scoring/packed.py — Auto-Demo
+        batch prompting, arxiv 2410.01724): each pack is a list of
+        ``(prompt, demo_continuation)`` segments that concatenate into ONE
+        row; the row prefills once and the yes/no relative probability of
+        every question reads from the logits gathered at its answer anchor
+        (the last token of its prompt segment) inside the prefill program
+        (models/decoder.forward_anchor_logits) — no decode path at all.
+
+        ``targets``: one (yes, no) pair, or one pair PER QUESTION in
+        pack-major order.  Returns one result row per question (pack-major)
+        with the ``get_yes_no_logprobs`` fields; ``completion`` is always
+        empty (nothing decodes), ``scan_found`` is the anchor's top-k
+        membership, and the ``first_token_*`` fields carry the
+        ``top_filter``-filtered view (default: the engine's API top-20
+        contract) — the fields the drift-parity leg compares against
+        isolated scoring.  Packed mode is MEASURED-DRIFT (PARITY.md):
+        question 0 of each pack is bit-identical to isolated scoring,
+        later questions legitimately move with their packed context."""
+        from ..scoring import packed as packed_mod
+
+        if self.is_encoder_decoder:
+            raise ValueError(
+                "packed anchor scoring is decoder-only (T5 re-reads the "
+                "full prompt per decoder step; there is no single prefill "
+                "to gather anchors from)")
+        ecfg = self.ecfg
+        with obs.span("encode_packed", phase="host_tokenize",
+                      rows=len(packs)):
+            encoded, anchors = packed_mod.encode_packs(self.tokenizer, packs)
+        n_questions = sum(len(a) for a in anchors)
+        ids_all = self._target_id_rows(list(range(n_questions)), targets)
+        kmax = max(len(a) for a in anchors)
+        # [N, kmax] anchor offsets + per-slot flat question index; padded
+        # slots duplicate anchor 0 (inert — consume skips them) so the
+        # device gather stays rectangular
+        anchor_arr = np.zeros((len(packs), kmax), np.int32)
+        qindex = np.zeros((len(packs), kmax), np.int64)
+        qvalid = np.zeros((len(packs), kmax), bool)
+        qi = 0
+        for i, offs in enumerate(anchors):
+            for k, off in enumerate(offs):
+                anchor_arr[i, k] = off
+                qindex[i, k] = qi
+                qvalid[i, k] = True
+                qi += 1
+            anchor_arr[i, len(offs):] = offs[0]
+            qindex[i, len(offs):] = qindex[i, 0]
+        results: List[Optional[Dict]] = [None] * n_questions
+        tf = ecfg.first_token_top_filter if top_filter is None else top_filter
+
+        def launch(batch):
+            ids = self._put(batch.token_ids)
+            mask = self._put(batch.attention_mask)
+            first = int(batch.indices[0])
+            idx = np.where(batch.indices >= 0, batch.indices, first)
+            banchors = anchor_arr[idx]                         # [B, kmax]
+            tids = ids_all[qindex[idx]]                        # [B, kmax, 2]
+            yes_f = jnp.asarray(tids[..., 0].reshape(-1))
+            no_f = jnp.asarray(tids[..., 1].reshape(-1))
+            with obs.span("packed_prefill", phase="prefill",
+                          bucket=int(batch.bucket_len),
+                          batch=int(batch.token_ids.shape[0]),
+                          questions=int(kmax)) as sp:
+                logits = dmod.forward_anchor_logits(
+                    self.params, self.cfg, ids, mask, jnp.asarray(banchors))
+                flat = logits.reshape((-1, logits.shape[-1]))  # [B*K, V]
+                scan0 = yn.first_token_scan(flat, yes_f, no_f,
+                                            top_k=ecfg.top_k)
+                first3 = yn.relative_prob_first_token(flat, yes_f, no_f, tf)
+                if sp is not None:
+                    sp["_sync_obj"] = first3[2]
+            return scan0, first3
+
+        def consume(batch, out):
+            scan0, first3 = out
+            yes0, no0, rel0, odds0, hit0 = (np.asarray(a) for a in scan0)
+            first3 = tuple(np.asarray(a) for a in first3)
+            for r, orig in enumerate(batch.indices):
+                if orig < 0:
+                    continue
+                for k in range(kmax):
+                    if not qvalid[int(orig), k]:
+                        continue
+                    f = r * kmax + k
+                    results[int(qindex[int(orig), k])] = _attach_first_token(
+                        _result_row(yes0[f], no0[f], rel0[f], odds0[f],
+                                    bool(hit0[f]), ""),
+                        first3, f)
+
+        self._run_pipelined(
+            batching.batches_for_prompts(
+                encoded, ecfg.batch_size, ecfg.buckets,
+                pad_id=self.tokenizer.pad_token_id or 0,
+                length_sorted=ecfg.length_sorted_batches,
+            ),
+            launch, consume, rebatch=self._oom_rebatch(encoded),
+        )
+        record_counter("packed_rows", len(packs))
+        record_counter("packed_questions", n_questions)
+        return [r if r is not None else _error_row("missing")
+                for r in results]
 
     def first_token_relative_prob(
         self, prompts: Sequence[str], targets: Sequence[str] = ("Yes", "No"),

@@ -369,6 +369,31 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
     }
 
 
+def packed_need_terms(cfg, weight_b: int, attention_impl: str,
+                      batch_rows: int, packed_seq: int, packing: int,
+                      pipeline_depth: int = 4) -> dict:
+    """Per-term HBM breakdown of the PACKED anchor-scoring sweep
+    (runtime/engine.score_packed): weights, the prefill attention
+    transient at the PACKED row length (Q questions + demonstrations per
+    row — dense attention is quadratic in it, which is what caps the
+    packing factor), activations at the packed length, and the
+    [B, K, V] fp32 anchor-logit transient per in-flight pipelined batch
+    riding the ``completions`` key (the batch-leading-extras slot —
+    :func:`~.plan_search.sharded_need_bytes` prices both workloads
+    through the same keys).  No phase-2 pool, no KV cache, no decode:
+    the packed path gathers anchor logits inside one prefill program."""
+    attn = (flash_workspace_bytes(cfg, batch_rows, packed_seq)
+            if attention_impl == "flash"
+            else dense_attention_bytes(cfg, batch_rows, packed_seq))
+    return {
+        "weights": weight_b,
+        "attn": attn,
+        "act": activation_bytes(cfg, batch_rows, packed_seq),
+        "completions": pipeline_depth * batch_rows * packing
+        * cfg.vocab_size * 4,
+    }
+
+
 @dataclasses.dataclass
 class ScoringPlan:
     attention_impl: str        # "xla" (dense) or "flash"

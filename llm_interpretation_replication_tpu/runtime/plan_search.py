@@ -97,6 +97,48 @@ CHUNK_PENALTY = 0.01
 #: FLOPs are ~proportional to parameter count in this regime).
 CALIBRATION_PARAMS = 6_921_420_800
 
+# -- packed batch prompting (ISSUE 10 — scoring/packed.py) ------------------
+#: Mean question tokens of the real perturbation corpus (the bench's own
+#: stderr line: "token lengths mean 104" on the 10k rephrasings at the
+#: sweep tokenizer; the sweep secondary measures its steady state at the
+#: same 104-token point).
+PACKED_QUESTION_TOKENS = 104.0
+#: Per-ROW shared scaffold tokens an isolated prompt pays once (the format
+#: suffix — the " Answer only 'Yes' or 'No'." texts tokenize to ~16 via
+#: the sweep tokenizer); a packed row pays it once per Q questions.
+PACKED_SHARED_TOKENS = 16.0
+#: Demonstration-continuation tokens per packed question (scoring/packed.
+#: format_demo: " {answer}.\n\n" plus the answer token — ~12 through the
+#: sweep tokenizer) — the overhead packing pays that isolated rows don't.
+PACKED_DEMO_TOKENS = 12.0
+#: Throughput the packed path recovers by having NO decode path at all:
+#: the r01-r04 steady-state anchors put the single forward at 38.15 p/s
+#: against the two-phase parity mode's 36.9 — the pooled phase-2 decode
+#: overhead packed rows never pay.  38.15 / 36.9 = 1.034.
+PACKED_NO_DECODE_GAIN = 1.034
+#: Packing factors the search enumerates (1 shows the demo-overhead
+#: tradeoff in the runner-up table; the attention transient's quadratic
+#: growth in the packed row length prices out large Q on its own).
+DEFAULT_PACKINGS = (1, 2, 4, 8)
+#: Per-device transient slack for the packed sweep beyond plan.py's
+#: reserve: the anchor-gather epilogue and host staging of the [B, K]
+#: result arrays — no pool, no completion caches, so a quarter GiB
+#: covers it (no measured OOM boundary exists yet for this workload;
+#: recalibrate from the first real packed bench the way
+#: BINARY_SWEEP_HEADROOM_BYTES was).
+PACKED_SWEEP_HEADROOM_BYTES = 1 << 28
+
+
+def packed_seq_tokens(packing: int,
+                      question_tokens: float = PACKED_QUESTION_TOKENS,
+                      shared_tokens: float = PACKED_SHARED_TOKENS,
+                      demo_tokens: float = PACKED_DEMO_TOKENS) -> int:
+    """Expected packed-row token length at one packing factor: the shared
+    scaffold once per row plus Q (question + demonstration) segments."""
+    return int(round(shared_tokens
+                     + packing * (question_tokens + demo_tokens)))
+
+
 #: Extra per-device headroom for the BINARY sweep beyond plan.py's reserve:
 #: the pooled phase-2 path holds the menu-capped cross-batch pool
 #: (EngineConfig.phase2_pool_max_bytes, 512 MiB) plus depth-4 in-flight
@@ -139,6 +181,8 @@ class PlanCandidate:
     reason: str                 # fit/reject audit (plan.budget_audit spelling)
     need_bytes: int             # per-device live set (0 when pre-budget reject)
     predicted_rows_per_s: float  # 0.0 when rejected
+    packing: int = 1            # questions per packed row (1 = isolated;
+                                # > 1 only on the "packed" workload)
 
     @property
     def mesh_shape(self) -> Dict[str, int]:
@@ -152,6 +196,7 @@ class PlanCandidate:
             "kv_dtype": self.kv_dtype,
             "prefill_chunk": self.prefill_chunk,
             "pool_target": self.pool_target,
+            "packing": self.packing,
             "fits": self.fits,
             "predicted_rows_per_s": round(self.predicted_rows_per_s, 2),
             "need_gib": round(self.need_bytes / 2**30, 2),
@@ -161,14 +206,28 @@ class PlanCandidate:
 
 def predicted_rows_per_s(cfg, data: int, model: int, batch: int,
                          kv_dtype: str = "bf16", prefill_chunk: int = 0,
-                         workload: str = "full", seq: int = 256) -> float:
+                         workload: str = "full", seq: int = 256,
+                         packing: int = 1) -> float:
     """Calibrated throughput estimate for one candidate (module docstring).
 
-    ``workload``: "binary" (the yes/no scoring sweep, prompts/s) or "full"
-    (the two-leg full-study row contract, rows/s).  ``seq`` sizes the
+    ``workload``: "binary" (the yes/no scoring sweep, prompts/s), "full"
+    (the two-leg full-study row contract, rows/s), or "packed" (anchor-
+    gathered batch prompting, questions/s — ``batch`` then counts PACKED
+    ROWS and ``packing`` questions ride each row).  ``seq`` sizes the
     chunked-prefill replay count (extra chunks beyond the first each cost
-    CHUNK_PENALTY)."""
+    CHUNK_PENALTY).
+
+    The packed estimate reuses the binary saturating curve at the
+    QUESTION batch (Q questions per row saturate the device like Q rows
+    — prefill FLOPs are token-proportional), scaled by (a) the
+    no-decode gain (PACKED_NO_DECODE_GAIN: anchor gather replaces the
+    whole phase-2 decode) and (b) the per-question token ratio — an
+    isolated question pays the shared scaffold every row, a packed one
+    amortizes it across Q but pays its demonstration continuation:
+    ``(SHARED + QUESTION) / (SHARED/Q + QUESTION + DEMO)``."""
     per_dev_batch = batch / data
+    if workload == "packed":
+        per_dev_batch *= max(1, packing)
     sat = per_dev_batch / (per_dev_batch + BATCH_HALF_SAT)
     scale = CALIBRATION_PARAMS / max(1, plan_mod.param_count(cfg))
     rate = ROWS_CEILING * scale * sat * data
@@ -180,6 +239,12 @@ def predicted_rows_per_s(cfg, data: int, model: int, batch: int,
         rate *= 1.0 - CHUNK_PENALTY * replays
     if workload == "full":
         rate /= FULL_STUDY_WORK
+    elif workload == "packed":
+        q = max(1, packing)
+        iso_tokens = PACKED_SHARED_TOKENS + PACKED_QUESTION_TOKENS
+        per_q_tokens = (PACKED_SHARED_TOKENS / q + PACKED_QUESTION_TOKENS
+                        + PACKED_DEMO_TOKENS)
+        rate *= PACKED_NO_DECODE_GAIN * iso_tokens / per_q_tokens
     return rate
 
 
@@ -237,20 +302,29 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                  hbm_bytes: int = HBM_BYTES_V5E,
                  max_pipe: int = 2,
                  max_model: Optional[int] = None,
-                 attention_impl: str = "xla") -> List[PlanCandidate]:
+                 attention_impl: str = "xla",
+                 packings: Sequence[int] = DEFAULT_PACKINGS
+                 ) -> List[PlanCandidate]:
     """Enumerate, budget-filter, and rank the candidate space.
 
     Returns every candidate, ranked: fitting plans first by predicted
     rows/s (ties break toward the simpler config — lower tp, pp, pool
-    target), then rejected plans grouped by reason.  ``ranked[0]`` is the
-    chosen plan when any candidate fits."""
-    if workload not in ("full", "binary"):
+    target, packing), then rejected plans grouped by reason.
+    ``ranked[0]`` is the chosen plan when any candidate fits.
+
+    ``workload="packed"`` (ISSUE 10) adds the PACKING axis and drops the
+    axes the anchor-gather path has no use for (no decode → no kv dtype,
+    no pool; monolithic prefill → no chunk): candidates are (mesh, packed
+    ROW batch, Q) points budgeted at the packed row length
+    (plan.packed_need_terms — dense attention is quadratic in it, which
+    is what prices out large Q) and ranked in predicted questions/s."""
+    if workload not in ("full", "binary", "packed"):
         raise ValueError(f"unknown workload {workload!r}")
     from ..parallel.mesh import enumerate_mesh_shapes
 
     if pool_targets is None:
         pool_targets = DEFAULT_POOL_TARGETS if workload == "full" else (0,)
-    if workload == "binary":
+    if workload in ("binary", "packed"):
         # the pooled binary path has no confidence pool and keeps
         # monolithic prefill by design (_prefill_select is one fused
         # program), so its chunk axis collapses to {0}; and its need
@@ -258,18 +332,24 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
         # pool with the flat 512 MiB cap), so enumerating int8 would
         # only produce dominated duplicates that can never win the 2%
         # dequant penalty back — the kv axis collapses to bf16 until the
-        # binary pool term is kv-priced
+        # binary pool term is kv-priced.  The packed path has no decode
+        # AT ALL (anchor gather inside the prefill program), so the same
+        # collapses apply there a fortiori.
         pool_targets = (0,)
         kv_dtypes = ("bf16",)
+    packings = tuple(packings) if workload == "packed" else (1,)
     wb = weight_bytes(cfg, quant)
-    budget = hbm_bytes - RESERVE_BYTES - (
-        THRASH_HEADROOM_BYTES if workload == "full"
-        else BINARY_SWEEP_HEADROOM_BYTES)
+    budget = hbm_bytes - RESERVE_BYTES - {
+        "full": THRASH_HEADROOM_BYTES,
+        "binary": BINARY_SWEEP_HEADROOM_BYTES,
+        "packed": PACKED_SWEEP_HEADROOM_BYTES,
+    }[workload]
     candidates: List[PlanCandidate] = []
 
-    def add(dp, pp, tp, b, kv, chunk, pool, fits, reason, need=0, pred=0.0):
+    def add(dp, pp, tp, b, kv, chunk, pool, fits, reason, need=0, pred=0.0,
+            packing=1):
         candidates.append(PlanCandidate(dp, pp, tp, b, kv, chunk, pool,
-                                        fits, reason, need, pred))
+                                        fits, reason, need, pred, packing))
 
     for dp, pp, tp in enumerate_mesh_shapes(n_devices, max_model=max_model,
                                             max_pipe=max_pipe):
@@ -300,36 +380,47 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                 for chunk in ([c for c in prefill_chunks if c < seq]
                               if workload == "full" else (0,)):
                     for pool in pool_targets:
-                        if workload == "full":
-                            terms = full_study_need_terms(
-                                cfg, wb, attention_impl, b, seq,
-                                gen_tokens, score_steps, pipeline_depth,
-                                reduced_scores=True, kv_dtype=kv,
-                                prefill_chunk=chunk,
-                                pooled_confidence=True,
-                                pool_target=pool or None)
-                        else:
-                            terms = binary_need_terms(
-                                cfg, wb, b, seq, pipeline_depth,
-                                attention_impl)
-                        need = sharded_need_bytes(terms, cfg, dp, tp, pp)
-                        if need > budget:
-                            add(dp, pp, tp, b, kv, chunk, pool, False,
-                                f"over budget: "
-                                f"{budget_reject(need, budget)} per device",
-                                need)
-                            continue
-                        pred = predicted_rows_per_s(cfg, dp, tp, b, kv,
-                                                    chunk, workload, seq)
-                        add(dp, pp, tp, b, kv, chunk, pool, True,
-                            f"fits: {budget_audit(need, budget)} per "
-                            f"device at dp{dp}" +
-                            (f"xtp{tp}" if tp > 1 else ""),
-                            need, pred)
+                        for packing in packings:
+                            if workload == "full":
+                                terms = full_study_need_terms(
+                                    cfg, wb, attention_impl, b, seq,
+                                    gen_tokens, score_steps, pipeline_depth,
+                                    reduced_scores=True, kv_dtype=kv,
+                                    prefill_chunk=chunk,
+                                    pooled_confidence=True,
+                                    pool_target=pool or None)
+                            elif workload == "packed":
+                                terms = plan_mod.packed_need_terms(
+                                    cfg, wb, attention_impl, b,
+                                    packed_seq_tokens(packing), packing,
+                                    pipeline_depth)
+                            else:
+                                terms = binary_need_terms(
+                                    cfg, wb, b, seq, pipeline_depth,
+                                    attention_impl)
+                            need = sharded_need_bytes(terms, cfg, dp, tp,
+                                                      pp)
+                            if need > budget:
+                                add(dp, pp, tp, b, kv, chunk, pool, False,
+                                    f"over budget: "
+                                    f"{budget_reject(need, budget)} "
+                                    f"per device",
+                                    need, packing=packing)
+                                continue
+                            pred = predicted_rows_per_s(
+                                cfg, dp, tp, b, kv, chunk, workload, seq,
+                                packing=packing)
+                            add(dp, pp, tp, b, kv, chunk, pool, True,
+                                f"fits: {budget_audit(need, budget)} per "
+                                f"device at dp{dp}" +
+                                (f"xtp{tp}" if tp > 1 else "") +
+                                (f" (Q={packing} packed)"
+                                 if workload == "packed" else ""),
+                                need, pred, packing=packing)
     candidates.sort(key=lambda c: (
         not c.fits, -c.predicted_rows_per_s, c.model, c.pipe,
-        c.pool_target, c.kv_dtype != "bf16", c.prefill_chunk, -c.batch,
-        c.reason))
+        c.pool_target, c.kv_dtype != "bf16", c.prefill_chunk, c.packing,
+        -c.batch, c.reason))
     return candidates
 
 
@@ -369,8 +460,9 @@ def format_candidate_table(ranked: Sequence[PlanCandidate], top: int = 8,
         lines.append(
             f"#   {tag}: mesh dp{c.data}xpp{c.pipe}xtp{c.model} "
             f"batch {c.batch} kv {c.kv_dtype} chunk {c.prefill_chunk} "
-            f"pool {c.pool_target or 'batch'} -> "
-            f"{c.predicted_rows_per_s:.1f} rows/s ({c.reason})")
+            f"pool {c.pool_target or 'batch'}"
+            + (f" packing {c.packing}" if c.packing > 1 else "")
+            + f" -> {c.predicted_rows_per_s:.1f} rows/s ({c.reason})")
     if not fit:
         lines.append("#   NO candidate fits the budget; first reject: "
                      + (ranked[0].reason if ranked else "(empty space)"))
@@ -546,9 +638,12 @@ def main(argv: Optional[List[str]] = None) -> int:
                         "init: the search is pure host arithmetic)")
     p.add_argument("--seq", type=int, default=256,
                    help="worst-bucket sequence length to budget")
-    p.add_argument("--workload", choices=["full", "binary"], default="full",
+    p.add_argument("--workload", choices=["full", "binary", "packed"],
+                   default="full",
                    help="full: the two-leg full-study row contract; "
-                        "binary: the yes/no pooled-phase-2 sweep")
+                        "binary: the yes/no pooled-phase-2 sweep; "
+                        "packed: anchor-gathered multi-question batch "
+                        "prompting (questions/s — adds the packing axis)")
     p.add_argument("--batch-max", type=int, default=512)
     p.add_argument("--pipeline-depth", type=int, default=None,
                    help="in-flight device batches to budget (default: 2 "

@@ -1,0 +1,179 @@
+"""Packed multi-question batching (Auto-Demo batch prompting, arxiv
+2410.01724): Q questions + their demonstrations in ONE sequence, scored at
+per-question answer anchors in a single prefill.
+
+The paper's studies score every question as an isolated prompt; the packed
+formatter trades that isolation for throughput — one packed row amortizes
+one prefill (and the shared scaffold tokens) across Q questions, and the
+binary leg needs NO decode path at all: the engine gathers the logits at
+each question's anchor offset (the last token of its prompt text) inside
+the prefill program (models/decoder.forward_anchor_logits) and runs the
+ordinary position-0 yes/no scan over the gathered rows.
+
+Contract (PARITY.md "Packed batch prompting — measured drift"): packed
+mode is a MEASURED-DRIFT workload, not a bit-parity one.  Question k >= 1
+of a pack sees the earlier questions and their demonstration answers as
+context, so its relative probability legitimately moves; the drift-parity
+leg (:func:`drift_report`) quantifies exactly that movement — itself a
+paper-relevant reliability measurement.  The FIRST question of each pack
+carries no packed context (its token stream is byte-identical to the
+isolated prompt), so its anchor logits are bit-identical to isolated
+scoring — the anchor-position correctness pin in tests/test_packed.py.
+
+Demonstrations follow Auto-Demo's self-generated convention when the
+caller can supply them (the sweep's drift-parity leg scores the isolated
+prompts first and feeds each question's OWN isolated answer back as its
+demonstration); callers without a generated answer fall back to the
+scenario's nominal yes target.
+"""
+
+from __future__ import annotations
+
+from typing import Dict, List, Optional, Sequence, Tuple
+
+import numpy as np
+
+# the packed FORMATTING contract lives in scoring/prompts.py with every
+# other prompt spelling; this module owns assembly + measurement
+from .prompts import PACKED_SEPARATOR, format_packed_demo as format_demo
+
+__all__ = ["PACKED_SEPARATOR", "format_demo", "build_packs",
+           "encode_packs", "drift_report", "demos_from_relative_probs"]
+
+
+def build_packs(prompts: Sequence, packing: int,
+                demos: Optional[Sequence[str]] = None) -> List[List[Tuple]]:
+    """Group ``prompts`` into packs of ``packing`` consecutive questions.
+
+    Returns one pack per group: a list of ``(prompt, demo_continuation)``
+    tuples where ``demo_continuation`` is the text appended AFTER the
+    question's answer anchor (:func:`format_demo` of the question's own
+    demonstration answer), and ``None`` for the last question of a pack —
+    tokens after the final anchor are causally dead and only waste
+    prefill FLOPs.  ``demos`` aligns with ``prompts`` (one demonstration
+    answer per question); question order is preserved pack-major."""
+    if packing < 1:
+        raise ValueError(f"packing must be >= 1, got {packing}")
+    packs: List[List[Tuple]] = []
+    for start in range(0, len(prompts), packing):
+        chunk = list(prompts[start:start + packing])
+        pack = []
+        for j, prompt in enumerate(chunk):
+            demo = None
+            if j + 1 < len(chunk):
+                answer = demos[start + j] if demos is not None else "Yes"
+                demo = format_demo(answer)
+            pack.append((prompt, demo))
+        packs.append(pack)
+    return packs
+
+
+def encode_packs(tokenizer, packs: Sequence[Sequence[Tuple]]
+                 ) -> Tuple[List[List[int]], List[List[int]]]:
+    """Tokenize packs into per-row id streams + per-question anchor offsets.
+
+    The FIRST question's prompt tokenizes exactly like the isolated path
+    (``batching.encode_prompts`` semantics), so its token stream — and
+    therefore its anchor logits — are byte-identical to isolated scoring.
+    Every later segment tokenizes with ``add_special_tokens=False`` (the
+    fused-suffix convention, sweeps/perturbation.py): the packed stream
+    is the concatenation spelling, self-consistent by construction —
+    packed mode is measured-drift, not byte-parity, for questions > 0.
+
+    ``anchors[i][k]`` is the index of question k's LAST prompt token in
+    row i — the position whose next-token logits score its answer.
+    Prompts/demos may be pre-tokenized id lists; strings tokenize once
+    per call via one batched tokenizer invocation per role."""
+    rows: List[List[int]] = []
+    anchors: List[List[int]] = []
+    # one batched tokenizer call per role (first prompts / continuation
+    # prompts / demos) instead of one call per segment
+    first_texts, later_texts, demo_texts = [], [], []
+    for pack in packs:
+        for k, (prompt, demo) in enumerate(pack):
+            if isinstance(prompt, str):
+                (first_texts if k == 0 else later_texts).append(prompt)
+            if isinstance(demo, str):
+                demo_texts.append(demo)
+    first_ids = iter(tokenizer(first_texts)["input_ids"]
+                     if first_texts else [])
+    later_ids = iter(tokenizer(later_texts,
+                               add_special_tokens=False)["input_ids"]
+                     if later_texts else [])
+    demo_ids = iter(tokenizer(demo_texts,
+                              add_special_tokens=False)["input_ids"]
+                    if demo_texts else [])
+    for pack in packs:
+        ids: List[int] = []
+        offs: List[int] = []
+        for k, (prompt, demo) in enumerate(pack):
+            if isinstance(prompt, str):
+                p_ids = next(first_ids) if k == 0 else next(later_ids)
+            else:
+                p_ids = prompt
+            ids.extend(int(t) for t in p_ids)
+            offs.append(len(ids) - 1)
+            if demo is not None:
+                d_ids = next(demo_ids) if isinstance(demo, str) else demo
+                ids.extend(int(t) for t in d_ids)
+        if not offs:
+            raise ValueError("empty pack")
+        rows.append(ids)
+        anchors.append(offs)
+    return rows, anchors
+
+
+def drift_report(packed_rel: Sequence[float], isolated_rel: Sequence[float],
+                 packing: int, flip_threshold: float = 0.5) -> Dict:
+    """The drift-parity result block: per-question |Δ relative_prob|
+    distribution + flip rate between packed and isolated scoring.
+
+    A FIRST-CLASS measurement, not a guardrail (ISSUE 10): the judgment
+    drift batch prompting introduces is itself a paper-relevant
+    reliability number.  ``flip_rate`` counts questions whose binary
+    verdict (relative_prob >= ``flip_threshold``) differs between the two
+    modes; NaN rows (error rows in either leg) are excluded and counted
+    in ``n_skipped``.  Deterministic: a pure function of the two arrays,
+    so two runs over identical inputs emit identical blocks."""
+    packed_rel = np.asarray(packed_rel, dtype=np.float64)
+    isolated_rel = np.asarray(isolated_rel, dtype=np.float64)
+    if packed_rel.shape != isolated_rel.shape:
+        raise ValueError(
+            f"packed/isolated length mismatch: {packed_rel.shape} vs "
+            f"{isolated_rel.shape}")
+    ok = np.isfinite(packed_rel) & np.isfinite(isolated_rel)
+    delta = np.abs(packed_rel[ok] - isolated_rel[ok])
+    flips = ((packed_rel[ok] >= flip_threshold)
+             != (isolated_rel[ok] >= flip_threshold))
+    n = int(ok.sum())
+    report = {
+        "packing": int(packing),
+        "n_questions": n,
+        "n_skipped": int(ok.size - n),
+        "flip_rate": round(float(flips.mean()), 4) if n else None,
+    }
+    if n:
+        report.update(
+            mean_abs_delta=round(float(delta.mean()), 6),
+            p50_abs_delta=round(float(np.percentile(delta, 50)), 6),
+            p90_abs_delta=round(float(np.percentile(delta, 90)), 6),
+            max_abs_delta=round(float(delta.max()), 6),
+        )
+    else:
+        report.update(mean_abs_delta=None, p50_abs_delta=None,
+                      p90_abs_delta=None, max_abs_delta=None)
+    return report
+
+
+def demos_from_relative_probs(rel: Sequence[float],
+                              target_pairs: Sequence[Sequence[str]]
+                              ) -> List[str]:
+    """Auto-Demo's self-generated demonstrations from an isolated scoring
+    pass: each question's demonstration answer is the target its OWN
+    isolated relative probability favors (>= 0.5 → the yes target).  NaN
+    rows (isolated error rows) fall back to the yes target."""
+    out = []
+    for r, pair in zip(rel, target_pairs):
+        yes, no = pair[0], pair[1]
+        out.append(no if (np.isfinite(r) and float(r) < 0.5) else yes)
+    return out

@@ -53,6 +53,26 @@ def format_prompt_parts(question: str, is_base_model: bool,
     return (question, f" {ANSWER_INSTRUCTION}")
 
 
+#: Separator between a packed question's demonstration answer and the next
+#: question — two newlines, the reference few-shot scaffold's question
+#: separator (FEW_SHOT_PREFIX above).  The packed batch-prompting machinery
+#: (scoring/packed.py, Auto-Demo arxiv 2410.01724) builds rows from these
+#: pieces; the formatting CONTRACT lives here with the other prompt
+#: spellings.
+PACKED_SEPARATOR = "\n\n"
+
+
+def format_packed_demo(answer: str) -> str:
+    """Packed batch prompting: the demonstration continuation appended
+    after a question's answer anchor — ``" {answer}.\\n\\n"``, the
+    reference few-shot scaffold's answer spelling (``Answer: No.\\n\\n``),
+    minus the ``Answer:`` cue the packed question text already ends with.
+    The anchor itself is the question prompt's last token; everything a
+    packed row contains is therefore spelled by this module's formatters
+    (scoring/packed.encode_packs assembles them)."""
+    return f" {answer}.{PACKED_SEPARATOR}"
+
+
 def format_binary_prompt(main_part: str, response_format: str) -> str:
     """Perturbation-sweep binary prompt: ``{rephrased_main} {response_format}``
     (perturb_prompts.py 'Full Rephrased Prompt' column)."""

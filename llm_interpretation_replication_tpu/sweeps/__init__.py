@@ -1,6 +1,10 @@
 from .base_vs_instruct_100q import run_model_on_prompts, run_sweep
 from .instruct_sweep import run_base_vs_instruct_word_meaning, run_instruct_sweep
-from .perturbation import load_existing_keys, run_model_perturbation_sweep
+from .perturbation import (
+    load_existing_keys,
+    run_model_perturbation_sweep,
+    run_packed_perturbation_sweep,
+)
 from .writers import (
     BASE_VS_INSTRUCT_100Q_COLUMNS,
     INSTRUCT_COMPARISON_COLUMNS,

@@ -429,3 +429,175 @@ def run_model_perturbation_sweep(
             f"{delta.get('kv_cache_bytes_saved', 0):.0f} "
             f"prefill_chunks={delta.get('prefill_chunks', 0):.0f}")
     return pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS)
+
+
+def run_packed_perturbation_sweep(
+    engine,
+    model_name: str,
+    scenarios: Sequence[Dict],
+    output_xlsx: str,
+    packing: int = 4,
+    drift_parity: bool = True,
+    checkpoint_every: int = 100,
+    max_rephrasings: Optional[int] = None,
+    score_chunk: int = 2000,
+    retry_policy: Optional[RetryPolicy] = None,
+    log: Optional[SessionLogger] = None,
+) -> Tuple[pd.DataFrame, Optional[Dict]]:
+    """Packed multi-question perturbation sweep (scoring/packed.py —
+    Auto-Demo batch prompting, arxiv 2410.01724): ``packing`` rephrasings
+    concatenate into ONE row (each followed by its demonstration answer),
+    the row prefills once, and every question's binary-leg probabilities
+    read from the logits gathered at its answer anchor — one prefill
+    amortized across Q questions, no decode path, no confidence leg.
+
+    ``drift_parity`` (default on) scores the SAME rows isolated first
+    (the API top-20 first-token contract — the packed rows' comparator)
+    and returns a drift block (per-question |Δ relative_prob|
+    distribution + flip rate, scoring/packed.drift_report) as a
+    first-class result next to the DataFrame; the isolated pass also
+    supplies each question's Auto-Demo demonstration (its own isolated
+    answer).  With parity off, demonstrations fall back to each
+    scenario's nominal yes target.
+
+    Workbook rows keep the 15-column schema: ``Model Response`` is empty
+    (nothing decodes), ``Log Probabilities`` names the packed extractor
+    (``local:packed{Q}:first_token_top20``), and the confidence columns
+    are None — resume keys and downstream readers are unchanged.
+    Returns ``(DataFrame, drift_report | None)``."""
+    from ..scoring import packed as packed_mod
+
+    if not callable(getattr(engine, "score_packed", None)):
+        raise ValueError(
+            "packed sweep needs an engine with score_packed (the anchor-"
+            "gather prefill path); foreign engines score isolated only")
+    log = log or SessionLogger()
+    if getattr(engine, "plan_decision", None):
+        log(f"[plan] {engine.plan_decision}")
+    all_rows, processed = load_existing_rows(output_xlsx)
+    pending: List[Dict] = []
+    os.makedirs(os.path.dirname(os.path.abspath(output_xlsx)), exist_ok=True)
+    sidelog = _sidelog_path(output_xlsx)
+    in_flush = False
+
+    def flush(final: bool = False):
+        nonlocal pending, all_rows, in_flush
+        if in_flush:
+            return
+        in_flush = True
+        try:
+            with obs.span("checkpoint_flush", phase="host_write",
+                          rows=len(pending), final=final):
+                if pending:
+                    append_jsonl(sidelog, pending)
+                    all_rows.extend(pending)
+                    pending = []
+                if final:
+                    write_xlsx(pd.DataFrame(all_rows,
+                                            columns=PERTURBATION_COLUMNS),
+                               output_xlsx)
+                    if os.path.exists(sidelog):
+                        os.remove(sidelog)
+        finally:
+            in_flush = False
+
+    todo_items: List[tuple] = []
+    for scenario in scenarios:
+        rephrasings = scenario["rephrasings"]
+        if max_rephrasings:
+            rephrasings = rephrasings[:max_rephrasings]
+        todo = [
+            r for r in rephrasings
+            if (model_name, scenario["original_main"], r) not in processed
+        ]
+        if not todo:
+            log(f"Scenario already complete for {model_name}")
+            continue
+        log(f"{model_name}: packed-scoring {len(todo)} rephrasings "
+            f"(Q={packing}) of scenario "
+            f"{scenario['original_main'][:50]!r}...")
+        todo_items.extend((scenario, r) for r in todo)
+
+    score_packed = faults.retry_transient(
+        engine.score_packed, retry_policy, label="perturbation.packed")
+    first_token = faults.retry_transient(
+        engine.first_token_relative_prob, retry_policy,
+        label="perturbation.packed_isolated")
+
+    sweep_t0 = time.perf_counter()
+    done_rows, total_rows = 0, len(todo_items)
+    drift_packed: List[float] = []
+    drift_isolated: List[float] = []
+    obs_flight.enable(os.path.dirname(os.path.abspath(output_xlsx)))
+    watchdog = obs_flight.StallWatchdog(
+        label=f"perturbation-packed:{model_name}")
+    with faults.PreemptionGuard(flush, label="perturbation-packed"), \
+            watchdog:
+        for start in range(0, len(todo_items), score_chunk):
+            chunk = todo_items[start:start + score_chunk]
+            prompts = [f"{r} {s['response_format']}" for s, r in chunk]
+            targets = [list(s["target_tokens"]) for s, _ in chunk]
+            iso = None
+            if drift_parity:
+                iso = first_token(prompts, targets=targets,
+                                  top_filter=TOP_LOGPROBS)
+                demos = packed_mod.demos_from_relative_probs(
+                    iso[:, 2], targets)
+            else:
+                demos = [t[0] for t in targets]
+            packs = packed_mod.build_packs(prompts, packing, demos)
+            rows = score_packed(packs, targets=targets)
+            if iso is not None:
+                drift_isolated.extend(float(v) for v in iso[:, 2])
+                # engine error rows carry no first_token_* fields
+                # (_error_row contract); NaN routes them into the drift
+                # report's n_skipped instead of crashing the sweep
+                drift_packed.extend(
+                    row.get("first_token_relative_prob", float("nan"))
+                    for row in rows)
+            n_err = sum(1 for row in rows if not row.get("success"))
+            if n_err:
+                record_fault("packed_error_rows", model=model_name,
+                             rows=n_err, chunk_start=start)
+                log(f"{model_name}: WARNING — {n_err} packed rows are "
+                    f"error rows (recorded in telemetry)")
+            with obs.span("build_rows", phase="host_rows",
+                          rows=len(chunk)):
+                for i, (scenario, reph) in enumerate(chunk):
+                    t1p = rows[i].get("first_token_yes_prob",
+                                      float("nan"))
+                    t2p = rows[i].get("first_token_no_prob",
+                                      float("nan"))
+                    odds = t1p / t2p if t2p > 0 else float("inf")
+                    pending.append(
+                        perturbation_row(
+                            model_name, scenario, reph,
+                            response_text="",
+                            confidence_text="",
+                            logprobs_repr=(f"local:packed{packing}:"
+                                           f"first_token_top{TOP_LOGPROBS}"),
+                            token_1_prob=t1p,
+                            token_2_prob=t2p,
+                            odds_ratio=odds,
+                            confidence_value=None,
+                            weighted_confidence=None,
+                        )
+                    )
+                    processed.add((model_name, scenario["original_main"],
+                                   reph))
+                    if len(pending) >= checkpoint_every:
+                        flush()
+            done_rows += len(chunk)
+            obs_metrics.heartbeat(f"{model_name}[packed{packing}]",
+                                  done_rows, total_rows,
+                                  time.perf_counter() - sweep_t0, log=log)
+        flush(final=True)
+    report = None
+    if drift_parity:
+        report = packed_mod.drift_report(drift_packed, drift_isolated,
+                                         packing)
+        log(f"{model_name}: packed drift |Δrel_prob| mean "
+            f"{report['mean_abs_delta']} p90 {report['p90_abs_delta']} "
+            f"flip rate {report['flip_rate']} "
+            f"({report['n_questions']} questions, Q={packing})")
+    return pd.DataFrame(all_rows, columns=PERTURBATION_COLUMNS), report

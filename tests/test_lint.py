@@ -686,6 +686,68 @@ class TestRepoGate:
                      if e.get("path", "").endswith(touched)]
         assert not offenders, offenders
 
+    def test_scoring_package_is_in_g05_scope(self):
+        """Satellite (ISSUE 10): scoring/ joined the G05 fault scope when
+        packed anchor scoring landed there (scoring/packed.py feeds the
+        engine's launch path) — a swallowing broad except in the packed
+        encoder would hide a device error inside prompt assembly."""
+        for path in ("scoring/packed.py", "scoring/confidence.py"):
+            findings = run(path, """
+                def encode(tok, packs):
+                    try:
+                        return tok(packs)
+                    except Exception:
+                        return None
+            """)
+            assert rules_of(findings) == ["G05"], path
+
+    def test_scoring_package_lint_clean_without_baseline(self):
+        """Satellite (ISSUE 10): scoring/ (incl. the new packed module)
+        ships lint-clean — zero findings with NO baseline, and no
+        lint_baseline.json entry grandfathers anything under scoring/."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        assert lint_paths([os.path.join(pkg, "scoring")]) == []
+        entries = load_baseline(default_baseline_path())
+        assert not [e for e in entries if e.get("path", "").startswith(
+            "llm_interpretation_replication_tpu/scoring/")]
+
+    def test_packed_module_is_scanned_by_the_gate(self):
+        from llm_interpretation_replication_tpu.lint.cli import (
+            iter_python_files,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        scanned = [f.replace(os.sep, "/") for f in iter_python_files([pkg])]
+        assert any("/scoring/packed.py" in f for f in scanned)
+
+    def test_packed_touched_modules_carry_no_baseline_entries(self):
+        """Satellite (ISSUE 10): the packed-batching / EOS-bracket change
+        ships lint-clean — zero new ``lint_baseline.json`` entries for
+        every module it touches (packed scoring + engine anchor path,
+        decoder anchor logits, sweep shell, plan/plan_search packing
+        terms, benchdiff keys, CLI plumbing, bench)."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        touched = ("scoring/packed.py", "scoring/prompts.py",
+                   "runtime/engine.py", "runtime/plan.py",
+                   "runtime/plan_search.py", "models/decoder.py",
+                   "sweeps/perturbation.py", "obs/benchdiff.py",
+                   "config/__init__.py",
+                   "llm_interpretation_replication_tpu/__main__.py",
+                   "bench.py")
+        entries = load_baseline(default_baseline_path())
+        offenders = [e for e in entries
+                     if e.get("path", "").endswith(touched)]
+        assert not offenders, offenders
+
     def test_plan_search_is_in_g05_scope(self):
         """Satellite (ISSUE 8): the plan search sits between the budget
         model and the engine factory — a broad except swallowing there

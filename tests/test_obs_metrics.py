@@ -525,6 +525,96 @@ class TestBenchDiff:
         assert "context:kv_dtype" in out        # changed context surfaces
         assert "prefill_chunks" not in out      # unchanged context is noise
 
+    def test_mixed_bracket_and_packed_rows_never_cross_compare(
+            self, tmp_path, capsys):
+        """ISSUE-10 satellite: eos_mode and the packing factor fold into
+        the workload alignment key — an EOS-typical bracket row (faster by
+        construction) must never align against a no-EOS row and read as an
+        'improvement', and a packed questions/sec row must never align
+        with an isolated row.  A record WITHOUT the new blocks aligns with
+        one that has them: the new rows report 'new', the shared rows
+        diff normally."""
+        old = {"metric": ("full-study rows/sec/chip (... no-EOS worst "
+                          "case)"), "value": 30.0, "unit": "rows/sec"}
+        new = {"metric": ("full-study rows/sec/chip (... no-EOS worst "
+                          "case)"), "value": 31.0, "unit": "rows/sec",
+               "brackets": [
+                   {"eos_mode": "no-eos", "value": 31.0,
+                    "unit": "rows/sec",
+                    "metric": "full-study rows/sec/chip (no-eos decode "
+                              "bracket)"},
+                   {"eos_mode": "eos-typical", "value": 95.0,
+                    "unit": "rows/sec",
+                    "metric": "full-study rows/sec/chip (eos-typical "
+                              "decode bracket)"},
+               ],
+               "packed": {"metric": "questions/sec/chip (packed batch "
+                                    "prompting secondary, Q=4 ...)",
+                          "value": 140.0, "unit": "questions/sec"}}
+        # the full-study CHILD secondary carries its own nested brackets
+        # (the bench child-extras forwarding) — flattened like top-level
+        new["secondary"] = [{
+            "metric": "full-study rows/sec/chip (child secondary)",
+            "value": 31.0, "unit": "rows/sec",
+            "brackets": [{"eos_mode": "eos-typical", "value": 96.0,
+                          "unit": "rows/sec",
+                          "metric": "full-study rows/sec/chip "
+                                    "(eos-typical decode bracket) #child"}],
+        }]
+        pa, pb = tmp_path / "BENCH_y01.json", tmp_path / "BENCH_y02.json"
+        pa.write_text(json.dumps(old))
+        pb.write_text(json.dumps(new))
+        assert benchdiff.main([str(pa), str(pb), "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        rows = {r["key"]: r for r in doc["metrics"]}
+        # distinct keys per bracket / packing — no cross-comparison
+        assert "full-study@eos-typical [rows/sec]" in rows
+        assert "packed@q4 [questions/sec]" in rows
+        # the bracket/packed rows are NEW vs the bracket-less record, and
+        # the 95-vs-30 bracket span never registers as a delta
+        assert rows["full-study@eos-typical [rows/sec]"]["verdict"] == "new"
+        assert rows["packed@q4 [questions/sec]"]["verdict"] == "new"
+        assert rows["headline"]["values"] == [30.0, 31.0]
+        # the child's NESTED bracket row surfaced too (disambiguated key)
+        assert any(k.startswith("full-study@eos-typical") and k !=
+                   "full-study@eos-typical [rows/sec]" for k in rows)
+
+    def test_headline_keys_fold_the_workload_shape(self, tmp_path, capsys):
+        """An --eos-mode typical headline (faster by construction) must
+        never produce a verdict against a no-EOS headline — the shape
+        tags fold into the otherwise-positional headline key."""
+        a = {"metric": "full-study rows/sec/chip (no-EOS worst case)",
+             "value": 30.0, "unit": "rows/sec"}
+        b = {"metric": "full-study rows/sec/chip (EOS-typical decode "
+                       "bracket)", "value": 95.0, "unit": "rows/sec"}
+        pa, pb = tmp_path / "BENCH_w01.json", tmp_path / "BENCH_w02.json"
+        pa.write_text(json.dumps(a))
+        pb.write_text(json.dumps(b))
+        assert benchdiff.main([str(pa), str(pb), "--format", "json"]) == 0
+        doc = json.loads(capsys.readouterr().out)
+        rows = {r["key"]: r for r in doc["metrics"]}
+        assert rows["headline"]["verdict"] == "gone"
+        assert rows["headline@eos-typical"]["verdict"] == "new"
+
+    def test_mixed_brackets_catch_same_bracket_regressions(
+            self, tmp_path, capsys):
+        """Same-bracket rows still diff: an EOS-typical drop between two
+        bracketed records is a real regression."""
+        def rec(no_eos, eos_typical):
+            return {"metric": "full-study rows/sec/chip (no-EOS)",
+                    "value": no_eos, "unit": "rows/sec",
+                    "brackets": [
+                        {"eos_mode": "eos-typical", "value": eos_typical,
+                         "unit": "rows/sec",
+                         "metric": "full-study rows/sec/chip (eos-typical "
+                                   "decode bracket)"}]}
+        pa, pb = tmp_path / "BENCH_z01.json", tmp_path / "BENCH_z02.json"
+        pa.write_text(json.dumps(rec(30.0, 95.0)))
+        pb.write_text(json.dumps(rec(30.0, 60.0)))
+        assert benchdiff.main([str(pa), str(pb)]) == 1
+        out = capsys.readouterr().out
+        assert "eos-typical" in out and "REGRESSION" in out
+
     def test_rejects_non_records(self, tmp_path, capsys):
         bad = tmp_path / "nope.json"
         bad.write_text('{"no": "value"}')

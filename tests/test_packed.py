@@ -1,0 +1,518 @@
+"""Packed multi-question batching + EOS-realistic decode brackets
+(ISSUE 10, ``-m packed``, tier-1).
+
+Pins the four contracts of the new workload shape:
+
+- **anchor-gather correctness**: a packed row's FIRST question carries no
+  packed context, so its anchor logits — and every probability field —
+  are bit-identical to isolated scoring; single-question packs reproduce
+  the isolated sweep everywhere.
+- **measured-drift determinism**: the drift-parity block is a pure
+  function of the two scoring passes — identical inputs emit identical
+  blocks (distribution fields + flip rate populated).
+- **EOS-typical bracket parity**: modifying ONLY the EOS unembedding row
+  leaves every position-0-decided row's relative_prob/odds_ratio
+  bit-identical (ratios of unchanged logits), while the completion
+  decode early-stops and records ``decode_steps_saved`` — the bracket
+  changes throughput, never decided judgments.
+- **strict mode**: the packed sweep runs end-to-end under the d2h
+  transfer guard with ``blocked_transfers == 0``.
+
+Plus the ISSUE-10 satellites: the mined decided-rate calibration asset
+validates the 0.87-0.92 targets (ROADMAP item 4's validation clause),
+and the bench forwards the new bracket flags to its sweep-full child.
+"""
+
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from helpers import build_test_tokenizer, random_decoder_params  # noqa: E402
+from llm_interpretation_replication_tpu.models.config import (  # noqa: E402
+    DecoderConfig,
+)
+from llm_interpretation_replication_tpu.runtime.engine import (  # noqa: E402
+    EngineConfig,
+    ScoringEngine,
+)
+from llm_interpretation_replication_tpu.scoring import packed as pk  # noqa: E402
+from llm_interpretation_replication_tpu.utils.telemetry import (  # noqa: E402
+    counters,
+)
+
+pytestmark = pytest.mark.packed
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(
+    vocab_size=300, hidden_size=32, num_layers=2, num_heads=4,
+    intermediate_size=64, position_embedding="rotary", rotary_pct=0.25,
+    max_position_embeddings=512,
+)
+
+
+@pytest.fixture(scope="module")
+def engine():
+    cfg = DecoderConfig(**TINY)
+    tok = build_test_tokenizer()
+    return ScoringEngine(
+        "falcon", cfg, random_decoder_params(cfg), tok,
+        engine_config=EngineConfig(batch_size=4, decode_completions=False,
+                                   buckets=(32, 64, 96, 128, 192, 256)))
+
+
+def _prompts(n=6):
+    return [f"Is item number {i} a beverage? Answer only 'Yes' or 'No'."
+            for i in range(n)]
+
+
+# ---------------------------------------------------------------------------
+# Formatter / encoding
+# ---------------------------------------------------------------------------
+
+class TestPackedEncoding:
+    def test_anchors_point_at_last_prompt_token(self, engine):
+        tok = engine.tokenizer
+        prompts = _prompts(4)
+        packs = pk.build_packs(prompts, 2, demos=["Yes"] * 4)
+        rows, anchors = pk.encode_packs(tok, packs)
+        assert len(rows) == 2 and [len(a) for a in anchors] == [2, 2]
+        # question 0's segment IS the isolated tokenization, and its
+        # anchor is its last token
+        iso = tok(prompts[0])["input_ids"]
+        assert rows[0][: len(iso)] == list(iso)
+        assert anchors[0][0] == len(iso) - 1
+        # the last question of a pack carries NO demo continuation:
+        # tokens after the final anchor are causally dead
+        assert anchors[0][-1] == len(rows[0]) - 1
+
+    def test_demo_continuation_between_questions(self, engine):
+        packs = pk.build_packs(_prompts(2), 2, demos=["Yes", "No"])
+        (p0, d0), (p1, d1) = packs[0]
+        assert d0 == " Yes.\n\n"     # question 0's OWN answer demonstrates
+        assert d1 is None            # nothing follows the last anchor
+
+    def test_build_packs_rejects_bad_packing(self):
+        with pytest.raises(ValueError):
+            pk.build_packs(_prompts(2), 0)
+
+    def test_demos_from_relative_probs(self):
+        demos = pk.demos_from_relative_probs(
+            [0.9, 0.1, float("nan")],
+            [["Yes", "No"]] * 3)
+        assert demos == ["Yes", "No", "Yes"]   # NaN falls back to yes
+
+
+# ---------------------------------------------------------------------------
+# Anchor-gather position correctness on a tiny model
+# ---------------------------------------------------------------------------
+
+class TestAnchorCorrectness:
+    def test_single_question_packs_reproduce_isolated_bitwise(self, engine):
+        prompts = _prompts(6)
+        targets = [["Yes", "No"]] * 6
+        iso = engine.first_token_relative_prob(prompts, targets=targets,
+                                               top_filter=0)
+        rows = engine.score_packed(pk.build_packs(prompts, 1),
+                                   targets=targets, top_filter=0)
+        got = np.asarray([r["first_token_relative_prob"] for r in rows])
+        np.testing.assert_array_equal(got, iso[:, 2])
+
+    def test_first_question_of_each_pack_is_bit_identical(self, engine):
+        """Question 0 has no packed context: its token stream equals the
+        isolated prompt's, so the anchor logits are the same numbers even
+        though the packed row pads to a LONGER bucket (masked softmax
+        positions contribute exact zeros)."""
+        prompts = _prompts(6)
+        targets = [["Yes", "No"]] * 6
+        iso = engine.first_token_relative_prob(prompts, targets=targets,
+                                               top_filter=0)
+        rows = engine.score_packed(pk.build_packs(prompts, 3),
+                                   targets=targets, top_filter=0)
+        rel = np.asarray([r["first_token_relative_prob"] for r in rows])
+        assert rel[0] == iso[0, 2]
+        assert rel[3] == iso[3, 2]
+        # later questions see packed context and legitimately move
+        assert not np.allclose(rel[1:3], iso[1:3, 2])
+
+    def test_packed_rows_carry_the_result_contract(self, engine):
+        prompts = _prompts(4)
+        rows = engine.score_packed(pk.build_packs(prompts, 2),
+                                   targets=[["Yes", "No"]] * 4)
+        assert len(rows) == 4
+        for row in rows:
+            assert row["success"] and row["completion"] == ""
+            for key in ("yes_prob", "no_prob", "relative_prob",
+                        "odds_ratio", "first_token_yes_prob",
+                        "first_token_no_prob",
+                        "first_token_relative_prob"):
+                assert key in row
+        c = counters()
+        assert c.get("packed_rows", 0) >= 2
+        assert c.get("packed_questions", 0) >= 4
+
+    def test_per_question_targets_route_to_the_right_anchor(self, engine):
+        """Mixed-scenario packing: each question's (yes, no) pair scores
+        at ITS anchor — swapping one question's pair must flip only that
+        question's relative probability (to 1 - rel)."""
+        prompts = _prompts(4)
+        base = [["Yes", "No"]] * 4
+        swapped = [["Yes", "No"], ["No", "Yes"],
+                   ["Yes", "No"], ["Yes", "No"]]
+        packs = pk.build_packs(prompts, 2)
+        a = engine.score_packed(packs, targets=base, top_filter=0)
+        b = engine.score_packed(packs, targets=swapped, top_filter=0)
+        ra = np.asarray([r["first_token_relative_prob"] for r in a])
+        rb = np.asarray([r["first_token_relative_prob"] for r in b])
+        np.testing.assert_allclose(rb[1], 1.0 - ra[1], rtol=1e-6)
+        np.testing.assert_array_equal(rb[[0, 2, 3]], ra[[0, 2, 3]])
+
+    def test_t5_rejects_packed_scoring(self):
+        eng = ScoringEngine("t5", None, None, None)
+        with pytest.raises(ValueError, match="decoder-only"):
+            eng.score_packed([[("q", None)]], targets=("Yes", "No"))
+
+
+# ---------------------------------------------------------------------------
+# Drift-parity determinism
+# ---------------------------------------------------------------------------
+
+class TestDriftReport:
+    def test_report_is_deterministic_and_populated(self, engine):
+        prompts = _prompts(6)
+        targets = [["Yes", "No"]] * 6
+        iso = engine.first_token_relative_prob(prompts, targets=targets,
+                                               top_filter=0)
+        packs = pk.build_packs(prompts, 3,
+                               pk.demos_from_relative_probs(
+                                   iso[:, 2], targets))
+
+        def one():
+            rows = engine.score_packed(packs, targets=targets,
+                                       top_filter=0)
+            rel = [r["first_token_relative_prob"] for r in rows]
+            return pk.drift_report(rel, iso[:, 2], 3)
+
+        a, b = one(), one()
+        assert a == b                         # bit-deterministic block
+        assert a["packing"] == 3 and a["n_questions"] == 6
+        for key in ("mean_abs_delta", "p50_abs_delta", "p90_abs_delta",
+                    "max_abs_delta", "flip_rate"):
+            assert a[key] is not None
+        assert a["max_abs_delta"] > 0         # real packed-context drift
+
+    def test_nan_rows_are_skipped_not_counted(self):
+        rep = pk.drift_report([0.6, float("nan")], [0.4, 0.5], 2)
+        assert rep["n_questions"] == 1 and rep["n_skipped"] == 1
+        assert rep["flip_rate"] == 1.0        # 0.6 vs 0.4 flips at 0.5
+
+    def test_length_mismatch_raises(self):
+        with pytest.raises(ValueError):
+            pk.drift_report([0.5], [0.5, 0.5], 2)
+
+    def test_packed_sweep_emits_the_drift_block(self, engine, tmp_path):
+        from llm_interpretation_replication_tpu.sweeps import (
+            run_packed_perturbation_sweep,
+        )
+
+        scen = [{"original_main": "Is soup a beverage?",
+                 "response_format": "Answer only 'Yes' or 'No'.",
+                 "confidence_format": "How confident (0-100)?",
+                 "target_tokens": ["Yes", "No"],
+                 "rephrasings": [f"Is soup nr {i} a beverage?"
+                                 for i in range(5)]}]
+        out = str(tmp_path / "packed.xlsx")
+        df, rep = run_packed_perturbation_sweep(
+            engine, "tiny", scen, out, packing=2,
+            log=lambda *a, **k: None)
+        assert len(df) == 5 and os.path.exists(out)
+        assert rep["packing"] == 2 and rep["n_questions"] == 5
+        assert df["Log Probabilities"].iloc[0] == \
+            "local:packed2:first_token_top20"
+        # resume skips every row; the drift block covers only new rows
+        df2, rep2 = run_packed_perturbation_sweep(
+            engine, "tiny", scen, out, packing=2,
+            log=lambda *a, **k: None)
+        assert len(df2) == 5 and rep2["n_questions"] == 0
+
+
+# ---------------------------------------------------------------------------
+# EOS-typical bracket: bit-parity for decided rows + decode_steps_saved
+# ---------------------------------------------------------------------------
+
+def _eos_boosted(engine, cfg, params, prompts, targets, eos_id):
+    """Deterministic EOS-typical twin of ``params``: the EOS unembedding
+    row boosted along the mean position-1 hidden direction orthogonalized
+    against position 0 — the _calibrate_eos_rate construction without the
+    bisection, so tiny-model tests stay fast and exact."""
+    from llm_interpretation_replication_tpu.models.decoder import (
+        decode_steps,
+        prefill,
+    )
+    from llm_interpretation_replication_tpu.runtime import batching
+
+    enc = batching.encode_prompts(engine.tokenizer, prompts)
+    batch = next(batching.batches_for_prompts(
+        enc, len(prompts), engine.ecfg.buckets, pad_id=0))
+    ids, mask = jnp.asarray(batch.token_ids), jnp.asarray(
+        batch.attention_mask)
+    last, cache = prefill(params, cfg, ids, mask,
+                          cache_len=int(ids.shape[1]))
+    lengths = jnp.sum(mask, axis=-1)
+    _, sc, _, _, _ = decode_steps(params, cfg, cache, last, lengths,
+                                  np.int32(0), 2, None, None,
+                                  with_scores=True)
+    unembed = jnp.transpose(params["lm_head"]).astype(jnp.float32)
+
+    def hdir(m):
+        d = jnp.matmul(m[None, :], unembed)[0]
+        return d / jnp.linalg.norm(d)
+
+    h0 = hdir(jnp.mean(sc[:, 0].astype(jnp.float32), axis=0))
+    h1 = hdir(jnp.mean(sc[:, 1].astype(jnp.float32), axis=0))
+    he = h1 - jnp.dot(h1, h0) * h0
+    he = he / jnp.linalg.norm(he)
+    row = (unembed[eos_id] + 64.0 * he).astype(params["lm_head"].dtype)
+    p = dict(params)
+    p["lm_head"] = params["lm_head"].at[:, eos_id].set(row)
+    return p
+
+
+class TestEosBracket:
+    def _setup(self):
+        # vocab headroom over the 300-token test tokenizer: the armed
+        # <|eos|> special token lands at id 300 and the model's
+        # unembedding must cover it (bench._arm_eos_token's own check)
+        cfg = DecoderConfig(**dict(TINY, vocab_size=384))
+        tok = build_test_tokenizer()
+        params = random_decoder_params(cfg)
+        eng = ScoringEngine(
+            "falcon", cfg, params, tok,
+            engine_config=EngineConfig(batch_size=8,
+                                       decode_completions=True,
+                                       buckets=(32, 64, 128)))
+        return cfg, tok, params, eng
+
+    def test_decided_rows_judgment_parity_across_brackets(self):
+        """The EOS boost touches ONLY the EOS unembedding row, so a
+        position-0-decided row's yes/no LOGITS are bit-identical between
+        the no-EOS and EOS-typical brackets — the brackets change decode
+        length, never decided judgments.  The recorded probabilities pass
+        through a softmax whose normalizer sums EVERY logit (including
+        the boosted EOS one), so raw bit-equality of the floats is
+        physically impossible; the contract PARITY.md pins is the
+        strongest true invariant: identical scan verdicts (hit mask,
+        scan_found, >= 0.5 judgments — zero flips) and probabilities
+        equal at the fp32 normalization rounding floor (the PARITY.md
+        tolerance, |Δ| <= 2e-6 vs the ~0.05 int8-KV class)."""
+        from llm_interpretation_replication_tpu.models.decoder import (
+            forward_last_logits,
+        )
+        from llm_interpretation_replication_tpu.runtime import batching
+        from llm_interpretation_replication_tpu.scoring import yes_no as yn
+
+        cfg, tok, params, eng = self._setup()
+        prompts = _prompts(6)
+        targets = [["Yes", "No"]] * 6
+        scen = [{"original_main": "x",
+                 "response_format": "Answer only 'Yes' or 'No'.",
+                 "confidence_format": "c", "target_tokens": ["Yes", "No"],
+                 "rephrasings": [p.rsplit(" Answer", 1)[0]
+                                 for p in prompts]}]
+        # decided-calibrated weights: most rows hit at position 0, the
+        # population the bracket-parity contract covers
+        params, rate = bench._calibrate_decided_rate(
+            params, cfg, eng, scen, [prompts], 0.9, sample_rows=8)
+        eng.params = params
+        base = eng.score_prompts(prompts, targets=targets)
+        # the position-0 hit mask, straight from the prefill logits
+        yes_id, no_id = eng.target_ids(["Yes", "No"])[:2]
+        batch = next(batching.batches_for_prompts(
+            batching.encode_prompts(tok, prompts), 8, eng.ecfg.buckets,
+            pad_id=0))
+        hit0 = np.asarray(yn.first_token_scan(
+            forward_last_logits(params, cfg,
+                                jnp.asarray(batch.token_ids),
+                                jnp.asarray(batch.attention_mask)),
+            yes_id, no_id, top_k=eng.ecfg.top_k)[4])
+        hit_by_orig = {int(orig): bool(hit0[r])
+                       for r, orig in enumerate(batch.indices) if orig >= 0}
+        decided = [i for i in range(len(prompts)) if hit_by_orig[i]]
+        assert decided, "calibration produced no position-0-decided rows"
+        eos_id = bench._arm_eos_token(tok, cfg)
+        assert tok.eos_token_id == eos_id and eos_id < cfg.vocab_size
+        eng.params = _eos_boosted(eng, cfg, params, prompts, targets,
+                                  eos_id)
+        try:
+            bracket = eng.score_prompts(prompts, targets=targets)
+        finally:
+            eng.params = params
+            tok.eos_token_id = None
+        for i in decided:
+            b, e = base[i], bracket[i]
+            # zero judgment flips, exact verdict-mask equality
+            assert e["scan_found"] == b["scan_found"]
+            assert (e["relative_prob"] >= 0.5) == (b["relative_prob"] >= 0.5)
+            assert (e["first_token_relative_prob"] >= 0.5) == \
+                (b["first_token_relative_prob"] >= 0.5)
+            # probabilities at the normalization rounding floor
+            assert e["relative_prob"] == pytest.approx(
+                b["relative_prob"], abs=2e-6)
+            assert e["first_token_relative_prob"] == pytest.approx(
+                b["first_token_relative_prob"], abs=2e-6)
+            assert e["odds_ratio"] == pytest.approx(
+                b["odds_ratio"], rel=1e-5)
+
+    def test_eos_bracket_records_decode_steps_saved(self):
+        """With the EOS-boosted weights + armed eos id, the completion
+        chunks early-stop and the saved steps land in the
+        decode_steps_saved counter; the no-EOS bracket records none."""
+        cfg, tok, params, eng = self._setup()
+        prompts = _prompts(6)
+        targets = [["Yes", "No"]] * 6
+        snap = dict(counters())
+        eng.score_prompts(prompts, targets=targets)
+        c = counters()
+        assert c.get("decode_steps_saved", 0) == \
+            snap.get("decode_steps_saved", 0)    # no-EOS: nothing saved
+        eos_id = bench._arm_eos_token(tok, cfg)
+        eng.params = _eos_boosted(eng, cfg, params, prompts, targets,
+                                  eos_id)
+        try:
+            snap = dict(counters())
+            rows = eng.score_prompts(prompts, targets=targets)
+        finally:
+            eng.params = params
+            tok.eos_token_id = None
+        saved = counters().get("decode_steps_saved", 0) - snap.get(
+            "decode_steps_saved", 0)
+        assert saved > 0
+        # completions cut at the first EOS: far shorter than the cap
+        assert all(len(r["completion"]) < 100 for r in rows)
+
+    def test_calibrate_eos_rate_converges_on_a_tiny_model(self):
+        """_calibrate_eos_rate's bisection lands near the target on a
+        model whose decided calibration holds (the real bench's regime),
+        and reports the measured rate, not the dial."""
+        cfg, tok, params, eng = self._setup()
+        scen = [{"original_main": "x",
+                 "response_format": "Answer only 'Yes' or 'No'.",
+                 "confidence_format": "c", "target_tokens": ["Yes", "No"],
+                 "rephrasings": [f"Is item {i} a beverage?"
+                                 for i in range(6)]}]
+        prompts_by = [[f"{r} {s['response_format']}"
+                       for r in s["rephrasings"]] for s in scen]
+        eos_id = bench._arm_eos_token(tok, cfg)
+        try:
+            boosted, rate = bench._calibrate_eos_rate(
+                params, cfg, eng, scen, prompts_by, 0.9, eos_id,
+                sample_rows=8)
+        finally:
+            tok.eos_token_id = None
+        assert 0.0 <= rate <= 1.0
+        assert boosted["lm_head"] is not params["lm_head"]
+
+    def test_bracket_targets_pinned_to_the_mined_asset(self):
+        """ISSUE-10 satellite (ROADMAP item 4's validation clause): the
+        bench's calibration targets are the mined bracket — the reference
+        workbooks' position-0 answer-start floor below it, the checked-in
+        rounds' measured calibrated rates spanning it, and the default
+        --decided-frac inside it."""
+        from llm_interpretation_replication_tpu.config import (
+            decided_rate_calibration,
+        )
+
+        asset = decided_rate_calibration()
+        lo, hi = asset["calibration_targets"]["bracket"]
+        assert (lo, hi) == bench.DECIDED_RATE_TARGETS == (0.87, 0.92)
+        assert lo <= asset["calibration_targets"]["default_decided_frac"] <= hi
+        # the reference floor sits strictly below the bracket (top-1 is
+        # the floor for top-5 membership)
+        floor = asset["reference_workbooks"][
+            "instruct_model_comparison_results_combined.csv"]["rate"]
+        assert floor < lo
+        # every measured calibrated rate from the checked-in rounds lands
+        # inside the bracket — the empirical validation of the targets
+        measured = [v for rec in asset["measured_calibrated_rates"].values()
+                    if isinstance(rec, dict)
+                    for v in rec.values() if isinstance(v, (int, float))]
+        assert measured and all(lo <= v <= hi for v in measured)
+        # and the bench records the asset mined really say so
+        r5 = json.load(open(os.path.join(REPO_ROOT, "BENCH_r05.json")))
+        assert "hit rate 0.92" in r5["parsed"]["metric"]
+
+    def test_arm_eos_rejects_vocab_overflow(self):
+        cfg = DecoderConfig(**dict(TINY, vocab_size=16))
+        tok = build_test_tokenizer()
+        with pytest.raises(ValueError, match="outside the model vocab"):
+            bench._arm_eos_token(tok, cfg)
+        tok.eos_token_id = None
+
+
+# ---------------------------------------------------------------------------
+# Strict mode + bench plumbing pins
+# ---------------------------------------------------------------------------
+
+class TestStrictAndPlumbing:
+    def test_strict_packed_sweep_blocked_transfers_zero(self, engine,
+                                                        tmp_path):
+        from llm_interpretation_replication_tpu.runtime import strict
+        from llm_interpretation_replication_tpu.sweeps import (
+            run_packed_perturbation_sweep,
+        )
+
+        scen = [{"original_main": "strict packed",
+                 "response_format": "Answer only 'Yes' or 'No'.",
+                 "confidence_format": "c", "target_tokens": ["Yes", "No"],
+                 "rephrasings": [f"Is strict item {i} a beverage?"
+                                 for i in range(5)]}]
+        snap = dict(counters())
+        strict.activate()
+        try:
+            df, rep = run_packed_perturbation_sweep(
+                engine, "tiny", scen, str(tmp_path / "strict.xlsx"),
+                packing=2, log=lambda *a, **k: None)
+        finally:
+            strict.deactivate()
+        assert len(df) == 5
+        assert counters().get("blocked_transfers", 0) == \
+            snap.get("blocked_transfers", 0)
+
+    def test_bench_forwards_bracket_flags_to_the_child(self):
+        """ISSUE-10 satellite (the PR-6/9 forwarding-pin pattern): the
+        sweep-full child re-exec inherits --eos-mode/--eos-brackets, and
+        the child's brackets block rides back into the parent record."""
+        bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
+        child = bench_src[bench_src.index('"--mode", "sweep-full"'):]
+        child = child[:child.index("subprocess.run")]
+        assert '"--eos-mode"' in child
+        assert '"--eos-brackets"' in child and '"--no-eos-brackets"' in child
+        assert '"plan_search", "brackets")' in bench_src
+
+    def test_context_block_carries_bracket_and_packing_fields(self):
+        """The record's context block names the bracket/packing settings
+        (source pin): eos_mode always, decided/eos rates when measured,
+        the packing factor in sweep-packed mode, and the
+        decode_steps_saved counter when nonzero."""
+        bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
+        ctx = bench_src[bench_src.index("def _operating_context"):]
+        ctx = ctx[:ctx.index("def main")]
+        for needle in ('"eos_mode"', '"decided_rate"', '"eos_rate"',
+                       '"packed"', '"decode_steps_saved"'):
+            assert needle in ctx, needle
+
+    def test_run_perturbation_cli_exposes_packed_flags(self):
+        src = open(os.path.join(
+            REPO_ROOT, "llm_interpretation_replication_tpu",
+            "__main__.py")).read()
+        assert '"--packed"' in src and '"--packed-parity"' in src
+        assert "run_packed_perturbation_sweep" in src

@@ -403,6 +403,106 @@ class TestDryrun:
 # ---------------------------------------------------------------------------
 # CLI + bench wiring
 # ---------------------------------------------------------------------------
+# Packed batch prompting (ISSUE 10): coefficient pins + the acceptance
+# ordering — packed predicted questions/s beats the isolated prediction at
+# equal device budget.
+# ---------------------------------------------------------------------------
+
+class TestPackedWorkload:
+    def test_packed_coefficients_are_pinned(self):
+        """The packed cost-model literals: question/scaffold/demo token
+        counts measured through the sweep tokenizer on the real corpus,
+        and the no-decode gain solved from the r01-r04 single-vs-parity
+        steady-state anchors (38.15 / 36.9)."""
+        assert ps.PACKED_QUESTION_TOKENS == 104.0
+        assert ps.PACKED_SHARED_TOKENS == 16.0
+        assert ps.PACKED_DEMO_TOKENS == 12.0
+        assert ps.PACKED_NO_DECODE_GAIN == 1.034
+        assert ps.DEFAULT_PACKINGS == (1, 2, 4, 8)
+        assert ps.PACKED_SWEEP_HEADROOM_BYTES == 1 << 28
+
+    def test_packed_seq_tokens(self):
+        assert ps.packed_seq_tokens(1) == 132
+        assert ps.packed_seq_tokens(4) == 480
+
+    def test_packed_beats_isolated_at_equal_budget(self):
+        """THE ISSUE-10 acceptance ordering: the chosen packed plan's
+        predicted questions/s beats the chosen isolated (binary) plan's
+        predicted prompts/s on the same 16 GiB device."""
+        f7 = _falcon()
+        binary = ps.chosen_plan(ps.search_plans(f7, "int8", 1,
+                                                workload="binary"))
+        packed = ps.chosen_plan(ps.search_plans(f7, "int8", 1,
+                                                workload="packed"))
+        assert binary is not None and packed is not None
+        assert packed.packing > 1
+        assert (packed.predicted_rows_per_s
+                > binary.predicted_rows_per_s), (packed, binary)
+
+    def test_packed_q1_pays_the_demo_overhead(self):
+        """Q=1 packing is strictly worse than isolated scoring at the
+        same batch: the demonstration-continuation tokens buy nothing
+        when no later question shares the row — the model must price the
+        overhead, not assume packing is free."""
+        f7 = _falcon()
+        q1 = ps.predicted_rows_per_s(f7, 1, 1, 320, workload="packed",
+                                     packing=1)
+        iso = ps.predicted_rows_per_s(f7, 1, 1, 320, workload="binary")
+        assert q1 < iso
+
+    def test_packed_question_batch_saturates(self):
+        """Packed rows saturate the device at the QUESTION batch: Q=4 at
+        80 rows predicts like 320 questions, not 80 — modulo the
+        no-decode gain and token ratio."""
+        f7 = _falcon()
+        q4 = ps.predicted_rows_per_s(f7, 1, 1, 80, workload="packed",
+                                     packing=4)
+        iso320 = ps.predicted_rows_per_s(f7, 1, 1, 320, workload="binary")
+        ratio = ((ps.PACKED_SHARED_TOKENS + ps.PACKED_QUESTION_TOKENS)
+                 / (ps.PACKED_SHARED_TOKENS / 4 + ps.PACKED_QUESTION_TOKENS
+                    + ps.PACKED_DEMO_TOKENS))
+        assert q4 == pytest.approx(
+            iso320 * ps.PACKED_NO_DECODE_GAIN * ratio, rel=1e-9)
+
+    def test_packed_need_terms_budget_large_q_out(self):
+        """The packed attention transient grows quadratically in the row
+        length, so the budget filter — not a hand rule — prices out large
+        packings at big row batches."""
+        f7 = _falcon()
+        ranked = ps.search_plans(f7, "int8", 1, workload="packed")
+        big = [c for c in ranked if c.packing == 8 and c.batch >= 256]
+        assert big and all(not c.fits for c in big)
+        # and every reject carries the budget_reject audit spelling
+        assert all("over budget" in c.reason for c in big)
+
+    def test_packed_record_carries_packing(self):
+        f7 = _falcon()
+        rec = ps.plan_search_record(
+            ps.search_plans(f7, "int8", 1, workload="packed"))
+        assert rec["chosen"]["packing"] > 1
+        assert all("packing" in r for r in rec["runners_up"])
+
+    def test_packed_need_terms_shape(self):
+        """plan.packed_need_terms mirrors the binary keys so
+        sharded_need_bytes prices both workloads, and the anchor-logit
+        transient rides the batch-leading 'completions' slot."""
+        f7 = _falcon()
+        wb = plan_mod.weight_bytes(f7, "int8")
+        terms = plan_mod.packed_need_terms(f7, wb, "xla", 96,
+                                           ps.packed_seq_tokens(4), 4,
+                                           pipeline_depth=4)
+        assert set(terms) == {"weights", "attn", "act", "completions"}
+        assert terms["completions"] == 4 * 96 * 4 * f7.vocab_size * 4
+        assert terms["attn"] == plan_mod.dense_attention_bytes(
+            f7, 96, ps.packed_seq_tokens(4))
+
+    def test_cli_accepts_packed_workload(self, capsys):
+        rc = ps.main(["search", "--model", "falcon-7b", "--devices", "1",
+                      "--workload", "packed", "--format", "json"])
+        assert rc == 0
+        rec = json.loads(capsys.readouterr().out)
+        assert rec["chosen"]["packing"] > 1
+
 
 class TestCli:
     def test_search_json_output(self, capsys):
@@ -425,12 +525,15 @@ class TestCli:
         assert '"--plan-search"' in child
 
     def test_bench_records_the_plan_search_block(self):
-        """Both sweep records attach the runner-up table, and the child's
-        block rides the secondary (source pin, the test_obs pattern)."""
+        """All three sweep records (sweep, sweep-full, sweep-packed)
+        attach the runner-up table, and the child's block rides the
+        secondary (source pin, the test_obs pattern)."""
         bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
         assert bench_src.count(
-            'record["plan_search"] = args.plan_search_report') == 2
-        assert '"plan_search")' in bench_src  # child-extra forwarding key
+            'record["plan_search"] = args.plan_search_report') == 3
+        # child-extra forwarding keys: the full-study child's plan_search
+        # AND brackets blocks ride into the parent's secondary
+        assert '"plan_search", "brackets")' in bench_src
 
 
 class TestEngineFactoryWiring:
