@@ -769,6 +769,44 @@ def run_sweep_mode(args, cfg, params):
               f"{rep_report['mismatched_rows']} mismatched row(s)",
               file=sys.stderr)
 
+    if getattr(args, "serve_load", False):
+        # Open-loop load companion (ISSUE 11): drive the scheduler with
+        # seeded Poisson traffic drawn from the SAME corpus at >= 3
+        # offered rates bracketing the measured offline ceiling, and
+        # attach the latency-anatomy block.  The headline rows double as
+        # the parity reference — load must change WHEN a row is
+        # computed, never WHAT.
+        from llm_interpretation_replication_tpu.serve import SchedulerConfig
+        from llm_interpretation_replication_tpu.serve import (
+            load as serve_load_mod,
+        )
+
+        offline_rate = n_total / best_score_s
+        rates_arg = getattr(args, "serve_load_rates", "auto")
+        if rates_arg and rates_arg != "auto":
+            rates = [float(r) for r in rates_arg.split(",") if r.strip()]
+        else:
+            # bracket the knee: below, at, and above the offline
+            # scoring-only ceiling the repeats above just measured
+            rates = [round(offline_rate * f, 2) for f in (0.5, 1.0, 1.5)]
+        load_block = serve_load_mod.rate_sweep(
+            engine, all_prompts, targets=all_targets, rates=rates,
+            duration_s=args.serve_load_duration,
+            seed=args.serve_load_seed,
+            config=SchedulerConfig(
+                max_batch=args.sweep_batch,
+                queue_capacity=max(
+                    4096, int(max(rates) * args.serve_load_duration * 2))),
+            offline_rows=last_rows, closed_comparator=True)
+        args.serve_load_report = load_block
+        print(serve_load_mod.format_rate_table(load_block),
+              file=sys.stderr)
+        if not load_block.get("parity_ok"):
+            # loud, like the replay contract: a load run that changed a
+            # row is a correctness failure, not a perf data point
+            print("# serve load: PARITY FAILED — served rows differ "
+                  "from the offline sweep rows", file=sys.stderr)
+
     if getattr(args, "packed", 0) and last_rows is not None:
         # Packed-mode companion (ISSUE 10): rescore the SAME corpus with
         # --packed questions per prefill row and report questions/s + the
@@ -1601,6 +1639,35 @@ def main():
                              "and attach a 'serve' block (scheduler vs "
                              "offline rows/sec, micro-batch count, queue "
                              "latency percentiles) to the JSON record")
+    parser.add_argument("--serve-load", action="store_true",
+                        help="sweep mode: after the offline repeats, "
+                             "drive the serve/ scheduler with the "
+                             "open-loop load harness (serve/load.py: "
+                             "seeded Poisson arrivals over the real "
+                             "corpus prompt mix) at >= 3 offered rates, "
+                             "and attach a 'serve_load' block (per-rate "
+                             "p50/p90/p99/p99.9 end-to-end latency + "
+                             "queue_wait/coalesce/serve_engine/respond "
+                             "phase decomposition from exact-count "
+                             "histograms, achieved-vs-offered rate, "
+                             "queue-depth trajectory, saturation "
+                             "throughput, row parity vs the offline "
+                             "rows) to the JSON record")
+    parser.add_argument("--serve-load-rates", metavar="R1,R2,R3[,...]",
+                        default="auto",
+                        help="offered rates (rows/s) for --serve-load; "
+                             "'auto' (default) brackets the measured "
+                             "offline scoring rate at 0.5x/1.0x/1.5x so "
+                             "the sweep crosses the knee")
+    parser.add_argument("--serve-load-duration", type=float, default=8.0,
+                        metavar="S",
+                        help="--serve-load: seconds of offered traffic "
+                             "per rate point")
+    parser.add_argument("--serve-load-seed", type=int, default=0,
+                        metavar="N",
+                        help="--serve-load: seed for the Poisson "
+                             "schedule + prompt mix (same seed = "
+                             "identical replayable traffic)")
     parser.add_argument("--strict", action="store_true",
                         help="arm strict mode (runtime/strict.py, same as "
                              "LLM_INTERP_STRICT=1): transfer-guard the "
@@ -1708,6 +1775,15 @@ def main():
     if args.serve_replay and args.mode != "sweep":
         parser.error("--serve-replay rides the sweep mode's offline rows "
                      "(row-parity needs them); use --mode sweep")
+    if args.serve_load and args.mode != "sweep":
+        parser.error("--serve-load rides the sweep mode's offline rows "
+                     "(the parity reference and the auto-rate anchor); "
+                     "use --mode sweep")
+    if args.serve_load and args.serve_load_rates != "auto":
+        rates = [r for r in args.serve_load_rates.split(",") if r.strip()]
+        if len(rates) < 3:
+            parser.error("--serve-load-rates needs >= 3 offered rates "
+                         "to bracket a knee (or 'auto')")
 
     import jax
     import jax.numpy as jnp
@@ -2238,6 +2314,11 @@ def main():
         record.update(getattr(args, "phases_report", None) or {})
         if getattr(args, "serve_report", None):
             record["serve"] = args.serve_report
+        if getattr(args, "serve_load_report", None):
+            # the open-loop latency/throughput curve (ISSUE 11): per-rate
+            # tail latency + phase anatomy + saturation estimate — the
+            # yardstick the EnginePool fleet PR will be judged against
+            record["serve_load"] = args.serve_load_report
         if getattr(args, "packed_report", None):
             # the packed-mode companion record (ISSUE 10): questions/s at
             # the packed operating point + the measured drift block
@@ -2324,6 +2405,12 @@ def main():
                     "--eos-brackets" if args.eos_brackets
                     else "--no-eos-brackets",
                 ]
+                # the --serve-load* flags (like --serve-replay before
+                # them) deliberately do NOT forward: both ride the sweep
+                # mode's offline rows, and the full-study child measures
+                # the row contract, not the serving harness — a child
+                # serve_load block would shadow the parent's
+                # (tests/test_bench.py pins this decision)
                 # forward the instrumentation flags (the PR-5 --kv-dtype/
                 # --prefill-chunk forwarding discipline): a traced/profiled
                 # parent must not silently run its full-study child

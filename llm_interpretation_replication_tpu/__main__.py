@@ -1433,6 +1433,26 @@ def main(argv=None):
                         "report scheduler-vs-offline throughput")
     p.add_argument("--max-rephrasings", type=int, default=None,
                    help="replay mode: cap rephrasings per scenario")
+    p.add_argument("--load-rate", metavar="R[,R2,...]", default=None,
+                   help="open-loop load harness (serve/load.py): drive "
+                        "the scheduler at a seeded-Poisson offered rate "
+                        "(requests/s) drawn from the --replay corpus (or "
+                        "the --input lines as the prompt pool) and "
+                        "report per-request latency anatomy (queue_wait/"
+                        "coalesce/serve_engine/respond) from exact-count "
+                        "histograms; a comma list of >= 3 rates walks "
+                        "the rate sweep and reports the knee")
+    p.add_argument("--load-duration", type=float, default=10.0,
+                   metavar="S",
+                   help="load mode: seconds of offered traffic per rate "
+                        "point")
+    p.add_argument("--load-seed", type=int, default=0, metavar="N",
+                   help="load mode: seed for the Poisson schedule and "
+                        "the prompt mix (same seed = identical traffic)")
+    p.add_argument("--load-jsonl", metavar="PATH", default=None,
+                   help="load mode: stream one per-request anatomy "
+                        "record (scheduled time, generator lag, e2e + "
+                        "per-phase ms) per line to PATH")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("lint",

@@ -25,11 +25,12 @@ the free-text metric strings legitimately drift round over round (batch
 sizes, hit rates).  Rows present in only one record report as ``new`` /
 ``gone`` instead of silently vanishing from the table.
 
-Regression semantics: every metric this bench records is throughput
-(prompts/sec, rows/sec — higher is better), so a drop beyond
-``--threshold`` percent is a REGRESSION; phase rows compare
-``ms_per_row`` (lower is better) when both records carry a ``phases``
-block.
+Regression semantics: throughput rows (prompts/sec, rows/sec — higher
+is better) regress on a drop beyond ``--threshold`` percent; the
+serve-load latency rows (``ms`` — ISSUE 11, aligned per offered rate
+from the record's ``serve_load`` block) regress on GROWTH beyond it;
+phase rows compare ``ms_per_row`` (lower is better) when both records
+carry a ``phases`` block.
 """
 
 from __future__ import annotations
@@ -43,6 +44,10 @@ from typing import Dict, List, Optional, Sequence, Tuple
 
 #: units where larger values are better (everything bench records today).
 _HIGHER_IS_BETTER_UNITS = ("prompts/sec", "rows/sec")
+
+#: units where SMALLER values are better — the serve-load latency rows
+#: (ISSUE 11): a p99 that grew past the threshold is the regression.
+_LOWER_IS_BETTER_UNITS = ("ms",)
 
 
 def load_bench_record(path: str) -> Dict:
@@ -148,6 +153,46 @@ def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
         out[key] = {"value": entry.get("value"),
                     "unit": entry.get("unit", ""),
                     "metric": entry.get("metric", "")}
+    out.update(_serve_load_rows(rec))
+    return out
+
+
+def _serve_load_rows(rec: Dict) -> Dict[str, Dict]:
+    """Aligned rows from a record's ``serve_load`` block (ISSUE 11): per
+    rate point, achieved throughput (higher-better) and p99 end-to-end
+    latency (LOWER-better, unit ``ms``), plus the saturation estimate.
+
+    Keyed by SWEEP POSITION, not the offered-rate value: the default
+    ``--serve-load-rates auto`` derives each record's rates from its own
+    measured offline ceiling, so the floats never repeat across rounds
+    and value-keyed rows would all report new/gone instead of comparing.
+    Position i is the same BRACKET of the ceiling round over round
+    (auto: 0.5x/1.0x/1.5x), which is the comparison that means
+    something; the offered rate itself rides along as an informational
+    row so a bracket drift is visible next to its latency verdict."""
+    block = rec.get("serve_load")
+    if not isinstance(block, dict):
+        return {}
+    out: Dict[str, Dict] = {}
+    for i, point in enumerate(block.get("rates", ()) or ()):
+        offered = point.get("offered_rate")
+        tag = f"serve-load[{i}]"
+        out[f"{tag} offered"] = {
+            "value": offered, "unit": "",
+            "metric": f"serve load sweep point {i} offered rate (rows/s)"}
+        out[f"{tag} achieved [rows/sec]"] = {
+            "value": point.get("achieved_rows_per_s"), "unit": "rows/sec",
+            "metric": f"serve load achieved rate at sweep point {i} "
+                      f"({offered} offered)"}
+        p99 = (point.get("latency_ms") or {}).get("p99")
+        out[f"{tag} p99 [ms]"] = {
+            "value": p99, "unit": "ms",
+            "metric": f"serve load p99 e2e latency at sweep point {i} "
+                      f"({offered} offered)"}
+    if block.get("saturation_rows_per_s") is not None:
+        out["serve-load saturation [rows/sec]"] = {
+            "value": block["saturation_rows_per_s"], "unit": "rows/sec",
+            "metric": "serve load saturation throughput"}
     return out
 
 
@@ -188,6 +233,10 @@ def diff_records(records: Sequence[Dict],
         elif unit in _HIGHER_IS_BETTER_UNITS and delta < -threshold_pct:
             verdict = "REGRESSION"
         elif unit in _HIGHER_IS_BETTER_UNITS and delta > threshold_pct:
+            verdict = "improved"
+        elif unit in _LOWER_IS_BETTER_UNITS and delta > threshold_pct:
+            verdict = "REGRESSION"   # latency rows: growth is the bug
+        elif unit in _LOWER_IS_BETTER_UNITS and delta < -threshold_pct:
             verdict = "improved"
         else:
             verdict = "ok"

@@ -62,6 +62,9 @@ METRIC_PREFIX = "llm_interp_"
 _NAME_OK = re.compile(r"[a-zA-Z_:][a-zA-Z0-9_:]*$")
 _NAME_BAD_CHARS = re.compile(r"[^a-zA-Z0-9_:]")
 _RING_PCTS = (50.0, 90.0, 99.0)
+#: streaming-histogram percentiles: exact counts, no truncation, so the
+#: p99.9 the bounded rings cannot keep is reportable here
+_HIST_PCTS = (50.0, 90.0, 99.0, 99.9)
 
 
 def sanitize_metric_name(name: str) -> str:
@@ -185,12 +188,18 @@ class MetricsRegistry:
         for name, meta in telemetry.sample_ring_report().items():
             pct = telemetry.sample_percentiles(name, _RING_PCTS)
             rings[name] = {**meta, **pct}
+        hists = {}
+        for name, h in telemetry.hist_report().items():
+            pct = telemetry.hist_percentiles(name, _HIST_PCTS)
+            hists[name] = {"count": h["count"],
+                           "sum": round(h["sum"], 3), **pct}
         doc = {
             "t": round(time.time(), 3),
             "uptime_s": round(time.time() - self._t0, 3),
             "counters": {k: v for k, v in sorted(counters.items())},
             "counters_delta": {k: v for k, v in sorted(delta.items())},
             "rings": rings,
+            "hists": hists,
         }
         with self._lock:
             for name, value in counters.items():
@@ -247,6 +256,21 @@ class MetricsRegistry:
                         f"{_format_value(pct[key])}")
             lines.append(f"{metric}_count {int(meta['total'])}")
             lines.append(f"{metric}_retained {int(meta['retained'])}")
+        # streaming histograms (telemetry.record_hist) as Prometheus
+        # ``histogram`` families: cumulative ``_bucket{le=...}`` over the
+        # exact log-bucket counts plus ``_sum``/``_count``.  hist_report
+        # only lists histograms with >= 1 observation, so an empty one
+        # emits NO series (the empty-ring discipline above)
+        for name, h in sorted(telemetry.hist_report().items()):
+            metric = METRIC_PREFIX + sanitize_metric_name(name)
+            lines.append(f"# TYPE {metric} histogram")
+            cum = 0
+            for le, n in h["buckets"]:
+                cum += n
+                lines.append(f'{metric}_bucket{{le="{le:g}"}} {cum}')
+            lines.append(f'{metric}_bucket{{le="+Inf"}} {int(h["count"])}')
+            lines.append(f"{metric}_sum {_format_value(h['sum'])}")
+            lines.append(f"{metric}_count {int(h['count'])}")
         with self._lock:
             gauges = sorted(
                 (name, labels, value)

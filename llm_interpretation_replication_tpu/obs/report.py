@@ -81,6 +81,50 @@ def format_phase_table(block: Dict, title: str = "phase attribution") -> str:
     return "\n".join(lines)
 
 
+def format_serve_load_table(block: Dict) -> str:
+    """The per-phase latency table from a bench record's ``serve_load``
+    block (ISSUE 11): one section per offered rate — end-to-end
+    p50/p90/p99/p99.9 plus each phase's (queue_wait / coalesce /
+    serve_engine / respond) percentiles from the exact-count
+    histograms, achieved-vs-offered, and the sweep's saturation/knee
+    summary."""
+    pcts = ("p50", "p90", "p99", "p99.9")
+    lines = [f"# serve-load latency anatomy ({block.get('mode', '?')}, "
+             f"seed {block.get('seed')}, {block.get('duration_s')}s per "
+             f"rate):"]
+    for point in block.get("rates", ()) or ():
+        lines.append(
+            f"  offered {point.get('offered_rate'):g} rows/s -> achieved "
+            f"{point.get('achieved_rows_per_s')} "
+            f"({point.get('completed')}/{point.get('requests')} ok, "
+            f"{point.get('shed', 0)} shed, queue depth max "
+            f"{(point.get('queue_depth') or {}).get('max')})")
+        header = "    " + "phase".ljust(14) + "".join(
+            f"{p:>10}" for p in pcts)
+        lines.append(header)
+        rows = [("e2e", point.get("latency_ms", {}))]
+        rows += [(name, (point.get("phases_ms") or {}).get(name, {}))
+                 for name in ("queue_wait", "coalesce", "serve_engine",
+                              "respond")]
+        for name, vals in rows:
+            lines.append("    " + name.ljust(14) + "".join(
+                f"{vals[p]:>10.2f}" if p in vals else f"{'-':>10}"
+                for p in pcts))
+    if block.get("knee_floor_saturated"):
+        knee_txt = "unknown (every swept rate saturated)"
+    elif block.get("knee_beyond_sweep"):
+        knee_txt = "beyond sweep"
+    else:
+        knee_txt = f"at {block.get('knee_offered_rate')} offered"
+    tail = (f"  saturation {block.get('saturation_rows_per_s')} rows/s, "
+            f"knee {knee_txt}")
+    if block.get("parity_ok") is not None:
+        tail += (", parity OK" if block["parity_ok"]
+                 else ", PARITY FAILED")
+    lines.append(tail)
+    return "\n".join(lines)
+
+
 def load_spans(path: str) -> List[Dict]:
     """Read spans back from either export format.
 
@@ -172,9 +216,15 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                              "phase/leg and print the table; "
                              "'bench-diff': compare bench records "
                              "(handled by obs/benchdiff.py)")
-    parser.add_argument("--trace", required=True, metavar="PATH",
+    parser.add_argument("--trace", default=None, metavar="PATH",
                         help="saved trace: the --trace JSONL span log or "
                              "the exported Chrome-trace JSON")
+    parser.add_argument("--serve-load", default=None, metavar="BENCH.json",
+                        help="render the per-phase latency table from a "
+                             "bench record's serve_load block (ISSUE 11: "
+                             "per-rate e2e + queue_wait/coalesce/"
+                             "serve_engine/respond percentiles) instead "
+                             "of a span trace")
     parser.add_argument("--wall-s", type=float, default=None, metavar="S",
                         help="measured wall-clock to compute coverage "
                              "against (e.g. the bench repeat time)")
@@ -183,6 +233,28 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
     parser.add_argument("--format", choices=["table", "json"],
                         default="table")
     args = parser.parse_args(argv)
+    if args.serve_load:
+        from .benchdiff import load_bench_record
+
+        try:
+            rec = load_bench_record(args.serve_load)
+        except (OSError, ValueError) as err:
+            print(f"obs report: cannot read {args.serve_load}: {err}",
+                  file=sys.stderr)
+            return 2
+        block = rec.get("serve_load")
+        if not isinstance(block, dict):
+            print(f"obs report: {args.serve_load} carries no serve_load "
+                  f"block (bench.py --serve-load produces one)",
+                  file=sys.stderr)
+            return 2
+        if args.format == "json":
+            print(json.dumps(block, indent=2))
+        else:
+            print(format_serve_load_table(block))
+        return 0
+    if not args.trace:
+        parser.error("one of --trace or --serve-load is required")
 
     try:
         spans = load_spans(args.trace)

@@ -24,6 +24,7 @@ import json
 import sys
 from typing import Dict, Optional
 
+from . import load as load_mod
 from .config import SchedulerConfig
 from .replay import replay
 from .request import ScoreRequest, ServeError
@@ -47,25 +48,40 @@ def parse_request_line(obj: Dict) -> ScoreRequest:
     return req
 
 
+def scheduler_health(sched) -> Dict:
+    """The scheduler's /healthz contribution: liveness + queue depth +
+    the OLDEST queued request's age.  Depth alone reads a wedged
+    coalescer with a short queue as healthy; a head request older than
+    ``SchedulerConfig.health_max_queue_age_s`` degrades the document
+    (the endpoint reports degraded, never 500s — obs/metrics.py)."""
+    doc = {"scheduler": "closed" if sched._closed else "running",
+           "queue_depth": len(sched.queue)}
+    age = sched.queue.oldest_wait_s()
+    max_age = getattr(sched.config, "health_max_queue_age_s", 0)
+    if age is not None:
+        doc["oldest_queued_age_s"] = round(age, 3)
+        if max_age and age > max_age:
+            doc["status"] = "degraded"
+            doc["degraded_reason"] = (
+                f"oldest queued request has waited {age:.1f}s "
+                f"(> {max_age:g}s threshold)")
+    return doc
+
+
 def _metrics_endpoint(sched, port: int):
     """``/metrics`` + ``/healthz`` for a live scheduler (obs/metrics.py):
-    the Prometheus exposition over the telemetry counters and serve
-    sample rings, plus a periodic sampler feeding the registry's
-    time-series.  Returns the started server (caller closes), or None
-    when ``port`` is falsy."""
+    the Prometheus exposition over the telemetry counters, serve sample
+    rings, and latency-anatomy histograms, plus a periodic sampler
+    feeding the registry's time-series.  Returns the started server
+    (caller closes), or None when ``port`` is falsy."""
     if not port:
         return None
     from ..obs import metrics as obs_metrics
 
     registry = obs_metrics.get_registry()
     registry.start_sampler()
-
-    def health():
-        return {"scheduler": "closed" if sched._closed else "running",
-                "queue_depth": len(sched.queue)}
-
-    server = obs_metrics.MetricsServer(registry, port,
-                                       healthz_fn=health).start()
+    server = obs_metrics.MetricsServer(
+        registry, port, healthz_fn=lambda: scheduler_health(sched)).start()
     print(f"# serve: metrics on :{server.port}/metrics, health on "
           f"/healthz", file=sys.stderr)
     return server
@@ -131,22 +147,97 @@ def run_replay(engine, perturbations_path: str,
                require_parity: bool = True) -> Dict:
     """Replay the perturbation sweep's binary-leg workload through the
     scheduler (the prompts the offline shell builds: ``{rephrasing}
-    {response_format}`` with per-scenario target pairs) and return the
-    parity + throughput report."""
-    with open(perturbations_path, encoding="utf-8") as f:
-        scenarios = json.load(f)
-    prompts, targets = [], []
-    for s in scenarios:
-        rephrasings = s["rephrasings"]
-        if max_rephrasings is not None:
-            rephrasings = rephrasings[:max_rephrasings]
-        for r in rephrasings:
-            prompts.append(f"{r} {s['response_format']}")
-            targets.append(tuple(s["target_tokens"][:2]))
+    {response_format}`` with per-scenario target pairs — ONE builder,
+    shared with the load harness: :func:`..serve.load.corpus_workload`)
+    and return the parity + throughput report."""
+    prompts, targets = load_mod.corpus_workload(
+        perturbations_path, max_rephrasings=max_rephrasings)
     report = replay(engine, prompts, targets=targets, config=config,
                     require_parity=require_parity)
     report.pop("serve_rows", None)
     return report
+
+
+def run_load_cli(engine, args, config: SchedulerConfig) -> int:
+    """``serve --load-rate``: the open-loop load harness (serve/load.py)
+    over the perturbation corpus (``--replay PATH`` supplies it) or the
+    ``--input`` JSONL request lines as the prompt pool.  A single rate
+    runs one operating point; a comma-separated list of >= 3 walks the
+    rate sweep and reports the knee.  Exits 1 on a parity mismatch."""
+    rates = [float(r) for r in str(args.load_rate).split(",") if r.strip()]
+    if not rates:
+        print("# serve load: --load-rate parsed to no rates; pass one "
+              "rate or a comma list of >= 3", file=sys.stderr)
+        return 2
+    if 1 < len(rates) < 3:
+        # never silently drop a requested rate: a sweep needs >= 3 points
+        # to bracket a knee, one point runs alone — two is ambiguous
+        print(f"# serve load: --load-rate with multiple rates needs >= 3 "
+              f"to bracket a knee (got {len(rates)}); pass one rate or "
+              f"add a third", file=sys.stderr)
+        return 2
+    if args.replay:
+        prompts, targets = load_mod.corpus_workload(
+            args.replay, max_rephrasings=args.max_rephrasings)
+    else:
+        prompts, targets = [], []
+        stream = sys.stdin if args.input == "-" else open(
+            args.input, encoding="utf-8")
+        try:
+            for line in stream:
+                line = line.strip()
+                if not line:
+                    continue
+                req = parse_request_line(json.loads(line))
+                if req.prompt is None:
+                    raise ValueError(
+                        "load mode pools plain-prompt request lines; "
+                        "prefix/suffix pairs are not poolable")
+                prompts.append(req.prompt)
+                targets.append(tuple(req.targets))
+        finally:
+            if stream is not sys.stdin:
+                stream.close()
+    if not prompts:
+        print("# serve load: empty prompt pool (need --replay or "
+              "--input lines)", file=sys.stderr)
+        return 2
+    # --metrics-port works in load mode too: the latency-anatomy
+    # histogram families exported on /metrics exist exactly for a
+    # scraper watching a load run.  The scheduler is created inside
+    # run_load per rate point, so /healthz carries the generic liveness
+    # document (no per-scheduler queue health here).
+    server = None
+    if getattr(args, "metrics_port", 0):
+        from ..obs import metrics as obs_metrics
+
+        registry = obs_metrics.get_registry()
+        registry.start_sampler()
+        server = obs_metrics.MetricsServer(
+            registry, args.metrics_port).start()
+        print(f"# serve load: metrics on :{server.port}/metrics",
+              file=sys.stderr)
+    try:
+        kw = dict(duration_s=args.load_duration, seed=args.load_seed,
+                  config=config, jsonl=getattr(args, "load_jsonl", None))
+        if len(rates) >= 3:
+            block = load_mod.rate_sweep(engine, prompts, targets=targets,
+                                        rates=rates,
+                                        closed_comparator=True, **kw)
+            print(load_mod.format_rate_table(block), file=sys.stderr)
+            print(json.dumps(block, indent=2))
+            return 0 if block.get("parity_ok") in (True, None) else 1
+        report = load_mod.run_load(engine, prompts, targets=targets,
+                                   rate=rates[0], **kw)
+        print(json.dumps(report, indent=2))
+        parity = report.get("parity")
+        return 0 if parity is None or parity["mismatched_rows"] == 0 else 1
+    finally:
+        if server is not None:
+            server.close()
+            from ..obs import metrics as obs_metrics
+
+            obs_metrics.get_registry().stop_sampler()
 
 
 def main(engine, args) -> int:
@@ -157,6 +248,8 @@ def main(engine, args) -> int:
         queue_capacity=args.queue_capacity,
         default_timeout_s=args.timeout_s,
     )
+    if getattr(args, "load_rate", None):
+        return run_load_cli(engine, args, config)
     if args.replay:
         # require_parity=False: the CLI's job on a skew is the full JSON
         # report plus exit 1 — raising would swallow the report the

@@ -40,3 +40,8 @@ class SchedulerConfig:
     #: close(drain=True) gives in-flight + queued work this long to
     #: finish before leftover requests fail with SchedulerClosed.
     drain_timeout_s: float = 120.0
+    #: /healthz degrades when the OLDEST queued request has waited this
+    #: long (serve/cli._metrics_endpoint): queue depth alone reads a
+    #: wedged coalescer with a short queue as healthy — the head
+    #: request's age cannot lie.  0 disables the check.
+    health_max_queue_age_s: float = 30.0

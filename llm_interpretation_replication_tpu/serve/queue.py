@@ -42,6 +42,8 @@ class Ticket:
                                     # id (set at submit when tracing is on;
                                     # threads queue-wait/engine/respond
                                     # spans and the result row together)
+    queue_wait_s: Optional[float] = None  # latency-anatomy stamps set at
+    coalesce_s: Optional[float] = None    # launch (scheduler.HIST_PHASES)
 
     def sort_key(self) -> Tuple[int, int]:
         return (-self.request.priority, self.seq)
@@ -85,6 +87,16 @@ class RequestQueue:
         with self._cond:
             self._closed = True
             self._cond.notify_all()
+
+    def oldest_wait_s(self, now_fn=time.monotonic) -> Optional[float]:
+        """Age of the OLDEST queued ticket in seconds (None when empty).
+        The /healthz degraded condition reads this: queue depth alone
+        cannot distinguish a short queue that is draining from a short
+        queue behind a wedged coalescer — the head request's age can."""
+        with self._cond:
+            if not self._items:
+                return None
+            return now_fn() - min(t.enqueue_t for t in self._items)
 
     def pop_group(self, max_batch: int, max_wait_s: float,
                   now_fn=time.monotonic

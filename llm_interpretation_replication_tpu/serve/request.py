@@ -72,14 +72,22 @@ class ScoreRequest:
 
 
 class ScoreFuture:
-    """Thread-safe one-shot result slot for a submitted request."""
+    """Thread-safe one-shot result slot for a submitted request.
 
-    __slots__ = ("_event", "_row", "_err")
+    ``timing`` is the request's latency anatomy — set by the scheduler
+    just before the result lands, so it is readable whenever ``result()``
+    has returned: ``{"e2e_ms", "queue_wait_ms", "coalesce_ms",
+    "serve_engine_ms", "respond_ms"}`` (serve/load.py semantics; the
+    four phases sum to e2e).  It rides the FUTURE, not the result row,
+    so the replay bit-parity contract never sees it."""
+
+    __slots__ = ("_event", "_row", "_err", "timing")
 
     def __init__(self):
         self._event = threading.Event()
         self._row: Optional[Dict] = None
         self._err: Optional[BaseException] = None
+        self.timing: Optional[Dict] = None
 
     # -- scheduler side --------------------------------------------------
 

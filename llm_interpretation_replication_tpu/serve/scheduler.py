@@ -47,7 +47,12 @@ from typing import List, Optional
 from ..obs import tracer as obs
 from ..runtime import faults
 from ..runtime.engine import LegSpec
-from ..utils.telemetry import record_counter, record_fault, record_sample
+from ..utils.telemetry import (
+    record_counter,
+    record_fault,
+    record_hist,
+    record_sample,
+)
 from . import coalescer
 from .config import SchedulerConfig
 from .queue import RequestQueue, Ticket
@@ -58,6 +63,26 @@ from .request import (
     ScoreFuture,
     ScoreRequest,
 )
+
+
+#: Streaming latency-anatomy histograms (telemetry.record_hist — exact
+#: counts, log-bucketed, NO tail truncation, unlike the serve_* sample
+#: rings): per-request end-to-end latency plus its DISJOINT phase
+#: decomposition, stamped at result fan-out.  The four phases sum to the
+#: e2e value: queue_wait (enqueue → the admission hold opened, i.e. time
+#: spent behind other traffic), coalesce (inside the max-wait hold
+#: window), serve_engine (micro-batch launch → engine return, shared by
+#: the group), respond (engine return → this request's future resolved).
+#: A request re-queued by an OOM split attributes everything before its
+#: FINAL launch to queue_wait/coalesce — the anatomy decomposes the
+#: launch that produced the result.  serve/load.py reads these.
+HIST_E2E = "serve_req_e2e_ms"
+HIST_PHASES = {
+    "queue_wait": "serve_req_queue_wait_ms",
+    "coalesce": "serve_req_coalesce_ms",
+    "serve_engine": "serve_req_engine_ms",
+    "respond": "serve_req_respond_ms",
+}
 
 
 class Scheduler:
@@ -184,17 +209,19 @@ class Scheduler:
             t_pop = time.monotonic()
             group, expired = self.queue.pop_group(
                 self._max_batch(), self.config.max_wait_s)
-            if group and obs.enabled():
+            hold_start = None
+            if group:
                 # the admission window: how long the loop held the head
                 # request open for co-batchable traffic (max-wait
                 # policy).  The hold starts when there was both a loop
                 # waiting AND a request to hold — max(pop start, first
                 # enqueue) — NOT at pop start, which on an idle server
                 # would misattribute the whole idle wait as coalescing
-                start = max(t_pop, min(t.enqueue_t for t in group))
-                obs.add_span("coalesce", start, time.monotonic(),
-                             phase="serve_coalesce", batch=len(group),
-                             trace_id=group[0].trace_id)
+                hold_start = max(t_pop, min(t.enqueue_t for t in group))
+                if obs.enabled():
+                    obs.add_span("coalesce", hold_start, time.monotonic(),
+                                 phase="serve_coalesce", batch=len(group),
+                                 trace_id=group[0].trace_id)
             for t in expired:
                 record_counter("serve_rejected_deadline")
                 self._reject(t, DeadlineExceeded(
@@ -203,7 +230,7 @@ class Scheduler:
             if group is None:
                 return          # closed and drained
             if group:
-                self._launch(group)
+                self._launch(group, hold_start)
 
     def _max_batch(self) -> int:
         if self.config.max_batch:
@@ -226,13 +253,24 @@ class Scheduler:
         ctx = getattr(self.engine, "config_overrides", None)
         return ctx(**ov) if ctx is not None else contextlib.nullcontext()
 
-    def _launch(self, group: List[Ticket]) -> None:
+    def _launch(self, group: List[Ticket],
+                hold_start: Optional[float] = None) -> None:
         now = time.monotonic()
         record_counter("serve_batches")
         record_counter("serve_batch_rows", len(group))
+        if hold_start is None:
+            hold_start = now
         for t in group:
             record_sample("serve_queue_wait_ms",
                           (now - t.enqueue_t) * 1000.0)
+            # latency-anatomy stamps (HIST_PHASES): the pre-launch wait
+            # splits into DISJOINT queue_wait (behind other traffic,
+            # before the admission hold opened) and coalesce (inside the
+            # hold window) — the head request is all coalesce, a
+            # late-arriving co-batched one all coalesce too, a request
+            # that sat behind an earlier launch mostly queue_wait
+            t.coalesce_s = max(0.0, now - max(hold_start, t.enqueue_t))
+            t.queue_wait_s = max(0.0, (now - t.enqueue_t) - t.coalesce_s)
             if t.trace_id is not None and obs.enabled():
                 # cross-thread span: enqueue happened on the submitting
                 # thread, the pop on this loop thread — manually timed
@@ -282,6 +320,7 @@ class Scheduler:
                 self._reject(t, err)
             return
         done = time.monotonic()
+        engine_s = done - now
         for t, row in zip(group, rows):
             record_sample("serve_latency_ms", (done - t.enqueue_t) * 1000.0)
             if t.trace_id is not None:
@@ -290,6 +329,25 @@ class Scheduler:
                 # parity ignores the key (serve/replay.rows_equal)
                 row = dict(row)
                 row["trace_id"] = t.trace_id
+            # per-request latency anatomy: four disjoint phases summing
+            # to e2e, streamed into the exact-count histograms and
+            # attached to the FUTURE (never the row — bit-parity)
+            t_set = time.monotonic()
+            respond_s = t_set - done
+            timing = {
+                "e2e_ms": (t_set - t.enqueue_t) * 1000.0,
+                "queue_wait_ms": (t.queue_wait_s or 0.0) * 1000.0,
+                "coalesce_ms": (t.coalesce_s or 0.0) * 1000.0,
+                "serve_engine_ms": engine_s * 1000.0,
+                "respond_ms": respond_s * 1000.0,
+            }
+            record_hist(HIST_E2E, timing["e2e_ms"])
+            record_hist(HIST_PHASES["queue_wait"], timing["queue_wait_ms"])
+            record_hist(HIST_PHASES["coalesce"], timing["coalesce_ms"])
+            record_hist(HIST_PHASES["serve_engine"],
+                        timing["serve_engine_ms"])
+            record_hist(HIST_PHASES["respond"], timing["respond_ms"])
+            t.future.timing = timing
             t.future._set_result(row)
         record_counter("serve_completed", len(group))
         if obs.enabled():

@@ -11,10 +11,11 @@ buffer donation in the jitted steps (handled in runtime/).
 from __future__ import annotations
 
 import gc
+import math
 import shutil
 import threading
 import time
-from typing import Dict, List, Optional
+from typing import Dict, List, Optional, Tuple
 
 # ---------------------------------------------------------------------------
 # Fault-event log (runtime/faults.py)
@@ -305,6 +306,188 @@ def clear_samples() -> None:
     with _COUNTERS_LOCK:
         _SAMPLES.clear()
         _SAMPLE_TOTALS.clear()
+
+
+# ---------------------------------------------------------------------------
+# Log-bucketed streaming histograms (serve/ load harness latency anatomy)
+#
+# The sample rings above keep the most recent ``cap`` VALUES, so over a
+# long window their percentiles are tail statistics — acceptable for a
+# dashboard, fatal for tail-latency measurement, where the one-in-a-
+# thousand slow request is exactly what the bounded ring is most likely
+# to have evicted.  A histogram inverts the trade: VALUES are quantized
+# onto geometric bucket boundaries (each bucket ``HIST_GROWTH``× the
+# previous, so any reported quantile overstates the true sample by at
+# most ~9%), but COUNTS are exact and nothing is ever evicted — a p99.9
+# over a million requests costs the same few hundred ints as over a
+# hundred.  This is the structure behind Prometheus ``histogram`` series
+# (obs/metrics.py exports these as ``_bucket``/``_sum``/``_count``).
+#
+# Scoping follows the counter discipline: histograms are process-global
+# monotones; callers measuring one phase take :func:`hist_snapshot`
+# before, run, and compute percentiles from :func:`hist_since`'s
+# bucket-count deltas — never ``clear_hists`` mid-run.
+# ---------------------------------------------------------------------------
+
+#: smallest distinguishable value; everything at or below lands in
+#: bucket 0 with upper bound HIST_MIN_VALUE (1 microsecond at ms scale).
+HIST_MIN_VALUE = 1e-3
+#: geometric bucket growth: 2**(1/8) ≈ 1.0905 — any quantile read from
+#: bucket upper bounds overstates the true sample value by < 9.05%.
+HIST_GROWTH = 2.0 ** 0.125
+_LOG_GROWTH = math.log(HIST_GROWTH)
+
+_HIST_COUNTS: Dict[str, Dict[int, int]] = {}
+_HIST_META: Dict[str, List[float]] = {}   # [count, sum, min, max]
+
+
+def hist_bucket_index(value: float) -> int:
+    """Bucket index for ``value``: 0 holds everything <= HIST_MIN_VALUE,
+    bucket i holds (le(i-1), le(i)] with le(i) = HIST_MIN_VALUE *
+    HIST_GROWTH**i."""
+    if value <= HIST_MIN_VALUE:
+        return 0
+    # epsilon guards the exact-boundary case against float log jitter
+    return max(0, int(math.ceil(
+        math.log(value / HIST_MIN_VALUE) / _LOG_GROWTH - 1e-9)))
+
+
+def hist_bucket_le(index: int) -> float:
+    """Upper (inclusive) bound of bucket ``index``."""
+    return HIST_MIN_VALUE * HIST_GROWTH ** index
+
+
+def record_hist(name: str, value: float) -> None:
+    """Add one observation to the named streaming histogram.  Exact
+    counts, no eviction — the no-truncation sibling of
+    :func:`record_sample`."""
+    value = float(value)
+    idx = hist_bucket_index(value)
+    with _COUNTERS_LOCK:
+        counts = _HIST_COUNTS.setdefault(name, {})
+        counts[idx] = counts.get(idx, 0) + 1
+        meta = _HIST_META.get(name)
+        if meta is None:
+            _HIST_META[name] = [1, value, value, value]
+        else:
+            meta[0] += 1
+            meta[1] += value
+            meta[2] = min(meta[2], value)
+            meta[3] = max(meta[3], value)
+
+
+def hist_count(name: str) -> int:
+    """Observations ever recorded to the named histogram (0 if none)."""
+    with _COUNTERS_LOCK:
+        meta = _HIST_META.get(name)
+        return int(meta[0]) if meta else 0
+
+
+def hist_counts(name: str) -> Dict[int, int]:
+    """Copy of the named histogram's {bucket index: exact count}."""
+    with _COUNTERS_LOCK:
+        return dict(_HIST_COUNTS.get(name, ()))
+
+
+def hist_snapshot(names: Optional[List[str]] = None) -> Dict[str, Dict]:
+    """Snapshot for phase scoping: ``{name: {"counts", "count", "sum"}}``.
+    Diff with :func:`hist_since` — the ``counters_since`` discipline."""
+    with _COUNTERS_LOCK:
+        keys = list(_HIST_COUNTS) if names is None else list(names)
+        return {
+            name: {
+                "counts": dict(_HIST_COUNTS.get(name, ())),
+                "count": int(_HIST_META[name][0]) if name in _HIST_META else 0,
+                "sum": float(_HIST_META[name][1]) if name in _HIST_META else 0.0,
+            }
+            for name in keys
+        }
+
+
+def hist_since(snapshot: Dict[str, Dict]) -> Dict[str, Dict]:
+    """Per-histogram bucket-count delta vs a :func:`hist_snapshot` —
+    ``{name: {"counts", "count", "sum"}}`` covering only observations
+    recorded after the snapshot.  Histograms absent from the snapshot
+    count from zero; a bucket whose count sits below its snapshot (a
+    mid-window :func:`clear_hists`) reports its current count, never a
+    negative."""
+    now = hist_snapshot()
+    out: Dict[str, Dict] = {}
+    for name, cur in now.items():
+        prev = snapshot.get(name, {"counts": {}, "count": 0, "sum": 0.0})
+        counts = {}
+        for idx, n in cur["counts"].items():
+            base = prev["counts"].get(idx, 0)
+            delta = n - base if n >= base else n
+            if delta:
+                counts[idx] = delta
+        count = (cur["count"] - prev["count"]
+                 if cur["count"] >= prev["count"] else cur["count"])
+        total = (cur["sum"] - prev["sum"]
+                 if cur["count"] >= prev["count"] else cur["sum"])
+        if count:
+            out[name] = {"counts": counts, "count": count, "sum": total}
+    return out
+
+
+def hist_percentiles_from(counts: Dict[int, int],
+                          pcts: Tuple = (50.0, 90.0, 99.0, 99.9)
+                          ) -> Dict[str, float]:
+    """Percentiles over a bucket-count dict (current state or a
+    :func:`hist_since` delta): nearest-rank over the exact counts, each
+    reported as its bucket's UPPER bound — so a reported quantile is
+    >= the true sample value and overstates it by < HIST_GROWTH.
+    ``{}`` when the counts are empty."""
+    total = sum(counts.values())
+    if not total:
+        return {}
+    ordered = sorted(counts.items())
+    out: Dict[str, float] = {}
+    for p in pcts:
+        rank = min(total, max(1, int(math.ceil(p / 100.0 * total))))
+        seen = 0
+        for idx, n in ordered:
+            seen += n
+            if seen >= rank:
+                out[f"p{p:g}"] = hist_bucket_le(idx)
+                break
+    return out
+
+
+def hist_percentiles(name: str,
+                     pcts: Tuple = (50.0, 90.0, 99.0, 99.9)
+                     ) -> Dict[str, float]:
+    """Percentiles over the named histogram's WHOLE (never-truncated)
+    history."""
+    return hist_percentiles_from(hist_counts(name), pcts)
+
+
+def hist_report(names: Optional[List[str]] = None) -> Dict[str, Dict]:
+    """Exposition-shaped report for every histogram with at least one
+    observation: ``{name: {count, sum, min, max, buckets: [(le, n)]}}``
+    with per-bucket (non-cumulative) exact counts sorted by bound."""
+    with _COUNTERS_LOCK:
+        keys = list(_HIST_COUNTS) if names is None else list(names)
+        out = {}
+        for name in keys:
+            meta = _HIST_META.get(name)
+            if not meta or not meta[0]:
+                continue
+            out[name] = {
+                "count": int(meta[0]),
+                "sum": float(meta[1]),
+                "min": float(meta[2]),
+                "max": float(meta[3]),
+                "buckets": [(hist_bucket_le(i), n) for i, n in
+                            sorted(_HIST_COUNTS.get(name, {}).items())],
+            }
+        return out
+
+
+def clear_hists() -> None:
+    with _COUNTERS_LOCK:
+        _HIST_COUNTS.clear()
+        _HIST_META.clear()
 
 
 def get_memory_usage() -> str:

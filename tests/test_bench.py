@@ -176,6 +176,30 @@ def test_sweep_full_oom_steps_batch_down_and_keeps_workbook(tmp_path,
     assert len(args.repeat_times) == 2
 
 
+def test_child_forwarding_pins_serve_load_flags():
+    """Satellite (ISSUE 11): the --serve-load* flags are pinned against
+    the sweep-full child's forwarding list (the PR-5/PR-6 discipline of
+    tests/test_obs.py::test_bench_forwards_trace_and_profile_to_the_child):
+    like --serve-replay before them, they ride the parent sweep mode's
+    offline rows and deliberately do NOT forward — the full-study child
+    measures the row contract, not the serving harness, and a child
+    serve_load block would shadow the parent's.  A future editor moving
+    them into the child cmd must consciously break this pin."""
+    bench_src = open(os.path.join(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))), "bench.py")).read()
+    # the flags exist on the parent argparse surface...
+    for flag in ("--serve-load", "--serve-load-rates",
+                 "--serve-load-duration", "--serve-load-seed"):
+        assert f'"{flag}"' in bench_src, flag
+    # ...and are absent from the child re-exec cmd, with the decision
+    # recorded next to the forwarding list
+    child = bench_src[bench_src.index('"--mode", "sweep-full"'):]
+    child = child[:child.index("subprocess.run")]
+    assert '"--serve-load"' not in child
+    assert '"--serve-replay"' not in child
+    assert "deliberately do NOT forward" in child
+
+
 def test_non_oom_errors_propagate(tmp_path, monkeypatch):
     cfg = DecoderConfig(**TINY)
     params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)

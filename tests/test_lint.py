@@ -371,6 +371,15 @@ class TestG05BroadExcept:
         findings = run("serve/scheduler.py", self.SWALLOW)
         assert rules_of(findings) == ["G05"]
 
+    def test_serve_load_in_g05_scope(self):
+        """Satellite (ISSUE 11): the load harness drives scheduler
+        launches and relays their failures, so a swallowed broad except
+        there would hide a device error inside the measurement — G05
+        applies to serve/load.py like the rest of serve/ (its deliberate
+        result-relay catches carry disable annotations)."""
+        findings = run("serve/load.py", self.SWALLOW)
+        assert rules_of(findings) == ["G05"]
+
     def test_out_of_scope_module_ok(self):
         assert run("viz/figures.py", self.SWALLOW) == []
 
@@ -568,6 +577,8 @@ class TestRepoGate:
         scanned = [f.replace(os.sep, "/") for f in iter_python_files([pkg])]
         assert any("/serve/scheduler.py" in f for f in scanned)
         assert any("/serve/queue.py" in f for f in scanned)
+        # ISSUE-11: the load harness joins the same gate
+        assert any("/serve/load.py" in f for f in scanned)
 
     def test_serve_package_lint_clean_without_baseline(self):
         """Satellite: serve/ ships lint-clean from day one — zero
@@ -579,6 +590,9 @@ class TestRepoGate:
 
         pkg = next(p for p in default_paths()
                    if p.endswith("llm_interpretation_replication_tpu"))
+        # the load harness (ISSUE 11) is part of the zero-baseline pin —
+        # assert it exists so this gate cannot green-light its removal
+        assert os.path.exists(os.path.join(pkg, "serve", "load.py"))
         assert lint_paths([os.path.join(pkg, "serve")]) == []
         entries = load_baseline(default_baseline_path())
         assert not [e for e in entries if e.get("path", "").startswith(

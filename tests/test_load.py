@@ -1,0 +1,824 @@
+"""Open-loop load harness + per-request latency anatomy (ISSUE 11):
+seeded Poisson schedule determinism, streaming-histogram exactness and
+percentile accuracy vs exact quantiles, scheduler latency stamps summing
+to e2e, replay parity under load (strict-mode clean), saturation
+shedding accounting, watchdog/flight-recorder non-interference, the
+/healthz oldest-queued-age degraded condition, bench --serve-load record
+structure, and obs bench-diff / obs report alignment of serve_load
+blocks."""
+
+import argparse
+import io
+import json
+import math
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from test_runtime import _tiny_engine
+from test_sweeps import FakeEngine
+
+from llm_interpretation_replication_tpu.obs import flight as obs_flight
+from llm_interpretation_replication_tpu.obs import metrics as obs_metrics
+from llm_interpretation_replication_tpu.obs.benchdiff import (
+    diff_records,
+    format_diff_table,
+)
+from llm_interpretation_replication_tpu.obs.report import (
+    format_serve_load_table,
+)
+from llm_interpretation_replication_tpu.obs.report import main as obs_main
+from llm_interpretation_replication_tpu.serve import (
+    Scheduler,
+    SchedulerConfig,
+    ScoreRequest,
+)
+from llm_interpretation_replication_tpu.serve import cli as serve_cli
+from llm_interpretation_replication_tpu.serve import load as load_mod
+from llm_interpretation_replication_tpu.serve.scheduler import (
+    HIST_E2E,
+    HIST_PHASES,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.serveload
+
+FAST = dict(max_wait_s=0.005)
+
+
+# ---------------------------------------------------------------------------
+# Seeded Poisson schedule
+# ---------------------------------------------------------------------------
+
+class TestPoissonSchedule:
+    def test_same_seed_same_arrival_times(self):
+        """Satellite: deterministic traffic — a latency comparison across
+        two builds replays bit-identical arrivals."""
+        a = load_mod.poisson_schedule(80.0, 2.0, seed=7)
+        b = load_mod.poisson_schedule(80.0, 2.0, seed=7)
+        assert a == b and len(a) > 50
+        assert load_mod.poisson_schedule(80.0, 2.0, seed=8) != a
+
+    def test_schedule_is_sorted_within_duration(self):
+        s = load_mod.poisson_schedule(50.0, 1.5, seed=0)
+        assert s == sorted(s)
+        assert all(0.0 < t < 1.5 for t in s)
+
+    def test_mean_interarrival_matches_rate(self):
+        s = load_mod.poisson_schedule(200.0, 30.0, seed=1)
+        # ~6000 arrivals: the mean inter-arrival converges on 1/rate
+        assert len(s) > 4000
+        gaps = np.diff([0.0] + s)
+        assert abs(float(np.mean(gaps)) - 1 / 200.0) < 0.1 / 200.0
+
+    def test_nonpositive_rate_rejected(self):
+        with pytest.raises(ValueError, match="rate"):
+            load_mod.poisson_schedule(0.0, 1.0)
+
+
+# ---------------------------------------------------------------------------
+# Streaming histograms (telemetry.record_hist)
+# ---------------------------------------------------------------------------
+
+class TestStreamingHistograms:
+    def test_bucket_bounds_contain_value(self):
+        for v in (0.0004, 0.0011, 0.5, 1.0, 7.3, 1234.5, 9e6):
+            idx = telemetry.hist_bucket_index(v)
+            assert telemetry.hist_bucket_le(idx) >= v * (1 - 1e-12)
+            if idx > 0:
+                assert telemetry.hist_bucket_le(idx - 1) < v
+
+    def test_exact_counts_no_tail_truncation(self):
+        """The point of the structure: a ring caps at 4096 retained
+        samples (the p99.9 history), a histogram never evicts."""
+        telemetry.clear_hists()
+        telemetry.clear_samples()
+        for i in range(10000):
+            telemetry.record_hist("load_test_hist", float(i + 1))
+            telemetry.record_sample("load_test_ring", float(i + 1))
+        assert telemetry.hist_count("load_test_hist") == 10000
+        assert telemetry.sample_count("load_test_ring") == 4096  # truncated
+        # the ring lost the slow head; the histogram still sees it
+        assert telemetry.hist_percentiles("load_test_hist")["p50"] < 6000
+        assert telemetry.sample_percentiles("load_test_ring")["p50"] > 6000
+
+    def test_percentiles_vs_exact_quantiles_small_samples(self):
+        """Satellite: any histogram quantile brackets the exact
+        nearest-rank quantile within one bucket (< HIST_GROWTH rel)."""
+        rng = np.random.default_rng(5)
+        values = np.exp(rng.normal(2.0, 1.5, size=137)) + 0.05
+        telemetry.clear_hists()
+        for v in values:
+            telemetry.record_hist("load_acc_hist", float(v))
+        got = telemetry.hist_percentiles("load_acc_hist",
+                                         (50.0, 90.0, 99.0, 99.9))
+        s = np.sort(values)
+        for p in (50.0, 90.0, 99.0, 99.9):
+            exact = float(s[max(0, math.ceil(p / 100.0 * len(s)) - 1)])
+            key = f"p{p:g}"
+            assert exact * (1 - 1e-9) <= got[key], (p, exact, got[key])
+            assert got[key] <= exact * telemetry.HIST_GROWTH * (1 + 1e-9), \
+                (p, exact, got[key])
+
+    def test_snapshot_since_scopes_a_phase(self):
+        telemetry.clear_hists()
+        for _ in range(10):
+            telemetry.record_hist("load_scope_hist", 1.0)
+        snap = telemetry.hist_snapshot(["load_scope_hist"])
+        for _ in range(5):
+            telemetry.record_hist("load_scope_hist", 1000.0)
+        delta = telemetry.hist_since(snap)["load_scope_hist"]
+        assert delta["count"] == 5
+        pct = telemetry.hist_percentiles_from(delta["counts"])
+        assert pct["p50"] >= 1000.0          # only the new phase
+        assert telemetry.hist_percentiles("load_scope_hist")["p50"] < 2.0
+
+    def test_since_never_negative_after_midwindow_clear(self):
+        telemetry.clear_hists()
+        for _ in range(20):
+            telemetry.record_hist("load_clear_hist", 3.0)
+        snap = telemetry.hist_snapshot(["load_clear_hist"])
+        telemetry.clear_hists()
+        for _ in range(4):
+            telemetry.record_hist("load_clear_hist", 3.0)
+        delta = telemetry.hist_since(snap).get("load_clear_hist")
+        assert delta is not None and delta["count"] == 4
+        assert all(n > 0 for n in delta["counts"].values())
+
+    def test_prometheus_histogram_exposition(self):
+        """Exported as a Prometheus ``histogram`` family: cumulative
+        _bucket series, +Inf == _count, _sum; an empty histogram emits
+        NO series (the empty-ring discipline)."""
+        telemetry.clear_hists()
+        for v in (1.0, 1.0, 10.0):
+            telemetry.record_hist("load_expo_ms", v)
+        text = obs_metrics.MetricsRegistry().prometheus_text()
+        assert "# TYPE llm_interp_load_expo_ms histogram" in text
+        lines = [l for l in text.splitlines()
+                 if l.startswith("llm_interp_load_expo_ms_bucket")]
+        assert lines[-1] == 'llm_interp_load_expo_ms_bucket{le="+Inf"} 3'
+        counts = [int(l.rsplit(" ", 1)[1]) for l in lines]
+        assert counts == sorted(counts)          # cumulative
+        assert "llm_interp_load_expo_ms_count 3" in text
+        assert "llm_interp_load_expo_ms_sum 12" in text
+        telemetry.clear_hists()
+        assert "load_expo_ms" not in obs_metrics.MetricsRegistry(
+            ).prometheus_text()
+
+    def test_registry_sample_carries_hists(self):
+        telemetry.clear_hists()
+        telemetry.record_hist("load_doc_ms", 2.5)
+        doc = obs_metrics.MetricsRegistry().sample()
+        assert doc["hists"]["load_doc_ms"]["count"] == 1
+        assert "p99.9" in doc["hists"]["load_doc_ms"]
+
+
+# ---------------------------------------------------------------------------
+# Scheduler latency stamps
+# ---------------------------------------------------------------------------
+
+class TestLatencyAnatomy:
+    def test_phases_are_disjoint_and_sum_to_e2e(self):
+        eng = FakeEngine("anatomy/model")
+        h0 = telemetry.hist_count(HIST_E2E)
+        with Scheduler(eng, SchedulerConfig(**FAST)) as sched:
+            futs = [sched.submit(ScoreRequest(prompt=f"q{i}"))
+                    for i in range(5)]
+            rows = [f.result(timeout=30) for f in futs]
+        for f in futs:
+            t = f.timing
+            assert t is not None
+            assert set(t) == {"e2e_ms", "queue_wait_ms", "coalesce_ms",
+                              "serve_engine_ms", "respond_ms"}
+            assert all(v >= 0.0 for v in t.values())
+            parts = (t["queue_wait_ms"] + t["coalesce_ms"]
+                     + t["serve_engine_ms"] + t["respond_ms"])
+            assert abs(parts - t["e2e_ms"]) < 1e-6, t
+        # the anatomy rides the FUTURE, never the result row (bit-parity)
+        assert all("e2e_ms" not in r and "timing" not in r for r in rows)
+        assert telemetry.hist_count(HIST_E2E) == h0 + 5
+        for name in HIST_PHASES.values():
+            assert telemetry.hist_count(name) >= 5
+
+
+# ---------------------------------------------------------------------------
+# run_load: parity under load, shedding, determinism, closed comparator
+# ---------------------------------------------------------------------------
+
+class TestRunLoad:
+    def test_replay_parity_under_load_tiny_engine(self):
+        """Acceptance: rows served under open-loop load are bit-identical
+        to the offline score_prompts path."""
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(6)]
+        report = load_mod.run_load(
+            eng, prompts, rate=20.0, duration_s=1.0, seed=3,
+            config=SchedulerConfig(**FAST))
+        assert report["requests"] > 5
+        assert report["completed"] == report["requests"]
+        assert report["errors"] == 0 and report["shed"] == 0
+        assert report["parity"]["mismatched_rows"] == 0
+        assert report["parity"]["checked_rows"] == report["completed"]
+        # every request of the run is in the histogram window
+        assert report["hist_requests"] == report["completed"]
+        assert report["drain_s"] >= 0.0
+        assert set(report["phases_ms"]) == {"queue_wait", "coalesce",
+                                            "serve_engine", "respond"}
+        for q in ("p50", "p90", "p99", "p99.9"):
+            assert q in report["latency_ms"]
+
+    def test_strict_mode_load_stays_clean(self):
+        """Acceptance: blocked_transfers == 0 for a load run under
+        strict mode."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is item {i} a thing?" for i in range(4)]
+        offline = eng.score_prompts(prompts)   # warm + parity reference
+        strict.activate(sentry=False)
+        try:
+            report = load_mod.run_load(
+                eng, prompts, rate=15.0, duration_s=0.8, seed=0,
+                config=SchedulerConfig(**FAST), offline_rows=offline)
+        finally:
+            strict.deactivate()
+        assert report["parity"]["mismatched_rows"] == 0
+        assert report["blocked_transfers"] == 0
+
+    def test_same_seed_same_traffic(self, tmp_path):
+        """Seed determinism end to end: schedule AND prompt picks."""
+        runs = []
+        for k in range(2):
+            path = tmp_path / f"load{k}.jsonl"
+            load_mod.run_load(FakeEngine("det/model"),
+                              [f"q{i}" for i in range(7)],
+                              rate=60.0, duration_s=0.5, seed=11,
+                              config=SchedulerConfig(**FAST),
+                              parity=False, jsonl=str(path))
+            lines = [json.loads(l) for l in
+                     path.read_text().splitlines()]
+            runs.append([(r["i"], r["scheduled_s"], r["prompt_idx"])
+                         for r in lines])
+        assert runs[0] == runs[1] and len(runs[0]) > 10
+
+    def test_open_loop_sheds_on_backpressure(self):
+        """At saturation the generator keeps its schedule and sheds into
+        the typed QueueFull path — it never silently turns closed-loop."""
+        gate = threading.Event()
+
+        class SlowEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                gate.wait(timeout=10)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        threading.Timer(0.6, gate.set).start()
+        report = load_mod.run_load(
+            SlowEngine("slow/model"), ["a", "b"], rate=100.0,
+            duration_s=0.5, seed=0, parity=False,
+            config=SchedulerConfig(queue_capacity=2, max_batch=1, **FAST))
+        assert report["shed"] > 0
+        assert report["completed"] + report["errors"] + report["shed"] \
+            == report["requests"]
+        assert report["queue_depth"]["max"] >= 1
+
+    def test_closed_loop_comparator(self):
+        report = load_mod.run_load(
+            FakeEngine("closed/model"), [f"q{i}" for i in range(5)],
+            mode="closed", concurrency=3, duration_s=0.4, seed=0,
+            parity=False, config=SchedulerConfig(**FAST))
+        assert report["mode"] == "closed"
+        assert report["offered_rate"] is None
+        assert report["concurrency"] == 3
+        assert report["completed"] > 0
+        assert report["achieved_rows_per_s"] > 0
+
+    def test_ring_truncation_visibility_rides_the_report(self):
+        """Satellite: per-ring caps + truncation visibility — a ring
+        capped below the run's volume reports total > retained in the
+        load report while the histogram keeps every request."""
+        telemetry.clear_samples()
+        telemetry.set_sample_cap(8, "serve_latency_ms")   # per-ring cap
+        try:
+            report = load_mod.run_load(
+                FakeEngine("trunc/model"), [f"q{i}" for i in range(4)],
+                rate=80.0, duration_s=0.6, seed=2, parity=False,
+                config=SchedulerConfig(**FAST))
+            ring = report["samples"]["serve_latency_ms"]
+            assert ring["cap"] == 8
+            assert ring["retained"] <= 8 < ring["total"]
+            assert report["rings_truncated"] is True
+            assert report["hist_requests"] == report["completed"] > 8
+        finally:
+            telemetry.set_sample_cap(telemetry._SAMPLES_CAP_DEFAULT,
+                                     "serve_latency_ms")
+
+
+# ---------------------------------------------------------------------------
+# rate_sweep: the knee finder / serve_load block
+# ---------------------------------------------------------------------------
+
+class TestRateSweep:
+    def test_block_structure_and_parity(self):
+        eng, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(5)]
+        block = load_mod.rate_sweep(
+            eng, prompts, rates=(8.0, 16.0, 32.0), duration_s=0.6,
+            seed=1, config=SchedulerConfig(**FAST),
+            closed_comparator=True)
+        assert len(block["rates"]) >= 3
+        offered = [p["offered_rate"] for p in block["rates"]]
+        assert offered == sorted(offered)
+        for p in block["rates"]:
+            assert {"p50", "p90", "p99", "p99.9"} <= set(p["latency_ms"])
+            assert set(p["phases_ms"]) == {"queue_wait", "coalesce",
+                                           "serve_engine", "respond"}
+            assert p["parity"]["mismatched_rows"] == 0
+        assert block["parity_ok"] is True
+        assert block["saturation_rows_per_s"] > 0
+        assert "knee_offered_rate" in block and "knee_beyond_sweep" in block
+        assert block["closed_loop"]["mode"] == "closed"
+        # renderers accept the block
+        assert "saturation" in load_mod.format_rate_table(block)
+        assert "queue_wait" in format_serve_load_table(block)
+
+    def test_fewer_than_three_rates_rejected(self):
+        with pytest.raises(ValueError, match=">= 3"):
+            load_mod.rate_sweep(FakeEngine("x/y"), ["a"], rates=(1.0, 2.0))
+
+    def test_knee_detects_saturation_by_drain_not_makespan_ratio(self):
+        """Review regression: the knee criterion must survive per-request
+        latency that is non-trivial vs the arrival window.  A fixed-delay
+        engine at ~50 req/s capacity keeps up at 10 and 20 offered (drain
+        ~ one service latency) and saturates at 200 (drain grows with the
+        backlog) — an achieved/makespan ratio would have misclassified
+        the sub-saturation points."""
+
+        class DelayEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                time.sleep(0.02)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        block = load_mod.rate_sweep(
+            DelayEngine("knee/model"), [f"q{i}" for i in range(4)],
+            rates=(10.0, 20.0, 200.0), duration_s=0.4, seed=0,
+            parity=False,
+            config=SchedulerConfig(max_batch=1, max_wait_s=0.001))
+        drains = [p["drain_s"] for p in block["rates"]]
+        assert drains[2] > drains[0] + 0.5          # backlog at 200/s
+        assert block["knee_offered_rate"] == 20.0
+        assert block["knee_beyond_sweep"] is False
+        assert block["knee_floor_saturated"] is False
+
+    def test_all_saturated_sweep_reports_unknown_knee(self):
+        """Review regression: the drain floor is relative, so a sweep
+        where EVERY rate is above saturation must report the knee as
+        unknown (None + knee_floor_saturated) — never confidently name
+        the least-saturated point as 'keeping up'."""
+
+        class DelayEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                time.sleep(0.02)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        block = load_mod.rate_sweep(
+            DelayEngine("sat/model"), [f"q{i}" for i in range(4)],
+            rates=(150.0, 200.0, 250.0), duration_s=0.2, seed=0,
+            parity=False,
+            config=SchedulerConfig(max_batch=1, max_wait_s=0.001))
+        assert block["knee_floor_saturated"] is True
+        assert block["knee_offered_rate"] is None
+        assert block["knee_beyond_sweep"] is False
+        assert "unknown" in load_mod.format_rate_table(block)
+
+    def test_wedged_scheduler_costs_one_timeout_not_n(self):
+        """Review regression: a wedged engine must cost ONE
+        result_timeout_s for the whole collection phase, never one per
+        outstanding future."""
+        block_forever = threading.Event()   # never set
+
+        class WedgedEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                block_forever.wait(timeout=30)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        t0 = time.monotonic()
+        report = load_mod.run_load(
+            WedgedEngine("wedge/model"), ["a", "b"], rate=40.0,
+            duration_s=0.3, seed=0, parity=False,
+            config=SchedulerConfig(max_batch=1, drain_timeout_s=0.2,
+                                   **FAST),
+            result_timeout_s=1.0)
+        elapsed = time.monotonic() - t0
+        assert report["requests"] >= 5
+        assert report["errors"] + report["shed"] == report["requests"]
+        assert elapsed < 6.0, elapsed   # one budget, not N x 1s
+        block_forever.set()             # release the stuck thread
+
+
+# ---------------------------------------------------------------------------
+# Watchdog / flight-recorder non-interference at saturation (satellite)
+# ---------------------------------------------------------------------------
+
+class TestObsNonInterference:
+    def test_saturated_load_trips_neither_watchdog_nor_flight(self, tmp_path):
+        """A saturated load run under an armed flight recorder and a
+        healthy sweep's watchdog must neither dump a flight record nor
+        trip the watchdog — the harness is measurement, not a fault."""
+        telemetry.clear_fault_events()
+        obs_flight.enable(str(tmp_path))
+        wd = obs_flight.StallWatchdog(label="load-test", floor_s=5.0,
+                                      poll_s=0.05).start()
+        stop = threading.Event()
+
+        def beats():   # a healthy co-resident sweep keeps beating
+            while not stop.wait(0.05):
+                wd.beat()
+
+        beater = threading.Thread(target=beats, daemon=True)
+        beater.start()
+        try:
+            report = load_mod.run_load(
+                FakeEngine("sat/model"), [f"q{i}" for i in range(6)],
+                rate=300.0, duration_s=0.6, seed=4, parity=False,
+                config=SchedulerConfig(queue_capacity=16, **FAST))
+        finally:
+            stop.set()
+            beater.join(timeout=2)
+            wd.stop()
+            obs_flight.disable()
+        assert report["requests"] > 50
+        assert wd.trips == 0
+        assert telemetry.fault_events("watchdog_stall") == []
+        assert not list(tmp_path.glob("flightrec-*.json"))
+
+
+# ---------------------------------------------------------------------------
+# /healthz degraded condition (satellite)
+# ---------------------------------------------------------------------------
+
+class TestHealthzQueueAge:
+    def test_wedged_short_queue_reads_degraded(self):
+        """A never-started scheduler with ONE queued request (short
+        queue!) degrades once the head request's age crosses the
+        threshold — depth alone would have read healthy."""
+        sched = Scheduler(FakeEngine("h/m"), SchedulerConfig(
+            health_max_queue_age_s=0.05, **FAST))
+        doc = serve_cli.scheduler_health(sched)
+        assert "status" not in doc and doc["queue_depth"] == 0
+        sched.submit(ScoreRequest(prompt="stuck"))
+        time.sleep(0.12)
+        doc = serve_cli.scheduler_health(sched)
+        assert doc["queue_depth"] == 1
+        assert doc["status"] == "degraded"
+        assert doc["oldest_queued_age_s"] >= 0.05
+        assert "waited" in doc["degraded_reason"]
+        sched.close(drain=False)
+
+    def test_threshold_zero_disables_and_fresh_queue_healthy(self):
+        sched = Scheduler(FakeEngine("h/m"), SchedulerConfig(
+            health_max_queue_age_s=0.0, **FAST))
+        sched.submit(ScoreRequest(prompt="young"))
+        time.sleep(0.02)
+        doc = serve_cli.scheduler_health(sched)
+        assert "status" not in doc
+        assert doc["oldest_queued_age_s"] >= 0.0
+        sched.close(drain=False)
+
+    def test_degraded_age_served_through_endpoint(self):
+        sched = Scheduler(FakeEngine("h/m"), SchedulerConfig(
+            health_max_queue_age_s=0.05, **FAST))
+        sched.submit(ScoreRequest(prompt="stuck"))
+        time.sleep(0.12)
+        import urllib.request
+
+        server = obs_metrics.MetricsServer(
+            obs_metrics.MetricsRegistry(), 0,
+            healthz_fn=lambda: serve_cli.scheduler_health(sched)).start()
+        try:
+            with urllib.request.urlopen(
+                    f"http://127.0.0.1:{server.port}/healthz") as resp:
+                doc = json.loads(resp.read())
+        finally:
+            server.close()
+            sched.close(drain=False)
+        assert doc["status"] == "degraded"
+        assert doc["oldest_queued_age_s"] >= 0.05
+
+
+# ---------------------------------------------------------------------------
+# serve CLI load mode + corpus workload
+# ---------------------------------------------------------------------------
+
+class TestServeCliLoadMode:
+    def _corpus(self, tmp_path):
+        scenarios = [
+            {"original_main": f"Is thing {s} a stuff?",
+             "response_format": "Answer only 'Yes' or 'No'.",
+             "target_tokens": ["Yes", "No"] if s == 0 else ["No", "Yes"],
+             "rephrasings": [f"Is thing {s} variant {i} a stuff?"
+                             for i in range(3)]}
+            for s in range(2)
+        ]
+        path = tmp_path / "perturbations.json"
+        path.write_text(json.dumps(scenarios))
+        return str(path)
+
+    def test_corpus_workload_matches_sweep_spelling(self, tmp_path):
+        prompts, targets = load_mod.corpus_workload(self._corpus(tmp_path),
+                                                    max_rephrasings=2)
+        assert len(prompts) == 4
+        assert prompts[0] == ("Is thing 0 variant 0 a stuff? "
+                              "Answer only 'Yes' or 'No'.")
+        assert targets[2] == ("No", "Yes")
+
+    def test_load_cli_single_rate_over_corpus(self, tmp_path, capsys):
+        args = argparse.Namespace(
+            load_rate="40", load_duration=0.5, load_seed=0,
+            load_jsonl=None, replay=self._corpus(tmp_path),
+            max_rephrasings=None, input="-")
+        rc = serve_cli.run_load_cli(FakeEngine("cli/model"), args,
+                                    SchedulerConfig(**FAST))
+        assert rc == 0
+        report = json.loads(capsys.readouterr().out)
+        assert report["mode"] == "open"
+        assert report["parity"]["mismatched_rows"] == 0
+
+    def test_load_cli_rate_list_runs_sweep(self, tmp_path, capsys):
+        args = argparse.Namespace(
+            load_rate="20,40,80", load_duration=0.3, load_seed=0,
+            load_jsonl=None, replay=self._corpus(tmp_path),
+            max_rephrasings=None, input="-")
+        rc = serve_cli.run_load_cli(FakeEngine("cli/model"), args,
+                                    SchedulerConfig(**FAST))
+        assert rc == 0
+        block = json.loads(capsys.readouterr().out)
+        assert len(block["rates"]) == 3
+        assert block["parity_ok"] is True
+        assert "closed_loop" in block
+
+    def test_load_cli_two_rates_rejected_not_dropped(self, tmp_path,
+                                                     capsys):
+        """Review regression: two comma-separated rates must be rejected
+        loudly — silently running only the first would report a
+        single-point curve as if it covered the request."""
+        args = argparse.Namespace(
+            load_rate="20,40", load_duration=0.2, load_seed=0,
+            load_jsonl=None, replay=self._corpus(tmp_path),
+            max_rephrasings=None, input="-")
+        rc = serve_cli.run_load_cli(FakeEngine("cli/model"), args,
+                                    SchedulerConfig(**FAST))
+        assert rc == 2
+        assert "needs >= 3" in capsys.readouterr().err
+
+    def test_load_cli_empty_rate_list_is_a_clean_error(self, tmp_path,
+                                                       capsys):
+        """Review regression: '--load-rate ,' must exit 2 with the
+        '# serve load:' diagnostic, not IndexError."""
+        args = argparse.Namespace(
+            load_rate=",", load_duration=0.2, load_seed=0,
+            load_jsonl=None, replay=self._corpus(tmp_path),
+            max_rephrasings=None, input="-")
+        rc = serve_cli.run_load_cli(FakeEngine("cli/model"), args,
+                                    SchedulerConfig(**FAST))
+        assert rc == 2
+        assert "no rates" in capsys.readouterr().err
+
+    def test_jsonl_lines_name_their_rate_point(self, tmp_path):
+        """Review regression: a sweep streams every point (and the
+        closed comparator) into ONE jsonl — each line must name its
+        mode + offered rate or the anatomy is unattributable."""
+        path = tmp_path / "anatomy.jsonl"
+        load_mod.rate_sweep(
+            FakeEngine("jl/model"), [f"q{i}" for i in range(4)],
+            rates=(20.0, 40.0, 80.0), duration_s=0.3, seed=0,
+            parity=False, closed_comparator=True,
+            config=SchedulerConfig(**FAST), jsonl=str(path))
+        lines = [json.loads(l) for l in path.read_text().splitlines()]
+        rates_seen = {(l["mode"], l["offered_rate"]) for l in lines}
+        assert ("open", 20.0) in rates_seen
+        assert ("open", 40.0) in rates_seen
+        assert ("open", 80.0) in rates_seen
+        assert ("closed", None) in rates_seen
+
+    def test_load_cli_pools_input_lines(self, tmp_path, capsys):
+        path = tmp_path / "reqs.jsonl"
+        path.write_text("".join(json.dumps({"prompt": f"q{i}"}) + "\n"
+                                for i in range(4)))
+        args = argparse.Namespace(
+            load_rate="30", load_duration=0.4, load_seed=1,
+            load_jsonl=str(tmp_path / "anatomy.jsonl"), replay=None,
+            max_rephrasings=None, input=str(path))
+        rc = serve_cli.run_load_cli(FakeEngine("cli/model"), args,
+                                    SchedulerConfig(**FAST))
+        assert rc == 0
+        lines = (tmp_path / "anatomy.jsonl").read_text().splitlines()
+        report = json.loads(capsys.readouterr().out)
+        assert len(lines) == report["requests"]
+        ok = [json.loads(l) for l in lines if json.loads(l).get("ok")]
+        assert ok and all("serve_engine_ms" in r for r in ok)
+
+    def test_load_cli_hosts_metrics_port_during_run(self, tmp_path,
+                                                    capsys, monkeypatch):
+        """Review regression: --metrics-port must not be silently
+        ignored in load mode — the histogram families exist exactly for
+        a scraper watching a load run.  The server wiring is asserted
+        with a recording fake (the real endpoint's behavior is covered
+        by the healthz/endpoint tests above and test_obs_metrics.py);
+        the start must precede the load run and the close must follow
+        it."""
+        events = []
+
+        class RecordingServer:
+            def __init__(self, registry, port, host="127.0.0.1",
+                         healthz_fn=None):
+                self.port = port
+
+            def start(self):
+                events.append(("start", self.port))
+                return self
+
+            def close(self):
+                events.append(("close", self.port))
+
+        monkeypatch.setattr(obs_metrics, "MetricsServer", RecordingServer)
+        args = argparse.Namespace(
+            load_rate="40", load_duration=0.3, load_seed=0,
+            load_jsonl=None, replay=self._corpus(tmp_path),
+            max_rephrasings=None, input="-", metrics_port=9617)
+        rc = serve_cli.run_load_cli(FakeEngine("cli/model"), args,
+                                    SchedulerConfig(**FAST))
+        assert rc == 0
+        assert events == [("start", 9617), ("close", 9617)]
+        err = capsys.readouterr().err
+        assert ":9617/metrics" in err           # operator told where
+
+    def test_main_cli_registers_load_flags(self):
+        import os
+
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))),
+            "llm_interpretation_replication_tpu", "__main__.py")).read()
+        for flag in ("--load-rate", "--load-duration", "--load-seed",
+                     "--load-jsonl"):
+            assert flag in src, flag
+
+
+# ---------------------------------------------------------------------------
+# bench --serve-load (acceptance) + bench-diff / obs report alignment
+# ---------------------------------------------------------------------------
+
+def _serve_load_block(p99s=(6.0, 8.0, 40.0), achieved=(10.0, 20.0, 24.0),
+                      offered=(10.0, 20.0, 30.0)):
+    rates = []
+    for o, a, p in zip(offered, achieved, p99s):
+        rates.append({
+            "mode": "open", "offered_rate": o, "achieved_rows_per_s": a,
+            "requests": 10, "completed": 10, "errors": 0, "shed": 0,
+            "duration_s": 1.0, "makespan_s": 1.0, "drain_s": 0.05,
+            "hist_requests": 10,
+            "latency_ms": {"p50": p / 2, "p90": p * 0.8, "p99": p,
+                           "p99.9": p * 1.2},
+            "phases_ms": {k: {"p50": 1.0, "p90": 2.0, "p99": 3.0,
+                              "p99.9": 4.0, "mean": 1.5}
+                          for k in ("queue_wait", "coalesce",
+                                    "serve_engine", "respond")},
+            "queue_depth": {"max": 3, "mean": 1.0, "trajectory": []},
+            "parity": {"checked_rows": 10, "mismatched_rows": 0,
+                       "mismatched_indices": []},
+        })
+    return {"mode": "open-loop poisson", "seed": 0, "duration_s": 1.0,
+            "rates": rates, "saturation_rows_per_s": max(achieved),
+            "knee_offered_rate": 20.0, "knee_beyond_sweep": False,
+            "parity_ok": True}
+
+
+class TestBenchServeLoad:
+    def test_sweep_mode_emits_serve_load_block(self, tmp_path):
+        """Acceptance: bench --serve-load attaches a serve_load block
+        with >= 3 offered-rate points, p50/p90/p99/p99.9 + per-phase
+        decomposition from histograms, a saturation estimate, and the
+        row-parity assertion vs the offline rows."""
+        import os
+        import sys as _sys
+
+        _sys.path.insert(0, os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))))
+        import bench
+        import jax
+        import jax.numpy as jnp
+        from test_bench import TINY, _args
+        from llm_interpretation_replication_tpu.models.decoder import (
+            DecoderConfig,
+        )
+
+        cfg = DecoderConfig(**TINY)
+        params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+        args = _args(tmp_path, batch=8)
+        args.serve_load = True
+        args.serve_load_rates = "auto"
+        args.serve_load_duration = 0.5
+        args.serve_load_seed = 0
+        pps, rate, out = bench.run_sweep_mode(args, cfg, params)
+        block = args.serve_load_report
+        assert len(block["rates"]) >= 3
+        for point in block["rates"]:
+            assert {"p50", "p90", "p99", "p99.9"} <= set(point["latency_ms"])
+            assert set(point["phases_ms"]) == {
+                "queue_wait", "coalesce", "serve_engine", "respond"}
+            assert point["parity"]["mismatched_rows"] == 0
+            assert "trajectory" in point["queue_depth"]
+        assert block["saturation_rows_per_s"] > 0
+        assert block["parity_ok"] is True
+        assert block["closed_loop"]["completed"] > 0
+
+    def test_bench_registers_and_gates_serve_load_flags(self):
+        import os
+
+        src = open(os.path.join(os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__))), "bench.py")).read()
+        for flag in ("--serve-load", "--serve-load-rates",
+                     "--serve-load-duration", "--serve-load-seed"):
+            assert f'"{flag}"' in src, flag
+        assert "--serve-load rides the sweep mode" in src
+
+
+class TestBenchDiffServeLoad:
+    def test_aligns_blocks_and_flags_latency_regression(self):
+        """Acceptance: bench-diff aligns serve_load blocks across two
+        records — per-point achieved (higher-better) and p99 latency
+        (LOWER-better) — and a p99 that grew is the regression.  Points
+        align by SWEEP POSITION: the records deliberately carry
+        DIFFERENT offered rates (the default 'auto' derives them from
+        each record's own measured ceiling, so the floats never repeat
+        across rounds — review regression)."""
+        old = {"metric": "prompts/sec/chip (END-TO-END ...)", "value": 100.0,
+               "unit": "prompts/sec", "label": "r06",
+               "serve_load": _serve_load_block(
+                   p99s=(6.0, 8.0, 40.0), offered=(10.0, 20.0, 30.0))}
+        new = {"metric": "prompts/sec/chip (END-TO-END ...)", "value": 101.0,
+               "unit": "prompts/sec", "label": "r07",
+               "serve_load": _serve_load_block(
+                   p99s=(6.0, 30.0, 40.0), offered=(10.4, 20.8, 31.2))}
+        diff = diff_records([old, new], threshold_pct=5.0)
+        rows = {r["key"]: r for r in diff["metrics"]}
+        assert rows["serve-load[1] p99 [ms]"]["verdict"] == "REGRESSION"
+        assert rows["serve-load[0] p99 [ms]"]["verdict"] == "ok"
+        assert rows["serve-load[0] achieved [rows/sec]"]["verdict"] == "ok"
+        assert rows["serve-load saturation [rows/sec]"]["verdict"] == "ok"
+        # the bracket's offered rate rides along informationally — its
+        # drift must not read as a verdict
+        assert rows["serve-load[1] offered"]["values"] == [20.0, 20.8]
+        assert rows["serve-load[1] offered"]["verdict"] == "ok"
+        assert any(r["key"] == "serve-load[1] p99 [ms]"
+                   for r in diff["regressions"])
+        assert "serve-load[1] p99 [ms]" in format_diff_table(diff)
+
+    def test_latency_drop_is_improvement_and_throughput_drop_regresses(self):
+        old = {"metric": "m", "value": 1.0, "unit": "prompts/sec",
+               "label": "a", "serve_load": _serve_load_block(
+                   p99s=(40.0, 40.0, 40.0), achieved=(10.0, 20.0, 24.0))}
+        new = {"metric": "m", "value": 1.0, "unit": "prompts/sec",
+               "label": "b", "serve_load": _serve_load_block(
+                   p99s=(6.0, 6.0, 6.0), achieved=(10.0, 20.0, 12.0))}
+        diff = diff_records([old, new], threshold_pct=5.0)
+        rows = {r["key"]: r for r in diff["metrics"]}
+        assert rows["serve-load[0] p99 [ms]"]["verdict"] == "improved"
+        assert rows["serve-load[2] achieved [rows/sec]"]["verdict"] \
+            == "REGRESSION"
+        assert rows["serve-load saturation [rows/sec]"]["verdict"] \
+            == "REGRESSION"
+
+    def test_obs_report_renders_serve_load_table(self, tmp_path, capsys):
+        rec = {"metric": "m", "value": 1.0, "unit": "prompts/sec",
+               "serve_load": _serve_load_block()}
+        path = tmp_path / "BENCH_r99.json"
+        path.write_text(json.dumps(rec))
+        rc = obs_main(["report", "--serve-load", str(path)])
+        out = capsys.readouterr().out
+        assert rc == 0
+        assert "serve-load latency anatomy" in out
+        for phase in ("queue_wait", "coalesce", "serve_engine", "respond"):
+            assert phase in out
+        assert "saturation" in out
+
+    def test_obs_report_without_block_is_a_clean_error(self, tmp_path,
+                                                       capsys):
+        path = tmp_path / "BENCH_r98.json"
+        path.write_text(json.dumps({"metric": "m", "value": 1.0,
+                                    "unit": "prompts/sec"}))
+        assert obs_main(["report", "--serve-load", str(path)]) == 2
+        assert "no serve_load block" in capsys.readouterr().err
