@@ -769,7 +769,10 @@ def cmd_serve(args):
     stdlib JSONL driver reads requests from --input (file or stdin) and
     answers every line in input order; --replay routes the perturbation
     sweep workload through the scheduler and asserts row-level parity
-    with the offline score_prompts path."""
+    with the offline score_prompts path; --pool-replicas N serves
+    through an EnginePool of N shared-snapshot replicas (serve/pool.py:
+    per-replica /healthz + labeled serve_* metrics, hot load/unload
+    over the engine's verified teardown)."""
     from .serve.cli import main as serve_main
 
     rc = _run_config(args)
@@ -1394,9 +1397,11 @@ def main(argv=None):
 
     p = sub.add_parser(
         "serve",
-        help="continuous-batching scoring service over one resident "
-             "model (serve/): JSONL stdin/file driver, or --replay for "
-             "offline-parity verification")
+        help="continuous-batching scoring service (serve/): JSONL "
+             "stdin/file driver over one resident model — or an "
+             "EnginePool replica fleet with --pool-replicas — plus "
+             "--replay for offline-parity verification and --load-rate "
+             "for the open-loop load harness")
     _add_run_config_args(p)
     p.add_argument("--model", required=True,
                    help="model snapshot name under --checkpoint-dir")
@@ -1453,6 +1458,16 @@ def main(argv=None):
                    help="load mode: stream one per-request anatomy "
                         "record (scheduled time, generator lag, e2e + "
                         "per-phase ms) per line to PATH")
+    p.add_argument("--pool-replicas", type=int, default=0, metavar="N",
+                   help="serve through an EnginePool (serve/pool.py) of "
+                        "N local replicas of the loaded snapshot — "
+                        "siblings share the param tree (same device "
+                        "buffers), each behind its own scheduler with "
+                        "{replica, model} labeled serve_* metrics; "
+                        "/healthz gains the per-replica health document "
+                        "and --load-rate drives the pool through the "
+                        "same open-loop harness (0/1 = single-engine "
+                        "scheduler, today's path)")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("lint",

@@ -76,6 +76,25 @@ def sanitize_metric_name(name: str) -> str:
     return name
 
 
+def split_labeled_name(name: str):
+    """``(base, labels_or_None)`` for the labeled-telemetry convention
+    ``name|k=v,k2=v2`` (serve/scheduler.labeled_metric): the telemetry
+    layer keys plain strings, so per-replica series ride the name — the
+    exporter splits them back into ONE Prometheus family with label
+    sets, which is how the EnginePool's ``serve_*`` counters and
+    latency histograms read as ``{replica="r0",model="..."}`` series
+    instead of N separate families."""
+    if "|" not in name:
+        return name, None
+    base, _, rest = name.partition("|")
+    labels = {}
+    for part in rest.split(","):
+        k, _, v = part.partition("=")
+        if k:
+            labels[k] = v
+    return base, (labels or None)
+
+
 def escape_label_value(value: str) -> str:
     """Label-value escaping per the exposition format: backslash, double
     quote, and newline."""
@@ -236,45 +255,69 @@ class MetricsRegistry:
         """The current state (fresh counter/ring snapshots + gauges) in
         Prometheus text exposition format 0.0.4."""
         lines: List[str] = []
+        typed: set = set()
+
+        def family(name: str, kind: str):
+            """(metric, labels) with the TYPE line emitted once per base
+            family — labeled series (the ``name|k=v`` convention) group
+            under their base name instead of minting a family each."""
+            base, labels = split_labeled_name(name)
+            metric = METRIC_PREFIX + sanitize_metric_name(base)
+            if metric not in typed:
+                lines.append(f"# TYPE {metric} {kind}")
+                typed.add(metric)
+            return metric, labels
+
         for name, value in sorted(telemetry.counters().items()):
-            metric = METRIC_PREFIX + sanitize_metric_name(name)
-            lines.append(f"# TYPE {metric} counter")
-            lines.append(f"{metric} {_format_value(value)}")
+            metric, labels = family(name, "counter")
+            lines.append(
+                f"{metric}{_format_labels(labels)} {_format_value(value)}")
         # rings as summaries; sample_ring_report only lists rings with at
         # least one recorded sample, so an empty ring emits NO series
         for name, meta in sorted(telemetry.sample_ring_report().items()):
             pct = telemetry.sample_percentiles(name, _RING_PCTS)
             if not pct:
                 continue
-            metric = METRIC_PREFIX + sanitize_metric_name(name)
-            lines.append(f"# TYPE {metric} summary")
+            metric, labels = family(name, "summary")
             for p in _RING_PCTS:
                 key = f"p{p:g}"
                 if key in pct:
-                    lines.append(
-                        f'{metric}{{quantile="{p / 100.0:g}"}} '
-                        f"{_format_value(pct[key])}")
-            lines.append(f"{metric}_count {int(meta['total'])}")
-            lines.append(f"{metric}_retained {int(meta['retained'])}")
+                    qlabels = {**(labels or {}), "quantile": f"{p / 100.0:g}"}
+                    lines.append(f"{metric}{_format_labels(qlabels)} "
+                                 f"{_format_value(pct[key])}")
+            lines.append(f"{metric}_count{_format_labels(labels)} "
+                         f"{int(meta['total'])}")
+            lines.append(f"{metric}_retained{_format_labels(labels)} "
+                         f"{int(meta['retained'])}")
         # streaming histograms (telemetry.record_hist) as Prometheus
         # ``histogram`` families: cumulative ``_bucket{le=...}`` over the
         # exact log-bucket counts plus ``_sum``/``_count``.  hist_report
         # only lists histograms with >= 1 observation, so an empty one
         # emits NO series (the empty-ring discipline above)
         for name, h in sorted(telemetry.hist_report().items()):
-            metric = METRIC_PREFIX + sanitize_metric_name(name)
-            lines.append(f"# TYPE {metric} histogram")
+            metric, labels = family(name, "histogram")
             cum = 0
             for le, n in h["buckets"]:
                 cum += n
-                lines.append(f'{metric}_bucket{{le="{le:g}"}} {cum}')
-            lines.append(f'{metric}_bucket{{le="+Inf"}} {int(h["count"])}')
-            lines.append(f"{metric}_sum {_format_value(h['sum'])}")
-            lines.append(f"{metric}_count {int(h['count'])}")
+                blabels = {**(labels or {}), "le": f"{le:g}"}
+                lines.append(f"{metric}_bucket{_format_labels(blabels)} "
+                             f"{cum}")
+            inf_labels = {**(labels or {}), "le": "+Inf"}
+            lines.append(f"{metric}_bucket{_format_labels(inf_labels)} "
+                         f"{int(h['count'])}")
+            lines.append(f"{metric}_sum{_format_labels(labels)} "
+                         f"{_format_value(h['sum'])}")
+            lines.append(f"{metric}_count{_format_labels(labels)} "
+                         f"{int(h['count'])}")
         with self._lock:
+            # sort on (name, canonical label tuple) — two gauges sharing a
+            # name but differing in labels must never compare their label
+            # DICTS (TypeError), which is exactly the heartbeat shape: one
+            # gauge name, one series per sweep label
             gauges = sorted(
-                (name, labels, value)
-                for (name, _), (value, labels) in self._gauges.items())
+                ((name, labels, value)
+                 for (name, _), (value, labels) in self._gauges.items()),
+                key=lambda g: (g[0], tuple(sorted(g[1].items()))))
         seen_type = set()
         for name, labels, value in gauges:
             metric = METRIC_PREFIX + sanitize_metric_name(name)

@@ -33,6 +33,26 @@ from . import batching, faults, strict
 from . import plan as plan_mod
 
 
+class EngineClosed(RuntimeError):
+    """Scoring was attempted on a closed :class:`ScoringEngine`.
+
+    The typed-lifecycle convention of serve/ (``SchedulerClosed``)
+    extended to the engine itself: after :meth:`ScoringEngine.close`
+    every scoring entry point raises this instead of dereferencing
+    deleted device buffers — the caller is always told WHY, never handed
+    an XLA use-after-free."""
+
+
+def live_buffer_count() -> int:
+    """Device-buffer census: live (not-yet-deleted) jax arrays in the
+    process.  The teardown contract's yardstick — after
+    :meth:`ScoringEngine.close` the count returns to its
+    pre-construction baseline (tests/test_pool.py pins it), which is
+    what makes unload-then-load-a-different-model possible in one
+    process instead of the bench's old subprocess workaround."""
+    return sum(1 for a in jax.live_arrays() if not a.is_deleted())
+
+
 @functools.partial(jax.jit, static_argnames=("num_positions", "k"))
 def _confidence_topk(scores, num_positions: int = 3, k: int = 19):
     """Device-side replacement for fetching the full [m, steps, V] score
@@ -320,6 +340,70 @@ class ScoringEngine:
         # the CLI engine factory); None = hand-configured.  Sweep shells
         # log it so every run names how its operating point was picked.
         self.plan_decision: Optional[str] = None
+        self._closed = False
+
+    # -- lifecycle -------------------------------------------------------
+
+    @property
+    def closed(self) -> bool:
+        return self._closed
+
+    def _check_open(self) -> None:
+        if self._closed:
+            raise EngineClosed(
+                "ScoringEngine is closed — its device buffers are "
+                "released; construct a new engine (or load a replica "
+                "through serve.pool.EnginePool) before scoring")
+
+    def close(self, release_params: bool = True) -> None:
+        """Verified resource teardown: release every device buffer this
+        engine pins so the HBM (and the allocator's arena state) return
+        to the pre-construction baseline — the fix the bench's
+        full-study subprocess isolation stood in for (VERDICT Missing
+        #3), and the prerequisite for :class:`~..serve.pool.EnginePool`
+        hot unload/load.
+
+        - parameter buffers are deleted DETERMINISTICALLY
+          (``jax.Array.delete`` per leaf) rather than waiting for GC —
+          a 7B snapshot is ~7-13 GB of HBM whose release must not
+          depend on reference-count timing; ``release_params=False``
+          skips the deletes for engines sharing a param tree with a
+          still-live sibling (bench replicas over one snapshot) and
+          only drops this engine's references
+        - the prefix-cache audit pool closes (idempotent — leak
+          accounting swept exactly once)
+        - the generation-plan and token-text caches clear
+
+        Compiled executables stay in the process-wide jit caches: they
+        close over SHAPES, not this engine's buffers, so an unload-then-
+        load of the same geometry re-warms free while a different model
+        compiles its own family.  Idempotent (double-close is a no-op);
+        scoring after close raises the typed :class:`EngineClosed`.
+        ``live_buffer_count()`` is the census tests verify around a
+        construct → score → close cycle."""
+        if self._closed:
+            return
+        self._closed = True
+        if self.last_prefix_pool is not None:
+            self.last_prefix_pool.close()
+        if release_params and self.params is not None:
+            for leaf in jax.tree_util.tree_leaves(self.params):
+                delete = getattr(leaf, "delete", None)
+                if delete is not None:
+                    try:
+                        delete()
+                    except RuntimeError:
+                        pass  # leaf shared with an already-closed sibling
+        self.params = None
+        self._plan_cache.clear()
+        self._tok_text_cache: Dict[int, str] = {}
+        record_counter("engine_closed")
+
+    def __enter__(self) -> "ScoringEngine":
+        return self
+
+    def __exit__(self, exc_type, exc, tb) -> None:
+        self.close()
 
     # -- helpers ---------------------------------------------------------
 
@@ -586,6 +670,7 @@ class ScoringEngine:
         one leg): the prefix prefills into a KV cache and the suffix runs
         as a short cache-extension prefill.
         """
+        self._check_open()
         if prompts and _is_prefix_pair(prompts[0]):
             leg = LegSpec(with_confidence=with_confidence,
                           max_new_tokens=max_new_tokens)
@@ -629,6 +714,7 @@ class ScoringEngine:
         Prefix cache lifetimes are audited on ``self.last_prefix_pool``
         (prefix_hit/prefix_miss telemetry; OOM re-buckets release their
         entry before retrying — the PR-1 composition rule)."""
+        self._check_open()
         n_legs = len(legs) if legs is not None else (
             len(pairs[0][1]) if pairs else 1)
         legs = list(legs) if legs is not None else [
@@ -1113,6 +1199,7 @@ class ScoringEngine:
         ``compile_cache_miss``.  The heuristic is for telemetry trend
         lines, not billing: a tiny model compiling fast on CPU also
         counts as a hit."""
+        self._check_open()
         ecfg = self.ecfg
         if prompt_lengths:
             buckets = sorted({batching.bucket_for(int(l), ecfg.buckets)
@@ -1607,6 +1694,7 @@ class ScoringEngine:
         later questions legitimately move with their packed context."""
         from ..scoring import packed as packed_mod
 
+        self._check_open()
         if self.is_encoder_decoder:
             raise ValueError(
                 "packed anchor scoring is decoder-only (T5 re-reads the "
@@ -1696,6 +1784,7 @@ class ScoringEngine:
         """Fast path: one forward per bucket, no generation — the pjit'd
         perturbation-sweep hot op.  Returns [N, 3] (yes, no, relative).
         ``targets`` may be per-prompt pairs (see ``_target_id_rows``)."""
+        self._check_open()
         ids_all = self._target_id_rows(prompts, targets)
         with obs.span("encode_prompts", phase="host_tokenize",
                       prompts=len(prompts)):

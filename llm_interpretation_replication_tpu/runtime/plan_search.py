@@ -429,6 +429,22 @@ def chosen_plan(ranked: Sequence[PlanCandidate]) -> Optional[PlanCandidate]:
     return ranked[0] if ranked and ranked[0].fits else None
 
 
+def replica_plan(cfg, quant: str, n_devices: int, workload: str = "binary",
+                 seq: int = 256, attention_impl: str = "xla",
+                 **kw) -> Optional[PlanCandidate]:
+    """Per-REPLICA operating point for the EnginePool (serve/pool.py):
+    search this replica's own mesh slice (``n_devices`` = the devices
+    the slice holds, not the fleet total) and return the chosen
+    candidate — batch / kv-dtype / prefill-chunk / pool-target priced
+    for the slice instead of inherited from fleet-wide flags.  None
+    when nothing fits the slice's budget (the caller keeps its
+    hand-configured EngineConfig and says so)."""
+    ranked = search_plans(cfg, quant, n_devices, seq=seq,
+                          workload=workload,
+                          attention_impl=attention_impl, **kw)
+    return chosen_plan(ranked)
+
+
 def plan_search_record(ranked: Sequence[PlanCandidate], top: int = 8,
                        rejects: int = 4) -> Dict:
     """The bench JSON record's ``plan_search`` block: the chosen plan, the

@@ -9,9 +9,25 @@ results back out per-request as futures (:mod:`.request`).  Replay
 (:mod:`.replay`) proves row-level parity with the offline sweep path;
 the stdlib JSONL driver (:mod:`.cli`) is the
 ``python -m llm_interpretation_replication_tpu serve`` subcommand.
+:mod:`.pool` scales the front door to a FLEET: an :class:`EnginePool`
+of N engine replicas (and ``api_backends/`` vendors as
+:class:`RemoteBackend` replicas) behind one router with per-model
+queues, hot load/unload over the engine's verified teardown, and
+cost/latency-aware backend selection.
 """
 
 from .config import SchedulerConfig
+from .pool import (
+    EnginePool,
+    LocalReplica,
+    ParamShareGroup,
+    PoolClient,
+    PoolClosed,
+    PoolConfig,
+    RemoteBackend,
+    RemoteReplica,
+    UnknownModel,
+)
 from .queue import RequestQueue, Ticket
 from .replay import replay, rows_equal
 from .request import (
@@ -22,11 +38,19 @@ from .request import (
     ScoreRequest,
     ServeError,
 )
-from .scheduler import Scheduler
+from .scheduler import Scheduler, labeled_metric
 
 __all__ = [
     "DeadlineExceeded",
+    "EnginePool",
+    "LocalReplica",
+    "ParamShareGroup",
+    "PoolClient",
+    "PoolClosed",
+    "PoolConfig",
     "QueueFull",
+    "RemoteBackend",
+    "RemoteReplica",
     "RequestQueue",
     "SchedulerClosed",
     "Scheduler",
@@ -35,6 +59,8 @@ __all__ = [
     "ScoreRequest",
     "ServeError",
     "Ticket",
+    "UnknownModel",
+    "labeled_metric",
     "replay",
     "rows_equal",
 ]

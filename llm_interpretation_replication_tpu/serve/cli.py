@@ -20,6 +20,7 @@ row-level parity and reporting scheduler-vs-offline throughput.
 
 from __future__ import annotations
 
+import contextlib
 import json
 import sys
 from typing import Dict, Optional
@@ -31,9 +32,11 @@ from .request import ScoreRequest, ServeError
 from .scheduler import Scheduler
 
 #: request-line keys accepted by :func:`parse_request_line`
+#: ("model" routes a line to an EnginePool replica under
+#: --pool-replicas; inert on a single-engine scheduler)
 _REQUEST_KEYS = ("prompt", "prefix", "suffix", "targets",
                  "with_confidence", "max_new_tokens", "priority",
-                 "timeout_s")
+                 "timeout_s", "model")
 
 
 def parse_request_line(obj: Dict) -> ScoreRequest:
@@ -68,12 +71,14 @@ def scheduler_health(sched) -> Dict:
     return doc
 
 
-def _metrics_endpoint(sched, port: int):
+def _metrics_endpoint(sched, port: int, healthz_fn=None):
     """``/metrics`` + ``/healthz`` for a live scheduler (obs/metrics.py):
     the Prometheus exposition over the telemetry counters, serve sample
     rings, and latency-anatomy histograms, plus a periodic sampler
-    feeding the registry's time-series.  Returns the started server
-    (caller closes), or None when ``port`` is falsy."""
+    feeding the registry's time-series.  ``healthz_fn`` overrides the
+    health contributor (the EnginePool hands its per-replica document);
+    default: :func:`scheduler_health` over ``sched``.  Returns the
+    started server (caller closes), or None when ``port`` is falsy."""
     if not port:
         return None
     from ..obs import metrics as obs_metrics
@@ -81,22 +86,59 @@ def _metrics_endpoint(sched, port: int):
     registry = obs_metrics.get_registry()
     registry.start_sampler()
     server = obs_metrics.MetricsServer(
-        registry, port, healthz_fn=lambda: scheduler_health(sched)).start()
+        registry, port,
+        healthz_fn=healthz_fn or (lambda: scheduler_health(sched))).start()
     print(f"# serve: metrics on :{server.port}/metrics, health on "
           f"/healthz", file=sys.stderr)
     return server
 
 
+def build_shared_pool(engine, model: str, replicas: int,
+                      config: Optional[SchedulerConfig] = None):
+    """An :class:`~.pool.EnginePool` of ``replicas`` local replicas of
+    ONE loaded snapshot: siblings share the param tree (no extra weight
+    HBM on the same devices — the arrays are the same buffers), each
+    behind its own scheduler with ``{replica, model}`` metric labels.
+    Ownership of the shared buffers is REFCOUNTED
+    (:class:`~.pool.ParamShareGroup`): only the last sibling to unload
+    releases them, whatever order the operator hot-unloads in.  When the
+    CLI's --plan-search factory chose the snapshot's operating point,
+    every sibling inherits it through the primary's engine config."""
+    from ..runtime.engine import ScoringEngine
+    from .pool import EnginePool, ParamShareGroup, PoolConfig
+
+    n = max(1, replicas)
+    group = ParamShareGroup(n)
+    pool = EnginePool(PoolConfig(scheduler=config))
+    pool.load(model, engine, share_group=group,
+              plan_note=getattr(engine, "plan_decision", None))
+    for _ in range(1, n):
+        sibling = ScoringEngine(
+            engine.family, engine.cfg, engine.params, engine.tokenizer,
+            mesh=engine.mesh, engine_config=engine.ecfg)
+        sibling.plan_decision = engine.plan_decision
+        pool.load(model, sibling, share_group=group,
+                  plan_note=engine.plan_decision)
+    return pool
+
+
 def run_jsonl_driver(engine, in_stream, out_stream,
                      config: Optional[SchedulerConfig] = None,
-                     metrics_port: int = 0) -> Dict:
+                     metrics_port: int = 0, pool=None) -> Dict:
     """Read JSONL requests, serve them, write JSONL results in input
-    order.  Returns ``{"requests": N, "errors": M}``."""
+    order.  Returns ``{"requests": N, "errors": M}``.  With ``pool``
+    the requests route through the EnginePool front door instead of a
+    fresh single-engine scheduler (lines may carry ``"model"``), and
+    /healthz serves the pool's per-replica document; the pool's
+    lifetime belongs to the caller."""
     entries = []  # (id, future-or-None, error-or-None)
     metrics_server = None
     try:
-        with Scheduler(engine, config) as sched:
-            metrics_server = _metrics_endpoint(sched, metrics_port)
+        with (contextlib.nullcontext(pool) if pool is not None
+              else Scheduler(engine, config)) as sched:
+            metrics_server = _metrics_endpoint(
+                sched, metrics_port,
+                healthz_fn=pool.health if pool is not None else None)
             for i, line in enumerate(in_stream):
                 line = line.strip()
                 if not line:
@@ -158,12 +200,15 @@ def run_replay(engine, perturbations_path: str,
     return report
 
 
-def run_load_cli(engine, args, config: SchedulerConfig) -> int:
+def run_load_cli(engine, args, config: SchedulerConfig, pool=None) -> int:
     """``serve --load-rate``: the open-loop load harness (serve/load.py)
     over the perturbation corpus (``--replay PATH`` supplies it) or the
     ``--input`` JSONL request lines as the prompt pool.  A single rate
     runs one operating point; a comma-separated list of >= 3 walks the
-    rate sweep and reports the knee.  Exits 1 on a parity mismatch."""
+    rate sweep and reports the knee.  Exits 1 on a parity mismatch.
+    With ``pool`` (``--pool-replicas``) the SAME harness drives the
+    EnginePool front door via ``pool.client()``; ``engine`` stays the
+    offline parity reference."""
     rates = [float(r) for r in str(args.load_rate).split(",") if r.strip()]
     if not rates:
         print("# serve load: --load-rate parsed to no rates; pass one "
@@ -220,6 +265,8 @@ def run_load_cli(engine, args, config: SchedulerConfig) -> int:
     try:
         kw = dict(duration_s=args.load_duration, seed=args.load_seed,
                   config=config, jsonl=getattr(args, "load_jsonl", None))
+        if pool is not None:
+            kw["scheduler_factory"] = lambda cfg: pool.client()
         if len(rates) >= 3:
             block = load_mod.rate_sweep(engine, prompts, targets=targets,
                                         rates=rates,
@@ -248,30 +295,50 @@ def main(engine, args) -> int:
         queue_capacity=args.queue_capacity,
         default_timeout_s=args.timeout_s,
     )
-    if getattr(args, "load_rate", None):
-        return run_load_cli(engine, args, config)
-    if args.replay:
-        # require_parity=False: the CLI's job on a skew is the full JSON
-        # report plus exit 1 — raising would swallow the report the
-        # operator needs to see WHICH rows diverged
-        report = run_replay(engine, args.replay,
-                            max_rephrasings=args.max_rephrasings,
-                            config=config, require_parity=False)
-        print(json.dumps(report, indent=2))
-        return 0 if report["mismatched_rows"] == 0 else 1
-    in_stream = sys.stdin if args.input == "-" else open(
-        args.input, encoding="utf-8")
-    out_stream = sys.stdout if args.output == "-" else open(
-        args.output, "w", encoding="utf-8")
+    replicas = getattr(args, "pool_replicas", 0) or 0
+    pool = None
+    # the bare --replay harness is single-engine parity by construction;
+    # every other mode (JSONL driver, --load-rate — including load over
+    # the --replay corpus) serves through the pool when asked
+    if replicas > 1 and (getattr(args, "load_rate", None)
+                         or not args.replay):
+        pool = build_shared_pool(engine, getattr(args, "model", "model"),
+                                 replicas, config)
+        print(f"# serve: EnginePool with {replicas} replicas of "
+              f"{getattr(args, 'model', 'model')} (shared snapshot)",
+              file=sys.stderr)
     try:
-        summary = run_jsonl_driver(engine, in_stream, out_stream, config,
-                                   metrics_port=getattr(
-                                       args, "metrics_port", 0) or 0)
+        if getattr(args, "load_rate", None):
+            return run_load_cli(engine, args, config, pool=pool)
+        if args.replay:
+            # require_parity=False: the CLI's job on a skew is the full
+            # JSON report plus exit 1 — raising would swallow the report
+            # the operator needs to see WHICH rows diverged.  (The replay
+            # harness is single-engine by construction; --pool-replicas
+            # is inert here.)
+            report = run_replay(engine, args.replay,
+                                max_rephrasings=args.max_rephrasings,
+                                config=config, require_parity=False)
+            print(json.dumps(report, indent=2))
+            return 0 if report["mismatched_rows"] == 0 else 1
+        in_stream = sys.stdin if args.input == "-" else open(
+            args.input, encoding="utf-8")
+        out_stream = sys.stdout if args.output == "-" else open(
+            args.output, "w", encoding="utf-8")
+        try:
+            summary = run_jsonl_driver(engine, in_stream, out_stream,
+                                       config,
+                                       metrics_port=getattr(
+                                           args, "metrics_port", 0) or 0,
+                                       pool=pool)
+        finally:
+            if in_stream is not sys.stdin:
+                in_stream.close()
+            if out_stream is not sys.stdout:
+                out_stream.close()
+        print(f"# serve: {summary['requests']} request(s), "
+              f"{summary['errors']} error(s)", file=sys.stderr)
+        return 0
     finally:
-        if in_stream is not sys.stdin:
-            in_stream.close()
-        if out_stream is not sys.stdout:
-            out_stream.close()
-    print(f"# serve: {summary['requests']} request(s), "
-          f"{summary['errors']} error(s)", file=sys.stderr)
-    return 0
+        if pool is not None:
+            pool.close()

@@ -11,7 +11,7 @@ few tens of milliseconds waiting for co-batchable traffic.
 from __future__ import annotations
 
 import dataclasses
-from typing import Optional, Sequence
+from typing import Dict, Optional, Sequence
 
 
 @dataclasses.dataclass
@@ -45,3 +45,12 @@ class SchedulerConfig:
     #: wedged coalescer with a short queue as healthy — the head
     #: request's age cannot lie.  0 disables the check.
     health_max_queue_age_s: float = 30.0
+    #: Prometheus labels stamped onto this scheduler's ``serve_*``
+    #: counters / sample rings / latency histograms IN ADDITION to the
+    #: unlabeled family (which stays the fleet-wide aggregate) — the
+    #: EnginePool sets ``{"replica": id, "model": name}`` per replica so
+    #: one wedged replica is visible as ITS series, not a fleet average.
+    #: The labeled spelling is the telemetry-name convention
+    #: ``name|k=v,k2=v2`` (obs/metrics.split_labeled_name); None = no
+    #: labeled series.
+    metric_labels: Optional[Dict[str, str]] = None

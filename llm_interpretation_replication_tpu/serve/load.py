@@ -185,7 +185,8 @@ def run_load(engine, prompts: Sequence, targets=("Yes", "No"),
              offline_rows: Optional[List[Dict]] = None,
              parity: bool = True,
              jsonl=None,
-             result_timeout_s: float = 600.0) -> Dict:
+             result_timeout_s: float = 600.0,
+             scheduler_factory=None) -> Dict:
     """Drive the scheduler at one operating point and report the latency
     anatomy.
 
@@ -203,7 +204,16 @@ def run_load(engine, prompts: Sequence, targets=("Yes", "No"),
     (path or open file) streams one per-request anatomy line.
     ``result_timeout_s`` is ONE shared budget for the whole
     result-collection phase — a wedged scheduler costs it once, never
-    once per outstanding request."""
+    once per outstanding request.
+
+    ``scheduler_factory(cfg)`` (optional) supplies the front door the
+    harness drives INSTEAD of building ``Scheduler(engine, cfg)`` — the
+    EnginePool measures its fleet through the SAME harness by handing
+    ``pool.client(model)`` here (a Scheduler-shaped facade whose
+    ``close()`` is a no-op: the pool outlives one load run, its
+    lifetime owned by the caller).  ``engine`` is still the offline
+    parity reference — for a pool of local replicas the served rows
+    must be bit-identical to any single replica's ``score_prompts``."""
     prompts = list(prompts)
     per_targets = _per_request_targets(targets, len(prompts))
     if mode not in ("open", "closed"):
@@ -230,7 +240,8 @@ def run_load(engine, prompts: Sequence, targets=("Yes", "No"),
     records: List[Dict] = []   # {"i", "scheduled_s", "lag_ms",
     #                             "prompt_idx", "future"}
     shed = 0
-    sched = Scheduler(engine, cfg).start()
+    sched = (scheduler_factory(cfg) if scheduler_factory is not None
+             else Scheduler(engine, cfg).start())
     t0 = time.monotonic()
     depth = _DepthSampler(sched, t0)
     try:
@@ -399,10 +410,13 @@ def rate_sweep(engine, prompts: Sequence, targets=("Yes", "No"),
                offline_rows: Optional[List[Dict]] = None,
                parity: bool = True, jsonl=None,
                closed_comparator: bool = False,
-               result_timeout_s: float = 600.0) -> Dict:
+               result_timeout_s: float = 600.0,
+               scheduler_factory=None) -> Dict:
     """The ``serve_load`` block: walk >= 3 offered rates (ascending)
     through :func:`run_load`, estimate saturation throughput and the
-    knee, and optionally append the closed-loop comparator point."""
+    knee, and optionally append the closed-loop comparator point.
+    ``scheduler_factory`` forwards to :func:`run_load` — the EnginePool
+    rides the same sweep (one pool serves every rate point)."""
     rates = sorted(float(r) for r in rates)
     if len(rates) < 3:
         raise ValueError(f"rate_sweep needs >= 3 offered rates to "
@@ -422,7 +436,8 @@ def rate_sweep(engine, prompts: Sequence, targets=("Yes", "No"),
                      duration_s=duration_s, seed=seed, mode="open",
                      config=config, offline_rows=offline_rows,
                      parity=parity, jsonl=jsonl,
-                     result_timeout_s=result_timeout_s)
+                     result_timeout_s=result_timeout_s,
+                     scheduler_factory=scheduler_factory)
             for rate in rates
         ]
         closed = None
@@ -432,7 +447,8 @@ def rate_sweep(engine, prompts: Sequence, targets=("Yes", "No"),
                               mode="closed", config=config,
                               offline_rows=offline_rows, parity=parity,
                               jsonl=jsonl,
-                              result_timeout_s=result_timeout_s)
+                              result_timeout_s=result_timeout_s,
+                              scheduler_factory=scheduler_factory)
     finally:
         if close_jsonl:
             jsonl.close()

@@ -56,6 +56,11 @@ class ScoreRequest:
     max_new_tokens: Optional[int] = None
     priority: int = 0
     timeout_s: Optional[float] = None
+    #: which model should answer — read by the EnginePool router
+    #: (serve/pool.py) to pick a compatible replica; inert on a
+    #: single-engine Scheduler (its one engine IS the model).  None on
+    #: a single-model pool resolves to that model.
+    model: Optional[str] = None
 
     def validate(self) -> None:
         has_prompt = self.prompt is not None

@@ -85,6 +85,19 @@ HIST_PHASES = {
 }
 
 
+def labeled_metric(name: str, labels) -> str:
+    """The ``name|k=v,k2=v2`` labeled-telemetry spelling: the telemetry
+    layer keys on plain strings, and :func:`..obs.metrics.prometheus_text`
+    splits this convention back into one labeled series of the base
+    Prometheus family — how the EnginePool's per-replica ``serve_*``
+    counters and latency histograms export as ``{replica=...,model=...}``
+    series instead of N separate metric families."""
+    if not labels:
+        return name
+    return name + "|" + ",".join(
+        f"{k}={v}" for k, v in sorted(labels.items()))
+
+
 class Scheduler:
     """Continuous-batching front door for one resident :class:`ScoringEngine`.
 
@@ -106,6 +119,28 @@ class Scheduler:
         self._seq_lock = threading.Lock()
         self._thread: Optional[threading.Thread] = None
         self._closed = False
+        self._labels = dict(self.config.metric_labels or {})
+        # the label suffix is constant for this scheduler's lifetime:
+        # build it once, not per event in the per-request hot path
+        self._label_suffix = (
+            labeled_metric("", self._labels) if self._labels else "")
+
+    # -- telemetry (labeled twin per metric when metric_labels is set) ---
+
+    def _counter(self, name: str, value: float = 1) -> None:
+        record_counter(name, value)
+        if self._label_suffix:
+            record_counter(name + self._label_suffix, value)
+
+    def _sample(self, name: str, value: float) -> None:
+        record_sample(name, value)
+        if self._label_suffix:
+            record_sample(name + self._label_suffix, value)
+
+    def _hist(self, name: str, value: float) -> None:
+        record_hist(name, value)
+        if self._label_suffix:
+            record_hist(name + self._label_suffix, value)
 
     # -- lifecycle -------------------------------------------------------
 
@@ -136,13 +171,13 @@ class Scheduler:
             leftover, expired = self.queue.pop_group(max_batch=1 << 30,
                                                      max_wait_s=0)
             for t in expired:
-                record_counter("serve_rejected_deadline")
+                self._counter("serve_rejected_deadline")
                 self._reject(t, DeadlineExceeded(
                     "deadline passed before the scheduler shut down"))
             if not leftover:
                 break
             for t in leftover:
-                record_counter("serve_rejected_closed")
+                self._counter("serve_rejected_closed")
                 self._reject(t, SchedulerClosed(
                     "scheduler shut down before the request launched"))
         # the prefix pool's close() is idempotent (safe double-close): the
@@ -169,7 +204,7 @@ class Scheduler:
         if self._closed:
             # typed rejection, counted like its QueueFull/DeadlineExceeded
             # siblings so the serve_rejected_* split stays complete
-            record_counter("serve_rejected_closed")
+            self._counter("serve_rejected_closed")
             raise SchedulerClosed("scheduler is shut down")
         now = time.monotonic()
         timeout_s = (request.timeout_s if request.timeout_s is not None
@@ -193,10 +228,10 @@ class Scheduler:
         try:
             self.queue.put(ticket)
         except QueueFull:
-            record_counter("serve_rejected_full")
+            self._counter("serve_rejected_full")
             raise
-        record_counter("serve_enqueued")
-        record_sample("serve_queue_depth", len(self.queue))
+        self._counter("serve_enqueued")
+        self._sample("serve_queue_depth", len(self.queue))
         return future
 
     def submit_many(self, requests) -> List[ScoreFuture]:
@@ -223,7 +258,7 @@ class Scheduler:
                                  phase="serve_coalesce", batch=len(group),
                                  trace_id=group[0].trace_id)
             for t in expired:
-                record_counter("serve_rejected_deadline")
+                self._counter("serve_rejected_deadline")
                 self._reject(t, DeadlineExceeded(
                     f"deadline passed {time.monotonic() - t.deadline:.3f}s "
                     f"before the micro-batch launched"))
@@ -256,12 +291,12 @@ class Scheduler:
     def _launch(self, group: List[Ticket],
                 hold_start: Optional[float] = None) -> None:
         now = time.monotonic()
-        record_counter("serve_batches")
-        record_counter("serve_batch_rows", len(group))
+        self._counter("serve_batches")
+        self._counter("serve_batch_rows", len(group))
         if hold_start is None:
             hold_start = now
         for t in group:
-            record_sample("serve_queue_wait_ms",
+            self._sample("serve_queue_wait_ms",
                           (now - t.enqueue_t) * 1000.0)
             # latency-anatomy stamps (HIST_PHASES): the pre-launch wait
             # splits into DISJOINT queue_wait (behind other traffic,
@@ -315,14 +350,14 @@ class Scheduler:
         except Exception as err:
             if faults.is_oom(err) and self._split_requeue(group, err):
                 return
-            record_counter("serve_failed", len(group))
+            self._counter("serve_failed", len(group))
             for t in group:
                 self._reject(t, err)
             return
         done = time.monotonic()
         engine_s = done - now
         for t, row in zip(group, rows):
-            record_sample("serve_latency_ms", (done - t.enqueue_t) * 1000.0)
+            self._sample("serve_latency_ms", (done - t.enqueue_t) * 1000.0)
             if t.trace_id is not None:
                 # measurement-only: the trace id rides the answer row so
                 # a JSONL output line joins back to its spans; replay
@@ -341,15 +376,15 @@ class Scheduler:
                 "serve_engine_ms": engine_s * 1000.0,
                 "respond_ms": respond_s * 1000.0,
             }
-            record_hist(HIST_E2E, timing["e2e_ms"])
-            record_hist(HIST_PHASES["queue_wait"], timing["queue_wait_ms"])
-            record_hist(HIST_PHASES["coalesce"], timing["coalesce_ms"])
-            record_hist(HIST_PHASES["serve_engine"],
+            self._hist(HIST_E2E, timing["e2e_ms"])
+            self._hist(HIST_PHASES["queue_wait"], timing["queue_wait_ms"])
+            self._hist(HIST_PHASES["coalesce"], timing["coalesce_ms"])
+            self._hist(HIST_PHASES["serve_engine"],
                         timing["serve_engine_ms"])
-            record_hist(HIST_PHASES["respond"], timing["respond_ms"])
+            self._hist(HIST_PHASES["respond"], timing["respond_ms"])
             t.future.timing = timing
             t.future._set_result(row)
-        record_counter("serve_completed", len(group))
+        self._counter("serve_completed", len(group))
         if obs.enabled():
             obs.add_span("respond", done, time.monotonic(),
                          phase="serve_respond", batch=len(group),
@@ -372,7 +407,7 @@ class Scheduler:
         if split is None:
             return False
         new_batch, sizes = split
-        record_counter("serve_oom_splits")
+        self._counter("serve_oom_splits")
         record_fault("serve_oom_split", rows=len(group), batch=current,
                      new_batch=new_batch, error=faults.oom_detail(err))
         print(f"# serve: device OOM at batch {current}; re-queueing "

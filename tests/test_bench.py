@@ -176,28 +176,63 @@ def test_sweep_full_oom_steps_batch_down_and_keeps_workbook(tmp_path,
     assert len(args.repeat_times) == 2
 
 
-def test_child_forwarding_pins_serve_load_flags():
-    """Satellite (ISSUE 11): the --serve-load* flags are pinned against
-    the sweep-full child's forwarding list (the PR-5/PR-6 discipline of
-    tests/test_obs.py::test_bench_forwards_trace_and_profile_to_the_child):
-    like --serve-replay before them, they ride the parent sweep mode's
-    offline rows and deliberately do NOT forward — the full-study child
-    measures the row contract, not the serving harness, and a child
-    serve_load block would shadow the parent's.  A future editor moving
-    them into the child cmd must consciously break this pin."""
+def test_full_study_secondary_runs_in_process(tmp_path):
+    """ISSUE 12: the full-study companion row is produced by an
+    in-process run over a FRESH engine (the sweep engine was closed by
+    run_sweep_mode) on a shallow-copied namespace — the parent's
+    operating point is never mutated by the secondary's."""
+    cfg = DecoderConfig(**TINY)
+    params = bench.init_params(cfg, jax.random.PRNGKey(0), jnp.float32)
+    args = _args(tmp_path, batch=8)
+    args.mode = "sweep"
+    args.sweep_repeats = 1
+    args.warmup = False
+    args.fuse_prefix = True
+    args.eos_mode = "none"
+    args.eos_brackets = False
+    args.full_kv_dtype = "bf16"
+    args.full_prefill_chunk = 0
+    args.profile = None
+    args.plan_search = False
+    entry = bench._full_study_secondary(args, cfg, TINY, params)
+    assert entry["unit"] == "rows/sec"
+    assert entry["value"] > 0 and np.isfinite(entry["value"])
+    assert "full-study" in entry["metric"]
+    assert "context" in entry            # its OWN operating context
+    # the secondary ran sweep-full on ITS copy; the parent keeps its mode
+    assert args.mode == "sweep"
+    assert args.sweep_out == str(tmp_path / "out.xlsx")
+
+
+def test_full_study_secondary_is_in_process_no_subprocess():
+    """Satellite (ISSUE 12): the full-study secondary runs IN-PROCESS.
+    The r05-era fresh-subprocess isolation is deleted — verified engine
+    teardown (ScoringEngine.close) is the fix that workaround stood in
+    for — so bench.py must (a) no longer re-exec itself for the
+    sweep-full companion, (b) close the sweep engine before the
+    full-study leg builds a fresh one, and (c) still keep the serving-
+    harness flags out of the full-study leg (the ISSUE-11 decision: the
+    secondary measures the row contract, not the serving harness).  A
+    future editor reintroducing the subprocess must consciously break
+    this pin."""
     bench_src = open(os.path.join(os.path.dirname(os.path.dirname(
         os.path.abspath(__file__))), "bench.py")).read()
-    # the flags exist on the parent argparse surface...
+    # the serving-harness flags still exist on the parent argparse
+    # surface and still ride the parent sweep mode's offline rows only
     for flag in ("--serve-load", "--serve-load-rates",
-                 "--serve-load-duration", "--serve-load-seed"):
+                 "--serve-load-duration", "--serve-load-seed",
+                 "--serve-load-replicas"):
         assert f'"{flag}"' in bench_src, flag
-    # ...and are absent from the child re-exec cmd, with the decision
-    # recorded next to the forwarding list
-    child = bench_src[bench_src.index('"--mode", "sweep-full"'):]
-    child = child[:child.index("subprocess.run")]
-    assert '"--serve-load"' not in child
-    assert '"--serve-replay"' not in child
-    assert "deliberately do NOT forward" in child
+    # the subprocess isolation is gone...
+    assert "import subprocess" not in bench_src
+    # ...replaced by the in-process secondary over a torn-down engine
+    assert "_full_study_secondary(" in bench_src
+    assert "engine.close(release_params=False)" in bench_src
+    # the full-study leg never measures the serving harness
+    secondary = bench_src[bench_src.index("def _full_study_secondary"):]
+    secondary = secondary[:secondary.index("\ndef ")]
+    assert "serve_load" not in secondary
+    assert "rate_sweep" not in secondary
 
 
 def test_non_oom_errors_propagate(tmp_path, monkeypatch):

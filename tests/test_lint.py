@@ -380,6 +380,23 @@ class TestG05BroadExcept:
         findings = run("serve/load.py", self.SWALLOW)
         assert rules_of(findings) == ["G05"]
 
+    def test_serve_pool_in_g05_scope(self):
+        """Satellite (ISSUE 12): the EnginePool router/relay sits
+        between replica engine errors and each request's future, and
+        its unload path runs the verified engine teardown — a swallowed
+        broad except there would drop a request silently or hide a
+        teardown failure.  G05 has teeth on serve/pool.py (its vendor
+        result-relay catch carries a disable annotation)."""
+        findings = run("serve/pool.py", self.SWALLOW)
+        assert rules_of(findings) == ["G05"]
+
+    def test_runtime_engine_teardown_in_g05_scope(self):
+        """The teardown path (ScoringEngine.close / EngineClosed) lives
+        in runtime/ — already fault scope; pin it so a refactor moving
+        close() out of scope cannot silently shed the gate."""
+        findings = run("runtime/engine.py", self.SWALLOW)
+        assert rules_of(findings) == ["G05"]
+
     def test_out_of_scope_module_ok(self):
         assert run("viz/figures.py", self.SWALLOW) == []
 
@@ -579,6 +596,8 @@ class TestRepoGate:
         assert any("/serve/queue.py" in f for f in scanned)
         # ISSUE-11: the load harness joins the same gate
         assert any("/serve/load.py" in f for f in scanned)
+        # ISSUE-12: the EnginePool joins the same gate
+        assert any("/serve/pool.py" in f for f in scanned)
 
     def test_serve_package_lint_clean_without_baseline(self):
         """Satellite: serve/ ships lint-clean from day one — zero
@@ -590,9 +609,11 @@ class TestRepoGate:
 
         pkg = next(p for p in default_paths()
                    if p.endswith("llm_interpretation_replication_tpu"))
-        # the load harness (ISSUE 11) is part of the zero-baseline pin —
-        # assert it exists so this gate cannot green-light its removal
+        # the load harness (ISSUE 11) and the EnginePool (ISSUE 12) are
+        # part of the zero-baseline pin — assert they exist so this gate
+        # cannot green-light their removal
         assert os.path.exists(os.path.join(pkg, "serve", "load.py"))
+        assert os.path.exists(os.path.join(pkg, "serve", "pool.py"))
         assert lint_paths([os.path.join(pkg, "serve")]) == []
         entries = load_baseline(default_baseline_path())
         assert not [e for e in entries if e.get("path", "").startswith(

@@ -487,16 +487,24 @@ class TestStrictAndPlumbing:
         assert counters().get("blocked_transfers", 0) == \
             snap.get("blocked_transfers", 0)
 
-    def test_bench_forwards_bracket_flags_to_the_child(self):
-        """ISSUE-10 satellite (the PR-6/9 forwarding-pin pattern): the
-        sweep-full child re-exec inherits --eos-mode/--eos-brackets, and
-        the child's brackets block rides back into the parent record."""
+    def test_bracket_flags_reach_the_full_study_secondary(self):
+        """ISSUE-10 satellite, ISSUE-12 shape: the full-study companion
+        is IN-PROCESS now (subprocess deleted), so --eos-mode /
+        --eos-brackets reach it by namespace inheritance — the shallow
+        copy must NOT override them, and the brackets block must ride
+        the shared record builder into the secondary entry."""
         bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
-        child = bench_src[bench_src.index('"--mode", "sweep-full"'):]
-        child = child[:child.index("subprocess.run")]
-        assert '"--eos-mode"' in child
-        assert '"--eos-brackets"' in child and '"--no-eos-brackets"' in child
-        assert '"plan_search", "brackets")' in bench_src
+        secondary = bench_src[bench_src.index("def _full_study_secondary"):]
+        secondary = secondary[:secondary.index("\ndef ")]
+        assert "copy.copy(args)" in secondary
+        # inherited, never overridden: a parent bracket run measures its
+        # bracket in the secondary too
+        assert "child.eos_mode" not in secondary
+        assert "child.eos_brackets" not in secondary
+        assert "_full_study_record(child" in secondary
+        builder = bench_src[bench_src.index("def _full_study_record"):]
+        builder = builder[:builder.index("\ndef ")]
+        assert 'record["brackets"] = a.brackets_report' in builder
 
     def test_context_block_carries_bracket_and_packing_fields(self):
         """The record's context block names the bracket/packing settings

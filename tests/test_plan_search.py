@@ -516,24 +516,31 @@ class TestCli:
         assert ps.main(["search", "--workload", "full"]) == 0
         assert "chosen" in capsys.readouterr().out
 
-    def test_bench_forwards_plan_search_to_the_full_study_child(self):
-        """The PR-5 forwarding discipline: a --plan-search parent must
-        not run its full-study child at the fixed operating point."""
+    def test_plan_search_reaches_the_full_study_secondary(self):
+        """The PR-5 forwarding discipline, ISSUE-12 shape: a
+        --plan-search parent must not run its in-process full-study
+        secondary at the fixed operating point — the secondary searches
+        its OWN full-study workload (the parent's binary-workload
+        choice does not transfer across workloads)."""
         bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
-        child = bench_src[bench_src.index('"--mode", "sweep-full"'):]
-        child = child[:child.index("subprocess.run")]
-        assert '"--plan-search"' in child
+        secondary = bench_src[bench_src.index("def _full_study_secondary"):]
+        secondary = secondary[:secondary.index("\ndef ")]
+        assert 'getattr(args, "plan_search", False)' in secondary
+        assert 'workload="full"' in secondary
 
     def test_bench_records_the_plan_search_block(self):
-        """All three sweep records (sweep, sweep-full, sweep-packed)
-        attach the runner-up table, and the child's block rides the
-        secondary (source pin, the test_obs pattern)."""
+        """Every sweep record attaches the runner-up table: the sweep and
+        sweep-packed branches directly, the sweep-full headline AND the
+        in-process full-study secondary through the shared record
+        builder (_full_study_record)."""
         bench_src = open(os.path.join(REPO_ROOT, "bench.py")).read()
         assert bench_src.count(
-            'record["plan_search"] = args.plan_search_report') == 3
-        # child-extra forwarding keys: the full-study child's plan_search
-        # AND brackets blocks ride into the parent's secondary
-        assert '"plan_search", "brackets")' in bench_src
+            'record["plan_search"] = args.plan_search_report') == 2
+        builder = bench_src[bench_src.index("def _full_study_record"):]
+        builder = builder[:builder.index("\ndef ")]
+        assert 'record["plan_search"] = a.plan_search_report' in builder
+        # both full-study consumers go through the shared builder
+        assert bench_src.count("_full_study_record(") >= 3
 
 
 class TestEngineFactoryWiring:

@@ -1,0 +1,843 @@
+"""serve/pool.py EnginePool + runtime engine teardown (ISSUE 12).
+
+Tier-1 suite (``-m enginepool``): verified ScoringEngine.close()
+teardown (device-buffer census back to baseline, double-close
+idempotent, typed EngineClosed), routing fairness across per-model
+queues and least-loaded replicas, hot unload/load mid-traffic with zero
+dropped requests, bit-identical row parity vs single-engine
+score_prompts for every local replica, the pool under the --serve-load
+open-loop harness with strict-mode ``blocked_transfers == 0``,
+cost/latency-aware remote-backend selection over a fake transport, and
+the per-replica /healthz + replica-labeled Prometheus export."""
+
+import gc
+import threading
+import time
+
+import pytest
+
+from test_runtime import _tiny_engine
+from test_sweeps import FakeEngine
+
+import jax
+
+from llm_interpretation_replication_tpu.api_backends.openai_client import (
+    OpenAIClient,
+)
+from llm_interpretation_replication_tpu.api_backends.transport import (
+    FakeTransport,
+)
+from llm_interpretation_replication_tpu.runtime import (
+    EngineClosed,
+    live_buffer_count,
+)
+from llm_interpretation_replication_tpu.serve import (
+    EnginePool,
+    PoolClosed,
+    PoolConfig,
+    RemoteBackend,
+    SchedulerConfig,
+    ScoreRequest,
+    UnknownModel,
+    rows_equal,
+)
+from llm_interpretation_replication_tpu.serve import load as load_mod
+from llm_interpretation_replication_tpu.serve.pool import (
+    LocalReplica,
+    RemoteReplica,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.enginepool
+
+#: fast admission for CPU-test traffic
+FAST = SchedulerConfig(max_batch=4, max_wait_s=0.005)
+
+
+def fast_pool(**kw):
+    return EnginePool(PoolConfig(scheduler=FAST, **kw))
+
+
+class SlowEngine(FakeEngine):
+    """FakeEngine with a per-call service time, so queues actually form
+    and least-loaded routing has load to balance."""
+
+    def __init__(self, model_name, delay_s=0.01):
+        super().__init__(model_name)
+        self.delay_s = delay_s
+
+    def score_prompts(self, prompts, targets=("Yes", "No"),
+                      with_confidence=False, max_new_tokens=None):
+        time.sleep(self.delay_s)
+        return super().score_prompts(prompts, targets, with_confidence,
+                                     max_new_tokens)
+
+
+# ---------------------------------------------------------------------------
+# ScoringEngine.close(): verified teardown (satellite)
+# ---------------------------------------------------------------------------
+
+class TestEngineTeardown:
+    def test_buffer_census_returns_to_baseline(self):
+        """Construct -> score -> close: live device-buffer counts return
+        to the pre-construction baseline, param leaves are deleted
+        DETERMINISTICALLY (not GC-timing), the prefix-pool audit state
+        is swept, and the engine_closed telemetry counter records the
+        teardown exactly once."""
+        gc.collect()
+        base = live_buffer_count()
+        snap = telemetry.counters()
+        eng, _, _ = _tiny_engine(batch_size=4)
+        assert live_buffer_count() > base       # params resident
+        rows = eng.score_prompts(
+            ["Is a tweet a publication?", "Is soup a beverage?"])
+        assert len(rows) == 2 and all(r["success"] for r in rows)
+        leaf = jax.tree_util.tree_leaves(eng.params)[0]
+        eng.close()
+        assert leaf.is_deleted()                # deterministic release
+        assert eng.params is None
+        pool = eng.last_prefix_pool
+        assert pool is None or pool.closed
+        del rows, leaf
+        gc.collect()
+        assert live_buffer_count() <= base
+        delta = telemetry.counters_since(snap)
+        assert delta.get("engine_closed") == 1
+
+    def test_double_close_idempotent_and_typed_raise(self):
+        snap = telemetry.counters()
+        eng, _, _ = _tiny_engine(batch_size=4)
+        eng.close()
+        eng.close()                             # idempotent: no raise
+        assert telemetry.counters_since(snap).get("engine_closed") == 1
+        with pytest.raises(EngineClosed):
+            eng.score_prompts(["x"])
+        with pytest.raises(EngineClosed):
+            eng.first_token_relative_prob(["x"])
+        with pytest.raises(EngineClosed):
+            eng.score_prefixed([("a", ("b",))])
+
+    def test_close_release_params_false_keeps_shared_leaves(self):
+        """Sibling replicas over ONE param tree (the bench fleet shape):
+        closing one with release_params=False must not delete the
+        buffers the survivor still scores through."""
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            ScoringEngine,
+        )
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        sibling = ScoringEngine(eng.family, eng.cfg, eng.params,
+                                eng.tokenizer, engine_config=eng.ecfg)
+        ref = eng.score_prompts(["Is soup a beverage?"])
+        sibling.close(release_params=False)
+        again = eng.score_prompts(["Is soup a beverage?"])   # still alive
+        assert rows_equal(ref[0], again[0])
+        eng.close()
+
+    def test_unload_then_load_a_different_model_in_process(self):
+        """The capability the teardown exists for: model A's buffers go,
+        model B loads into the same process, the census never
+        accumulates."""
+        gc.collect()
+        base = live_buffer_count()
+        eng_a, _, _ = _tiny_engine(batch_size=4)
+        eng_a.score_prompts(["Is a tweet a publication?"])
+        eng_a.close()
+        gc.collect()
+        assert live_buffer_count() <= base
+        eng_b, _, _ = _tiny_engine(batch_size=4)   # the "different" model
+        rows = eng_b.score_prompts(["Is soup a beverage?"])
+        assert rows[0]["success"]
+        eng_b.close()
+        gc.collect()
+        assert live_buffer_count() <= base
+
+
+# ---------------------------------------------------------------------------
+# Routing: per-model queues, least-loaded replicas
+# ---------------------------------------------------------------------------
+
+class TestRouting:
+    def test_per_model_queues_route_to_their_own_engines(self):
+        """Two models behind one front door: every request resolves
+        through ITS model's engine (FakeEngine rows hash the model name,
+        so cross-model leakage would show as a row mismatch)."""
+        alpha, beta = FakeEngine("fake/alpha-7b"), FakeEngine("fake/beta-7b")
+        ref_a = alpha.score_prompts(["q0", "q1"])
+        ref_b = beta.score_prompts(["q0", "q1"])
+        with fast_pool() as pool:
+            pool.load("alpha", alpha)
+            pool.load("beta", beta)
+            futs_a = [pool.submit(ScoreRequest(prompt=f"q{i}"),
+                                  model="alpha") for i in range(2)]
+            futs_b = [pool.submit(ScoreRequest(prompt=f"q{i}",
+                                               model="beta"))
+                      for i in range(2)]
+            rows_a = [f.result(timeout=30) for f in futs_a]
+            rows_b = [f.result(timeout=30) for f in futs_b]
+        for got, want in zip(rows_a, ref_a):
+            assert rows_equal(got, want)
+        for got, want in zip(rows_b, ref_b):
+            assert rows_equal(got, want)
+
+    def test_least_loaded_spreads_across_replicas(self):
+        """With real service time, a 2-replica model serves from BOTH
+        replicas — the router balances on outstanding work instead of
+        pinning one."""
+        ea = SlowEngine("fake/alpha-7b", delay_s=0.02)
+        eb = SlowEngine("fake/alpha-7b", delay_s=0.02)
+        with fast_pool() as pool:
+            pool.load("alpha", ea)
+            pool.load("alpha", eb)
+            futs = [pool.submit(ScoreRequest(prompt=f"q{i}"),
+                                model="alpha") for i in range(24)]
+            for f in futs:
+                f.result(timeout=60)
+        assert ea.calls > 0 and eb.calls > 0
+
+    def test_unknown_model_is_typed(self):
+        with fast_pool() as pool:
+            pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            with pytest.raises(UnknownModel):
+                pool.submit(ScoreRequest(prompt="x"), model="nope")
+
+    def test_single_model_pool_resolves_model_omitted(self):
+        with fast_pool() as pool:
+            pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            row = pool.submit(ScoreRequest(prompt="q0")).result(timeout=30)
+        assert row["success"]
+
+    def test_submit_after_close_is_typed(self):
+        pool = fast_pool()
+        pool.load("alpha", FakeEngine("fake/alpha-7b"))
+        pool.close()
+        with pytest.raises(PoolClosed):
+            pool.submit(ScoreRequest(prompt="x"), model="alpha")
+
+    def test_pool_queue_honors_deadlines(self):
+        """A deadline covers POOL queue time (the scheduler convention):
+        a bounded-time request parked behind a hot swap with no live
+        replica rejects TYPED instead of hanging, and the queue never
+        silently grants the pool wait on top of the replica wait."""
+        from llm_interpretation_replication_tpu.serve import (
+            DeadlineExceeded,
+        )
+
+        with fast_pool() as pool:
+            r0 = pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            pool.unload(r0.rid)            # swap window: no live replica
+            fut = pool.submit(ScoreRequest(prompt="x", timeout_s=0.05),
+                              model="alpha")
+            err = fut.exception(timeout=10)
+            assert isinstance(err, DeadlineExceeded)
+
+    def test_pool_queue_backpressure_is_typed(self):
+        """The per-model front queue is bounded by the scheduler
+        template's queue_capacity — a submit past it sheds with the
+        typed QueueFull, never silent unbounded admission."""
+        from llm_interpretation_replication_tpu.serve import QueueFull
+
+        cfg = SchedulerConfig(max_batch=4, max_wait_s=0.005,
+                              queue_capacity=3)
+        pool = EnginePool(PoolConfig(scheduler=cfg))
+        try:
+            r0 = pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            pool.unload(r0.rid)            # nothing drains the queue
+            for i in range(3):
+                pool.submit(ScoreRequest(prompt=f"q{i}"), model="alpha")
+            with pytest.raises(QueueFull):
+                pool.submit(ScoreRequest(prompt="q3"), model="alpha")
+        finally:
+            pool.close(drain=False)
+
+    def test_pool_queue_priority_ordering(self):
+        """Higher priority dispatches first from the pool queue (FIFO
+        within a level) — measured at the replica engine's call log."""
+        order = []
+
+        class LoggingEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                order.extend(prompts)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        cfg = SchedulerConfig(max_batch=1, max_wait_s=0.005)
+        with EnginePool(PoolConfig(scheduler=cfg)) as pool:
+            # queue during a swap window (no live replica), so dispatch
+            # order is the router's choice, not submission timing
+            r0 = pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            pool.unload(r0.rid)
+            futs = [
+                pool.submit(ScoreRequest(prompt="low", priority=0),
+                            model="alpha"),
+                pool.submit(ScoreRequest(prompt="high", priority=5),
+                            model="alpha"),
+            ]
+            pool.load("alpha", LoggingEngine("fake/alpha-7b"))
+            for f in futs:
+                f.result(timeout=30)
+        assert order[0] == "high"
+
+
+# ---------------------------------------------------------------------------
+# Hot unload / load under live traffic: zero dropped
+# ---------------------------------------------------------------------------
+
+class TestHotSwap:
+    def test_unload_mid_traffic_zero_dropped(self):
+        """Unloading one of two replicas under continuous traffic drops
+        NOTHING: every submitted request resolves with a real row (the
+        always-answered contract), and the survivor keeps serving."""
+        ea = SlowEngine("fake/alpha-7b", delay_s=0.005)
+        eb = SlowEngine("fake/alpha-7b", delay_s=0.005)
+        with fast_pool() as pool:
+            ra = pool.load("alpha", ea)
+            pool.load("alpha", eb)
+            futs, stop = [], threading.Event()
+
+            def traffic():
+                i = 0
+                while not stop.is_set() and i < 200:
+                    futs.append(pool.submit(
+                        ScoreRequest(prompt=f"w{i}"), model="alpha"))
+                    i += 1
+                    time.sleep(0.002)
+
+            t = threading.Thread(target=traffic, daemon=True)
+            t.start()
+            time.sleep(0.05)
+            pool.unload(ra.rid)          # hot: eb keeps serving
+            time.sleep(0.05)
+            stop.set()
+            t.join(timeout=5)
+            rows = [f.result(timeout=60) for f in futs]
+        assert futs and all(r["success"] for r in rows)
+        assert len(pool.replicas()) == 0   # closed pool
+        assert eb.calls > 0
+
+    def test_unload_all_then_load_keeps_queued_traffic(self):
+        """The swap window: with NO replica live, submits for a known
+        model queue (never fail) and drain onto the replica loaded
+        next — hot model replacement without a dropped request."""
+        with fast_pool() as pool:
+            r0 = pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            pool.unload(r0.rid)
+            fut = pool.submit(ScoreRequest(prompt="held"), model="alpha")
+            assert not fut.done()
+            health = pool.health()
+            assert health["status"] == "degraded"        # queued, no replica
+            assert "no live replica" in health["degraded_reason"]
+            pool.load("alpha", FakeEngine("fake/alpha-7b"))
+            assert fut.result(timeout=30)["success"]
+
+    def test_shared_group_releases_only_on_last_unload_any_order(self):
+        """build_shared_pool ownership is REFCOUNTED: hot-unloading the
+        siblings in ANY order never deletes buffers a survivor still
+        scores through; only the last unload releases the shared tree."""
+        import json
+
+        from llm_interpretation_replication_tpu.serve import cli as serve_cli
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        leaf = jax.tree_util.tree_leaves(eng.params)[0]
+        prompts = ["Is soup a beverage?"]
+        offline = eng.score_prompts(prompts)
+        pool = serve_cli.build_shared_pool(
+            eng, "tiny", 2, SchedulerConfig(max_batch=4, max_wait_s=0.005))
+        try:
+            rids = [r.rid for r in pool.replicas()]
+            pool.unload(rids[0])           # the PRIMARY's replica first
+            assert not leaf.is_deleted()   # sibling still serves the tree
+            row = pool.submit(ScoreRequest(prompt=prompts[0]),
+                              model="tiny").result(timeout=120)
+            assert rows_equal(row, offline[0])
+            pool.unload(rids[1])           # last sibling out releases
+            assert leaf.is_deleted()
+        finally:
+            pool.close()
+
+    def test_unload_closes_engine_verified(self):
+        """Pool unload runs the engine's verified teardown: buffers
+        deleted, EngineClosed afterwards."""
+        eng, _, _ = _tiny_engine(batch_size=4)
+        leaf = jax.tree_util.tree_leaves(eng.params)[0]
+        with fast_pool() as pool:
+            rep = pool.load("tiny", eng)
+            row = pool.submit(ScoreRequest(prompt="Is soup a beverage?"),
+                              model="tiny").result(timeout=120)
+            assert row["success"]
+            pool.unload(rep.rid)
+            assert leaf.is_deleted()
+            with pytest.raises(EngineClosed):
+                eng.score_prompts(["x"])
+
+
+# ---------------------------------------------------------------------------
+# Parity: pool-served rows are bit-identical to single-engine scoring
+# ---------------------------------------------------------------------------
+
+class TestPoolParity:
+    def test_rows_bit_identical_for_every_local_replica(self):
+        """Two tiny-engine replicas (same seed => same weights): every
+        pool-served row equals the single-engine offline row bit for
+        bit, regardless of which replica answered — routing is
+        measurement-only."""
+        eng_ref, _, _ = _tiny_engine(batch_size=4)
+        eng_a, _, _ = _tiny_engine(batch_size=4)
+        eng_b, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(8)]
+        offline = eng_ref.score_prompts(prompts)
+        with fast_pool() as pool:
+            pool.load("tiny", eng_a)
+            pool.load("tiny", eng_b)
+            futs = [pool.submit(ScoreRequest(prompt=p), model="tiny")
+                    for p in prompts]
+            rows = [f.result(timeout=300) for f in futs]
+        for got, want in zip(rows, offline):
+            assert rows_equal(got, want)
+        eng_ref.close()
+
+
+# ---------------------------------------------------------------------------
+# The pool under the --serve-load harness (strict mode)
+# ---------------------------------------------------------------------------
+
+class TestPoolUnderLoad:
+    def test_serve_load_smoke_strict_clean(self):
+        """The SAME open-loop harness that measures the single-engine
+        scheduler drives the pool (scheduler_factory=pool.client): rows
+        stay parity-clean under offered load and the strict-mode
+        transfer guard records blocked_transfers == 0."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng_ref, _, _ = _tiny_engine(batch_size=4)
+        eng_a, _, _ = _tiny_engine(batch_size=4)
+        eng_b, _, _ = _tiny_engine(batch_size=4)
+        prompts = [f"Is thing {i} a stuff?" for i in range(6)]
+        offline = eng_ref.score_prompts(prompts)   # warm + parity reference
+        with fast_pool() as pool:
+            pool.load("tiny", eng_a)
+            pool.load("tiny", eng_b)
+            pool.submit(ScoreRequest(prompt=prompts[0]),
+                        model="tiny").result(timeout=300)  # warm replicas
+            strict.activate(sentry=False)
+            try:
+                report = load_mod.run_load(
+                    eng_ref, prompts, rate=30.0, duration_s=0.5,
+                    offline_rows=offline,
+                    scheduler_factory=lambda cfg: pool.client("tiny"))
+            finally:
+                strict.deactivate()
+        assert report["errors"] == 0
+        assert report["parity"]["mismatched_rows"] == 0
+        assert report["blocked_transfers"] == 0
+        eng_ref.close()
+
+
+# ---------------------------------------------------------------------------
+# Remote backends: cost/latency-aware selection over a fake transport
+# ---------------------------------------------------------------------------
+
+def _openai_backend(model, pricing, calls_log=None):
+    ft = FakeTransport()
+
+    def responder(call):
+        if calls_log is not None:
+            calls_log.append(call["json"]["model"])
+        return (200, {
+            "choices": [{
+                "message": {"content": "Yes"},
+                "logprobs": {"content": [{"top_logprobs": [
+                    {"token": "Yes", "logprob": -0.2},
+                    {"token": "No", "logprob": -1.8}]}]},
+            }],
+            "usage": {"prompt_tokens": 10, "completion_tokens": 2},
+        })
+
+    ft.add("POST", "chat/completions", responder)
+    client = OpenAIClient(api_key="test-key", transport=ft)
+    return RemoteBackend.openai(client, model, pricing=pricing)
+
+
+class TestRemoteBackends:
+    def test_vendor_row_matches_result_contract(self):
+        backend = _openai_backend("gpt-cheap",
+                                  {"gpt-cheap": {"input": 1, "output": 2}})
+        with fast_pool() as pool:
+            pool.load_remote(backend)
+            row = pool.submit(ScoreRequest(prompt="Is soup a beverage?"),
+                              model="gpt-cheap").result(timeout=30)
+        assert set(row) >= {"yes_prob", "no_prob", "relative_prob",
+                            "odds_ratio", "completion", "success"}
+        assert row["success"] and row["completion"] == "Yes"
+        assert 0.0 <= row["relative_prob"] <= 1.0
+        usage = backend.tracker.summary()["gpt-cheap"]
+        assert usage["requests"] == 1
+        assert backend.tracker.cost("gpt-cheap") > 0
+
+    def test_cost_weight_prefers_cheaper_backend(self):
+        """cost_weight=1/latency_weight=0: every request lands on the
+        cheaper vendor — selection reads the pre-dispatch USD estimate
+        from the cost.py pricing table."""
+        log = []
+        cheap = _openai_backend(
+            "gpt-cheap", {"gpt-cheap": {"input": 1.0, "output": 1.0}}, log)
+        dear = _openai_backend(
+            "gpt-dear", {"gpt-dear": {"input": 500.0, "output": 500.0}}, log)
+        with fast_pool(cost_weight=1.0, latency_weight=0.0) as pool:
+            pool.load_remote(cheap, model="gpt")
+            pool.load_remote(dear, model="gpt")
+            futs = [pool.submit(ScoreRequest(prompt="Is soup a beverage?"),
+                                model="gpt") for _ in range(6)]
+            for f in futs:
+                f.result(timeout=30)
+        assert log.count("gpt-cheap") == 6 and "gpt-dear" not in log
+
+    def test_latency_weight_prefers_faster_backend(self):
+        """latency_weight=1/cost_weight=0 with seeded observations: the
+        router picks the replica whose observed-latency EWMA predicts
+        the smaller wait."""
+        fast = _openai_backend("gpt-fast", {})
+        slow = _openai_backend("gpt-slow", {})
+        pool = fast_pool(cost_weight=0.0, latency_weight=1.0)
+        try:
+            r_fast = pool.load_remote(fast, model="gpt")
+            r_slow = pool.load_remote(slow, model="gpt")
+            r_fast.note_latency(0.01)
+            r_slow.note_latency(2.0)
+            with pool._lock:
+                chosen = pool._select_replica(
+                    "gpt", ScoreRequest(prompt="q"))
+            assert chosen is r_fast
+            # flip the observations: selection follows the evidence
+            r_fast.note_latency(10.0)
+            for _ in range(64):
+                r_slow.note_latency(0.01)
+            with pool._lock:
+                chosen = pool._select_replica(
+                    "gpt", ScoreRequest(prompt="q"))
+            assert chosen is r_slow
+        finally:
+            pool.close()
+
+    def test_remote_leg_honors_deadlines_without_spending(self):
+        """An expired request never reaches the vendor (no dollars
+        spent) — it rejects with the typed DeadlineExceeded, same as
+        the local scheduler's queue sweep."""
+        from llm_interpretation_replication_tpu.serve import (
+            DeadlineExceeded,
+        )
+
+        calls = []
+        ft = FakeTransport()
+
+        def responder(call):
+            calls.append(1)
+            time.sleep(0.15)
+            return (200, {"choices": [{"message": {"content": "Yes"},
+                                       "logprobs": {"content": []}}]})
+
+        ft.add("POST", "chat/completions", responder)
+        client = OpenAIClient(api_key="k", transport=ft)
+        backend = RemoteBackend.openai(client, "gpt-x")
+        with fast_pool() as pool:
+            pool.load_remote(backend, model="gpt")
+            f1 = pool.submit(ScoreRequest(prompt="a"), model="gpt")
+            f2 = pool.submit(ScoreRequest(prompt="b", timeout_s=0.05),
+                             model="gpt")
+            err = f2.exception(timeout=30)
+            assert isinstance(err, DeadlineExceeded)
+            assert f1.result(timeout=30)["success"]
+        assert len(calls) == 1     # the expired request spent nothing
+
+    def test_remote_failure_is_this_requests_typed_error(self):
+        """A vendor transport error fails ITS request's future and the
+        replica keeps draining — never wedges the pool."""
+        ft = FakeTransport()   # no handler registered: every call 404s
+        client = OpenAIClient(api_key="k", transport=ft)
+        backend = RemoteBackend.openai(client, "gpt-x")
+        ok = _openai_backend("gpt-x", {})
+        with fast_pool(cost_weight=0.0, latency_weight=1.0) as pool:
+            bad = pool.load_remote(backend, model="gpt")
+            fut = pool.submit(ScoreRequest(prompt="q"), model="gpt")
+            err = fut.exception(timeout=30)
+            assert err is not None
+            # hot-swap the failing vendor for a healthy one — traffic heals
+            pool.unload(bad.rid)
+            pool.load_remote(ok, model="gpt")
+            row = pool.submit(ScoreRequest(prompt="q"),
+                              model="gpt").result(timeout=30)
+            assert row["success"]
+
+
+# ---------------------------------------------------------------------------
+# /healthz per-replica + replica-labeled Prometheus export (satellite)
+# ---------------------------------------------------------------------------
+
+class TestObservability:
+    def test_health_reports_per_replica_and_degrades_on_wedge(self):
+        """One wedged replica reads degraded while the pool stays up:
+        the per-replica document carries id/model/queue-depth/oldest-
+        wait, and the pool-level status only degrades where the
+        evidence is."""
+        release, entered = threading.Event(), threading.Event()
+
+        class WedgedEngine(FakeEngine):
+            def score_prompts(self, prompts, targets=("Yes", "No"),
+                              with_confidence=False, max_new_tokens=None):
+                entered.set()
+                release.wait(timeout=30)
+                return super().score_prompts(prompts, targets,
+                                             with_confidence,
+                                             max_new_tokens)
+
+        pool = EnginePool(PoolConfig(scheduler=FAST,
+                                     health_max_queue_age_s=0.03))
+        try:
+            pool.load("wedged", WedgedEngine("fake/wedged-7b"),
+                      replica_id="rw")
+            pool.load("fine", FakeEngine("fake/fine-7b"), replica_id="rf")
+            # one request wedges the engine IN FLIGHT; only then queue a
+            # second behind it (submitting both at once would coalesce
+            # them into one micro-batch, leaving the queue empty and
+            # nothing to age)
+            f1 = pool.submit(ScoreRequest(prompt="a"), model="wedged")
+            assert entered.wait(timeout=10)
+            f2 = pool.submit(ScoreRequest(prompt="b"), model="wedged")
+            deadline = time.monotonic() + 10
+            doc = pool.health()
+            while time.monotonic() < deadline:
+                doc = pool.health()
+                wedged = [r for r in doc["replicas"]
+                          if r["replica"] == "rw"][0]
+                if wedged.get("status") == "degraded":
+                    break
+                time.sleep(0.01)
+            assert wedged["status"] == "degraded"
+            assert "oldest_wait_s" in wedged
+            assert doc["status"] == "degraded"
+            assert doc["pool"] == "running"            # pool stays up
+            fine = [r for r in doc["replicas"] if r["replica"] == "rf"][0]
+            assert fine.get("status") != "degraded"
+            assert {"replica", "model", "queue_depth", "outstanding"} <= \
+                set(fine)
+            # the healthy model still serves while the wedge persists
+            row = pool.submit(ScoreRequest(prompt="c"),
+                              model="fine").result(timeout=30)
+            assert row["success"]
+            release.set()
+            assert f1.result(timeout=30)["success"]
+            assert f2.result(timeout=30)["success"]
+        finally:
+            release.set()
+            pool.close()
+
+    def test_prometheus_export_labels_serve_metrics_by_replica(self):
+        """serve_* counters and the latency-anatomy histograms export as
+        ``{replica=...,model=...}`` series of the SAME family (the
+        ``name|k=v`` labeled-telemetry convention), next to the
+        unlabeled fleet aggregate."""
+        from llm_interpretation_replication_tpu.obs import (
+            metrics as obs_metrics,
+        )
+
+        with fast_pool() as pool:
+            pool.load("alpha", FakeEngine("fake/alpha-7b"),
+                      replica_id="ra")
+            pool.load("alpha", FakeEngine("fake/alpha-7b"),
+                      replica_id="rb")
+            futs = [pool.submit(ScoreRequest(prompt=f"q{i}"),
+                                model="alpha") for i in range(8)]
+            for f in futs:
+                f.result(timeout=30)
+        text = obs_metrics.prometheus_text()
+        labeled = [l for l in text.splitlines() if 'replica="r' in l]
+        assert any(l.startswith("llm_interp_serve_completed{")
+                   for l in labeled)
+        assert any("llm_interp_serve_req_e2e_ms_bucket{" in l
+                   for l in labeled)
+        assert any('model="alpha"' in l for l in labeled)
+        # one TYPE line per family: labeled series extend the base
+        # family instead of minting llm_interp_serve_completed_replica_*
+        assert text.count("# TYPE llm_interp_serve_completed counter") == 1
+
+    def test_scheduler_config_labels_are_additive(self):
+        """labeled_metric spelling round-trips through the exporter's
+        split (unlabeled name unchanged; labels parse back)."""
+        from llm_interpretation_replication_tpu.obs.metrics import (
+            split_labeled_name,
+        )
+        from llm_interpretation_replication_tpu.serve import labeled_metric
+
+        name = labeled_metric("serve_batches",
+                              {"replica": "r0", "model": "m"})
+        assert name == "serve_batches|model=m,replica=r0"
+        base, labels = split_labeled_name(name)
+        assert base == "serve_batches"
+        assert labels == {"replica": "r0", "model": "m"}
+        assert split_labeled_name("serve_batches") == ("serve_batches",
+                                                       None)
+
+
+# ---------------------------------------------------------------------------
+# Plan-search per-replica operating points
+# ---------------------------------------------------------------------------
+
+class TestReplicaPlans:
+    def test_replica_plan_prices_the_slice(self):
+        """replica_plan searches ONE replica's mesh slice and the chosen
+        point maps onto a replica EngineConfig via
+        replica_engine_config."""
+        from llm_interpretation_replication_tpu.models.config import (
+            BENCH_GEOMETRIES,
+            DecoderConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            EngineConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime.plan_search import (
+            replica_plan,
+        )
+        from llm_interpretation_replication_tpu.serve.pool import (
+            replica_engine_config,
+        )
+
+        cfg = DecoderConfig(**BENCH_GEOMETRIES["falcon-7b"])
+        plan = replica_plan(cfg, "int8", 1, workload="binary")
+        assert plan is not None and plan.fits
+        assert plan.data * plan.pipe * plan.model == 1
+        ecfg = replica_engine_config(EngineConfig(), plan)
+        assert ecfg.batch_size == plan.batch
+        assert ecfg.kv_dtype == plan.kv_dtype
+        # None plan = keep the hand-configured point
+        base = EngineConfig(batch_size=7)
+        assert replica_engine_config(base, None) is base
+
+    def test_load_applies_plan_to_the_replica_engine_config(self):
+        """EnginePool.load(plan=...) is the production wiring: the
+        searched candidate rewrites THIS replica's EngineConfig and
+        becomes its health-doc plan note."""
+        from llm_interpretation_replication_tpu.models.config import (
+            BENCH_GEOMETRIES,
+            DecoderConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime.engine import (
+            EngineConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime.plan_search import (
+            replica_plan,
+        )
+
+        cfg = DecoderConfig(**BENCH_GEOMETRIES["falcon-7b"])
+        plan = replica_plan(cfg, "int8", 1, workload="binary")
+        eng = FakeEngine("fake/alpha-7b")
+        eng.ecfg = EngineConfig(batch_size=4)
+        with fast_pool() as pool:
+            rep = pool.load("alpha", eng, plan=plan)
+            assert eng.ecfg.batch_size == plan.batch
+            assert eng.ecfg.kv_dtype == plan.kv_dtype
+            assert rep.plan_note == plan.reason
+            doc = pool.health()
+        assert doc["replicas"][0]["plan"] == plan.reason
+
+    def test_pool_records_plan_note_in_health(self):
+        with fast_pool() as pool:
+            pool.load("alpha", FakeEngine("fake/alpha-7b"),
+                      plan_note="fits: 1.0 GiB headroom at dp1")
+            doc = pool.health()
+        assert doc["replicas"][0]["plan"].startswith("fits:")
+
+
+# ---------------------------------------------------------------------------
+# serve CLI: --pool-replicas
+# ---------------------------------------------------------------------------
+
+class TestServeCliPool:
+    def test_jsonl_driver_over_shared_pool(self):
+        """serve --pool-replicas: the JSONL driver answers every line in
+        input order through the pool front door; siblings share one
+        param tree and the LAST unload releases it (verified teardown
+        at pool close)."""
+        import io
+        import json
+
+        from llm_interpretation_replication_tpu.serve import cli as serve_cli
+
+        eng, _, _ = _tiny_engine(batch_size=4)
+        leaf = jax.tree_util.tree_leaves(eng.params)[0]
+        pool = serve_cli.build_shared_pool(
+            eng, "tiny", 2, SchedulerConfig(max_batch=4, max_wait_s=0.005))
+        try:
+            groups = {id(r.share_group) for r in pool.replicas()}
+            assert len(groups) == 1        # one refcounted owner group
+            lines = "\n".join(json.dumps({"prompt": f"Is thing {i} a stuff?"})
+                              for i in range(4))
+            out = io.StringIO()
+            summary = serve_cli.run_jsonl_driver(
+                eng, io.StringIO(lines), out,
+                SchedulerConfig(max_batch=4), pool=pool)
+        finally:
+            pool.close()
+        assert summary == {"requests": 4, "errors": 0}
+        rows = [json.loads(l) for l in out.getvalue().splitlines()]
+        assert [r["id"] for r in rows] == [0, 1, 2, 3]
+        assert all(r["success"] for r in rows)
+        # pool close tore the shared snapshot down through the owning
+        # sibling — the census contract, not GC luck
+        assert leaf.is_deleted()
+
+    def test_request_lines_accept_model_key(self):
+        from llm_interpretation_replication_tpu.serve import cli as serve_cli
+
+        req = serve_cli.parse_request_line({"prompt": "q", "model": "m"})
+        assert req.model == "m"
+
+
+# ---------------------------------------------------------------------------
+# bench --serve-load over the pool (acceptance)
+# ---------------------------------------------------------------------------
+
+class TestBenchPoolServeLoad:
+    def test_bench_emits_serve_load_block_per_pool_configuration(
+            self, tmp_path):
+        """Acceptance (ISSUE 12): the pool runs through the SAME bench
+        --serve-load harness — one serve_load block per configuration
+        (single-model-x2 replicas AND a multi-model roster), each with
+        >= 3 rate points, per-replica health/plan notes, and the
+        row-parity contract intact."""
+        import bench
+        import jax as _jax
+        import jax.numpy as jnp
+        from test_bench import TINY, _args
+        from llm_interpretation_replication_tpu.models.decoder import (
+            DecoderConfig,
+        )
+
+        cfg = DecoderConfig(**TINY)
+        params = bench.init_params(cfg, _jax.random.PRNGKey(0),
+                                   jnp.float32)
+        args = _args(tmp_path, batch=8)
+        args.sweep_repeats = 1
+        args.serve_load = True
+        args.serve_load_rates = "auto"
+        args.serve_load_duration = 0.4
+        args.serve_load_seed = 0
+        args.serve_load_replicas = 2
+        bench.run_sweep_mode(args, cfg, params)
+        block = args.serve_load_pool_report
+        assert block["replicas"] == 2
+        names = [c["name"] for c in block["configurations"]]
+        assert names == ["single-model-x2", "multi-model"]
+        for conf in block["configurations"]:
+            assert len(conf["replicas"]) == 2
+            sl = conf["serve_load"]
+            assert len(sl["rates"]) >= 3
+            assert sl["parity_ok"] is True
+            for point in sl["rates"]:
+                assert {"p50", "p90", "p99", "p99.9"} <= set(
+                    point["latency_ms"])
+        # multi-model configuration really hosts two models
+        multi = block["configurations"][1]
+        assert len({r["model"] for r in multi["replicas"]}) == 2
