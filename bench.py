@@ -981,6 +981,78 @@ def _packed_secondary(args, engine, prompts, targets, isolated_rows) -> dict:
     return report
 
 
+def _distill_bench_k_head(args, engine, scenarios, prompts_by_scenario,
+                          label="sweep-full"):
+    """Self-distill the engine's K-head on the sweep's own texts (both
+    legs' formats) when ``--decode-k`` > 1 — AFTER calibration swapped in
+    the final params (a head distilled on stale weights still verifies
+    safely, it just rejects) and BEFORE warmup, so the verify programs
+    compile untimed with everything else.  Re-run after any later param
+    swap (the EOS-typical bracket leg)."""
+    import time as timemod
+
+    if (getattr(args, "decode_k", 1) or 1) <= 1:
+        return
+    sample = [p for ps in prompts_by_scenario for p in ps][:24]
+    sample += [f"{r} {s['confidence_format']}" for s in scenarios
+               for r in s["rephrasings"][:2]][:8]
+    t0 = timemod.perf_counter()
+    engine.distill_k_head_on(sample)
+    print(f"# {label}: K-head distilled for decode_k={args.decode_k} on "
+          f"{min(len(sample), 32)} sample prompts "
+          f"({timemod.perf_counter() - t0:.1f}s)", file=sys.stderr)
+
+
+def _k_decode_block(args) -> "dict | None":
+    """The ``k_decode`` block for sweep-full records (ISSUE 13): the
+    configured vs plan-search-predicted K, the measured accepted-K
+    distribution (telemetry ``accepted_k`` histogram, scoped to the
+    measured repeats like the context counters), per-leg steps saved,
+    and the block reject rate — everything the next driver run needs to
+    measure the multiplier per leg and recalibrate K_ACCEPT_PRIOR."""
+    k = int(getattr(args, "decode_k", 1) or 1)
+    predicted = getattr(args, "predicted_k", None)
+    if k <= 1 and predicted is None:
+        return None
+    from llm_interpretation_replication_tpu.utils.telemetry import (
+        HIST_GROWTH,
+        hist_bucket_le,
+    )
+
+    c = getattr(args, "context_counters", None) or {}
+    hist = getattr(args, "k_hist", None) or {}
+    proposed = int(c.get("k_blocks_proposed", 0))
+    rejected = int(c.get("k_blocks_rejected", 0))
+    # recover the INTEGER accepted-K each log bucket holds: accepted
+    # lengths are small ints and the 2^(1/8) growth keeps consecutive
+    # ints in distinct buckets through K ~ 11, so rounding the bucket's
+    # geometric midpoint (le / sqrt(growth) — the upper bound itself can
+    # round UP past the content, e.g. le(8) = 8.72) is exact for every
+    # K the engine can record — the driver's K_ACCEPT_PRIOR
+    # recalibration reads these labels as K values
+    mid = HIST_GROWTH ** 0.5
+    return {
+        "decode_k": k,
+        "predicted_k": predicted,
+        "accepted_k_hist": {
+            str(int(round(hist_bucket_le(idx) / mid))): int(n)
+            for idx, n in sorted(hist.get("counts", {}).items())
+        },
+        "accepted_k_mean": (round(hist["sum"] / hist["count"], 3)
+                            if hist.get("count") else None),
+        "k_steps_saved": {
+            "total": int(c.get("k_steps_saved", 0)),
+            "confidence": int(c.get("k_steps_saved|leg=confidence", 0)),
+            "completion": int(c.get("k_steps_saved|leg=completion", 0)),
+        },
+        "k_blocks_proposed": proposed,
+        "k_blocks_rejected": rejected,
+        "k_reject_rate": (round(rejected / proposed, 4)
+                          if proposed else None),
+        "head_missing": bool(c.get("k_decode_head_missing")),
+    }
+
+
 def run_sweep_full_mode(args, cfg, params):
     """Full-study row contract, end to end, through the REAL sweep shell
     (sweeps/perturbation.run_model_perturbation_sweep): per rephrasing, the
@@ -1028,6 +1100,7 @@ def run_sweep_full_mode(args, cfg, params):
             pipeline_depth=args.pipeline_depth,
             kv_dtype=getattr(args, "kv_dtype", "bf16") or "bf16",
             prefill_chunk=getattr(args, "prefill_chunk", 0) or 0,
+            decode_k=getattr(args, "decode_k", 1) or 1,
             # measured operating point: repeat-level step-down only (the
             # engine's silent per-batch degradation would skew the record)
             oom_backoff=False,
@@ -1058,6 +1131,7 @@ def run_sweep_full_mode(args, cfg, params):
           f"confidence), calibrated position-0 hit rate {measured_rate:.2f}, "
           f"prefix reuse {'ON (fused legs)' if fuse else 'OFF'}",
           file=sys.stderr)
+    _distill_bench_k_head(args, engine, scenarios, prompts_by_scenario)
 
     if getattr(args, "warmup", True):
         # Explicit bucket warmup (engine.warmup): compile — or deserialize
@@ -1107,11 +1181,16 @@ def run_sweep_full_mode(args, cfg, params):
             print(f"# warmup failed ({err}); repeat 0 compiles inline",
                   file=sys.stderr)
 
-    from llm_interpretation_replication_tpu.utils.telemetry import counters
+    from llm_interpretation_replication_tpu.utils.telemetry import (
+        counters,
+        hist_snapshot,
+    )
 
     # context-block counters scope to the measured repeats: the warmup
     # pass above also runs _prefill and must not inflate the record
+    # (the accepted_k histogram follows the same discipline)
     args.counters_snap = counters()
+    args.k_hist_snap = hist_snapshot(["accepted_k"])
     _obs_phase_snap(args)
     best_dt = float("inf")
     last_ok_path = None
@@ -1195,6 +1274,12 @@ def run_sweep_full_mode(args, cfg, params):
     # cache frees must not leak into a record whose context names the
     # no-EOS bracket (_operating_context prefers this snapshot)
     args.context_counters = dict(c_main)
+    from llm_interpretation_replication_tpu.utils.telemetry import (
+        hist_since as _hist_since,
+    )
+
+    args.k_hist = _hist_since(
+        getattr(args, "k_hist_snap", None) or {}).get("accepted_k")
     main_mode = ("eos-typical" if getattr(args, "eos_mode", "none")
                  == "typical" else "no-eos")
     brackets = [_bracket_row(main_mode, n_total / best_dt, args.eos_rate,
@@ -1211,6 +1296,12 @@ def run_sweep_full_mode(args, cfg, params):
                 params, cfg, engine, scenarios, prompts_by_scenario,
                 args.decided_frac, eos_id)
             engine.params = eparams
+            # the bracket swaps params, so the K-head re-distills on the
+            # EOS-boosted weights (its continuations now end in EOS —
+            # exactly what the heads must learn to propose)
+            _distill_bench_k_head(args, engine, scenarios,
+                                  prompts_by_scenario,
+                                  label="sweep-full eos-bracket")
             snap = counters()
             out_b = os.path.join(
                 tempfile.mkdtemp(prefix="bench_sweep_full_eos_"),
@@ -1289,6 +1380,10 @@ def _bracket_row(eos_mode: str, rows_per_s: float, eos_rate, decided_rate,
     if counter_delta.get("completion_cache_bytes_freed"):
         row["completion_cache_gib_freed"] = round(
             counter_delta["completion_cache_bytes_freed"] / n / 2**30, 3)
+    if counter_delta.get("k_steps_saved"):
+        # joint K-decode savings per bracket (ISSUE 13): the EOS-typical
+        # bracket is where accepted blocks cover whole completions
+        row["k_steps_saved"] = int(counter_delta["k_steps_saved"] / n)
     return row
 
 
@@ -1304,6 +1399,12 @@ def _full_study_record(a, rps: float, rate: float) -> dict:
     bracket_tag = ("EOS-typical decode bracket"
                    if getattr(a, "eos_mode", "none") == "typical"
                    else "no-EOS worst case")
+    # the K tag folds into the metric text so bench-diff's alignment key
+    # (obs/benchdiff._shape_tags) never cross-compares a joint-K-decode
+    # run with the sequential workload shape; K=1 stays untagged so
+    # legacy records keep aligning
+    k_tag = (f", joint decode-k {a.decode_k}"
+             if (getattr(a, "decode_k", 1) or 1) > 1 else "")
     record = {
         "metric": (
             f"full-study rows/sec/chip (END-TO-END perturbation "
@@ -1313,7 +1414,7 @@ def _full_study_record(a, rps: float, rate: float) -> dict:
             f"{a.model} geometry, "
             f"{'w8a8 int8' if a.quant == 'int8' else 'bf16'}, "
             f"batch {a.sweep_batch}, measured position-0 hit "
-            f"rate {rate:.2f}, {bracket_tag})"
+            f"rate {rate:.2f}, {bracket_tag}{k_tag})"
         ),
         "value": round(rps, 2),
         "unit": "rows/sec",
@@ -1327,6 +1428,11 @@ def _full_study_record(a, rps: float, rate: float) -> dict:
         # the decode early-stop span is a recorded number, with
         # decode_steps_saved/cache frees per bracket
         record["brackets"] = a.brackets_report
+    k_block = _k_decode_block(a)
+    if k_block:
+        # joint K-decode telemetry (ISSUE 13): accepted-K distribution,
+        # per-leg steps saved, reject rate, predicted-vs-configured K
+        record["k_decode"] = k_block
     record.update(_repeat_report(a))
     record.update(_operating_context(a))
     if getattr(a, "plan_search_report", None):
@@ -1419,6 +1525,10 @@ def _full_study_secondary(args, cfg, geometry, params) -> dict:
             child.pool_target = best.pool_target
             child.fit_decision = best.reason
             child.predicted_batch = best.batch
+            # the priced K axis rides the secondary's own full-workload
+            # search, like batch/kv/chunk/pool (ISSUE 13)
+            child.decode_k = best.decode_k
+            child.predicted_k = best.decode_k
         else:
             # same fallback a direct --mode sweep-full run takes: no
             # fitting full-workload candidate means the fixed-plan
@@ -1671,7 +1781,16 @@ def _operating_context(args) -> dict:
         ctx["eos_rate"] = round(float(args.eos_rate), 3)
     if getattr(args, "mode", "") == "sweep-packed":
         ctx["packed"] = int(getattr(args, "packed", 0) or 0)
-    for name in ("decode_steps_saved", "packed_rows", "packed_questions"):
+    if (getattr(args, "decode_k", 1) or 1) > 1 and \
+            getattr(args, "mode", "") == "sweep-full":
+        # the joint-K operating point is part of the record's identity
+        # (bench-diff keys on it); K=1 stays absent like the other
+        # default-off knobs, and only the full-study mode actually runs
+        # the decode legs the knob touches (the sweep mode's secondary
+        # carries its own sweep-full child namespace)
+        ctx["decode_k"] = int(args.decode_k)
+    for name in ("decode_steps_saved", "packed_rows", "packed_questions",
+                 "k_steps_saved", "k_blocks_proposed", "k_blocks_rejected"):
         if c.get(name):
             ctx[name] = int(c[name])
     if getattr(args, "pool_max_bytes", 0):
@@ -1869,6 +1988,22 @@ def main():
                              "runtime/engine._Phase2Pool).  "
                              "--no-pooled-confidence measures the r5 "
                              "per-batch decode")
+    parser.add_argument("--decode-k", type=int, default=1, metavar="K",
+                        help="sweep-full mode (and the sweep mode's "
+                             "full-study secondary): joint next-K-token "
+                             "decode with verify-and-accept on both decode "
+                             "legs (models/decoder.k_verify_block) — a "
+                             "K-head self-distilled on the calibrated "
+                             "weights proposes K tokens per pass, one "
+                             "joint program verifies them against the "
+                             "single-step argmax path, accepted blocks "
+                             "are bit-identical to the sequential decode "
+                             "and rejections fall back to it.  The record "
+                             "gains a k_decode block (accepted-K "
+                             "distribution, per-leg steps saved, reject "
+                             "rate).  1 = sequential (default); "
+                             "--plan-search may override with the priced "
+                             "K axis")
     parser.add_argument("--pipeline-depth", type=int, default=None,
                         metavar="N",
                         help="sweep modes: in-flight device batches (host "
@@ -2428,12 +2563,21 @@ def main():
                 if workload == "packed":
                     # the packing factor is part of the chosen plan too
                     args.packed = best.packing
+                if workload == "full":
+                    # the priced K axis (ISSUE 13): the chosen block size
+                    # overrides --decode-k like every other plan knob, and
+                    # predicted_k rides into the k_decode block so the
+                    # record names prediction vs configuration
+                    args.decode_k = best.decode_k
+                    args.predicted_k = best.decode_k
                 print(f"# plan search: running chosen plan batch "
                       f"{best.batch} kv {best.kv_dtype} chunk "
                       f"{best.prefill_chunk} pool "
                       f"{best.pool_target or 'batch'} "
                       + (f"packing {best.packing} "
                          if workload == "packed" else "")
+                      + (f"decode-k {best.decode_k} "
+                         if best.decode_k > 1 else "")
                       + f"({best.predicted_rows_per_s:.1f} predicted "
                       f"rows/s)", file=sys.stderr)
         sweep_plan = None

@@ -90,6 +90,15 @@ def _add_run_config_args(p: argparse.ArgumentParser):
     p.add_argument("--phase2-pool-target", type=int, default=0, metavar="N",
                    help="rows per pooled phase-2 decode (binary undecided "
                         "pool AND confidence pool); 0 = batch size")
+    p.add_argument("--decode-k", type=int, default=1, metavar="K",
+                   help="joint next-K-token decode with verify-and-accept "
+                        "(models/decoder.k_verify_block): a K-head "
+                        "distilled on sample corpus prompts proposes K "
+                        "tokens per pass and one joint program verifies "
+                        "them against the single-step argmax path — "
+                        "accepted blocks are bit-identical to the "
+                        "sequential decode, rejections fall back to it "
+                        "(PARITY.md 'K-decode'); 1 = sequential (default)")
     p.add_argument("--plan-search", action="store_true",
                    help="auto-parallel plan search (runtime/plan_search.py)"
                         ": enumerate mesh x batch x kv-dtype x "
@@ -113,6 +122,7 @@ def _run_config(args):
         kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
         pooled_confidence=getattr(args, "pooled_confidence", True),
         phase2_pool_target=getattr(args, "phase2_pool_target", 0),
+        decode_k=getattr(args, "decode_k", 1),
         plan_search=getattr(args, "plan_search", False),
         attention_impl=args.attention_impl,
         mesh_model=args.mesh_model,
@@ -159,6 +169,7 @@ def _engine_factory(run_config):
                 prefill_chunk=rc.prefill_chunk,
                 pooled_confidence=rc.pooled_confidence,
                 phase2_pool_target=rc.phase2_pool_target,
+                decode_k=getattr(rc, "decode_k", 1),
             ),
         )
         engine.plan_decision = plan_note
@@ -208,12 +219,15 @@ def _searched_run_config(rc, path, mesh):
         # unconditional: pool_target 0 IS the chosen plan's pool-at-batch
         # configuration, not "keep the flag"
         phase2_pool_target=best.pool_target,
+        decode_k=getattr(best, "decode_k", 1),
         mesh_model=best.model)
     if best.data * best.model > 1:
         mesh = make_mesh(data=best.data, model=best.model)
     note = (f"plan search chose mesh dp{best.data}xtp{best.model} batch "
-            f"{best.batch} kv {best.kv_dtype} chunk {best.prefill_chunk} "
-            f"({best.reason})")
+            f"{best.batch} kv {best.kv_dtype} chunk {best.prefill_chunk}"
+            + (f" decode-k {best.decode_k}"
+               if getattr(best, "decode_k", 1) > 1 else "")
+            + f" ({best.reason})")
     print(f"# {note}", file=sys.stderr)
     return rc, mesh, note
 
@@ -320,6 +334,17 @@ def cmd_run_perturbation(args):
     rc = _run_config(args)
     scenarios = load_perturbations(args.perturbations, expected_scenarios=legal_scenarios())
     engine = _engine_factory(rc)(args.model)
+    if getattr(engine.ecfg, "decode_k", 1) > 1:
+        # K-head self-distillation on the sweep's own texts (both legs'
+        # formats — the continuations the decode legs will replay); a
+        # verify-and-accept head can only cost rejections, never rows
+        sample = [f"{r} {s['response_format']}" for s in scenarios
+                  for r in s["rephrasings"][:3]][:24]
+        sample += [f"{r} {s['confidence_format']}" for s in scenarios
+                   for r in s["rephrasings"][:2]][:12]
+        engine.distill_k_head_on(sample)
+        print(f"# K-head distilled for decode_k={engine.ecfg.decode_k} "
+              f"on {min(len(sample), 32)} sample prompts", file=sys.stderr)
     if getattr(args, "packed", 0):
         # packed multi-question batching (scoring/packed.py): Q rephrasings
         # per prefill, anchor-gathered binary leg, measured-drift contract

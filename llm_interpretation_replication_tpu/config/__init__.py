@@ -148,6 +148,11 @@ class RunConfig:
                                          # EngineConfig.pooled_confidence)
     phase2_pool_target: int = 0          # rows per pooled decode (binary +
                                          # confidence pools); 0 = batch_size
+    decode_k: int = 1                    # joint next-K-token decode block
+                                         # size (verify-and-accept —
+                                         # runtime/engine EngineConfig.
+                                         # decode_k); 1 = sequential
+
     plan_search: bool = False            # auto-parallel plan search (runtime/
                                          # plan_search.py): pick batch/
                                          # kv-dtype/prefill-chunk/mesh from

@@ -401,7 +401,12 @@ def _embed(cfg: DecoderConfig, params, token_ids, positions):
     return x
 
 
-def _unembed(cfg: DecoderConfig, params, x):
+def _unembed_hidden(cfg: DecoderConfig, params, x):
+    """(final-normed hidden, fp32 logits) — the two halves of
+    :func:`_unembed`.  The K-token verify path (``k_verify_block``) needs
+    the hidden its K-head proposals project from AND the logits, computed
+    by exactly the ops every other path runs, so the split lives here and
+    ``_unembed`` stays a thin wrapper (bit-identical by construction)."""
     if cfg.final_norm:
         x = _norm(cfg, x, params["final_ln"])
     table = params.get("lm_head")
@@ -419,7 +424,11 @@ def _unembed(cfg: DecoderConfig, params, x):
     bias = params.get("lm_head_bias")          # GPT-J ships an lm_head bias
     if bias is not None:
         logits = logits + bias.astype(jnp.float32)
-    return logits
+    return x, logits
+
+
+def _unembed(cfg: DecoderConfig, params, x):
+    return _unembed_hidden(cfg, params, x)[1]
 
 
 def run_layers(cfg: DecoderConfig, layers, x, positions, attention_mask):
@@ -963,6 +972,308 @@ def greedy_decode(
         eos_token_id, jnp.zeros((b,), bool), True,
     )
     return tokens, scores
+
+
+# ---------------------------------------------------------------------------
+# Joint next-K-token decode with verify-and-accept (K-Forcing, 2606.10820)
+# ---------------------------------------------------------------------------
+#
+# Every decode in this system is a short, highly predictable continuation
+# (confidence digits, EOS-terminated completions), so a lightweight K-head
+# — per-offset logit projections off the LAST final-normed hidden state —
+# proposes the next K tokens and ONE joint forward pass over the proposed
+# block verifies them against the single-step argmax path.  The verify
+# pass reuses the decode path's own machinery (`_block_decode`, the
+# two-block split-softmax attention, the same per-chunk tail buffer and
+# end-of-chunk fold), so a fully-accepted block reproduces the sequential
+# `decode_steps` scan EXACTLY in tokens — and everything derived from
+# them: completion text, first-int parses, EOS stops, retirement points —
+# and reproduces its logits/scores to fp32 REDUCTION-ORDER NOISE, the
+# chunked-prefill equivalence class (that function's docstring): the
+# per-row math is identical, but a K-query pass may group summations
+# differently from K single-query steps in the last ulp on some
+# geometries/backends (measured on the CPU harness: single-query blocks
+# are bit-identical, multi-query blocks drift <= 1 ulp — PARITY.md
+# "K-decode").  Any proposal mismatch is a rejection: the caller discards
+# the pass WHOLESALE and re-runs the chunk through the unchanged
+# sequential loop (runtime/engine._k_decode_chunk), which is bit-
+# identical by identity — so a bad K-head can only cost wasted passes,
+# never a wrong row.  On weight-streaming-bound decode hardware the
+# accepted pass streams the weights ONCE for K tokens instead of K times
+# — the multiplier the bench's k_decode block measures.
+
+
+class KVerifyOut(NamedTuple):
+    """One joint verification pass over a proposed K-token block."""
+    tokens: jnp.ndarray          # [B, kb] TRUE tokens (argmax/EOS chain)
+    scores: Optional[object]     # ReducedScores | [B, kb, V] fp32 | None
+    last_logits: jnp.ndarray     # [B, V] fp32 — predicts the next position
+    last_hidden: jnp.ndarray     # [B, H] final-normed hidden at the last
+    #                            # block position (the K-head's input for
+    #                            # the next block's proposals)
+    done: jnp.ndarray            # [B] EOS-done after the TRUE chain
+    a_len: jnp.ndarray           # [B] int32 leading proposals that match
+    accepted: jnp.ndarray        # [B] bool — the whole block matched
+    tail_k: jnp.ndarray          # updated chunk tail buffers
+    tail_v: jnp.ndarray
+    cache: Optional[KVCache]     # folded cache when ``fold`` (else None)
+
+
+def k_head_num_heads(k_head) -> int:
+    """Look-ahead heads a K-head params tree carries (proposal block size
+    = 1 + this: position 0 is always the free, exact argmax)."""
+    if k_head is None:
+        return 0
+    return int(k_head["w"].shape[0])
+
+
+def init_k_head(cfg: DecoderConfig, k: int, seed: int = 0, dtype=None):
+    """Random K-head: ``k - 1`` per-offset logit projections [H, V] off
+    the last hidden state.  Random proposals verify-and-REJECT almost
+    everywhere — correctness never depends on head quality — so this is
+    the forced-rejection test fixture and the cold-start shape;
+    :func:`distill_k_head` is what makes proposals land."""
+    import numpy as np
+
+    heads = max(0, int(k) - 1)
+    rng = np.random.default_rng(seed)
+    w = rng.standard_normal(
+        (heads, cfg.hidden_size, cfg.vocab_size)).astype(np.float32) * 0.02
+    return {"w": jnp.asarray(w, dtype) if dtype else jnp.asarray(w)}
+
+
+def distill_k_head(params, cfg: DecoderConfig, token_ids, attention_mask,
+                   k: int, eos_token_id: Optional[int] = None,
+                   gen_steps: Optional[int] = None, ridge: float = 1e-4):
+    """Greedy self-distillation of the K-head on sample prompts.
+
+    Teacher-force the model's OWN greedy continuations: generate
+    ``gen_steps`` tokens per row, run one full forward over
+    [prompt + continuation], and fit each offset's projection ``W_i`` as
+    a ridge linear probe from the final-normed hidden at position ``t``
+    to the one-hot greedy token at ``t + 1 + i`` — hidden states along
+    the greedy path, i.e. the exact inputs the head sees at decode time.
+    Closed-form normal equations on host (no optimizer dependency); the
+    probe only has to beat the verify-and-accept floor, never be exact —
+    a miss costs one rejected block, not a wrong row."""
+    import numpy as np
+
+    heads = max(0, int(k) - 1)
+    if heads == 0:
+        return {"w": jnp.zeros((0, cfg.hidden_size, cfg.vocab_size))}
+    gen = int(gen_steps or (k + 4))
+    ids = jnp.asarray(token_ids)
+    mask = jnp.asarray(attention_mask)
+    b = ids.shape[0]
+    toks, _ = greedy_decode(params, cfg, ids, mask, num_steps=gen,
+                            eos_token_id=eos_token_id)
+    seq = jnp.concatenate([ids, toks], axis=1)
+    full_mask = jnp.concatenate(
+        [mask, jnp.ones((b, gen), mask.dtype)], axis=1)
+    x, _ = _trunk(params, cfg, seq, full_mask, None)
+    hidden, _ = _unembed_hidden(cfg, params, x)          # [B, S+gen, H]
+    hid = np.asarray(hidden, np.float32)
+    toks_np = np.asarray(toks)
+    lens = np.asarray(jnp.sum(mask, axis=-1))
+    s = ids.shape[1]
+    h_dim, v = cfg.hidden_size, cfg.vocab_size
+    ws = []
+    for i in range(1, heads + 1):
+        feats, targets = [], []
+        for r in range(b):
+            # ARRAY SLOTS vs POSITIONS: prompts are right-padded, so the
+            # greedy region always sits at slots [s, s+gen) while its
+            # positions continue the row's real run — the frontier hidden
+            # (position len-1) lives at slot len-1, greedy token j at
+            # slot s+j.  Hidden at position p trains head i on the greedy
+            # token at position p + 1 + i.
+            if i < gen:
+                feats.append(hid[r, int(lens[r]) - 1])
+                targets.append(int(toks_np[r, i]))
+            for jj in range(0, gen - 1 - i):
+                feats.append(hid[r, s + jj])
+                targets.append(int(toks_np[r, jj + 1 + i]))
+        if not feats:
+            ws.append(np.zeros((h_dim, v), np.float32))
+            continue
+        hm = np.stack(feats)                              # [N, H]
+        y = np.zeros((len(targets), v), np.float32)
+        y[np.arange(len(targets)), targets] = 1.0
+        a = hm.T @ hm + ridge * max(1, len(feats)) * np.eye(h_dim,
+                                                           dtype=np.float32)
+        ws.append(np.linalg.solve(a, hm.T @ y))           # [H, V]
+    # store in the WEIGHTS dtype (bf16 on TPU): the head is a second
+    # lm_head and plan.k_head_bytes prices it at the weights' width — a
+    # resident fp32 copy would pin 2x the budgeted HBM
+    return {"w": jnp.asarray(np.stack(ws),
+                             params["embed"]["tokens"].dtype)}
+
+
+@functools.partial(jax.jit, static_argnames=("k",))
+def k_propose(k_head, hidden, prev_logits, k: int, done=None,
+              eos_token_id: Optional[int] = None):
+    """[B, k] proposed next tokens: position 0 is the free, exact
+    ``argmax(prev_logits)``; positions 1..k-1 project ``hidden`` (the
+    last final-normed hidden state) through the K-head's per-offset
+    matrices.  Rows already EOS-done propose ``eos`` throughout — the
+    frozen continuation the sequential path emits."""
+    cols = [jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)]
+    for i in range(1, k):
+        logits_i = lax.dot_general(
+            hidden, k_head["w"][i - 1].astype(hidden.dtype),
+            (((hidden.ndim - 1,), (0,)), ((), ())),
+            preferred_element_type=jnp.float32,
+        )
+        cols.append(jnp.argmax(logits_i, axis=-1).astype(jnp.int32))
+    props = jnp.stack(cols, axis=1)
+    if eos_token_id is not None and done is not None:
+        props = jnp.where(done[:, None], eos_token_id, props)
+    return props
+
+
+def _k_verify_block_impl(params, cfg: DecoderConfig, cache: KVCache,
+                         tail_k, tail_v, prev_logits, lengths, offset,
+                         block_start, proposals, eos_token_id, done,
+                         target_ids, with_scores, fold: bool):
+    """Body of :func:`k_verify_block` (split like ``_decode_steps_impl``
+    so the trace-time structure branches — quantized-vs-bf16 cache, the
+    reduced-score mode — stay outside the jit decoration)."""
+    b, kb = proposals.shape
+    n = tail_k.shape[2]
+    quantized = cache.k_scale is not None
+    if done is None:
+        done = jnp.zeros((b,), bool)
+    if with_scores == "reduced" and target_ids is None:
+        raise ValueError("with_scores='reduced' needs target_ids [B, 2]")
+    cdt = params["embed"]["tokens"].dtype if quantized else cache.k.dtype
+    q_pos = lengths[:, None] + offset + block_start + jnp.arange(kb)[None, :]
+    tail_positions = lengths[:, None] + offset + jnp.arange(n)[None, :]
+    # slots of earlier blocks stay visible; later slots are masked out —
+    # causality WITHIN the block comes from the position comparison in
+    # make_attention_bias, exactly like decode_steps' step mask
+    tail_valid = jnp.broadcast_to(
+        jnp.arange(n)[None, :] < block_start + kb, (b, n))
+    bias_p = make_attention_bias(cfg, q_pos, cache.positions, cache.valid)
+    bias_t = make_attention_bias(cfg, q_pos, tail_positions, tail_valid)
+    sin_cos = None
+    if cfg.position_embedding == "rotary":
+        rd = int(cfg.rotary_pct * cfg.head_dim) // 2 * 2
+        sin_cos = rotary_embedding(q_pos, rd, cfg.rope_theta, cdt)
+    x = _embed(cfg, params, proposals, q_pos)
+
+    def body(h, xs):
+        if quantized:
+            lp, kp_l, vp_l, ks_l, vs_l, tk_l, tv_l = xs
+        else:
+            (lp, kp_l, vp_l, tk_l, tv_l), ks_l, vs_l = xs, None, None
+        h, (tk_l, tv_l) = _block_decode(
+            cfg, lp, h, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l, tv_l,
+            block_start, ks_l, vs_l
+        )
+        return h, (tk_l, tv_l)
+
+    layer_xs = (
+        (params["layers"], cache.k, cache.v, cache.k_scale,
+         cache.v_scale, tail_k, tail_v)
+        if quantized
+        else (params["layers"], cache.k, cache.v, tail_k, tail_v))
+    x, (tail_k, tail_v) = lax.scan(body, x, layer_xs)
+    hidden, logits_blk = _unembed_hidden(cfg, params, x)  # [B,kb,H/V]
+    # logits predicting block position i: prev_logits for i=0, the pass's
+    # own logits at i-1 after — the sequential scan's score convention
+    pred = jnp.concatenate([prev_logits[:, None], logits_blk[:, :-1]],
+                           axis=1)
+    reduced = with_scores == "reduced"
+
+    def chain(done_b, pred_i):
+        # per-position ops on [B, V] slices — the EXACT spellings
+        # _decode_steps_impl's step body runs, so stats/argmaxes can
+        # never drift from the sequential path's
+        nt = jnp.argmax(pred_i, axis=-1).astype(jnp.int32)
+        if eos_token_id is not None:
+            nt = jnp.where(done_b, eos_token_id, nt)
+            done_b = done_b | (nt == eos_token_id)
+        out = (nt, _reduce_step_scores(pred_i, target_ids)) if reduced \
+            else (nt,)
+        return done_b, out
+
+    done_out, outs = lax.scan(chain, done, jnp.swapaxes(pred, 0, 1))
+    true_toks = jnp.swapaxes(outs[0], 0, 1)              # [B, kb]
+    if reduced:
+        s_vals, s_ids, s_logz, s_tgt = outs[1]
+        scores = ReducedScores(
+            jnp.swapaxes(s_vals, 0, 1), jnp.swapaxes(s_ids, 0, 1),
+            jnp.swapaxes(s_logz, 0, 1), jnp.swapaxes(s_tgt, 0, 1))
+    elif with_scores:
+        scores = pred
+    else:
+        scores = None
+    match = proposals == true_toks
+    a_len = jnp.cumprod(match.astype(jnp.int32), axis=1).sum(axis=1)
+    new_cache = None
+    if fold:
+        # end-of-chunk fold, byte-for-byte decode_steps' (int8 caches
+        # quantize the whole tail here, once — same quantization points)
+        fk, fv = tail_k, tail_v
+        if quantized:
+            fk, tk_s = quant.quantize_kv(fk)
+            fv, tv_s = quant.quantize_kv(fv)
+        new_cache = KVCache(
+            k=jnp.concatenate([cache.k, fk], axis=2),
+            v=jnp.concatenate([cache.v, fv], axis=2),
+            positions=jnp.concatenate([cache.positions, tail_positions],
+                                      axis=1),
+            valid=jnp.concatenate([cache.valid, jnp.ones((b, n), bool)],
+                                  axis=1),
+            length=cache.length + n,
+            k_scale=(jnp.concatenate([cache.k_scale, tk_s], axis=2)
+                     if quantized else None),
+            v_scale=(jnp.concatenate([cache.v_scale, tv_s], axis=2)
+                     if quantized else None),
+        )
+    return KVerifyOut(true_toks, scores, logits_blk[:, -1],
+                      hidden[:, -1], done_out, a_len, a_len == kb,
+                      tail_k, tail_v, new_cache)
+
+
+@functools.partial(jax.jit,
+                   static_argnames=("cfg", "with_scores", "fold"))
+def k_verify_block(params, cfg: DecoderConfig, cache: KVCache, tail_k,
+                   tail_v, prev_logits, lengths, offset, block_start,
+                   proposals, eos_token_id: Optional[int] = None,
+                   done=None, target_ids=None, with_scores="reduced",
+                   fold: bool = False):
+    """ONE joint forward over a proposed token block + in-program
+    verification against the single-step argmax path.
+
+    The block's ``kb`` proposed tokens run as ``kb`` parallel queries
+    through the SAME per-layer machinery the sequential scan uses
+    (`_block_decode`: two-block split-softmax over the read-only cache
+    plus the chunk's ``n``-slot tail, K/V landing in tail slots
+    ``block_start..block_start+kb``), so when every proposal matches the
+    argmax chain the pass reproduces ``decode_steps`` over the same
+    chunk exactly in tokens and to fp32 reduction-order noise in
+    logits/scores (single-query blocks bit-identically) — pinned by
+    tests, the engine's verify-and-accept contract (PARITY.md
+    "K-decode").
+
+    In-program acceptance: the TRUE token at block position ``i`` is the
+    EOS-frozen argmax of the logits predicting it (position 0:
+    ``prev_logits``; later: the pass's own logits at ``i - 1``), exactly
+    the sequential chain.  ``a_len`` counts leading proposal matches per
+    row; a row's outputs past its first mismatch are garbage BY
+    CONSTRUCTION (the wrong token's K/V contaminated its own row only),
+    which is why the engine consumes a pass only when every real row
+    accepted the whole block and otherwise falls back to the sequential
+    loop.  ``fold=True`` (the chunk's last block) folds the tail into
+    the cache with the exact end-of-chunk quantize+concat
+    ``decode_steps`` performs, so chunk boundaries — and therefore the
+    int8 quantization points — match the sequential path's."""
+    with jax.named_scope("k_verify"):  # profiler attribution (obs/)
+        return _k_verify_block_impl(
+            params, cfg, cache, tail_k, tail_v, prev_logits, lengths,
+            offset, block_start, proposals, eos_token_id, done,
+            target_ids, with_scores, fold)
 
 
 def _block_decode(cfg, lp, x, sin_cos, bias_p, bias_t, kp_l, vp_l, tk_l,

@@ -104,9 +104,10 @@ def metric_key(metric: str, unit: str) -> str:
 
 
 def _shape_tags(text: str) -> List[str]:
-    """The workload-SHAPE tags (decode bracket, packing factor) that must
-    never cross-compare — shared by :func:`metric_key` and the headline
-    key, which is otherwise positional.  No-EOS / isolated spellings stay
+    """The workload-SHAPE tags (decode bracket, packing factor, joint
+    K-decode block size) that must never cross-compare — shared by
+    :func:`metric_key` and the headline key, which is otherwise
+    positional.  No-EOS / isolated / sequential (K=1) spellings stay
     untagged so legacy records keep aligning."""
     tags = []
     if "eos-typical" in text:
@@ -114,6 +115,12 @@ def _shape_tags(text: str) -> List[str]:
     m = re.search(r"(?:q=|packing )(\d+)", text)
     if m:
         tags.append(f"q{m.group(1)}")
+    m = re.search(r"decode-k (\d+)", text)
+    if m and int(m.group(1)) > 1:
+        # an ISSUE-13 joint-K-decode run is a different workload shape
+        # from its sequential twin (the decode legs run different
+        # programs); K-tagged rows align only with K-tagged rows
+        tags.append(f"k{m.group(1)}")
     return tags
 
 
@@ -153,7 +160,44 @@ def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
         out[key] = {"value": entry.get("value"),
                     "unit": entry.get("unit", ""),
                     "metric": entry.get("metric", "")}
+    # k_decode blocks ride top-level on a sweep-full record and NESTED on
+    # the sweep record's full-study child secondary — flatten both (the
+    # brackets discipline); first spelling wins on a key collision
+    for holder in [rec] + [e for e in extra_rows if isinstance(e, dict)]:
+        for key, row in _k_decode_rows(holder).items():
+            out.setdefault(key, row)
     out.update(_serve_load_rows(rec))
+    return out
+
+
+def _k_decode_rows(rec: Dict) -> Dict[str, Dict]:
+    """Aligned rows from a record's ``k_decode`` block (ISSUE 13): the
+    per-leg steps saved, the mean accepted K, and the block reject rate
+    — informational rows (no regression verdict: steps-saved scale with
+    corpus size and the reject rate is a prior-calibration input, not a
+    perf promise), keyed by the leg name so rounds compare like for
+    like."""
+    block = rec.get("k_decode")
+    if not isinstance(block, dict):
+        return {}
+    out: Dict[str, Dict] = {}
+    k = block.get("decode_k")
+    saved = block.get("k_steps_saved") or {}
+    for leg in ("confidence", "completion"):
+        if saved.get(leg) is not None:
+            out[f"k-decode steps-saved ({leg})"] = {
+                "value": saved.get(leg), "unit": "",
+                "metric": f"joint decode-k {k} steps saved on the {leg} "
+                          f"leg (measured repeats)"}
+    if block.get("accepted_k_mean") is not None:
+        out["k-decode accepted-k mean"] = {
+            "value": block["accepted_k_mean"], "unit": "",
+            "metric": f"mean accepted block length at decode-k {k}"}
+    if block.get("k_reject_rate") is not None:
+        out["k-decode reject rate"] = {
+            "value": block["k_reject_rate"], "unit": "",
+            "metric": f"verify-and-accept block reject rate at "
+                      f"decode-k {k}"}
     return out
 
 

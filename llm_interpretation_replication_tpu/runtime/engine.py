@@ -28,7 +28,7 @@ from ..models import t5 as t5mod
 from ..obs import tracer as obs
 from ..scoring import yes_no as yn
 from ..scoring.confidence import weighted_confidence_digits
-from ..utils.telemetry import record_counter, record_fault
+from ..utils.telemetry import record_counter, record_fault, record_hist
 from . import batching, faults, strict
 from . import plan as plan_mod
 
@@ -165,6 +165,25 @@ class EngineConfig:
                                     # config at engine construction; not a
                                     # config_overrides-able knob (compiled
                                     # program families key on it).
+    decode_k: int = 1               # > 1: joint next-K-token decode with
+                                    # verify-and-accept (K-Forcing, arxiv
+                                    # 2606.10820): a K-head proposes up to
+                                    # this many tokens per pass and ONE
+                                    # joint verification program accepts
+                                    # the block only when every proposal
+                                    # matches the single-step argmax chain
+                                    # — accepted blocks reproduce the
+                                    # sequential decode exactly in tokens
+                                    # and to fp32 reduction-order noise in
+                                    # scores (PARITY.md "K-decode"),
+                                    # rejections fall back bit-identically
+                                    # to the unchanged step loop.  Engages on
+                                    # both decode legs (the pooled
+                                    # confidence scan and the completion
+                                    # chunk loop) once a K-head is set
+                                    # (ScoringEngine.distill_k_head_on);
+                                    # 1 = the existing sequential path,
+                                    # untouched.
     prefill_chunk: int = 0          # > 0: prompts whose bucket exceeds this
                                     # prefill in fixed-size chunks through
                                     # the suffix-extension path
@@ -340,6 +359,10 @@ class ScoringEngine:
         # the CLI engine factory); None = hand-configured.  Sweep shells
         # log it so every run names how its operating point was picked.
         self.plan_decision: Optional[str] = None
+        # K-head params for the joint next-K-token decode (models/decoder.
+        # k_propose); None with decode_k > 1 runs sequentially, noted once
+        self.k_head = None
+        self._k_head_missing_noted = False
         self._closed = False
 
     # -- lifecycle -------------------------------------------------------
@@ -395,6 +418,7 @@ class ScoringEngine:
                     except RuntimeError:
                         pass  # leaf shared with an already-closed sibling
         self.params = None
+        self.k_head = None
         self._plan_cache.clear()
         self._tok_text_cache: Dict[int, str] = {}
         record_counter("engine_closed")
@@ -908,6 +932,8 @@ class ScoringEngine:
             # batch (models/decoder.KVCache docstring).  Five cheap
             # compiles beat a relayout per batch.
             reduced = ecfg.top_k <= dmod.REDUCED_TOPK
+            use_k = self._k_active()
+            prev_h = None  # K-path frontier hidden (proposal input)
             prev, done, offset = last, None, 0
             chunk_toks, scores_dev = [], None
             lag_flag = None  # all-done flag of the PREVIOUS chunk
@@ -917,16 +943,39 @@ class ScoringEngine:
                 while offset < gen_total:
                     n = min(steps, gen_total - offset)
                     ws = offset == 0 and need_scores
-                    toks, sc, cache, prev, done = dmod.decode_steps(
-                        self.params, self.cfg, cache, prev, lengths,
-                        np.int32(offset), n, eos_id, done,
-                        with_scores=("reduced" if reduced else True) if ws else False,
-                        target_ids=jnp.asarray(row_ids) if ws and reduced else None,
-                    )
+                    if use_k:
+                        # joint K-token verify-and-accept over THIS chunk
+                        # (fold boundaries unchanged — same positions,
+                        # same programs' partition on reject): accepted
+                        # chunks collapse to 1-2 verification passes
+                        toks, sc, cache, prev, done, prev_h, _acc = \
+                            self._k_decode_chunk(
+                                cache, prev, lengths, np.int32(offset), n,
+                                eos_id, done,
+                                ("reduced" if reduced else True)
+                                if ws else False,
+                                jnp.asarray(row_ids) if ws and reduced
+                                else None,
+                                prev_h, valid, "completion")
+                    else:
+                        toks, sc, cache, prev, done = dmod.decode_steps(
+                            self.params, self.cfg, cache, prev, lengths,
+                            np.int32(offset), n, eos_id, done,
+                            with_scores=("reduced" if reduced else True) if ws else False,
+                            target_ids=jnp.asarray(row_ids) if ws and reduced else None,
+                        )
                     if ws:
                         scores_dev = sc
                     chunk_toks.append(toks)
                     offset += n
+                    if use_k and eos_id is not None and offset < gen_total:
+                        # the K path already synced this chunk's accept
+                        # data, so the EOS stop is EXACT (no lag chunk):
+                        # remaining chunks count into decode_steps_saved
+                        # below exactly like the sequential early stop
+                        if bool(np.asarray(done).all()):
+                            break
+                        continue
                     if eos_id is not None and offset < gen_total:
                         # EOS early exit with a ONE-CHUNK LAG: reading chunk
                         # k's `done` flag synchronously would leave the device
@@ -1536,11 +1585,7 @@ class ScoringEngine:
             ).astype(jnp.int32)
 
         def cat(parts):
-            if not reduced:
-                return jnp.concatenate(parts, axis=1)
-            return dmod.ReducedScores(*(
-                jnp.concatenate([getattr(p, f) for p in parts], axis=1)
-                for f in dmod.ReducedScores._fields))
+            return _cat_scores(parts, reduced)
 
         with obs.span("scan_decode", phase="decode", steps=int(steps),
                       rows=int(last_s.shape[0])):
@@ -1593,6 +1638,192 @@ class ScoringEngine:
             if offset >= min_steps and bool(resolved.all()):
                 break
         return cat(sc_parts), jnp.concatenate(tok_parts, axis=1)
+
+    # -- joint next-K-token decode (verify-and-accept) --------------------
+
+    def _k_enabled(self) -> bool:
+        """decode_k asks for the K path (decoder-only; T5 re-reads its
+        prompt per step — there is no frontier cache to verify against)."""
+        return int(self.ecfg.decode_k) > 1 and not self.is_encoder_decoder
+
+    def _k_active(self) -> bool:
+        """The K path engages: ``decode_k > 1`` AND a K-head is resident.
+        A missing head is noted once (counter + stderr) and the decode
+        legs run the unchanged sequential loop — never an error."""
+        if not self._k_enabled():
+            return False
+        if self.k_head is None:
+            if not self._k_head_missing_noted:
+                self._k_head_missing_noted = True
+                record_counter("k_decode_head_missing")
+                print(f"# engine: decode_k={self.ecfg.decode_k} configured "
+                      f"but no K-head is set (distill_k_head_on); decode "
+                      f"legs run sequentially", file=sys.stderr)
+            return False
+        return True
+
+    def distill_k_head_on(self, prompts, max_rows: int = 32,
+                          gen_steps: Optional[int] = None):
+        """Distill this engine's K-head on sample prompts (greedy
+        self-distillation — models/decoder.distill_k_head): the head
+        learns the model's OWN continuations, which is exactly the
+        distribution the decode legs replay.  Callers re-distill after
+        swapping ``engine.params`` (bench calibration, the EOS-typical
+        bracket) — proposals from a stale head still verify safely, they
+        just reject.  No-op (returns None) when ``decode_k <= 1``."""
+        self._check_open()
+        if not self._k_enabled():
+            return None
+        with obs.span("distill_k_head", phase="host_prep",
+                      rows=min(len(prompts), max_rows)):
+            encoded = batching.encode_prompts(self.tokenizer,
+                                              list(prompts)[:max_rows])
+            pad_id = self.tokenizer.pad_token_id or 0
+            width = max(len(e) for e in encoded)
+            ids = np.full((len(encoded), width), pad_id, np.int32)
+            mask = np.zeros((len(encoded), width), np.int32)
+            for r, e in enumerate(encoded):
+                ids[r, : len(e)] = e
+                mask[r, : len(e)] = 1
+            self.k_head = dmod.distill_k_head(
+                self.params, self.cfg, ids, mask,
+                k=int(self.ecfg.decode_k),
+                eos_token_id=getattr(self.tokenizer, "eos_token_id", None),
+                gen_steps=gen_steps)
+        record_counter("k_head_distilled")
+        return self.k_head
+
+    def _k_propose(self, hidden, prev_logits, kb, done, eos_id):
+        """Proposal source for one verification pass — a method (not a
+        direct ``dmod.k_propose`` call) so tests can inject oracle or
+        adversarial proposals; the verify pass re-derives the true chain
+        either way, so a bad injection costs a rejection, never a wrong
+        row.  ``hidden=None`` (no frontier hidden yet — the chunk's
+        bootstrap block) proposes only the free, exact argmax."""
+        if hidden is None or kb <= 1:
+            props = jnp.argmax(prev_logits, axis=-1).astype(jnp.int32)[:, None]
+            if eos_id is not None and done is not None:
+                props = jnp.where(done[:, None], eos_id, props)
+            return props
+        return dmod.k_propose(self.k_head, hidden, prev_logits, kb, done,
+                              eos_id)
+
+    def _k_decode_chunk(self, cache, prev, lens, offset, n, eos_id, done,
+                        with_scores, target_ids, prev_h, real_mask, leg):
+        """One reference chunk — one ``decode_steps`` call's worth of
+        positions — through the K-token verify-and-accept path.
+
+        The chunk's ``n``-slot tail buffer is shared by every proposal
+        block and folds into the cache ONLY at chunk end, so fold
+        boundaries (and the int8 quantization points) match the
+        sequential path's exactly — the partition-sensitivity the
+        two-block softmax has at 1 ulp makes this the load-bearing
+        parity rule: fold-point drift would compound chunk over chunk,
+        while the remaining multi-query reduction-order noise stays
+        bounded at the last ulp (PARITY.md "K-decode").  Per block:
+        propose up to
+        ``decode_k`` tokens (``_k_propose``; the chunk's first block
+        bootstraps at size 1 when no frontier hidden exists yet), run
+        ONE joint ``k_verify_block`` pass, and accept iff every REAL row
+        (``real_mask``; gather padding and pool blanks are per-row inert
+        and must not veto) matched the whole block.  Any rejection
+        discards the pass and re-runs the WHOLE chunk through the
+        unchanged ``dmod.decode_steps`` — so every emitted bit, on
+        either path, is the sequential path's.
+
+        Telemetry (SPECULATIVE passes only — kb=1 bootstrap/remainder
+        blocks propose the free exact argmax and can never reject, so
+        they are excluded or they would dilute the very numbers the
+        accept-prior recalibration reads): ``k_blocks_proposed``/
+        ``k_blocks_rejected`` (reject rate), the ``accepted_k``
+        histogram (batch-min accepted length per pass), and
+        ``k_steps_saved`` (+ a ``|leg=`` labeled twin) — sequential
+        steps the K path covered beyond one program per block, recorded
+        only when the WHOLE chunk completed on the K path (a late
+        reject erases earlier blocks' savings).  Host reads here are
+        fine under strict mode: both
+        decode legs run inside the pipeline's sanctioned consume fetch
+        (or after it, in ``flush_all``).
+
+        Returns ``(toks, scores, cache, prev_logits, done, prev_hidden,
+        accepted)`` — the ``decode_steps`` contract plus the frontier
+        hidden for the next chunk's proposals (None after a fallback)."""
+        ecfg = self.ecfg
+        b = int(prev.shape[0])
+        n_real = int(real_mask.sum()) if real_mask is not None else b
+        quantized = cache.k_scale is not None
+        cdt = (self.params["embed"]["tokens"].dtype if quantized
+               else cache.k.dtype)
+        tail_shape = (self.cfg.num_layers, b, n, self.cfg.num_kv_heads,
+                      self.cfg.head_dim)
+        tail_k = jnp.zeros(tail_shape, cdt)
+        tail_v = jnp.zeros(tail_shape, cdt)
+        cache0, prev0, done0 = cache, prev, done
+        kmax = max(1, min(int(ecfg.decode_k),
+                          1 + dmod.k_head_num_heads(self.k_head)))
+        toks_parts, sc_parts = [], []
+        j, cur_done, hid = 0, done, prev_h
+        saved_steps = 0   # recorded only if the WHOLE chunk stays on the
+        #                   K path — a later block's reject re-runs the
+        #                   chunk sequentially and erases every earlier
+        #                   block's saving, so per-block recording would
+        #                   report savings on runs that did MORE work
+        out = None
+        while j < n:
+            kb = 1 if hid is None else max(1, min(kmax, n - j))
+            props = self._k_propose(hid, prev, kb, cur_done, eos_id)
+            out = dmod.k_verify_block(
+                self.params, self.cfg, cache, tail_k, tail_v, prev, lens,
+                offset, jnp.int32(j), props, eos_id, cur_done, target_ids,
+                with_scores=with_scores, fold=(j + kb >= n))
+            a_len = np.asarray(out.a_len)
+            acc = np.asarray(out.accepted)
+            if real_mask is not None and n_real:
+                a_min = int(a_len[real_mask].min())
+                ok = bool(acc[real_mask].all())
+            else:
+                a_min = int(a_len.min()) if n_real else kb
+                ok = bool(acc.all()) if n_real else True
+            if kb > 1:
+                # telemetry counts SPECULATIVE passes only: a kb=1 pass
+                # (chunk bootstrap, kmax-remainder tail) proposes the
+                # free exact argmax and can never reject, so counting it
+                # would dilute k_reject_rate and drag accepted_k_mean
+                # toward 1 — the two numbers the accept-prior
+                # recalibration reads from the first driver record
+                record_counter("k_blocks_proposed")
+                record_hist("accepted_k", a_min)
+            if not ok:
+                # verify-and-accept REJECT: the pass's outputs are
+                # discarded wholesale and the chunk re-runs through the
+                # unchanged sequential loop from the chunk-entry state —
+                # the fallback leg of the parity contract
+                record_counter("k_blocks_rejected")
+                toks, sc, cache, prev, cur_done = dmod.decode_steps(
+                    self.params, self.cfg, cache0, prev0, lens, offset, n,
+                    eos_id, done0, with_scores=with_scores,
+                    target_ids=target_ids)
+                return toks, sc, cache, prev, cur_done, None, False
+            saved_steps += (kb - 1) * n_real
+            toks_parts.append(out.tokens)
+            if out.scores is not None:
+                sc_parts.append(out.scores)
+            prev, cur_done, hid = out.last_logits, out.done, out.last_hidden
+            tail_k, tail_v = out.tail_k, out.tail_v
+            j += kb
+        if saved_steps:
+            record_counter("k_steps_saved", saved_steps)
+            record_counter(f"k_steps_saved|leg={leg}", saved_steps)
+        cache = out.cache                 # folded by the chunk's last block
+        toks = (toks_parts[0] if len(toks_parts) == 1
+                else jnp.concatenate(toks_parts, axis=1))
+        if not sc_parts:
+            sc = None
+        elif len(sc_parts) == 1:
+            sc = sc_parts[0]
+        else:
+            sc = _cat_scores(sc_parts, with_scores == "reduced")
+        return toks, sc, cache, prev, cur_done, hid, True
 
     def _score_encdec(self, prompts, targets, with_confidence,
                   max_new_tokens=None) -> List[Dict]:
@@ -1826,6 +2057,19 @@ def _is_prefix_pair(prompt) -> bool:
     two spellings never collide."""
     return (isinstance(prompt, tuple) and len(prompt) == 2
             and not isinstance(prompt[0], (int, np.integer)))
+
+
+def _cat_scores(parts, reduced: bool):
+    """Concatenate per-chunk/per-block score pieces along the step axis —
+    ONE spelling of the ReducedScores stitching rule, shared by the
+    sequential scan loop (``_scan_decode_loop``) and the K-decode chunk
+    driver (``_k_decode_chunk``) so a field/axis change can never make
+    the two paths' scores silently diverge."""
+    if not reduced:
+        return jnp.concatenate(parts, axis=1)
+    return dmod.ReducedScores(*(
+        jnp.concatenate([getattr(p, f) for p in parts], axis=1)
+        for f in dmod.ReducedScores._fields))
 
 
 def _cache_nbytes(cache) -> int:
@@ -2177,6 +2421,8 @@ class _Phase2Pool:
         cache_real = real.copy()          # cache row holds a live real row
         cur_cache, prev, cur_lens, done = cache, last, lens, None
         cur_ids = jnp.asarray(ids)
+        use_k = engine._k_active()
+        prev_h = None                     # K-path frontier hidden
         retired_log = []
         offset = 0
         with obs.span("pool_flush", phase="pooled_decode", leg=self.leg,
@@ -2185,11 +2431,23 @@ class _Phase2Pool:
             while offset < steps:
                 n = min_conf if offset == 0 else min(
                     max(1, ecfg.scan_chunk), steps - offset)
-                toks_c, sc_c, cur_cache, prev, done = dmod.decode_steps(
-                    engine.params, engine.cfg, cur_cache, prev, cur_lens,
-                    np.int32(offset), n, self.eos_id, done,
-                    with_scores="reduced", target_ids=cur_ids,
-                )
+                if use_k:
+                    # K-block confidence scan (verify-and-accept): the
+                    # chunk schedule — and so the retirement points the
+                    # first_int_stable parse reads — is unchanged; only
+                    # the launches per chunk collapse.  Blank filler
+                    # rows (cache_real False) never veto acceptance.
+                    toks_c, sc_c, cur_cache, prev, done, prev_h, _acc = \
+                        engine._k_decode_chunk(
+                            cur_cache, prev, cur_lens, np.int32(offset),
+                            n, self.eos_id, done, "reduced", cur_ids,
+                            prev_h, cache_real, "confidence")
+                else:
+                    toks_c, sc_c, cur_cache, prev, done = dmod.decode_steps(
+                        engine.params, engine.cfg, cur_cache, prev, cur_lens,
+                        np.int32(offset), n, self.eos_id, done,
+                        with_scores="reduced", target_ids=cur_ids,
+                    )
                 for a in (toks_c,) + tuple(sc_c):
                     try:
                         a.copy_to_host_async()
@@ -2238,6 +2496,8 @@ class _Phase2Pool:
                         cur_cache, prev, cur_lens, idx_dev)
                     done = done[idx_dev]
                     cur_ids = cur_ids[idx_dev]
+                    if prev_h is not None:  # K-path frontier rides along
+                        prev_h = prev_h[idx_dev]
                     freed -= _cache_nbytes(cur_cache)
                     record_counter("completion_cache_bytes_freed", freed)
                     cache_map = cache_map[idx]

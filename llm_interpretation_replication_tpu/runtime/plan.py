@@ -106,6 +106,18 @@ def flash_workspace_bytes(cfg, batch: int, seq: int) -> int:
     return batch * seq * cfg.num_heads * cfg.head_dim * 4
 
 
+def k_head_bytes(cfg, decode_k: int) -> int:
+    """HBM the joint K-token decode's K-head pins: ``decode_k - 1``
+    per-offset logit projections [H, V] in the weights dtype (bf16 —
+    models/decoder.k_propose reads them like a second lm_head).  Full-
+    vocab heads are the dominant K-decode cost at 7B scale (~0.6 GiB per
+    offset on the falcon geometry), which is what prices large K out of
+    small-HBM plans — the term the plan-search K axis budgets."""
+    if decode_k <= 1:
+        return 0
+    return (decode_k - 1) * cfg.hidden_size * cfg.vocab_size * 2
+
+
 # ---------------------------------------------------------------------------
 # Fit-decision formatting — ONE spelling for every budget audit
 # ---------------------------------------------------------------------------
@@ -337,7 +349,8 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
                           reduced_scores: bool = True,
                           kv_dtype: str = "bf16", prefill_chunk: int = 0,
                           pooled_confidence: bool = False,
-                          pool_target: Optional[int] = None) -> dict:
+                          pool_target: Optional[int] = None,
+                          decode_k: int = 1) -> dict:
     """Per-term HBM breakdown of the full-study live set at one operating
     point — the exact terms :func:`resolve_full_sweep_plan`'s ``need()``
     sums.  Exposed as a dict so the auto-parallel search
@@ -349,7 +362,10 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
     Keys: ``weights``, ``attn`` (score tensor / flash workspace),
     ``act`` (activation live set), ``completions`` (pinned completion
     caches + logits/scores), ``conf_pool`` (pooled-confidence worst-case
-    peak; 0 unless ``pooled_confidence``)."""
+    peak; 0 unless ``pooled_confidence``), plus ``k_head`` (the joint
+    K-decode's proposal projections, :func:`k_head_bytes`) ONLY when
+    ``decode_k > 1`` — absent at the default so every existing term-sum
+    pin stays byte-identical."""
     attn = (flash_workspace_bytes(cfg, batch, seq)
             if attention_impl == "flash"
             else dense_attention_bytes(cfg, batch, seq, prefill_chunk))
@@ -358,7 +374,7 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
         conf_pool = pooled_confidence_extra_bytes(
             cfg, pool_target or batch, seq, score_steps=score_steps,
             kv_dtype=kv_dtype)
-    return {
+    terms = {
         "weights": weight_b,
         "attn": attn,
         "act": activation_bytes(cfg, batch, seq, prefill_chunk),
@@ -367,6 +383,9 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
             reduced_scores, kv_dtype),
         "conf_pool": conf_pool,
     }
+    if decode_k > 1:
+        terms["k_head"] = k_head_bytes(cfg, decode_k)
+    return terms
 
 
 def packed_need_terms(cfg, weight_b: int, attention_impl: str,

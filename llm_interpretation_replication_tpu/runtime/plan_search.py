@@ -97,6 +97,51 @@ CHUNK_PENALTY = 0.01
 #: FLOPs are ~proportional to parameter count in this regime).
 CALIBRATION_PARAMS = 6_921_420_800
 
+# -- joint next-K-token decode (ISSUE 13 — models/decoder.k_verify_block) ---
+#: Per-position proposal-accept prior for the K-head on this system's
+#: decode legs.  A PRIOR, not a measurement: both legs are short, highly
+#: predictable continuations (digit positions with an early-settling
+#: first-int parse; EOS-terminated completions), the K-Forcing regime
+#: (arxiv 2606.10820).  Recalibrate from the first driver bench record's
+#: ``k_decode.accepted_k_hist`` (the block exists for exactly this).
+K_ACCEPT_PRIOR = 0.9
+#: Fraction of the full-study per-row work spent in the two decode legs —
+#: what K-decode can touch (Amdahl).  Derived from the phases-block
+#: shape of the r05-era decomposition (decode launches dominate per-row
+#: time after the prefill-side wins); a prior until a K>1 bench record
+#: exists, like the accept prior above.
+K_DECODE_SHARE = 0.55
+#: decode_k values the full-study search enumerates (1 = the sequential
+#: baseline row in the runner-up table).
+DEFAULT_DECODE_KS = (1, 2, 4, 8)
+
+
+def k_decode_speedup(decode_k: int, accept: float = K_ACCEPT_PRIOR) -> float:
+    """Expected decode-leg speedup of verify-and-accept at block size K.
+
+    Per proposed block: position 0 is the free exact argmax, positions
+    1..K-1 each hold with probability ``accept``, and acceptance is
+    all-or-nothing per block (the engine's parity rule —
+    runtime/engine._k_decode_chunk): with probability ``accept^(K-1)``
+    the block costs ~1 weight stream for K tokens, otherwise the pass is
+    wasted and the block's positions re-run sequentially (1 + K
+    streams).  Speedup = K / expected streams — non-monotone in K, which
+    is the whole point of pricing the axis instead of hardcoding a
+    block size.
+
+    The closed form is exact when the block IS the chunk (n == K) and
+    OPTIMISTIC for multi-block chunks: the engine's fallback is
+    chunk-granular (a late block's reject re-runs the whole n-position
+    chunk, wasting earlier accepted blocks' passes too).  That optimism
+    is part of why both coefficients are PRIORS — the first driver
+    record's measured ``k_decode`` block (accepted-K histogram + reject
+    rate) is the recalibration input that replaces them."""
+    k = int(decode_k)
+    if k <= 1:
+        return 1.0
+    p_blk = accept ** (k - 1)
+    return k / (p_blk + (1.0 - p_blk) * (1.0 + k))
+
 # -- packed batch prompting (ISSUE 10 — scoring/packed.py) ------------------
 #: Mean question tokens of the real perturbation corpus (the bench's own
 #: stderr line: "token lengths mean 104" on the 10k rephrasings at the
@@ -183,6 +228,9 @@ class PlanCandidate:
     predicted_rows_per_s: float  # 0.0 when rejected
     packing: int = 1            # questions per packed row (1 = isolated;
                                 # > 1 only on the "packed" workload)
+    decode_k: int = 1           # joint K-token decode block size (1 = the
+                                # sequential path; > 1 only on the "full"
+                                # workload — the legs K-decode touches)
 
     @property
     def mesh_shape(self) -> Dict[str, int]:
@@ -197,6 +245,7 @@ class PlanCandidate:
             "prefill_chunk": self.prefill_chunk,
             "pool_target": self.pool_target,
             "packing": self.packing,
+            "decode_k": self.decode_k,
             "fits": self.fits,
             "predicted_rows_per_s": round(self.predicted_rows_per_s, 2),
             "need_gib": round(self.need_bytes / 2**30, 2),
@@ -207,7 +256,7 @@ class PlanCandidate:
 def predicted_rows_per_s(cfg, data: int, model: int, batch: int,
                          kv_dtype: str = "bf16", prefill_chunk: int = 0,
                          workload: str = "full", seq: int = 256,
-                         packing: int = 1) -> float:
+                         packing: int = 1, decode_k: int = 1) -> float:
     """Calibrated throughput estimate for one candidate (module docstring).
 
     ``workload``: "binary" (the yes/no scoring sweep, prompts/s), "full"
@@ -239,6 +288,12 @@ def predicted_rows_per_s(cfg, data: int, model: int, batch: int,
         rate *= 1.0 - CHUNK_PENALTY * replays
     if workload == "full":
         rate /= FULL_STUDY_WORK
+        if decode_k > 1:
+            # Amdahl over the decode share: only the two decode legs
+            # (K_DECODE_SHARE of full-study work) see the K multiplier,
+            # priced by the accepted-K prior (k_decode_speedup)
+            rate /= (1.0 - K_DECODE_SHARE
+                     + K_DECODE_SHARE / k_decode_speedup(decode_k))
     elif workload == "packed":
         q = max(1, packing)
         iso_tokens = PACKED_SHARED_TOKENS + PACKED_QUESTION_TOKENS
@@ -264,7 +319,10 @@ def sharded_need_bytes(terms: Dict[str, int], cfg, data: int, model: int,
             + terms["attn"] // (data * head_div)
             + terms["act"] // data
             + terms.get("completions", 0) // kv_div
-            + terms.get("conf_pool", 0) // kv_div)
+            + terms.get("conf_pool", 0) // kv_div
+            # the K-head is a second lm_head: vocab-sharded over tp and
+            # staged over pp exactly like the weights term
+            + terms.get("k_head", 0) // (model * pipe))
 
 
 def binary_need_terms(cfg, weight_b: int, batch: int, seq: int,
@@ -303,7 +361,8 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                  max_pipe: int = 2,
                  max_model: Optional[int] = None,
                  attention_impl: str = "xla",
-                 packings: Sequence[int] = DEFAULT_PACKINGS
+                 packings: Sequence[int] = DEFAULT_PACKINGS,
+                 decode_ks: Sequence[int] = DEFAULT_DECODE_KS
                  ) -> List[PlanCandidate]:
     """Enumerate, budget-filter, and rank the candidate space.
 
@@ -338,6 +397,10 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
         pool_targets = (0,)
         kv_dtypes = ("bf16",)
     packings = tuple(packings) if workload == "packed" else (1,)
+    # the K axis prices the two decode legs — only the full-study
+    # workload runs them (the binary pooled flush is the async no-read
+    # decode, the packed path has no decode at all)
+    decode_ks = tuple(decode_ks) if workload == "full" else (1,)
     wb = weight_bytes(cfg, quant)
     budget = hbm_bytes - RESERVE_BYTES - {
         "full": THRASH_HEADROOM_BYTES,
@@ -347,9 +410,10 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
     candidates: List[PlanCandidate] = []
 
     def add(dp, pp, tp, b, kv, chunk, pool, fits, reason, need=0, pred=0.0,
-            packing=1):
+            packing=1, decode_k=1):
         candidates.append(PlanCandidate(dp, pp, tp, b, kv, chunk, pool,
-                                        fits, reason, need, pred, packing))
+                                        fits, reason, need, pred, packing,
+                                        decode_k))
 
     for dp, pp, tp in enumerate_mesh_shapes(n_devices, max_model=max_model,
                                             max_pipe=max_pipe):
@@ -380,7 +444,9 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                 for chunk in ([c for c in prefill_chunks if c < seq]
                               if workload == "full" else (0,)):
                     for pool in pool_targets:
-                        for packing in packings:
+                        for packing, dk in [
+                                (p, k) for p in packings
+                                for k in decode_ks]:
                             if workload == "full":
                                 terms = full_study_need_terms(
                                     cfg, wb, attention_impl, b, seq,
@@ -388,7 +454,8 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                                     reduced_scores=True, kv_dtype=kv,
                                     prefill_chunk=chunk,
                                     pooled_confidence=True,
-                                    pool_target=pool or None)
+                                    pool_target=pool or None,
+                                    decode_k=dk)
                             elif workload == "packed":
                                 terms = plan_mod.packed_need_terms(
                                     cfg, wb, attention_impl, b,
@@ -405,22 +472,24 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                                     f"over budget: "
                                     f"{budget_reject(need, budget)} "
                                     f"per device",
-                                    need, packing=packing)
+                                    need, packing=packing, decode_k=dk)
                                 continue
                             pred = predicted_rows_per_s(
                                 cfg, dp, tp, b, kv, chunk, workload, seq,
-                                packing=packing)
+                                packing=packing, decode_k=dk)
                             add(dp, pp, tp, b, kv, chunk, pool, True,
                                 f"fits: {budget_audit(need, budget)} per "
                                 f"device at dp{dp}" +
                                 (f"xtp{tp}" if tp > 1 else "") +
                                 (f" (Q={packing} packed)"
-                                 if workload == "packed" else ""),
-                                need, pred, packing=packing)
+                                 if workload == "packed" else "") +
+                                (f" (K={dk} joint decode)"
+                                 if dk > 1 else ""),
+                                need, pred, packing=packing, decode_k=dk)
     candidates.sort(key=lambda c: (
         not c.fits, -c.predicted_rows_per_s, c.model, c.pipe,
         c.pool_target, c.kv_dtype != "bf16", c.prefill_chunk, c.packing,
-        -c.batch, c.reason))
+        c.decode_k, -c.batch, c.reason))
     return candidates
 
 
@@ -478,6 +547,7 @@ def format_candidate_table(ranked: Sequence[PlanCandidate], top: int = 8,
             f"batch {c.batch} kv {c.kv_dtype} chunk {c.prefill_chunk} "
             f"pool {c.pool_target or 'batch'}"
             + (f" packing {c.packing}" if c.packing > 1 else "")
+            + (f" decode-k {c.decode_k}" if c.decode_k > 1 else "")
             + f" -> {c.predicted_rows_per_s:.1f} rows/s ({c.reason})")
     if not fit:
         lines.append("#   NO candidate fits the budget; first reject: "

@@ -54,7 +54,14 @@ def _bucket_of(engine, length: Optional[int]) -> Any:
 
 
 def compat_key(engine, req: ScoreRequest, encoded: Any) -> Tuple:
-    """The micro-batch compatibility key for one request."""
+    """The micro-batch compatibility key for one request.
+
+    ``decode_k`` is part of the key (ISSUE 13): the joint K-token decode
+    consumes chunks in K-sized verification blocks, so two requests
+    resolving to DIFFERENT K would force one request's block schedule on
+    the other's rows — mixed-K requests must never share an engine call.
+    A request's ``decode_k=None`` resolves to the engine's configured
+    value, so plain traffic on a K-configured engine still coalesces."""
     ecfg = getattr(engine, "ecfg", None)
     if ecfg is not None:
         plan_part = plan_cache_key(
@@ -62,6 +69,9 @@ def compat_key(engine, req: ScoreRequest, encoded: Any) -> Tuple:
             ecfg.decode_completions, req.max_new_tokens)
     else:
         plan_part = (req.max_new_tokens,)
+    engine_k = int(getattr(ecfg, "decode_k", 1) or 1) if ecfg is not None \
+        else 1
+    decode_k = int(req.decode_k) if req.decode_k is not None else engine_k
     if req.prefix is not None:
         length = len(encoded[0]) if encoded is not None else None
         kind = PREFIXED
@@ -69,4 +79,4 @@ def compat_key(engine, req: ScoreRequest, encoded: Any) -> Tuple:
         length = len(encoded) if encoded is not None else None
         kind = PLAIN
     return (kind, _bucket_of(engine, length), bool(req.with_confidence),
-            req.max_new_tokens, plan_part)
+            req.max_new_tokens, decode_k, plan_part)

@@ -56,6 +56,12 @@ class ScoreRequest:
     max_new_tokens: Optional[int] = None
     priority: int = 0
     timeout_s: Optional[float] = None
+    #: joint K-token decode block size for THIS request's launch (the
+    #: engine override the scheduler applies — EngineConfig.decode_k);
+    #: None inherits the engine's configured value.  Part of the
+    #: coalescer compatibility key: mixed-K requests must never share an
+    #: engine call (the K path's chunk consumption differs per K).
+    decode_k: Optional[int] = None
     #: which model should answer — read by the EnginePool router
     #: (serve/pool.py) to pick a compatible replica; inert on a
     #: single-engine Scheduler (its one engine IS the model).  None on
@@ -74,6 +80,8 @@ class ScoreRequest:
         if len(self.targets) != 2:
             raise ValueError(f"targets must be a (yes, no) pair, got "
                              f"{self.targets!r}")
+        if self.decode_k is not None and self.decode_k < 1:
+            raise ValueError(f"decode_k must be >= 1, got {self.decode_k}")
 
 
 class ScoreFuture:

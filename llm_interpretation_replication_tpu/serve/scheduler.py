@@ -279,12 +279,19 @@ class Scheduler:
 
     def _engine_overrides(self, group: List[Ticket]):
         """Per-launch EngineConfig overrides: the serve path owns OOM
-        recovery (in-place ladder disarmed), and split chunks carry the
-        stepped-down batch size they re-entered the queue with."""
+        recovery (in-place ladder disarmed), split chunks carry the
+        stepped-down batch size they re-entered the queue with, and a
+        request-level ``decode_k`` overrides the engine's joint K-token
+        decode block size for this launch (safe to read off the head
+        request: the coalescer key includes the resolved decode_k, so a
+        micro-batch can never mix K values)."""
         ov = {"oom_backoff": False}
         degraded = [t.degraded for t in group if t.degraded]
         if degraded:
             ov["batch_size"] = min(degraded)
+        req_k = getattr(group[0].request, "decode_k", None)
+        if req_k is not None:
+            ov["decode_k"] = int(req_k)
         ctx = getattr(self.engine, "config_overrides", None)
         return ctx(**ov) if ctx is not None else contextlib.nullcontext()
 

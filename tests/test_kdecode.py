@@ -1,0 +1,894 @@
+"""Joint next-K-token decode with verify-and-accept (ISSUE 13,
+``-m kdecode``, tier-1).
+
+Pins the four contracts of the K-decode path:
+
+- **verify-and-accept exactness** (PARITY.md "K-decode"): a fully
+  accepted proposal block reproduces the sequential ``decode_steps``
+  scan EXACTLY in tokens — and everything derived from them (completion
+  text, first-int parse, scan verdicts, EOS stops, retirement points) —
+  because the joint pass reuses the decode path's own per-layer
+  machinery, the chunk's shared tail buffer, and the exact end-of-chunk
+  fold (so int8 quantization points match too).  Logits/scores
+  reproduce the sequential scan to fp32 REDUCTION-ORDER NOISE (the
+  chunked-prefill equivalence class): single-query blocks are pinned
+  BIT-IDENTICAL — the structural proof that the argmax chain is the
+  sequential chain — while multi-query blocks may regroup summations in
+  the last ulp.  At the ENGINE level, rows at any K carry identical
+  discrete fields and probability fields within the fp32 rounding floor
+  (the EOS-calibration |Δ| <= 2e-6 precedent).
+- **rejection falls back to the unchanged step loop**: adversarial
+  (random-head) proposals still yield the K=1 rows BIT-identically (the
+  fallback IS the sequential code path) — a bad K-head can only cost
+  wasted passes, never a wrong row — and a missing head runs
+  sequentially with a one-time counter, never an error.
+- **composition**: pooled-confidence retirement stays bit-reproducible
+  across pool compositions at K > 1; EOS-bracket ``decode_steps_saved``
+  and ``k_steps_saved`` count DISJOINT position sets (never-launched vs
+  launched-jointly — no double count); strict mode holds
+  (``blocked_transfers == 0``) because every K fetch happens inside the
+  sanctioned consume scope.
+- **pricing + plumbing**: plan_search's K axis literals are anchor-
+  pinned, at least one K>1 candidate survives the full-study budget
+  filter on the bench geometry, the serve coalescer key separates
+  mixed-K requests, bench-diff K-tags rows so sequential and joint-K
+  records never cross-compare, and the telemetry exports as a
+  Prometheus histogram + per-leg labeled counters.
+"""
+
+import dataclasses
+import json
+import os
+import sys
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+import bench  # noqa: E402
+from helpers import build_test_tokenizer, random_decoder_params  # noqa: E402
+from llm_interpretation_replication_tpu.models import (  # noqa: E402
+    decoder as dmod,
+)
+from llm_interpretation_replication_tpu.models.config import (  # noqa: E402
+    DecoderConfig,
+)
+from llm_interpretation_replication_tpu.runtime import (  # noqa: E402
+    plan as plan_mod,
+)
+from llm_interpretation_replication_tpu.runtime import (  # noqa: E402
+    plan_search as ps,
+)
+from llm_interpretation_replication_tpu.runtime.engine import (  # noqa: E402
+    EngineConfig,
+    LegSpec,
+    ScoringEngine,
+)
+from llm_interpretation_replication_tpu.utils import telemetry  # noqa: E402
+
+pytestmark = pytest.mark.kdecode
+
+REPO_ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+TINY = dict(
+    vocab_size=300, hidden_size=32, num_layers=2, num_heads=4,
+    intermediate_size=64, position_embedding="rotary", rotary_pct=0.25,
+    max_position_embeddings=512,
+)
+
+#: discrete/derived-from-tokens fields plus the prefill-computed
+#: position-0 view — IDENTICAL programs on both paths, so exact always
+EXACT_FIELDS = ("scan_found", "completion", "success",
+                "first_token_yes_prob", "first_token_no_prob",
+                "first_token_relative_prob")
+#: decode-score-derived probability fields: equal within the fp32
+#: reduction-order rounding floor (the EOS-calibration 2e-6 precedent)
+PROB_FIELDS = ("yes_prob", "no_prob", "relative_prob")
+PROB_ATOL = 2e-6
+
+
+def _prompts(n):
+    return [f"Scenario {i}: does the bylaw cover bicycles in the park? "
+            f"Answer:" for i in range(n)]
+
+
+def _rows_equal(a_rows, b_rows):
+    for a, b in zip(a_rows, b_rows):
+        for f in EXACT_FIELDS:
+            assert a.get(f) == b.get(f), (f, a.get(f), b.get(f))
+        for f in PROB_FIELDS:
+            va, vb = a.get(f), b.get(f)
+            if va != va:                                 # NaN == NaN here
+                assert vb != vb, (f, va, vb)
+            else:
+                assert vb == pytest.approx(va, abs=PROB_ATOL), (f, va, vb)
+        if a.get("odds_ratio") == a.get("odds_ratio"):
+            assert b.get("odds_ratio") == pytest.approx(
+                a.get("odds_ratio"), rel=1e-5, abs=PROB_ATOL)
+        wa, wb = a.get("weighted_confidence"), b.get("weighted_confidence")
+        if wa is None:
+            assert wb is None or "weighted_confidence" not in b
+        else:
+            assert wb == pytest.approx(wa, abs=1e-3)
+
+
+def _engine(cfg=None, params=None, tok=None, **ecfg_kw):
+    cfg = cfg or DecoderConfig(**TINY)
+    tok = tok or build_test_tokenizer()
+    params = params if params is not None else random_decoder_params(cfg)
+    kw = dict(batch_size=4, buckets=(32, 64))
+    kw.update(ecfg_kw)
+    return ScoringEngine("falcon", cfg, params, tok,
+                         engine_config=EngineConfig(**kw)), cfg, params, tok
+
+
+def _prefilled(cfg, params, kv_dtype="bf16", b=3, s=8, seed=0):
+    """(cache, last, lengths, target_ids) from a tiny synthetic prefill."""
+    if kv_dtype != "bf16":
+        cfg = dataclasses.replace(cfg, kv_cache_dtype=kv_dtype)
+    rng = np.random.default_rng(seed)
+    ids = rng.integers(1, cfg.vocab_size - 10, (b, s)).astype(np.int32)
+    mask = np.ones((b, s), np.int32)
+    mask[1, 6:] = 0
+    ids[1, 6:] = 0
+    last, cache = dmod.prefill(params, cfg, jnp.asarray(ids),
+                               jnp.asarray(mask), cache_len=s)
+    lengths = jnp.sum(jnp.asarray(mask), axis=-1)
+    tgt = jnp.asarray(np.tile([[5, 9]], (b, 1)).astype(np.int32))
+    return cfg, cache, last, lengths, tgt
+
+
+def _verify_chunk(params, cfg, cache, last, lengths, tgt, n, blocks,
+                  proposals, eos_id=None):
+    """Drive k_verify_block over one chunk in the given block sizes with
+    per-position ``proposals`` [B, n]; returns (tokens, ReducedScores,
+    folded cache, last logits, per-pass outs)."""
+    b = int(last.shape[0])
+    tail_shape = (cfg.num_layers, b, n, cfg.num_kv_heads, cfg.head_dim)
+    cdt = (params["embed"]["tokens"].dtype
+           if cache.k_scale is not None else cache.k.dtype)
+    tk = tv = jnp.zeros(tail_shape, cdt)
+    prev, done, j = last, None, 0
+    toks_parts, sc_parts, outs = [], [], []
+    for kb in blocks:
+        out = dmod.k_verify_block(
+            params, cfg, cache, tk, tv, prev, lengths, jnp.int32(0),
+            jnp.int32(j), jnp.asarray(proposals[:, j:j + kb]), eos_id,
+            done, tgt, with_scores="reduced", fold=(j + kb == n))
+        outs.append(out)
+        toks_parts.append(np.asarray(out.tokens))
+        sc_parts.append(out.scores)
+        prev, done, tk, tv = out.last_logits, out.done, out.tail_k, \
+            out.tail_v
+        j += kb
+    sc = dmod.ReducedScores(*(
+        np.concatenate([np.asarray(getattr(p, f)) for p in sc_parts],
+                       axis=1)
+        for f in dmod.ReducedScores._fields))
+    return np.concatenate(toks_parts, axis=1), sc, outs[-1].cache, prev, \
+        outs
+
+
+# ---------------------------------------------------------------------------
+# Decoder-level: the verify-and-accept bit-parity contract
+# ---------------------------------------------------------------------------
+
+class TestKVerifyBlock:
+    def test_single_query_blocks_bit_identical_to_sequential(self):
+        """The STRUCTURAL exactness proof: a chunk verified in
+        single-query blocks sharing the chunk tail reproduces the
+        sequential scan bit for bit — tokens, every reduced-score field,
+        the frontier logits, AND the folded cache.  This is what makes
+        the argmax chain THE sequential chain; the multi-query test
+        below adds only summation regrouping on top of it."""
+        cfg = DecoderConfig(**TINY)
+        params = random_decoder_params(cfg, seed=3)
+        cfg, cache, last, lengths, tgt = _prefilled(cfg, params)
+        n = 6
+        t6, s6, c6, l6, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, None, None,
+            with_scores="reduced", target_ids=tgt)
+        t6 = np.asarray(t6)
+        toks, sc, fc, prev, outs = _verify_chunk(
+            params, cfg, cache, last, lengths, tgt, n, (1,) * n, t6)
+        for out in outs:
+            assert bool(np.asarray(out.accepted).all())
+        assert (toks == t6).all()
+        for f in dmod.ReducedScores._fields:
+            assert (getattr(sc, f) == np.asarray(getattr(s6, f))).all(), f
+        assert (np.asarray(prev) == np.asarray(l6)).all()
+        assert (np.asarray(fc.k) == np.asarray(c6.k)).all()
+        assert (np.asarray(fc.valid) == np.asarray(c6.valid)).all()
+        assert (np.asarray(fc.positions) == np.asarray(c6.positions)).all()
+
+    def test_multi_query_blocks_token_exact_scores_within_noise(self):
+        """Multi-query blocks: the TRUE token chain (and acceptance) is
+        exactly the sequential one, and every score statistic matches to
+        fp32 reduction-order noise — the PARITY.md "K-decode" contract
+        (the last-ulp regrouping a K-query pass may legitimately do)."""
+        cfg = DecoderConfig(**TINY)
+        params = random_decoder_params(cfg, seed=3)
+        cfg, cache, last, lengths, tgt = _prefilled(cfg, params)
+        n = 6
+        t6, s6, c6, l6, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, None, None,
+            with_scores="reduced", target_ids=tgt)
+        t6 = np.asarray(t6)
+        toks, sc, fc, prev, outs = _verify_chunk(
+            params, cfg, cache, last, lengths, tgt, n, (1, 3, 2), t6)
+        for out in outs:
+            assert bool(np.asarray(out.accepted).all())
+        assert (toks == t6).all()                    # tokens: EXACT
+        assert (sc.topk_ids == np.asarray(s6.topk_ids)).all()
+        for f in ("topk_vals", "logz", "target_logits"):
+            np.testing.assert_allclose(
+                getattr(sc, f), np.asarray(getattr(s6, f)),
+                rtol=1e-6, atol=1e-5, err_msg=f)
+        np.testing.assert_allclose(np.asarray(prev), np.asarray(l6),
+                                   rtol=1e-6, atol=1e-5)
+        np.testing.assert_allclose(np.asarray(fc.k), np.asarray(c6.k),
+                                   rtol=1e-5, atol=1e-5)
+        assert (np.asarray(fc.valid) == np.asarray(c6.valid)).all()
+
+    def test_int8_fold_points_match_sequential(self):
+        """Fold boundaries — and therefore the int8 quantization points —
+        are chunk-aligned on both paths: single-query blocks on a
+        quantized cache stay BIT-identical to the sequential int8 scan
+        (K-decode adds no new drift class; the tolerance vs bf16 is the
+        documented kvcache one, unchanged), and multi-query blocks keep
+        the same token-exact/noise contract as bf16."""
+        cfg0 = DecoderConfig(**TINY)
+        params = random_decoder_params(cfg0, seed=5)
+        cfg, cache, last, lengths, tgt = _prefilled(cfg0, params,
+                                                    kv_dtype="int8")
+        assert cache.k_scale is not None
+        n = 5
+        t5, s5, c5, l5, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, None, None,
+            with_scores="reduced", target_ids=tgt)
+        toks, sc, fc, prev, outs = _verify_chunk(
+            params, cfg, cache, last, lengths, tgt, n, (1,) * n,
+            np.asarray(t5))
+        assert all(bool(np.asarray(o.accepted).all()) for o in outs)
+        assert (toks == np.asarray(t5)).all()
+        for f in dmod.ReducedScores._fields:
+            assert (getattr(sc, f) == np.asarray(getattr(s5, f))).all(), f
+        assert (np.asarray(fc.k) == np.asarray(c5.k)).all()
+        assert (np.asarray(fc.k_scale) == np.asarray(c5.k_scale)).all()
+        toks2, _, _, _, outs2 = _verify_chunk(
+            params, cfg, cache, last, lengths, tgt, n, (1, 4),
+            np.asarray(t5))
+        assert all(bool(np.asarray(o.accepted).all()) for o in outs2)
+        assert (toks2 == np.asarray(t5)).all()
+
+    def test_mismatch_reports_prefix_and_rejects(self):
+        """A wrong proposal at position 2 accepts exactly the 2-token
+        prefix for that row and fails block acceptance; rows whose
+        proposals all match still report full acceptance."""
+        cfg = DecoderConfig(**TINY)
+        params = random_decoder_params(cfg, seed=3)
+        cfg, cache, last, lengths, tgt = _prefilled(cfg, params)
+        n = 4
+        t4, _, _, _, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, None, None,
+            with_scores=False)
+        props = np.asarray(t4).copy()
+        props[0, 2] = (props[0, 2] + 1) % cfg.vocab_size
+        b = int(last.shape[0])
+        tail = jnp.zeros((cfg.num_layers, b, n, cfg.num_kv_heads,
+                          cfg.head_dim), cache.k.dtype)
+        out = dmod.k_verify_block(
+            params, cfg, cache, tail, tail, last, lengths, jnp.int32(0),
+            jnp.int32(0), jnp.asarray(props), None, None, tgt,
+            with_scores="reduced", fold=True)
+        a_len = np.asarray(out.a_len)
+        acc = np.asarray(out.accepted)
+        assert a_len[0] == 2 and not acc[0]
+        assert (a_len[1:] == n).all() and acc[1:].all()
+        # the TRUE chain is immune to the bad proposal at its own position
+        assert int(np.asarray(out.tokens)[0, 2]) == int(np.asarray(t4)[0, 2])
+
+    def test_eos_frozen_chain_matches_sequential(self):
+        """With an armed EOS id the verify pass's true chain freezes rows
+        exactly like decode_steps (eos emitted -> eos forever), so a
+        sequential-token proposal block still fully accepts."""
+        cfg = DecoderConfig(**TINY)
+        params = random_decoder_params(cfg, seed=3)
+        cfg, cache, last, lengths, tgt = _prefilled(cfg, params)
+        n = 6
+        ref, _, _, _, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, None, None,
+            with_scores=False)
+        # pick the token row 0 greedily emits at step 1 as the "EOS":
+        # every row that ever emits it freezes from there on
+        eos_id = int(np.asarray(ref)[0, 1])
+        t_eos, _, _, _, d_eos = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, eos_id,
+            None, with_scores=False)
+        toks, _, _, _, outs = _verify_chunk(
+            params, cfg, cache, last, lengths, tgt, n, (1, 5),
+            np.asarray(t_eos), eos_id=eos_id)
+        assert all(bool(np.asarray(o.accepted).all()) for o in outs)
+        assert (toks == np.asarray(t_eos)).all()
+        assert (np.asarray(outs[-1].done) == np.asarray(d_eos)).all()
+
+
+class TestKHead:
+    def test_init_and_depth(self):
+        cfg = DecoderConfig(**TINY)
+        head = dmod.init_k_head(cfg, 4, seed=1)
+        assert head["w"].shape == (3, cfg.hidden_size, cfg.vocab_size)
+        assert dmod.k_head_num_heads(head) == 3
+        assert dmod.k_head_num_heads(None) == 0
+
+    def test_distill_predicts_greedy_continuations(self):
+        """Self-distillation on the evaluation prompts themselves (the
+        bench's regime) interpolates the tiny geometry: proposals match
+        the greedy continuation, so multi-token blocks fully accept."""
+        cfg = DecoderConfig(**TINY)
+        params = random_decoder_params(cfg, seed=3)
+        cfg2, cache, last, lengths, tgt = _prefilled(cfg, params)
+        rng = np.random.default_rng(0)
+        ids = rng.integers(1, cfg.vocab_size - 10, (3, 8)).astype(np.int32)
+        mask = np.ones((3, 8), np.int32)
+        mask[1, 6:] = 0
+        ids[1, 6:] = 0
+        head = dmod.distill_k_head(params, cfg, ids, mask, k=4,
+                                   gen_steps=8)
+        # resident in the WEIGHTS dtype: plan.k_head_bytes prices the
+        # head at the weights' width, so an fp32 copy beside bf16
+        # params would pin 2x the budgeted HBM
+        assert head["w"].dtype == params["embed"]["tokens"].dtype
+        n = 4
+        ref, _, _, _, _ = dmod.decode_steps(
+            params, cfg, cache, last, lengths, np.int32(0), n, None, None,
+            with_scores=False)
+        # bootstrap (argmax) then a 3-token head block from its hidden
+        b = 3
+        tail = jnp.zeros((cfg.num_layers, b, n, cfg.num_kv_heads,
+                          cfg.head_dim), cache.k.dtype)
+        boot = dmod.k_verify_block(
+            params, cfg, cache, tail, tail, last, lengths, jnp.int32(0),
+            jnp.int32(0),
+            jnp.argmax(last, axis=-1).astype(jnp.int32)[:, None],
+            None, None, tgt, with_scores="reduced", fold=False)
+        props = dmod.k_propose(head, boot.last_hidden, boot.last_logits, 3)
+        assert (np.asarray(props) == np.asarray(ref)[:, 1:4]).all()
+
+    def test_propose_freezes_done_rows(self):
+        cfg = DecoderConfig(**TINY)
+        head = dmod.init_k_head(cfg, 3)
+        hidden = jnp.ones((2, cfg.hidden_size))
+        logits = jnp.ones((2, cfg.vocab_size))
+        done = jnp.asarray([True, False])
+        props = np.asarray(dmod.k_propose(head, hidden, logits, 3,
+                                          done, 7))
+        assert (props[0] == 7).all()
+
+
+# ---------------------------------------------------------------------------
+# Engine-level: rows at any K == the K=1 rows
+# ---------------------------------------------------------------------------
+
+class TestEngineParity:
+    def _pair(self, decode_k=4, **kw):
+        eng, cfg, params, tok = _engine(**kw)
+        k_eng = ScoringEngine(
+            "falcon", cfg, params, tok,
+            engine_config=dataclasses.replace(eng.ecfg, decode_k=decode_k))
+        return eng, k_eng, tok
+
+    def test_completion_and_confidence_rows_match_k1(self):
+        """Acceptance pin: at K=4 with a self-distilled head, the binary
+        (50-token completions) and confidence (10-token, pooled) legs
+        emit the K=1 rows — discrete fields exactly, probability fields
+        at the fp32 rounding floor — while the accept path really ran
+        (k_steps_saved > 0, accepted_k histogram recorded)."""
+        eng, k_eng, _ = self._pair()
+        prompts = _prompts(6)
+        ref_b = eng.score_prompts(prompts)
+        ref_c = eng.score_prompts(prompts, with_confidence=True,
+                                  max_new_tokens=10)
+        k_eng.distill_k_head_on(prompts)
+        snap = dict(telemetry.counters())
+        h0 = telemetry.hist_count("accepted_k")
+        got_b = k_eng.score_prompts(prompts)
+        got_c = k_eng.score_prompts(prompts, with_confidence=True,
+                                    max_new_tokens=10)
+        delta = telemetry.counters_since(snap)
+        _rows_equal(ref_b, got_b)
+        _rows_equal(ref_c, got_c)
+        assert delta.get("k_blocks_proposed", 0) > 0
+        assert delta.get("k_steps_saved", 0) > 0        # accepts happened
+        assert telemetry.hist_count("accepted_k") > h0
+        # per-leg split sums into the total
+        legs = (delta.get("k_steps_saved|leg=completion", 0)
+                + delta.get("k_steps_saved|leg=confidence", 0))
+        assert legs == delta.get("k_steps_saved", 0)
+
+    def test_forced_rejection_fallback_bit_identical(self):
+        """Acceptance pin: ADVERSARIAL proposals (random head) force
+        rejections and the fallback re-runs the unchanged sequential
+        loop — rows stay bit-identical, only telemetry differs."""
+        eng, k_eng, _ = self._pair()
+        prompts = _prompts(6)
+        ref = eng.score_prompts(prompts, with_confidence=True,
+                                max_new_tokens=10)
+        k_eng.k_head = dmod.init_k_head(k_eng.cfg, 4, seed=11)
+        snap = dict(telemetry.counters())
+        got = k_eng.score_prompts(prompts, with_confidence=True,
+                                  max_new_tokens=10)
+        delta = telemetry.counters_since(snap)
+        _rows_equal(ref, got)
+        assert delta.get("k_blocks_rejected", 0) > 0
+        # an all-rejecting run did MORE work than sequential, never
+        # less: no chunk completed on the K path, so zero steps-saved
+        # may be claimed (the bench-record honesty rule)
+        assert delta.get("k_steps_saved", 0) == 0
+
+    def test_missing_head_runs_sequential(self):
+        eng, k_eng, _ = self._pair()
+        prompts = _prompts(4)
+        ref = eng.score_prompts(prompts)
+        snap = dict(telemetry.counters())
+        got = k_eng.score_prompts(prompts)      # no head set
+        delta = telemetry.counters_since(snap)
+        _rows_equal(ref, got)
+        assert delta.get("k_decode_head_missing", 0) == 1
+        assert delta.get("k_blocks_proposed", 0) == 0
+        # noted ONCE: a second call stays quiet
+        k_eng.score_prompts(prompts)
+        assert telemetry.counters_since(snap).get(
+            "k_decode_head_missing", 0) == 1
+
+    def test_k1_never_records_k_telemetry(self):
+        eng, _, _ = self._pair()
+        snap = dict(telemetry.counters())
+        eng.score_prompts(_prompts(4), with_confidence=True,
+                          max_new_tokens=10)
+        delta = telemetry.counters_since(snap)
+        assert not any(k.startswith("k_") for k in delta)
+
+    def test_fused_two_leg_parity_across_pool_compositions(self):
+        """The pooled-confidence composition contract extends to K > 1:
+        different pool targets (different flush groupings) and the K=1
+        reference all emit bit-identical rows on the fused two-leg
+        path — acceptance is per flush batch, but BOTH outcomes of the
+        accept/reject decision emit the sequential path's bits."""
+        pairs = [(f"Scenario {i}: the bylaw covers bicycles.",
+                  (" Answer Yes or No.", " How confident, 0-100?"))
+                 for i in range(6)]
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        sample = [p + s for p, (s, _) in pairs]
+        eng, cfg, params, tok = _engine()
+        ref = eng.score_prefixed(pairs, legs=legs)
+        rows_by_target = []
+        for target in (0, 3):
+            k_eng = ScoringEngine(
+                "falcon", cfg, params, tok,
+                engine_config=dataclasses.replace(
+                    eng.ecfg, decode_k=4, phase2_pool_target=target))
+            k_eng.distill_k_head_on(sample)
+            rows_by_target.append(k_eng.score_prefixed(pairs, legs=legs))
+        for got in rows_by_target:
+            for leg_ref, leg_got in zip(ref, got):
+                _rows_equal(leg_ref, leg_got)
+
+
+# ---------------------------------------------------------------------------
+# EOS composition: k_steps_saved and decode_steps_saved never double count
+# ---------------------------------------------------------------------------
+
+class TestEosComposition:
+    def test_eos_saved_and_k_saved_are_disjoint(self):
+        """``decode_steps_saved`` counts positions whose chunks were
+        NEVER launched (EOS early stop); ``k_steps_saved`` counts
+        positions that WERE decoded, jointly, beyond the one verify
+        pass.  Disjoint by construction: their sum can never exceed the
+        total decode positions, and both fire on an EOS-typical run."""
+        from test_packed import _eos_boosted
+
+        cfg = DecoderConfig(**dict(TINY, vocab_size=384))
+        tok = build_test_tokenizer()
+        params = random_decoder_params(cfg)
+        eng = ScoringEngine(
+            "falcon", cfg, params, tok,
+            engine_config=EngineConfig(batch_size=8, buckets=(32, 64)))
+        prompts = _prompts(6)
+        targets = [["Yes", "No"]] * 6
+        eos_id = bench._arm_eos_token(tok, cfg)
+        boosted = _eos_boosted(eng, cfg, params, prompts, targets, eos_id)
+        try:
+            eng.params = boosted
+            ref = eng.score_prompts(prompts, targets=targets)
+            k_eng = ScoringEngine(
+                "falcon", cfg, boosted, tok,
+                engine_config=dataclasses.replace(eng.ecfg, decode_k=4))
+            k_eng.distill_k_head_on(prompts)
+            snap = dict(telemetry.counters())
+            got = k_eng.score_prompts(prompts, targets=targets)
+            delta = telemetry.counters_since(snap)
+        finally:
+            eng.params = params
+            tok.eos_token_id = None
+        _rows_equal(ref, got)
+        gen_total = eng.ecfg.max_new_tokens
+        n_rows = len(prompts)
+        saved_eos = delta.get("decode_steps_saved", 0)
+        saved_k = delta.get("k_steps_saved", 0)
+        assert saved_eos > 0                      # EOS early stop engaged
+        assert saved_k > 0                        # joint blocks accepted
+        assert saved_eos + saved_k <= gen_total * n_rows
+
+
+# ---------------------------------------------------------------------------
+# Strict mode
+# ---------------------------------------------------------------------------
+
+class TestStrictMode:
+    def test_strict_k_decode_sweep_no_blocked_transfers(self):
+        """Every K-path fetch (accept flags, chunk tokens, retirement
+        reads) happens inside the sanctioned consume scope, so a
+        strict-mode K-decode sweep holds ``blocked_transfers == 0`` —
+        and its rows still match the K=1 strict rows."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        pairs = [(f"Scenario {i}: the bylaw covers bicycles.",
+                  (" Answer Yes or No.", " How confident, 0-100?"))
+                 for i in range(4)]
+        legs = [LegSpec("binary"),
+                LegSpec("confidence", with_confidence=True,
+                        max_new_tokens=10)]
+        eng, cfg, params, tok = _engine()
+        ref = eng.score_prefixed(pairs, legs=legs)
+        k_eng = ScoringEngine(
+            "falcon", cfg, params, tok,
+            engine_config=dataclasses.replace(eng.ecfg, decode_k=4))
+        k_eng.distill_k_head_on([p + s for p, (s, _) in pairs])
+        strict.activate()
+        try:
+            snap = telemetry.counters()
+            got = k_eng.score_prefixed(pairs, legs=legs)
+            delta = telemetry.counters_since(snap)
+            assert delta.get(strict.BLOCKED_COUNTER, 0) == 0
+            assert delta.get("k_blocks_proposed", 0) > 0
+        finally:
+            strict.deactivate()
+        for leg_ref, leg_got in zip(ref, got):
+            _rows_equal(leg_ref, leg_got)
+
+
+# ---------------------------------------------------------------------------
+# Telemetry export (obs/metrics satellite)
+# ---------------------------------------------------------------------------
+
+class TestTelemetryExport:
+    def test_prometheus_hist_and_leg_counters(self):
+        """``accepted_k`` exports as a Prometheus ``histogram`` family and
+        the per-leg ``k_steps_saved|leg=...`` twins surface as labeled
+        series of ONE counter family (the README counter-table rows)."""
+        from llm_interpretation_replication_tpu.obs import metrics
+
+        eng, cfg, params, tok = _engine()
+        k_eng = ScoringEngine(
+            "falcon", cfg, params, tok,
+            engine_config=dataclasses.replace(eng.ecfg, decode_k=4))
+        prompts = _prompts(4)
+        k_eng.distill_k_head_on(prompts)
+        k_eng.score_prompts(prompts, with_confidence=True,
+                            max_new_tokens=10)
+        text = metrics.prometheus_text()
+        assert "# TYPE llm_interp_accepted_k histogram" in text
+        assert "llm_interp_accepted_k_bucket" in text
+        assert "llm_interp_k_blocks_proposed" in text
+        assert 'llm_interp_k_steps_saved{leg="confidence"}' in text
+
+
+# ---------------------------------------------------------------------------
+# plan / plan_search: the priced K axis
+# ---------------------------------------------------------------------------
+
+class TestPlanSearchKAxis:
+    def _falcon(self):
+        from llm_interpretation_replication_tpu.models.config import (
+            BENCH_GEOMETRIES,
+        )
+
+        return DecoderConfig(**BENCH_GEOMETRIES["falcon-7b"])
+
+    def test_coefficient_literals_pinned(self):
+        """The PR-5/PR-8 anchor discipline: coefficients are literals a
+        recalibration must change deliberately, test-first."""
+        assert ps.K_ACCEPT_PRIOR == 0.9
+        assert ps.K_DECODE_SHARE == 0.55
+        assert ps.DEFAULT_DECODE_KS == (1, 2, 4, 8)
+
+    def test_speedup_formula(self):
+        assert ps.k_decode_speedup(1) == 1.0
+        p = ps.K_ACCEPT_PRIOR
+        for k in (2, 4, 8):
+            pb = p ** (k - 1)
+            assert ps.k_decode_speedup(k) == pytest.approx(
+                k / (pb + (1 - pb) * (1 + k)))
+        # the non-monotone shape IS the reason the axis is priced: at the
+        # 0.9 prior K=4 beats both K=2 and K=8
+        assert ps.k_decode_speedup(4) > ps.k_decode_speedup(2)
+        assert ps.k_decode_speedup(4) > ps.k_decode_speedup(8)
+
+    def test_k_head_bytes_and_need_terms(self):
+        f7 = self._falcon()
+        assert plan_mod.k_head_bytes(f7, 1) == 0
+        assert plan_mod.k_head_bytes(f7, 4) == \
+            3 * f7.hidden_size * f7.vocab_size * 2
+        wb = plan_mod.weight_bytes(f7, "int8")
+        base = plan_mod.full_study_need_terms(f7, wb, "xla", 320, 256)
+        assert "k_head" not in base          # default: every old pin holds
+        terms = plan_mod.full_study_need_terms(f7, wb, "xla", 320, 256,
+                                               decode_k=4)
+        assert terms["k_head"] == plan_mod.k_head_bytes(f7, 4)
+        # the K-head shards like a second lm_head: over tp (and pp)
+        d1 = ps.sharded_need_bytes(terms, f7, 1, 1, 1)
+        d2 = ps.sharded_need_bytes(terms, f7, 1, 2, 1)
+        assert d1 - ps.sharded_need_bytes(base, f7, 1, 1, 1) == \
+            terms["k_head"]
+        assert d2 < d1
+
+    def test_pricing_applies_amdahl_over_decode_share(self):
+        f7 = self._falcon()
+        base = ps.predicted_rows_per_s(f7, 1, 1, 320, workload="full")
+        k4 = ps.predicted_rows_per_s(f7, 1, 1, 320, workload="full",
+                                     decode_k=4)
+        s = ps.k_decode_speedup(4)
+        assert k4 == pytest.approx(
+            base / (1 - ps.K_DECODE_SHARE + ps.K_DECODE_SHARE / s))
+        # binary/packed workloads never price the axis
+        assert ps.predicted_rows_per_s(
+            f7, 1, 1, 320, workload="binary", decode_k=4) == \
+            ps.predicted_rows_per_s(f7, 1, 1, 320, workload="binary")
+
+    def test_k_gt1_candidate_survives_full_study_budget(self):
+        """Acceptance criterion: the full-study search on the bench
+        geometry keeps at least one K>1 candidate inside the budget —
+        and records the axis on every candidate row."""
+        f7 = self._falcon()
+        ranked = ps.search_plans(f7, "int8", 1, seq=256, workload="full")
+        fit_k = [c for c in ranked if c.fits and c.decode_k > 1]
+        assert fit_k, "no K>1 candidate fits the full-study budget"
+        assert all("decode_k" in c.as_record() for c in ranked[:4])
+        # at the 0.9 prior the K axis WINS the search outright
+        chosen = ps.chosen_plan(ranked)
+        assert chosen is not None and chosen.decode_k > 1
+
+    def test_binary_and_packed_collapse_the_axis(self):
+        f7 = self._falcon()
+        for workload in ("binary", "packed"):
+            ranked = ps.search_plans(f7, "int8", 1, seq=256,
+                                     workload=workload)
+            assert all(c.decode_k == 1 for c in ranked)
+
+
+# ---------------------------------------------------------------------------
+# serve: mixed-K requests never share an engine call
+# ---------------------------------------------------------------------------
+
+class TestServeDecodeK:
+    def test_compat_key_resolves_engine_default_and_override(self):
+        from llm_interpretation_replication_tpu.serve import coalescer
+        from llm_interpretation_replication_tpu.serve.request import (
+            ScoreRequest,
+        )
+
+        eng, _, _, _ = _engine(decode_k=4)
+        base = coalescer.compat_key(eng, ScoreRequest(prompt="p"), None)
+        inherit = coalescer.compat_key(
+            eng, ScoreRequest(prompt="q", decode_k=4), None)
+        override = coalescer.compat_key(
+            eng, ScoreRequest(prompt="r", decode_k=1), None)
+        assert base == inherit          # None inherits the engine's K
+        assert override != base         # explicit K=1 is its own group
+        with pytest.raises(ValueError, match="decode_k"):
+            ScoreRequest(prompt="p", decode_k=0).validate()
+
+    def test_mixed_k_requests_never_share_an_engine_call(self):
+        from test_serve import FAST, RecordingEngine
+
+        from llm_interpretation_replication_tpu.serve import (
+            Scheduler,
+            SchedulerConfig,
+        )
+        from llm_interpretation_replication_tpu.serve.request import (
+            ScoreRequest,
+        )
+
+        eng = RecordingEngine()
+        sched = Scheduler(eng, SchedulerConfig(max_batch=16, **FAST))
+        futs = [sched.submit(ScoreRequest(
+            prompt=f"q{i}", decode_k=(2 if i % 2 else 1)))
+            for i in range(8)]
+        with sched:
+            rows = [f.result(timeout=30) for f in futs]
+        assert all(r["success"] for r in rows)
+        assert len(eng.call_log) == 2
+        assert sorted(len(c["prompts"]) for c in eng.call_log) == [4, 4]
+
+
+# ---------------------------------------------------------------------------
+# obs/benchdiff: K-tagged workload alignment + k_decode flattening
+# ---------------------------------------------------------------------------
+
+class TestBenchDiffDecodeK:
+    def _rec(self, label, metric, value, **extra):
+        rec = {"label": label, "metric": metric, "value": value,
+               "unit": "rows/sec"}
+        rec.update(extra)
+        return rec
+
+    def test_shape_tag_only_above_one(self):
+        from llm_interpretation_replication_tpu.obs.benchdiff import (
+            _shape_tags,
+        )
+
+        assert _shape_tags("full-study rows (joint decode-k 4)") == ["k4"]
+        assert _shape_tags("full-study rows (joint decode-k 1)") == []
+        assert _shape_tags("full-study rows, no-EOS worst case") == []
+
+    def test_mixed_k_records_report_new_gone(self):
+        """A K-tagged headline never cross-compares with the sequential
+        one: the K row reads ``new``, the legacy row ``gone`` — no
+        verdict is computed across workload shapes (the ISSUE-11/10
+        alignment discipline)."""
+        from llm_interpretation_replication_tpu.obs.benchdiff import (
+            diff_records,
+        )
+
+        legacy = self._rec("r05", "full-study rows/sec/chip (no-EOS "
+                           "worst case)", 31.64)
+        ktagged = self._rec("r06", "full-study rows/sec/chip (no-EOS "
+                            "worst case, joint decode-k 4)", 45.0)
+        diff = diff_records([legacy, ktagged])
+        verdicts = {r["key"]: r["verdict"] for r in diff["metrics"]}
+        assert verdicts["headline"] == "gone"
+        assert verdicts["headline@k4"] == "new"
+        assert not diff["regressions"]
+        # same-shape records still align and judge
+        diff2 = diff_records([ktagged, dict(ktagged, value=30.0,
+                                            label="r07")])
+        assert diff2["metrics"][0]["verdict"] == "REGRESSION"
+
+    def test_k_decode_block_flattens_top_level_and_nested(self):
+        from llm_interpretation_replication_tpu.obs.benchdiff import (
+            flatten_metrics,
+        )
+
+        block = {"decode_k": 4, "predicted_k": 4,
+                 "accepted_k_mean": 3.2, "k_reject_rate": 0.12,
+                 "k_steps_saved": {"total": 900, "confidence": 400,
+                                   "completion": 500}}
+        top = self._rec("r06", "full-study rows (joint decode-k 4)",
+                        45.0, k_decode=block)
+        flat = flatten_metrics(top)
+        assert flat["k-decode steps-saved (confidence)"]["value"] == 400
+        assert flat["k-decode steps-saved (completion)"]["value"] == 500
+        assert flat["k-decode accepted-k mean"]["value"] == 3.2
+        assert flat["k-decode reject rate"]["value"] == 0.12
+        nested = self._rec("r06", "sweep prompts/sec", 120.0,
+                           secondary=[self._rec(
+                               "x", "full-study rows (joint decode-k 4)",
+                               45.0, k_decode=block)])
+        flat2 = flatten_metrics(nested)
+        assert flat2["k-decode reject rate"]["value"] == 0.12
+
+
+# ---------------------------------------------------------------------------
+# bench wiring
+# ---------------------------------------------------------------------------
+
+class TestBenchWiring:
+    def test_k_decode_block_builder(self):
+        import argparse
+
+        telemetry.record_hist("accepted_k", 4)
+        ns = argparse.Namespace(
+            decode_k=4, predicted_k=4,
+            context_counters={
+                "k_blocks_proposed": 100, "k_blocks_rejected": 10,
+                "k_steps_saved": 900,
+                "k_steps_saved|leg=confidence": 400,
+                "k_steps_saved|leg=completion": 500},
+            k_hist={"counts": {telemetry.hist_bucket_index(4): 25},
+                    "count": 25, "sum": 100.0})
+        block = bench._k_decode_block(ns)
+        assert block["decode_k"] == 4 and block["predicted_k"] == 4
+        assert block["k_reject_rate"] == 0.1
+        assert block["k_steps_saved"] == {
+            "total": 900, "confidence": 400, "completion": 500}
+        assert block["accepted_k_mean"] == 4.0
+        # keys are the recovered INTEGER accepted-K values, not the log
+        # histogram's geometric bucket bounds
+        assert block["accepted_k_hist"] == {"4": 25}
+        assert bench._k_decode_block(
+            argparse.Namespace(decode_k=1)) is None
+        json.dumps(block)       # record-serializable
+
+    def test_bench_sweep_full_k_decode_end_to_end(self, tmp_path):
+        """The whole bench wiring, executed: a tiny --mode sweep-full run
+        at decode_k=4 distills the K-head, runs both legs through the K
+        path, and lands a k_decode block (accepted-K histogram scoped to
+        the measured repeats, per-leg steps saved, reject rate) plus the
+        K-tagged metric text in the record."""
+        import argparse
+
+        import jax
+
+        scenarios = [{
+            "original_main": "Is soup a beverage?",
+            "response_format": "Answer only 'Yes' or 'No'.",
+            "confidence_format": "How confident are you (0-100)?",
+            "target_tokens": ["Yes", "No"],
+            "rephrasings": [f"Is soup number {i} a beverage?"
+                            for i in range(6)],
+        }]
+        corpus = tmp_path / "perturbations.json"
+        corpus.write_text(json.dumps(scenarios))
+        cfg = DecoderConfig(**dict(
+            TINY, parallel_residual=True, qkv_bias=True, out_bias=True,
+            mlp_bias=True))
+        params = bench.init_params(cfg, jax.random.PRNGKey(0),
+                                   jnp.float32)
+        args = argparse.Namespace(
+            model="tiny", quant="none", sweep_batch=8, sweep_rows=0,
+            sweep_repeats=1, pool_target=0, pipeline_depth=2,
+            checkpoint_every=100, sweep_out=str(tmp_path / "out.xlsx"),
+            decided_frac=0.9, perturbations=str(corpus), mode="sweep-full",
+            warmup=False, fuse_prefix=True, eos_mode="none",
+            eos_brackets=False, decode_k=4)
+        rps, rate, out = bench.run_sweep_full_mode(args, cfg, params)
+        assert rps > 0 and np.isfinite(rps)
+        record = bench._full_study_record(args, rps, rate)
+        assert ", joint decode-k 4" in record["metric"]
+        block = record["k_decode"]
+        assert block["decode_k"] == 4
+        assert block["k_blocks_proposed"] > 0
+        assert sum(block["accepted_k_hist"].values()) == \
+            block["k_blocks_proposed"]
+        # integer K labels, within the engine's possible range
+        assert all(0 <= int(kk) <= 4 for kk in block["accepted_k_hist"])
+        assert block["k_steps_saved"]["total"] == \
+            (block["k_steps_saved"]["confidence"]
+             + block["k_steps_saved"]["completion"])
+        assert record["context"]["decode_k"] == 4
+        json.dumps(record)
+
+    def test_bench_source_wires_decode_k(self):
+        """Source pins (the child-forwarding test style): the flag
+        exists, the sweep-full engine receives it, the K-head distills
+        before warmup and re-distills on the EOS bracket's params, the
+        plan search applies the chosen K, and the record attaches the
+        block."""
+        src = open(os.path.join(REPO_ROOT, "bench.py"),
+                   encoding="utf-8").read()
+        assert '"--decode-k"' in src
+        assert 'decode_k=getattr(args, "decode_k", 1) or 1' in src
+        # the definition plus its two call sites (post-calibration and
+        # the EOS bracket's re-distill)
+        assert src.count("_distill_bench_k_head(") == 3
+        assert "args.decode_k = best.decode_k" in src
+        assert "child.decode_k = best.decode_k" in src
+        assert 'record["k_decode"] = k_block' in src
+
+    def test_cli_source_wires_decode_k(self):
+        from llm_interpretation_replication_tpu.config import RunConfig
+
+        assert RunConfig().decode_k == 1
+        path = os.path.join(
+            REPO_ROOT, "llm_interpretation_replication_tpu",
+            "__main__.py")
+        src = open(path, encoding="utf-8").read()
+        assert '"--decode-k"' in src
+        assert "distill_k_head_on" in src
+        assert "decode_k=getattr(rc, \"decode_k\", 1)" in src

@@ -829,6 +829,62 @@ class TestRepoGate:
                      if e.get("path", "").endswith(touched)]
         assert not offenders, offenders
 
+    def test_kdecode_verify_path_is_in_g05_scope(self):
+        """Satellite (ISSUE 13): the K-decode verify/propose path lives
+        in models/ and runtime/ — both fault scope — so a broad except
+        swallowing around a verify pass would hide the device error the
+        reject-fallback ladder must classify.  Teeth check for the two
+        modules the K path runs through."""
+        for path in ("models/decoder.py", "runtime/engine.py"):
+            findings = run(path, """
+                def verify(block):
+                    try:
+                        return block.accept()
+                    except Exception:
+                        return None
+            """)
+            assert rules_of(findings) == ["G05"], path
+
+    def test_kdecode_touched_modules_are_scanned_by_the_gate(self):
+        """Satellite (ISSUE 13): every package module the K-decode change
+        touches sits inside the default-paths walker, so the repo gate
+        lints the new code on every run."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            iter_python_files,
+        )
+
+        pkg = next(p for p in default_paths()
+                   if p.endswith("llm_interpretation_replication_tpu"))
+        scanned = [f.replace(os.sep, "/") for f in iter_python_files([pkg])]
+        for mod in ("/models/decoder.py", "/runtime/engine.py",
+                    "/runtime/plan.py", "/runtime/plan_search.py",
+                    "/serve/request.py", "/serve/coalescer.py",
+                    "/serve/scheduler.py", "/obs/benchdiff.py"):
+            assert any(mod in f for f in scanned), mod
+
+    def test_kdecode_touched_modules_carry_no_baseline_entries(self):
+        """Satellite (ISSUE 13): the joint K-token decode change ships
+        lint-clean — zero new ``lint_baseline.json`` entries for every
+        module it touches (decoder K-head/verify program, engine K-chunk
+        driver, plan/plan_search K axis, serve request/coalescer/
+        scheduler key plumbing, benchdiff K tags, CLI/config plumbing,
+        bench)."""
+        from llm_interpretation_replication_tpu.lint.cli import (
+            default_baseline_path,
+        )
+
+        touched = ("models/decoder.py", "runtime/engine.py",
+                   "runtime/plan.py", "runtime/plan_search.py",
+                   "serve/request.py", "serve/coalescer.py",
+                   "serve/scheduler.py", "obs/benchdiff.py",
+                   "config/__init__.py",
+                   "llm_interpretation_replication_tpu/__main__.py",
+                   "bench.py")
+        entries = load_baseline(default_baseline_path())
+        offenders = [e for e in entries
+                     if e.get("path", "").endswith(touched)]
+        assert not offenders, offenders
+
     def test_gate_would_catch_an_injected_violation(self, tmp_path):
         """End-to-end teeth check: copy one real hot-path file, inject a
         G01 `.item()` into it, and confirm the same entry point that the
