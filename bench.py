@@ -580,6 +580,7 @@ def run_sweep_mode(args, cfg, params):
             batch_size=args.sweep_batch, decode_completions=False,
             phase2_pool_target=args.pool_target,
             pooled_confidence=getattr(args, "pooled_confidence", True),
+            slot_repack=getattr(args, "slot_repack", True),
             pipeline_depth=args.pipeline_depth,
             kv_dtype=getattr(args, "kv_dtype", "bf16") or "bf16",
             prefill_chunk=getattr(args, "prefill_chunk", 0) or 0,
@@ -663,6 +664,9 @@ def run_sweep_mode(args, cfg, params):
     # scope the record's context-block counters to the measured repeats
     # (calibration above must not inflate them) — _operating_context
     args.counters_snap = counters()
+    engine.occupancy_report()      # drop calibration/warmup ring stats —
+    #                                the occupancy block scopes to the
+    #                                measured repeats like the counters
     _obs_phase_snap(args)
     best_dt = float("inf")
     best_score_s = float("inf")
@@ -742,6 +746,9 @@ def run_sweep_mode(args, cfg, params):
     args.context_counters = counters_since(args.counters_snap)
     args.phases_report = _phases_report(
         args, sum(repeat_times), n_total * max(1, len(repeat_times)))
+    # slot-occupancy block (ROADMAP item 3): idle fraction before/after
+    # repack, refills, repack stalls — drained from the engine's rings
+    args.occupancy_report = engine.occupancy_report()
 
     if getattr(args, "serve_replay", False):
         # Route the SAME workload through the serve/ continuous-batching
@@ -1097,6 +1104,7 @@ def run_sweep_full_mode(args, cfg, params):
             batch_size=args.sweep_batch, decode_completions=True,
             phase2_pool_target=args.pool_target,
             pooled_confidence=getattr(args, "pooled_confidence", True),
+            slot_repack=getattr(args, "slot_repack", True),
             pipeline_depth=args.pipeline_depth,
             kv_dtype=getattr(args, "kv_dtype", "bf16") or "bf16",
             prefill_chunk=getattr(args, "prefill_chunk", 0) or 0,
@@ -1191,6 +1199,8 @@ def run_sweep_full_mode(args, cfg, params):
     # (the accepted_k histogram follows the same discipline)
     args.counters_snap = counters()
     args.k_hist_snap = hist_snapshot(["accepted_k"])
+    engine.occupancy_report()      # scope the occupancy block to the
+    #                                measured repeats (counters discipline)
     _obs_phase_snap(args)
     best_dt = float("inf")
     last_ok_path = None
@@ -1257,6 +1267,8 @@ def run_sweep_full_mode(args, cfg, params):
     args.repeat_times = repeat_times
     args.phases_report = _phases_report(
         args, sum(repeat_times), n_total * max(1, len(repeat_times)))
+    # slot-occupancy block (ROADMAP item 3): measured-repeat ring stats
+    args.occupancy_report = engine.occupancy_report()
 
     # {no-EOS, EOS-typical} bracket rows (ROADMAP item 4): the measured
     # repeats above are one bracket; when they ran no-EOS (the r01-r06
@@ -1433,6 +1445,11 @@ def _full_study_record(a, rps: float, rate: float) -> dict:
         # joint K-decode telemetry (ISSUE 13): accepted-K distribution,
         # per-leg steps saved, reject rate, predicted-vs-configured K
         record["k_decode"] = k_block
+    if getattr(a, "occupancy_report", None):
+        # slot-occupancy block (ROADMAP item 3): slot-idle fraction
+        # before/after decode-then-repack, refills, repack stalls — the
+        # number the next driver record measures the occupancy gain by
+        record["occupancy"] = a.occupancy_report
     record.update(_repeat_report(a))
     record.update(_operating_context(a))
     if getattr(a, "plan_search_report", None):
@@ -1490,6 +1507,7 @@ def _full_study_secondary(args, cfg, geometry, params) -> dict:
     child.prefill_chunk = getattr(args, "full_prefill_chunk", 128)
     child.attn = getattr(args, "attn", "xla")
     child.pooled_confidence = getattr(args, "pooled_confidence", True)
+    child.slot_repack = getattr(args, "slot_repack", True)
     child.sweep_out = None          # fresh tempdir workbook — never the
     #                                 parent sweep's artifact
     child.plan_search_report = None
@@ -1511,7 +1529,10 @@ def _full_study_secondary(args, cfg, geometry, params) -> dict:
         ranked = search_plans(
             cfg, args.quant, n_devices=1, seq=256, workload="full",
             batches=tuple(range(32, max(512, args.sweep_batch) + 1, 32)),
-            pipeline_depth=args.pipeline_depth, attention_impl=child.attn)
+            pipeline_depth=args.pipeline_depth, attention_impl=child.attn,
+            # price the pool the way the engine will actually run it: the
+            # refill model when decode-then-repack is on (the default)
+            slot_repack=getattr(child, "slot_repack", True))
         best = chosen_plan(ranked)
         print(format_candidate_table(ranked,
                                      title="plan search (full-study)"),
@@ -1547,6 +1568,7 @@ def _full_study_secondary(args, cfg, geometry, params) -> dict:
             kv_dtype=child.kv_dtype, prefill_chunk=child.prefill_chunk,
             pooled_confidence=child.pooled_confidence,
             pool_target=child.pool_target or None,
+            slot_repack=getattr(child, "slot_repack", True),
         )
         child.fit_decision = sweep_plan.reason
         child.predicted_batch = sweep_plan.batch
@@ -1767,6 +1789,7 @@ def _operating_context(args) -> dict:
         # produced it, not just the kv/chunk knobs
         "phase2_pool_target": getattr(args, "pool_target", 0),
         "pooled_confidence": bool(getattr(args, "pooled_confidence", True)),
+        "slot_repack": bool(getattr(args, "slot_repack", True)),
         # the decode bracket + packing settings (ISSUE 10): a record's
         # number names which {no-EOS, EOS-typical} bracket produced it
         # and whether rows were packed, so bench-diff can refuse to
@@ -1801,7 +1824,9 @@ def _operating_context(args) -> dict:
         ctx["kv_cache_gib_saved"] = round(
             c["kv_cache_bytes_saved"] / 2**30, 2)
     for name in ("pooled_conf_rows", "pooled_conf_retired_rows",
-                 "conf_steps_saved"):
+                 "conf_steps_saved", "slot_rows", "slot_refills",
+                 "slot_retired", "slot_repacks", "slot_repack_stalls",
+                 "slot_compactions"):
         if c.get(name):
             ctx[name] = int(c[name])
     if c.get("completion_cache_bytes_freed"):
@@ -1988,6 +2013,16 @@ def main():
                              "runtime/engine._Phase2Pool).  "
                              "--no-pooled-confidence measures the r5 "
                              "per-batch decode")
+    parser.add_argument("--slot-repack",
+                        action=argparse.BooleanOptionalAction, default=True,
+                        help="sweep modes: decode-then-repack slot-level "
+                             "continuous batching (runtime/slots.py) — "
+                             "retired pool lanes refill from the pending "
+                             "queue mid-decode and the record gains an "
+                             "'occupancy' block (slot-idle fraction "
+                             "before/after, refills, repack stalls).  "
+                             "--no-slot-repack measures the legacy "
+                             "whole-flush schedule")
     parser.add_argument("--decode-k", type=int, default=1, metavar="K",
                         help="sweep-full mode (and the sweep mode's "
                              "full-study secondary): joint next-K-token "
@@ -2543,7 +2578,8 @@ def main():
                 # a --attn flash run must be priced as flash (the fp32
                 # output workspace), not as the dense score tensor the
                 # flash kernel never materializes
-                attention_impl=args.attn)
+                attention_impl=args.attn,
+                slot_repack=getattr(args, "slot_repack", True))
             best = chosen_plan(ranked)
             print(format_candidate_table(ranked), file=sys.stderr)
             if best is None:
@@ -2612,6 +2648,7 @@ def main():
                 # own (clamped) batch_size, not the requested one
                 pooled_confidence=args.pooled_confidence,
                 pool_target=args.pool_target or None,
+                slot_repack=getattr(args, "slot_repack", True),
             )
         elif args.mode == "sweep-packed":
             from llm_interpretation_replication_tpu.runtime.plan_search import (
@@ -2719,6 +2756,10 @@ def main():
         if getattr(args, "plan_search_report", None):
             record["plan_search"] = args.plan_search_report
         record.update(getattr(args, "phases_report", None) or {})
+        if getattr(args, "occupancy_report", None):
+            # slot-occupancy block (ROADMAP item 3) for the binary
+            # sweep's pooled rings — same shape as the sweep-full one
+            record["occupancy"] = args.occupancy_report
         if getattr(args, "serve_report", None):
             record["serve"] = args.serve_report
         if getattr(args, "serve_load_report", None):

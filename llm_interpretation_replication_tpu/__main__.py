@@ -90,6 +90,13 @@ def _add_run_config_args(p: argparse.ArgumentParser):
     p.add_argument("--phase2-pool-target", type=int, default=0, metavar="N",
                    help="rows per pooled phase-2 decode (binary undecided "
                         "pool AND confidence pool); 0 = batch size")
+    p.add_argument("--slot-repack",
+                   action=argparse.BooleanOptionalAction, default=True,
+                   help="decode-then-repack slot-level continuous batching "
+                        "(runtime/slots.py): retired pool lanes refill "
+                        "from the pending queue mid-decode; "
+                        "--no-slot-repack keeps the legacy whole-flush "
+                        "schedule")
     p.add_argument("--decode-k", type=int, default=1, metavar="K",
                    help="joint next-K-token decode with verify-and-accept "
                         "(models/decoder.k_verify_block): a K-head "
@@ -122,6 +129,7 @@ def _run_config(args):
         kv_dtype=args.kv_dtype, prefill_chunk=args.prefill_chunk,
         pooled_confidence=getattr(args, "pooled_confidence", True),
         phase2_pool_target=getattr(args, "phase2_pool_target", 0),
+        slot_repack=getattr(args, "slot_repack", True),
         decode_k=getattr(args, "decode_k", 1),
         plan_search=getattr(args, "plan_search", False),
         attention_impl=args.attention_impl,
@@ -169,10 +177,23 @@ def _engine_factory(run_config):
                 prefill_chunk=rc.prefill_chunk,
                 pooled_confidence=rc.pooled_confidence,
                 phase2_pool_target=rc.phase2_pool_target,
+                slot_repack=getattr(rc, "slot_repack", True),
                 decode_k=getattr(rc, "decode_k", 1),
             ),
         )
         engine.plan_decision = plan_note
+        if getattr(rc, "decode_k", 1) > 1:
+            # load-or-redistill (ROADMAP 2(c)): a K-head distilled in an
+            # earlier process persists beside the snapshot keyed on
+            # (weights fingerprint, decode_k) — a hit skips the
+            # per-process ridge-probe distillation entirely; callers that
+            # distill on a miss persist via loader_mod.save_k_head
+            from .runtime import loader as loader_mod
+
+            if loader_mod.attach_k_head(engine, path):
+                print(f"# K-head loaded from snapshot "
+                      f"({loader_mod.K_HEAD_FILENAME}, decode_k="
+                      f"{rc.decode_k})", file=sys.stderr)
         return engine
 
     return factory
@@ -334,10 +355,13 @@ def cmd_run_perturbation(args):
     rc = _run_config(args)
     scenarios = load_perturbations(args.perturbations, expected_scenarios=legal_scenarios())
     engine = _engine_factory(rc)(args.model)
-    if getattr(engine.ecfg, "decode_k", 1) > 1:
+    if getattr(engine.ecfg, "decode_k", 1) > 1 and engine.k_head is None:
         # K-head self-distillation on the sweep's own texts (both legs'
         # formats — the continuations the decode legs will replay); a
-        # verify-and-accept head can only cost rejections, never rows
+        # verify-and-accept head can only cost rejections, never rows.
+        # Skipped entirely when the factory loaded a persisted head
+        # (k_head.npz beside the snapshot); a fresh distillation persists
+        # for the next process.
         sample = [f"{r} {s['response_format']}" for s in scenarios
                   for r in s["rephrasings"][:3]][:24]
         sample += [f"{r} {s['confidence_format']}" for s in scenarios
@@ -345,6 +369,16 @@ def cmd_run_perturbation(args):
         engine.distill_k_head_on(sample)
         print(f"# K-head distilled for decode_k={engine.ecfg.decode_k} "
               f"on {min(len(sample), 32)} sample prompts", file=sys.stderr)
+        if engine.k_head is not None:
+            from .runtime import loader as loader_mod
+
+            try:
+                saved = loader_mod.save_k_head(
+                    rc.snapshot_path(args.model), engine.k_head,
+                    engine.ecfg.decode_k)
+                print(f"# K-head persisted to {saved}", file=sys.stderr)
+            except OSError as err:   # read-only snapshot dir: still runs
+                print(f"# K-head not persisted ({err})", file=sys.stderr)
     if getattr(args, "packed", 0):
         # packed multi-question batching (scoring/packed.py): Q rephrasings
         # per prefill, anchor-gathered binary leg, measured-drift contract

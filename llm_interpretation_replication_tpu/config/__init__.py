@@ -148,6 +148,10 @@ class RunConfig:
                                          # EngineConfig.pooled_confidence)
     phase2_pool_target: int = 0          # rows per pooled decode (binary +
                                          # confidence pools); 0 = batch_size
+    slot_repack: bool = True             # decode-then-repack slot ring
+                                         # (runtime/slots.py): retired pool
+                                         # lanes refill mid-decode; False =
+                                         # the legacy whole-flush schedule
     decode_k: int = 1                    # joint next-K-token decode block
                                          # size (verify-and-accept —
                                          # runtime/engine EngineConfig.

@@ -47,7 +47,7 @@ _HIGHER_IS_BETTER_UNITS = ("prompts/sec", "rows/sec")
 
 #: units where SMALLER values are better — the serve-load latency rows
 #: (ISSUE 11): a p99 that grew past the threshold is the regression.
-_LOWER_IS_BETTER_UNITS = ("ms",)
+_LOWER_IS_BETTER_UNITS = ("ms", "idle-frac")
 
 
 def load_bench_record(path: str) -> Dict:
@@ -166,7 +166,41 @@ def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
     for holder in [rec] + [e for e in extra_rows if isinstance(e, dict)]:
         for key, row in _k_decode_rows(holder).items():
             out.setdefault(key, row)
+    # occupancy blocks (ROADMAP item 3) follow the same two-home rule
+    for holder in [rec] + [e for e in extra_rows if isinstance(e, dict)]:
+        for key, row in _occupancy_rows(holder).items():
+            out.setdefault(key, row)
     out.update(_serve_load_rows(rec))
+    return out
+
+
+def _occupancy_rows(rec: Dict) -> Dict[str, Dict]:
+    """Aligned rows from a record's ``occupancy`` block (ROADMAP item 3,
+    decode-then-repack): the SLOT-IDLE FRACTION is a lower-is-better
+    verdict row (unit ``idle-frac`` — occupancy regressing means the
+    repack pipeline stopped refilling lanes), the whole-flush
+    counterfactual and refill/stall counts ride along as informational
+    rows so an idle-fraction move is explainable in place."""
+    block = rec.get("occupancy")
+    if not isinstance(block, dict):
+        return {}
+    out: Dict[str, Dict] = {}
+    if block.get("slot_idle_frac") is not None:
+        out["slot idle fraction [idle-frac]"] = {
+            "value": block["slot_idle_frac"], "unit": "idle-frac",
+            "metric": "decode slot-idle fraction under repack "
+                      "(lower = fuller lanes)"}
+    if block.get("slot_idle_frac_no_repack") is not None:
+        out["slot idle fraction (no-repack counterfactual)"] = {
+            "value": block["slot_idle_frac_no_repack"], "unit": "",
+            "metric": "whole-flush counterfactual slot-idle fraction "
+                      "(same rows, legacy schedule)"}
+    for name in ("refills", "repack_stalls"):
+        if block.get(name) is not None:
+            out[f"slot {name.replace('_', ' ')}"] = {
+                "value": block[name], "unit": "",
+                "metric": f"decode-then-repack {name.replace('_', ' ')} "
+                          f"(informational)"}
     return out
 
 

@@ -31,6 +31,7 @@ from ..scoring.confidence import weighted_confidence_digits
 from ..utils.telemetry import record_counter, record_fault, record_hist
 from . import batching, faults, strict
 from . import plan as plan_mod
+from . import slots as slots_mod
 
 
 class EngineClosed(RuntimeError):
@@ -154,6 +155,20 @@ class EngineConfig:
                                     # (engages only when the leg's decode
                                     # cap fits inside the scored scan and
                                     # top_k <= ReducedScores' candidates)
+    slot_repack: bool = True        # decode-then-repack (ROADMAP item 3,
+                                    # runtime/slots.py): the cross-batch
+                                    # pools decode through a fixed-capacity
+                                    # slot ring where a retired row's lane
+                                    # is immediately REFILLED from the
+                                    # pending queue between chunks instead
+                                    # of idling until the flush ends.
+                                    # Row-level results are unchanged
+                                    # (retirement is a pure per-row
+                                    # function; scores stay in the
+                                    # chunked-prefill fp32 class — PARITY
+                                    # "Decode-then-repack").  False = the
+                                    # legacy whole-flush schedule
+                                    # (accumulate to target, decode, drain).
     kv_dtype: str = "bf16"          # decode-time KV cache storage dtype:
                                     # "bf16" keeps every bit-parity contract
                                     # (fused-vs-unfused, serve --replay);
@@ -359,6 +374,10 @@ class ScoringEngine:
         # the CLI engine factory); None = hand-configured.  Sweep shells
         # log it so every run names how its operating point was picked.
         self.plan_decision: Optional[str] = None
+        # per-call slot-occupancy stats from the decode-then-repack rings
+        # (runtime/slots.py) — bench drains them into the record's
+        # ``occupancy`` block via occupancy_report()
+        self._occupancy: List[slots_mod.OccupancyStats] = []
         # K-head params for the joint next-K-token decode (models/decoder.
         # k_propose); None with decode_k > 1 runs sequentially, noted once
         self.k_head = None
@@ -2008,6 +2027,359 @@ class ScoringEngine:
         return [r if r is not None else _error_row("missing")
                 for r in results]
 
+    # -- decode-then-repack consumers (runtime/slots.py) ------------------
+
+    def record_occupancy(self, stats) -> None:
+        """Collect one ring's :class:`~.slots.OccupancyStats` (pools and
+        slotted sessions call this as they finish)."""
+        if stats is not None and (stats.capacity_steps or stats.rows):
+            self._occupancy.append(stats)
+
+    def occupancy_report(self, clear: bool = True):
+        """Merged slot-occupancy block for everything scored since the
+        last drain (None when no ring ran) — the bench record's
+        ``occupancy`` block."""
+        merged = slots_mod.merge_occupancy(self._occupancy)
+        if clear:
+            self._occupancy = []
+        return slots_mod.occupancy_block(merged)
+
+    def score_prompts_slotted(
+        self,
+        prompts: Sequence,
+        targets: Sequence = ("Yes", "No"),
+        admit_fn: Optional[Callable] = None,
+    ) -> List[Dict]:
+        """Binary scored decoding through the slot allocator with
+        MID-DECODE admission — the serve scheduler's slot-level
+        continuous-batching entry (ROADMAP item 3's serve consumer).
+
+        Prompts prefill in ordinary batches; rows whose position-0 scan
+        already hit resolve immediately, undecided rows feed the slot
+        ring.  Between decode chunks the ring's starvation hook calls
+        ``admit_fn()``, which may return ``(prompts, target_pairs)`` of
+        NEWLY-ARRIVED work — those rows prefill and drop into vacated
+        slots while earlier rows keep decoding, instead of waiting for
+        the next coalescer boundary.  Results return in feed order
+        (initial prompts first, admitted rows appended).
+
+        The scored contract matches ``score_prompts`` with
+        ``decode_completions=False`` / ``with_confidence=False`` (the
+        pooled binary path): tokens/verdicts identical, probability
+        fields within the chunked-prefill fp32 class vs the whole-flush
+        schedule (PARITY.md "Decode-then-repack")."""
+        self._check_open()
+        if self.is_encoder_decoder:
+            raise ValueError("slotted scoring is decoder-only (T5 has no "
+                             "decoder-side prompt cache to refill)")
+        ecfg = self.ecfg
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
+        steps, _ = self._gen_plan(None, False)
+        results: List[Optional[Dict]] = []
+
+        def emit(rows):
+            self._emit_scored_slot_rows(rows, steps, eos_id, results)
+
+        ring = slots_mod.SlotRing(
+            self, steps=steps, eos_id=eos_id,
+            capacity=ecfg.phase2_pool_target or ecfg.batch_size,
+            leg="binary", workload="serve",
+            retire=_binary_retire, emit=emit,
+            batch_review=self._binary_batch_review(steps, eos_id),
+            pad_slice=lambda n: _pad_slice(n, max(n, 1)),
+        )
+
+        def feed(batch_prompts, batch_targets):
+            base = len(results)
+            results.extend([None] * len(batch_prompts))
+            ids_all = self._target_id_rows(batch_prompts, batch_targets)
+            with obs.span("encode_prompts", phase="host_tokenize",
+                          prompts=len(batch_prompts)):
+                encoded = batching.encode_prompts(self.tokenizer,
+                                                  batch_prompts)
+            for batch in batching.batches_for_prompts(
+                    encoded, ecfg.batch_size, ecfg.buckets,
+                    pad_id=self.tokenizer.pad_token_id or 0,
+                    length_sorted=ecfg.length_sorted_batches):
+                out = _prefill_select(
+                    self.params, self.cfg, self._put(batch.token_ids),
+                    self._put(batch.attention_mask),
+                    jnp.asarray(batch.indices >= 0),
+                    self._batch_target_rows(ids_all, batch)[:, 0],
+                    self._batch_target_rows(ids_all, batch)[:, 1],
+                    cache_len=batch.bucket_len,
+                    slice_m=int(batch.token_ids.shape[0]),
+                    top_k=ecfg.top_k,
+                    top_filter=ecfg.first_token_top_filter,
+                    out_len=_pool_len(batch.bucket_len),
+                )
+                scan0, first3, sel, sub_cache, last_s, len_s = out
+                yes0, no0, rel0, odds0, hit0 = (np.asarray(a)
+                                                for a in scan0)
+                first3 = tuple(np.asarray(a) for a in first3)
+                row_ids = self._batch_target_rows(ids_all, batch)
+                valid = batch.indices >= 0
+                undecided = np.flatnonzero(~hit0 & valid)
+                sel_np = np.asarray(sel)
+                for r, orig in enumerate(batch.indices):
+                    if orig >= 0 and hit0[r]:
+                        results[base + int(orig)] = _attach_first_token(
+                            _result_row(yes0[r], no0[r], rel0[r],
+                                        odds0[r], True, ""), first3, r)
+                if undecided.size:
+                    count = undecided.size
+                    idx = jnp.asarray(np.arange(count, dtype=np.int32))
+                    sub, last_u, len_u = slots_mod._gather_ring_rows(
+                        sub_cache, idx), last_s[idx], len_s[idx]
+                    mapped = sel_np[:count]
+                    metas = [
+                        {"orig": base + int(batch.indices[m]),
+                         "first3": np.asarray([first3[0][m], first3[1][m],
+                                               first3[2][m]])}
+                        for m in mapped]
+                    ring.feed(sub, last_u, len_u, row_ids[mapped], metas)
+
+        def refill_hook(n_free):
+            # NOTE: admit_fn owns the admission BOUND (the scheduler caps
+            # at one extra micro-batch per launch) — a hook that never
+            # returns empty would keep this session alive indefinitely
+            if admit_fn is None:
+                return False
+            more = admit_fn()
+            if not more:
+                return False
+            more_prompts, more_targets = more
+            if not more_prompts:
+                return False
+            feed(more_prompts, more_targets)
+            slots_mod.slot_counter("slot_admitted", len(more_prompts),
+                                   "binary", "serve")
+            return True
+
+        ring.refill_hook = refill_hook
+        with strict.scoring_guard(type(self).__name__):
+            with strict.sanctioned_fetch():
+                feed(list(prompts), targets)
+                ring.drain()
+                # one more admission window after the drain so work that
+                # arrived during the last chunk is not orphaned
+                while admit_fn is not None and refill_hook(0):
+                    ring.drain()
+        self.record_occupancy(ring.stats)
+        return [r if r is not None else _error_row("missing")
+                for r in results]
+
+    def _binary_batch_review(self, steps, eos_id):
+        """Vectorized found-scan hook for binary slot rows: one yes/no
+        reduction per chunk over the live rows' accumulated statistics —
+        the per-row ``retire`` then just reads the cached verdict."""
+        ecfg = self.ecfg
+
+        def review(rows):
+            vals = np.stack([r.vals for r in rows])
+            logz = np.stack([r.logz for r in rows])
+            tgt = np.stack([r.tgt for r in rows])
+            toks = np.stack([r.toks for r in rows])
+            vsteps = np.asarray([r.decoded for r in rows], np.int32)
+            if eos_id is not None:
+                for i, r in enumerate(rows):
+                    hits = np.flatnonzero(toks[i, : r.decoded] == eos_id)
+                    if hits.size:
+                        vsteps[i] = min(vsteps[i], int(hits[0]) + 1)
+            res = yn.yes_no_from_reduced(
+                jnp.asarray(vals), jnp.asarray(logz), jnp.asarray(tgt),
+                max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+                valid_steps=jnp.asarray(vsteps))
+            found = np.asarray(res.found)
+            for i, r in enumerate(rows):
+                done = (eos_id is not None
+                        and bool((toks[i, : r.decoded] == eos_id).any()))
+                r.meta["resolved"] = bool(found[i]) or done
+
+        return review
+
+    def _emit_scored_slot_rows(self, rows, steps, eos_id, results):
+        """Finish binary slot rows: one batched yes/no scan over their
+        decoded statistics (valid steps cut at EOS), then the ordinary
+        result-row assembly keyed by the meta's original index."""
+        ecfg = self.ecfg
+        vals = np.stack([r.vals for r in rows])
+        logz = np.stack([r.logz for r in rows])
+        tgt = np.stack([r.tgt for r in rows])
+        vsteps = np.asarray([max(1, r.decoded) for r in rows], np.int32)
+        if eos_id is not None:
+            for i, r in enumerate(rows):
+                hits = np.flatnonzero(r.toks[: r.decoded] == eos_id)
+                if hits.size:
+                    vsteps[i] = min(vsteps[i], int(hits[0]) + 1)
+        res = yn.yes_no_from_reduced(
+            jnp.asarray(vals), jnp.asarray(logz), jnp.asarray(tgt),
+            max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+            valid_steps=jnp.asarray(vsteps))
+        res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+        for i, r in enumerate(rows):
+            f3 = r.meta["first3"]
+            row = _attach_first_token(
+                _result_row(res_np["yes_prob"][i], res_np["no_prob"][i],
+                            res_np["relative_prob"][i],
+                            res_np["odds_ratio"][i],
+                            res_np["found"][i], ""),
+                (f3[0:1], f3[1:2], f3[2:3]), 0)
+            results[int(r.meta["orig"])] = row
+
+    def packed_autoregressive_demos(
+        self,
+        prompts: Sequence[str],
+        packing: int,
+        max_demo_tokens: int = 8,
+        repack: Optional[bool] = None,
+    ):
+        """Auto-Demo's AUTOREGRESSIVE demonstrations (the PR-10 follow-up)
+        through decode-then-repack: each pack builds stage by stage —
+        question k's demonstration is the model's OWN greedy continuation
+        decoded in the pack's packed context so far, then the grown pack
+        (prompt + demo + next question) re-enters the pending queue.  A
+        slot retires the moment its question's demo finishes (EOS or the
+        token budget) and is refilled by whatever pack stage is ready —
+        packs at different stages share the ring, which is the occupancy
+        win over decoding each stage as its own static batch.
+
+        Returns ``(packs, demos)``: ``packs`` in
+        :func:`~..scoring.packed.build_packs` layout (ready for
+        ``score_packed``; the last question of each pack stays
+        demo-free), ``demos`` the raw per-question continuation texts
+        (pack-major; None for each pack's last question).
+
+        ``repack=False`` runs the same stages whole-flush (slots only
+        fill when the ring is empty) — the legacy comparator the parity
+        suite pins; demos are per-row pure either way, so the two modes
+        emit identical texts."""
+        from ..scoring import packed as packed_mod
+
+        self._check_open()
+        if self.is_encoder_decoder:
+            raise ValueError("packed demo decode is decoder-only")
+        if packing < 1:
+            raise ValueError(f"packing must be >= 1, got {packing}")
+        ecfg = self.ecfg
+        use_repack = ecfg.slot_repack if repack is None else bool(repack)
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
+        groups = [list(prompts[i: i + packing])
+                  for i in range(0, len(prompts), packing)]
+        with obs.span("encode_packed_demos", phase="host_tokenize",
+                      rows=len(prompts)):
+            first_ids = batching.encode_prompts(
+                self.tokenizer, [g[0] for g in groups])
+            later: Dict[int, List[int]] = {}
+            texts, keys = [], []
+            for gi, g in enumerate(groups):
+                for qi in range(1, len(g)):
+                    keys.append((gi, qi))
+                    texts.append(g[qi])
+            if texts:
+                enc = self.tokenizer(texts,
+                                     add_special_tokens=False)["input_ids"]
+                later = {k: [int(t) for t in e]
+                         for k, e in zip(keys, enc)}
+        demos: List[List[Optional[str]]] = [
+            [None] * len(g) for g in groups]
+        # stage items: (pack_idx, question_idx, ids_so_far) — question_idx
+        # is the question whose demo the slot decodes next
+        stage_ready: List = [
+            (gi, 0, [int(t) for t in first_ids[gi]])
+            for gi, g in enumerate(groups) if len(g) > 1]
+        steps = max(1, int(max_demo_tokens))
+
+        def retire(row):
+            if eos_id is not None and \
+                    (row.toks[: row.decoded] == eos_id).any():
+                return int(np.flatnonzero(
+                    row.toks[: row.decoded] == eos_id)[0]) + 1
+            return row.decoded if row.decoded >= steps else -1
+
+        def emit(rows):
+            for r in rows:
+                gi, qi = r.meta["pack"], r.meta["question"]
+                text = self._completion_text(
+                    r.toks[: r.retire_step], eos_id)
+                demos[gi][qi] = text
+                # the grown pack carries the FORMATTED demo (the same
+                # spelling encode_packs tokenizes), so the autoregressive
+                # context matches the pack score_packed will prefill
+                demo_ids = (self.tokenizer(
+                    packed_mod.format_demo(text),
+                    add_special_tokens=False)["input_ids"]
+                    if text else [])
+                grown = r.meta["ids"] + [int(t) for t in demo_ids]
+                if qi + 1 < len(groups[gi]) - 1:
+                    # the NEXT question needs a demo too: re-enter pending
+                    stage_ready.append(
+                        (gi, qi + 1, grown + list(later[(gi, qi + 1)])))
+
+        ring = slots_mod.SlotRing(
+            self, steps=steps, eos_id=eos_id,
+            capacity=ecfg.phase2_pool_target or ecfg.batch_size,
+            leg="packed", workload="packed",
+            retire=retire, emit=emit, refill=use_repack,
+            with_scores=False,
+            pad_slice=lambda n: _pad_slice(n, max(n, 1)),
+        )
+
+        def prefill_stage():
+            """Prefill every ready stage item as one batch and feed the
+            ring (the decode-then-REPACK half: a grown pack's prefill
+            lands its cache row into whatever lane is free)."""
+            if not stage_ready:
+                return False
+            items, stage_ready[:] = list(stage_ready), []
+            pad_id = self.tokenizer.pad_token_id or 0
+            for batch in batching.batches_for_prompts(
+                    [ids for _, _, ids in items], ecfg.batch_size,
+                    ecfg.buckets, pad_id=pad_id,
+                    length_sorted=ecfg.length_sorted_batches):
+                last, cache = self._prefill(
+                    self._put(batch.token_ids),
+                    self._put(batch.attention_mask), batch.bucket_len)
+                lengths = jnp.sum(
+                    self._put(batch.attention_mask), axis=-1)
+                valid = batch.indices >= 0
+                count = int(valid.sum())
+                idx = jnp.asarray(
+                    np.flatnonzero(valid).astype(np.int32))
+                sub, last_u, len_u = _gather_rows(cache, last, lengths,
+                                                  idx)
+                plen = _pool_len(int(sub.k.shape[2]))
+                if plen > int(sub.k.shape[2]):
+                    sub = _pad_cache_slots(sub, plen)
+                metas = []
+                for m in np.flatnonzero(valid):
+                    gi, qi, ids = items[int(batch.indices[m])]
+                    metas.append({"pack": gi, "question": qi, "ids": ids})
+                ring.feed(sub, last_u, len_u,
+                          np.zeros((count, 2), np.int32), metas)
+            return True
+
+        # starvation hook: a freed lane pulls the next READY pack stage
+        # in mid-decode (prefill + feed), instead of waiting for the ring
+        # to drain — the decode-then-repack loop proper
+        ring.refill_hook = lambda n_free: prefill_stage()
+        with strict.scoring_guard(type(self).__name__):
+            with strict.sanctioned_fetch():
+                while prefill_stage() or ring.live_rows():
+                    ring.drain()
+        self.record_occupancy(ring.stats)
+        packs = []
+        for gi, g in enumerate(groups):
+            pack = []
+            for qi, prompt in enumerate(g):
+                demo = None
+                if qi + 1 < len(g) and demos[gi][qi]:
+                    demo = packed_mod.format_demo(demos[gi][qi])
+                pack.append((prompt, demo))
+            packs.append(pack)
+        flat_demos = [d for g in demos for d in g]
+        return packs, flat_demos
+
     def first_token_relative_prob(
         self, prompts: Sequence[str], targets: Sequence[str] = ("Yes", "No"),
         top_filter: int = 0,
@@ -2049,6 +2421,15 @@ class ScoringEngine:
             launch, consume, rebatch=self._oom_rebatch(encoded),
         )
         return out
+
+
+def _binary_retire(row) -> int:
+    """Slot-ring retirement for binary scored rows: a row leaves its lane
+    as soon as its yes/no scan is RESOLVED (top-k hit or EOS — no later
+    position can change the row, the same early-exit rule
+    ``_scan_decode_loop`` applies batch-wide), computed once per chunk by
+    the vectorized ``_binary_batch_review`` hook."""
+    return row.decoded if row.meta.get("resolved") else -1
 
 
 def _is_prefix_pair(prompt) -> bool:
@@ -2154,7 +2535,8 @@ class _Phase2Pool:
 
     def __init__(self, engine, steps, eos_id, target, results,
                  max_bytes: int = 512 << 20, leg: str = "binary",
-                 confidence: bool = False, completions: bool = False):
+                 confidence: bool = False, completions: bool = False,
+                 repack: Optional[bool] = None):
         self.engine = engine
         self.steps = steps
         self.eos_id = eos_id
@@ -2164,6 +2546,13 @@ class _Phase2Pool:
         self.leg = leg
         self.confidence = bool(confidence)
         self.completions = bool(completions)
+        # decode-then-repack (runtime/slots.py): rows stream through a
+        # fixed-capacity slot ring — retired lanes refill from the queue
+        # mid-decode — instead of accumulating to a whole flush.  The
+        # engine config is the default; False keeps the legacy schedule.
+        self.repack = (bool(engine.ecfg.slot_repack) if repack is None
+                       else bool(repack))
+        self._rings: Dict[int, slots_mod.SlotRing] = {}
         self.entries: Dict[int, List] = {}
         self.counts: Dict[int, int] = {}
         self.bytes: Dict[int, int] = {}
@@ -2195,6 +2584,10 @@ class _Phase2Pool:
         entry flushes FIRST, so a padded flush total never exceeds the menu
         and never compiles a bespoke decode shape (user-set targets above
         ~450 used to)."""
+        if self.repack:
+            self._ring_add(pool_len, sub_cache, last_s, len_s, n_real,
+                           orig_idx, row_ids, first3)
+            return
         nb = self._entry_bytes(sub_cache)
         # Evict from the POOL (largest key first, as before — flushing moves
         # its bytes to the dispatched set, so this loop terminates)...
@@ -2221,9 +2614,127 @@ class _Phase2Pool:
             self.flush(pool_len)
 
     def flush_all(self):
+        if self.repack:
+            for ring in self._rings.values():
+                with obs.span("pool_flush", phase="pooled_decode",
+                              leg=self.leg, rows=ring.stats.rows,
+                              repack=True):
+                    ring.drain()
+                self.engine.record_occupancy(ring.stats)
+            self._rings = {}
+            return
         for bucket_len in list(self.entries):
             self.flush(bucket_len)
         self.drain()
+
+    # -- decode-then-repack (runtime/slots.py) ---------------------------
+
+    def _ring_add(self, pool_len, sub_cache, last_s, len_s, n_real,
+                  orig_idx, row_ids, first3):
+        """Feed one batch's real rows into the slot ring for this
+        quantized cache length, then crank: the ring spins up once a
+        full capacity of pending rows exists (the flush-at-target
+        cadence) and from then on refills retired lanes from the queue
+        between chunks instead of draining whole flushes."""
+        if not n_real:
+            return
+        ring = self._rings.get(pool_len)
+        if ring is None:
+            ring = self._rings[pool_len] = self._make_ring()
+        orig_idx = np.asarray(orig_idx)
+        row_ids = np.asarray(row_ids, np.int32)
+        first3 = np.asarray(first3)
+        idx = jnp.asarray(np.arange(int(n_real), dtype=np.int32))
+        sub, last_u, len_u = _gather_rows(sub_cache, last_s, len_s, idx)
+        metas = [{"orig": int(orig_idx[j]), "first3": first3[j]}
+                 for j in range(int(n_real))]
+        if self.confidence:
+            record_counter("pooled_conf_rows", int(n_real))
+        ring.feed(sub, last_u, len_u, row_ids[: int(n_real)], metas)
+        with obs.span("pool_flush", phase="pooled_decode", leg=self.leg,
+                      rows=int(n_real), repack=True):
+            ring.pump(drain=False)
+
+    def _make_ring(self) -> slots_mod.SlotRing:
+        min_conf = min(3, self.steps) if self.confidence else 1
+        return slots_mod.SlotRing(
+            self.engine, steps=self.steps, eos_id=self.eos_id,
+            capacity=self.target, leg=self.leg, workload="engine",
+            retire=(self._conf_ring_retire if self.confidence
+                    else _binary_retire),
+            emit=(self._conf_ring_emit if self.confidence
+                  else self._binary_ring_emit),
+            batch_review=(None if self.confidence
+                          else self.engine._binary_batch_review(
+                              self.steps, self.eos_id)),
+            min_check=min_conf,
+            pad_slice=lambda n: _pad_slice(n, max(n, 1)),
+        )
+
+    def _conf_ring_retire(self, row) -> int:
+        """r* for one ring row — the SAME per-row predicate the legacy
+        flush scans (:meth:`_conf_retired_at`, monkeypatch point of the
+        retirement tests), checked incrementally over the new window."""
+        min_conf = min(3, self.steps)
+        start = max(int(row.checked), min_conf - 1) + 1
+        for k in range(start, row.decoded + 1):
+            if self._conf_retired_at(row.toks, k):
+                return k
+        return -1
+
+    def _binary_ring_emit(self, rows):
+        self.engine._emit_scored_slot_rows(rows, self.steps, self.eos_id,
+                                           self.results)
+
+    def _conf_ring_emit(self, rows):
+        """Finish retired confidence rows (batched): identical emitted
+        fields to the legacy flush tail — weighted confidence from
+        positions 0..2, yes/no scan over positions < min(r*, EOS),
+        completion cut at r* — just grouped by retirement instead of by
+        flush."""
+        engine = self.engine
+        ecfg = engine.ecfg
+        steps = self.steps
+        min_conf = min(3, steps)
+        record_counter("pooled_conf_retired_rows",
+                       sum(1 for r in rows if r.natural))
+        saved = sum(steps - r.decoded for r in rows)
+        if saved > 0:
+            record_counter("conf_steps_saved", saved)
+        vals = np.stack([r.vals for r in rows])
+        idsk = np.stack([r.ids_k for r in rows])
+        logz = np.stack([r.logz for r in rows])
+        tgt = np.stack([r.tgt for r in rows])
+        r_star = np.asarray([max(1, r.retire_step) for r in rows],
+                            np.int32)
+        vs = r_star.copy()
+        if self.eos_id is not None:
+            for i, r in enumerate(rows):
+                hits = np.flatnonzero(r.toks[: r_star[i]] == self.eos_id)
+                if hits.size:
+                    vs[i] = min(int(vs[i]), int(hits[0]) + 1)
+        res = yn.yes_no_from_reduced(
+            jnp.asarray(vals), jnp.asarray(logz), jnp.asarray(tgt),
+            max_look_ahead=ecfg.max_look_ahead, top_k=ecfg.top_k,
+            valid_steps=jnp.asarray(vs))
+        res_np = {k: np.asarray(v) for k, v in res._asdict().items()}
+        conf_lp = vals[:, :min_conf] - logz[:, :min_conf, None]
+        conf_idx = idsk[:, :min_conf]
+        for i, r in enumerate(rows):
+            completion = ""
+            if self.completions:
+                completion = engine._completion_text(
+                    r.toks[: r_star[i]], self.eos_id)
+            f3 = np.asarray(r.meta["first3"], np.float64)
+            out = _attach_first_token(
+                _result_row(res_np["yes_prob"][i], res_np["no_prob"][i],
+                            res_np["relative_prob"][i],
+                            res_np["odds_ratio"][i],
+                            res_np["found"][i], completion),
+                (f3[0:1], f3[1:2], f3[2:3]), 0)
+            cands = engine._candidates_from_topk(conf_lp[i], conf_idx[i])
+            out["weighted_confidence"] = weighted_confidence_digits(cands)
+            self.results[int(r.meta["orig"])] = out
 
     def _blank_entry(self, template, rows: int):
         """Numerically-inert filler rows that pad a pooled decode up to a
@@ -2677,24 +3188,14 @@ def _gather_rows(cache, last, lengths, idx):
     return sub, last[idx], lengths[idx]
 
 
-@functools.partial(jax.jit, static_argnames=("out_len",))
-def _pad_cache_slots(cache, out_len: int):
-    """Pad a cache's slot axis to ``out_len`` with inert invalid slots —
-    the host-dispatched twin of _prefill_select's in-program padding, for
-    caches that already exist (the fused confidence leg's suffix-extended
-    cache): zero K/V the attention bias masks out (zero int8 codes decode
-    to zero under any scale), ``valid=False``, position 0."""
-    pad_t = out_len - cache.k.shape[2]  # static: shape entries are ints
-
-    def pad_slots(a):   # k/v are [L, m, T, G, D]; scales [L, m, T, G]
-        widths = ((0, 0), (0, 0), (0, pad_t)) + ((0, 0),) * (a.ndim - 3)
-        return jnp.pad(a, widths)
-
-    return dmod.cache_kv_map(
-        cache, pad_slots,
-        positions=jnp.pad(cache.positions, ((0, 0), (0, pad_t))),
-        valid=jnp.pad(cache.valid, ((0, 0), (0, pad_t))),
-    )
+#: Pad a cache's slot axis to ``out_len`` with inert invalid slots — the
+#: host-dispatched twin of _prefill_select's in-program padding, for
+#: caches that already exist (the fused confidence leg's suffix-extended
+#: cache): zero K/V the attention bias masks out (zero int8 codes decode
+#: to zero under any scale), ``valid=False``, position 0.  ONE definition
+#: (runtime/slots.py owns it — the ring's newcomer-into-vacated-lane pad
+#: is the same rule) so the inert-slot convention can never fork.
+_pad_cache_slots = slots_mod._pad_cache_to
 
 
 def _attach_first_token(row: Dict, first3, i: int) -> Dict:

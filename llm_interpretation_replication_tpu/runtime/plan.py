@@ -219,6 +219,26 @@ def pooled_confidence_extra_bytes(cfg, target: int, seq: int,
     return 2 * kv_cache_bytes(cfg, target, pool_len + score_steps, kv_dtype)
 
 
+def slot_refill_pool_bytes(cfg, target: int, batch: int, seq: int,
+                           suffix_len: int = 64, score_steps: int = 10,
+                           kv_dtype: str = "bf16") -> int:
+    """REFILL-model confidence-pool peak (decode-then-repack,
+    runtime/slots.py): the slot ring holds at most ``target`` LIVE rows
+    grown by the scored steps, plus one prefill batch of gathered
+    slices waiting in the pending queue for lanes — NOT the 2x
+    whole-accumulation worst case :func:`pooled_confidence_extra_bytes`
+    prices for the all-or-nothing flush (where every gathered slice and
+    its concatenated copy coexist until the flush decode executes).
+    Retired lanes' K/V are dropped at the next repack, so the ring's
+    steady-state residency is capacity-shaped, not accumulation-shaped.
+    The legacy function (and every anchor pin built on it) is untouched;
+    plan search opts in per candidate via ``slot_repack=True``."""
+    pool_len = conf_pool_len_for(seq + suffix_len)
+    live = kv_cache_bytes(cfg, target, pool_len + score_steps, kv_dtype)
+    pending = kv_cache_bytes(cfg, min(batch, target), pool_len, kv_dtype)
+    return live + pending
+
+
 def completions_extra_bytes(cfg, batch: int, seq: int,
                             gen_tokens: int = 50, score_steps: int = 10,
                             pipeline_depth: int = 2,
@@ -350,7 +370,8 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
                           kv_dtype: str = "bf16", prefill_chunk: int = 0,
                           pooled_confidence: bool = False,
                           pool_target: Optional[int] = None,
-                          decode_k: int = 1) -> dict:
+                          decode_k: int = 1,
+                          slot_repack: bool = False) -> dict:
     """Per-term HBM breakdown of the full-study live set at one operating
     point — the exact terms :func:`resolve_full_sweep_plan`'s ``need()``
     sums.  Exposed as a dict so the auto-parallel search
@@ -362,7 +383,9 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
     Keys: ``weights``, ``attn`` (score tensor / flash workspace),
     ``act`` (activation live set), ``completions`` (pinned completion
     caches + logits/scores), ``conf_pool`` (pooled-confidence worst-case
-    peak; 0 unless ``pooled_confidence``), plus ``k_head`` (the joint
+    peak; 0 unless ``pooled_confidence`` — priced by the refill model
+    :func:`slot_refill_pool_bytes` when ``slot_repack``, else the legacy
+    all-or-nothing accumulation), plus ``k_head`` (the joint
     K-decode's proposal projections, :func:`k_head_bytes`) ONLY when
     ``decode_k > 1`` — absent at the default so every existing term-sum
     pin stays byte-identical."""
@@ -370,7 +393,11 @@ def full_study_need_terms(cfg, weight_b: int, attention_impl: str,
             if attention_impl == "flash"
             else dense_attention_bytes(cfg, batch, seq, prefill_chunk))
     conf_pool = 0
-    if pooled_confidence:
+    if pooled_confidence and slot_repack:
+        conf_pool = slot_refill_pool_bytes(
+            cfg, pool_target or batch, batch, seq,
+            score_steps=score_steps, kv_dtype=kv_dtype)
+    elif pooled_confidence:
         conf_pool = pooled_confidence_extra_bytes(
             cfg, pool_target or batch, seq, score_steps=score_steps,
             kv_dtype=kv_dtype)
@@ -487,7 +514,8 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
                             kv_dtype: str = "bf16",
                             prefill_chunk: int = 0,
                             pooled_confidence: bool = False,
-                            pool_target: Optional[int] = None) -> ScoringPlan:
+                            pool_target: Optional[int] = None,
+                            slot_repack: bool = False) -> ScoringPlan:
     """Route the FULL-STUDY sweep (binary leg with completions + confidence
     leg): resolve the attention impl like a binary sweep, then shrink the
     batch (steps of 32) until the live set INCLUDING the completion path's
@@ -529,7 +557,7 @@ def resolve_full_sweep_plan(cfg, quant: str, batch: int, seq: int,
         return full_study_need_terms(
             cfg, wb, base.attention_impl, b, seq, gen_tokens, score_steps,
             pipeline_depth, reduced_scores, kv_dtype, prefill_chunk,
-            pooled_confidence, pool_target)
+            pooled_confidence, pool_target, slot_repack=slot_repack)
 
     def need(b):
         return sum(terms(b).values())

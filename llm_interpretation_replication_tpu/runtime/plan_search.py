@@ -362,14 +362,21 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                  max_model: Optional[int] = None,
                  attention_impl: str = "xla",
                  packings: Sequence[int] = DEFAULT_PACKINGS,
-                 decode_ks: Sequence[int] = DEFAULT_DECODE_KS
-                 ) -> List[PlanCandidate]:
+                 decode_ks: Sequence[int] = DEFAULT_DECODE_KS,
+                 slot_repack: bool = False) -> List[PlanCandidate]:
     """Enumerate, budget-filter, and rank the candidate space.
 
     Returns every candidate, ranked: fitting plans first by predicted
     rows/s (ties break toward the simpler config — lower tp, pp, pool
     target, packing), then rejected plans grouped by reason.
     ``ranked[0]`` is the chosen plan when any candidate fits.
+
+    ``slot_repack=True`` prices each full-study candidate's confidence
+    pool with the REFILL model (plan.slot_refill_pool_bytes — ring
+    residency is capacity-shaped, retired lanes drop at repack) instead
+    of the all-or-nothing flush accumulation; the default keeps every
+    anchor pin byte-identical, and bench passes the engine's actual
+    ``--slot-repack`` setting so searched plans price what will run.
 
     ``workload="packed"`` (ISSUE 10) adds the PACKING axis and drops the
     axes the anchor-gather path has no use for (no decode → no kv dtype,
@@ -455,7 +462,8 @@ def search_plans(cfg, quant: str, n_devices: int, seq: int = 256,
                                     prefill_chunk=chunk,
                                     pooled_confidence=True,
                                     pool_target=pool or None,
-                                    decode_k=dk)
+                                    decode_k=dk,
+                                    slot_repack=slot_repack)
                             elif workload == "packed":
                                 terms = plan_mod.packed_need_terms(
                                     cfg, wb, attention_impl, b,

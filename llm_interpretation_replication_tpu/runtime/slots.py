@@ -1,0 +1,677 @@
+"""Slot-level continuous batching: decode-then-repack (ROADMAP item 3).
+
+The engine's three decode pools — the ``_Phase2Pool`` legs, the packed
+demo decode, and the serve micro-batches — all share one failure mode:
+a row that finishes early leaves its batch lane EMPTY for the rest of
+the flush.  PR 7's pools compact retired rows' K/V away (the HBM win)
+but never backfill the lane (the occupancy loss); PR 10's packed rows
+are a static pack; serve admits only at coalescer boundaries.  This
+module owns the fix: a fixed-capacity ring of decode SLOTS where a
+retired slot (EOS'd completion, settled ``first_int_stable`` parse,
+answered pack question) is immediately REFILLED from a pending-work
+queue between decode chunks — the newcomer's prefilled cache row drops
+into the vacated lane (padded with inert invalid slots to the ring's
+current cache length) while live slots keep decoding.
+
+Numerics contract (PARITY.md "Decode-then-repack"):
+
+- A row's decode is the same per-row math whether it runs in a fresh
+  batch, a refilled slot, or the legacy whole-flush path: the decode
+  offset folds into the row's effective length (``positions =
+  lengths + offset + i`` — the ring passes ``lengths + decoded`` and
+  ``offset = 0``, the same positions the sequential path computes), the
+  tail buffer's unwritten slots are masked exact zeros, and padding
+  slots are inert (masked softmax terms are exact fp32 zeros).  Tokens,
+  parses, retirement points and verdicts are therefore identical across
+  ring compositions — the pooled-confidence bit-reproducibility rule,
+  re-pinned by ``pytest -m slots``.
+- Multi-chunk SCORE fields stay in the chunked-prefill fp32 tolerance
+  class: fold points and slot-compaction gathers regroup reduction
+  order in the last ulp, exactly like the chunk boundaries the pooled
+  path already documents.  Bit-identity is promised only where the
+  pooled contract already promises it (positions 0-2 of the confidence
+  stats, single-chunk windows).
+
+Fragmentation vs retirement: RETIREMENT never triggers a cache rebuild
+by itself — the vacated lane is reused in place by the refill concat.
+Only FRAGMENTATION does: every chunk appends ``chunk`` tail slots to
+every row, so a long-lived ring accumulates dead columns; once the slot
+axis outgrows ``base_len + compact_slack`` the ring compacts each row's
+valid slots to the front (stable per-row gather — content and order
+preserved) and truncates.  ``slot_compactions`` counts these.
+
+Telemetry rides the PR-12 labeled convention from day one: every
+``slot_*`` counter records an unlabeled fleet-wide twin AND a
+``name|leg=...,workload=...`` labeled series, so the Prometheus export
+(obs/metrics.split_labeled_name) never needs a second migration.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+import functools
+from typing import Callable, Dict, List, Optional
+
+import numpy as np
+
+import jax
+import jax.numpy as jnp
+
+from ..models import decoder as dmod
+from ..utils.telemetry import record_counter
+
+__all__ = ["OccupancyStats", "SlotRing", "SlotRow", "slot_counter",
+           "merge_occupancy", "occupancy_block"]
+
+
+def slot_counter(name: str, value: float, leg: str, workload: str) -> None:
+    """Record a ``slot_*`` counter plus its ``name|k=v`` labeled twin
+    (the PR-12 convention — serve/scheduler.labeled_metric's spelling,
+    keys sorted), so per-leg/per-workload Prometheus series exist from
+    day one next to the fleet aggregate."""
+    record_counter(name, value)
+    record_counter(f"{name}|leg={leg},workload={workload}", value)
+
+
+@dataclasses.dataclass
+class OccupancyStats:
+    """Slot-occupancy accounting for one ring (or a merged fleet).
+
+    ``capacity_steps`` counts (batch lanes x decode steps) the ring's
+    chunks spent; ``live_steps`` counts the subset occupied by live REAL
+    rows still inside their decode budget.  The idle fraction is the
+    headline the bench ``occupancy`` block reports, next to the
+    whole-flush COUNTERFACTUAL (what the same rows' retirement profile
+    would have idled under the legacy flush-at-target schedule) so the
+    next driver record measures the occupancy gain directly."""
+
+    capacity: int = 0
+    rows: int = 0
+    capacity_steps: int = 0
+    live_steps: int = 0
+    refills: int = 0
+    repacks: int = 0
+    compactions: int = 0
+    repack_stalls: int = 0
+    #: per-row decode steps actually spent (chunk-aligned retirement) —
+    #: the counterfactual's input.
+    row_steps: List[int] = dataclasses.field(default_factory=list)
+
+    def idle_fraction(self) -> Optional[float]:
+        if not self.capacity_steps:
+            return None
+        return 1.0 - self.live_steps / self.capacity_steps
+
+    def no_repack_idle_fraction(self) -> Optional[float]:
+        """Counterfactual slot-idle fraction under the legacy whole-flush
+        schedule: rows group into flushes of ``capacity`` in arrival
+        order, each flush runs until its LAST row retires (the flush's
+        lanes all spin that long), nothing refills."""
+        if not self.row_steps or not self.capacity:
+            return None
+        total = live = 0
+        cap = max(1, self.capacity)
+        for i in range(0, len(self.row_steps), cap):
+            flush = self.row_steps[i: i + cap]
+            dur = max(flush)
+            total += cap * dur
+            live += sum(flush)
+        if not total:
+            return None
+        return 1.0 - live / total
+
+    def merged(self, other: "OccupancyStats") -> "OccupancyStats":
+        return OccupancyStats(
+            capacity=max(self.capacity, other.capacity),
+            rows=self.rows + other.rows,
+            capacity_steps=self.capacity_steps + other.capacity_steps,
+            live_steps=self.live_steps + other.live_steps,
+            refills=self.refills + other.refills,
+            repacks=self.repacks + other.repacks,
+            compactions=self.compactions + other.compactions,
+            repack_stalls=self.repack_stalls + other.repack_stalls,
+            row_steps=self.row_steps + other.row_steps,
+        )
+
+    def report(self) -> Dict:
+        idle = self.idle_fraction()
+        before = self.no_repack_idle_fraction()
+        return {
+            "capacity": int(self.capacity),
+            "rows": int(self.rows),
+            "slot_steps": int(self.capacity_steps),
+            "live_steps": int(self.live_steps),
+            "slot_idle_frac": None if idle is None else round(idle, 4),
+            "slot_idle_frac_no_repack": (
+                None if before is None else round(before, 4)),
+            "refills": int(self.refills),
+            "repacks": int(self.repacks),
+            "compactions": int(self.compactions),
+            "repack_stalls": int(self.repack_stalls),
+        }
+
+
+def merge_occupancy(stats) -> Optional[OccupancyStats]:
+    """Fold an iterable of :class:`OccupancyStats` into one (None when
+    empty) — how the engine aggregates per-ring stats per call and bench
+    aggregates per-call stats into the record's ``occupancy`` block."""
+    out = None
+    for s in stats:
+        if s is None or not s.capacity_steps and not s.rows:
+            continue
+        out = s if out is None else out.merged(s)
+    return out
+
+
+def occupancy_block(stats: Optional[OccupancyStats]) -> Optional[Dict]:
+    return None if stats is None else stats.report()
+
+
+class SlotRow:
+    """Host-side state of one real row travelling through the ring."""
+
+    __slots__ = ("meta", "row_ids", "toks", "vals", "ids_k", "logz", "tgt",
+                 "decoded", "checked", "retire_step", "admit_chunk",
+                 "natural")
+
+    def __init__(self, meta, row_ids, steps: int, topk: int,
+                 with_scores: bool):
+        self.meta = meta
+        self.row_ids = row_ids                      # [2] int32 target ids
+        self.toks = np.zeros((steps,), np.int32)
+        if with_scores:
+            self.vals = np.zeros((steps, topk), np.float32)
+            self.ids_k = np.zeros((steps, topk), np.int32)
+            self.logz = np.zeros((steps,), np.float32)
+            self.tgt = np.zeros((steps, 2), np.float32)
+        else:
+            self.vals = self.ids_k = self.logz = self.tgt = None
+        self.decoded = 0
+        self.checked = 0          # retire_fn has inspected prefixes <= this
+        self.retire_step = -1     # r*: first frozen prefix (-1 = live)
+        self.admit_chunk = 0
+        self.natural = False      # retired by the predicate (vs budget)
+
+
+class _PendingGroup:
+    """One batch's gathered rows waiting for slots: device arrays shared,
+    rows handed out by index as lanes free up."""
+
+    __slots__ = ("cache", "last", "lens", "row_ids", "metas", "taken")
+
+    def __init__(self, cache, last, lens, row_ids, metas):
+        self.cache = cache
+        self.last = last
+        self.lens = lens
+        self.row_ids = np.asarray(row_ids, np.int32)
+        self.metas = list(metas)
+        self.taken = 0
+
+    def remaining(self) -> int:
+        return len(self.metas) - self.taken
+
+
+@functools.partial(jax.jit, static_argnames=("out_len",))
+def _compact_cache_slots(cache, out_len: int):
+    """Per-row slot compaction: stable-sort each row's slots valid-first
+    (preserving the relative order of real slots, which are already
+    position-ordered) and truncate the slot axis to ``out_len``.  Row
+    content is exactly preserved; only the reduction grouping of the
+    masked-zero terms moves (the chunked-prefill fp32 class)."""
+    order = jnp.argsort(~cache.valid, axis=1, stable=True)    # [m, T]
+    idx = order[:, :out_len]
+
+    def take_kv(a):       # k/v [L, m, T, G, D]; scales [L, m, T, G]
+        # broadcastable index built from STATIC rank arithmetic (a.ndim
+        # is trace-time Python), one spelling for both layouts
+        expand = idx.reshape((1,) + idx.shape + (1,) * (a.ndim - 3))
+        return jnp.take_along_axis(a, expand, axis=2)
+
+    return dmod.cache_kv_map(
+        cache, take_kv,
+        positions=jnp.take_along_axis(cache.positions, idx, axis=1),
+        valid=jnp.take_along_axis(cache.valid, idx, axis=1),
+    )
+
+
+@functools.partial(jax.jit, static_argnames=("out_len",))
+def _pad_cache_to(cache, out_len: int):
+    """Append inert invalid slots up to ``out_len`` (the newcomer-into-
+    vacated-lane pad: zero K/V the attention bias masks out; zero int8
+    codes decode to zero under any scale)."""
+    pad_t = out_len - cache.k.shape[2]
+
+    def pad_slots(a):
+        widths = ((0, 0), (0, 0), (0, pad_t)) + ((0, 0),) * (a.ndim - 3)
+        return jnp.pad(a, widths)
+
+    return dmod.cache_kv_map(
+        cache, pad_slots,
+        positions=jnp.pad(cache.positions, ((0, 0), (0, pad_t))),
+        valid=jnp.pad(cache.valid, ((0, 0), (0, pad_t))),
+    )
+
+
+@jax.jit
+def _gather_ring_rows(cache, idx):
+    return dmod.cache_kv_map(
+        cache, lambda a: a[:, idx],
+        positions=cache.positions[idx], valid=cache.valid[idx],
+    )
+
+
+def _concat_caches(parts) -> dmod.KVCache:
+    first = parts[0]
+    if len(parts) == 1:
+        return first
+    return dmod.KVCache(
+        k=jnp.concatenate([c.k for c in parts], axis=1),
+        v=jnp.concatenate([c.v for c in parts], axis=1),
+        positions=jnp.concatenate([c.positions for c in parts], axis=0),
+        valid=jnp.concatenate([c.valid for c in parts], axis=0),
+        length=first.length,
+        k_scale=(jnp.concatenate([c.k_scale for c in parts], axis=1)
+                 if first.k_scale is not None else None),
+        v_scale=(jnp.concatenate([c.v_scale for c in parts], axis=1)
+                 if first.v_scale is not None else None),
+    )
+
+
+def _cache_nbytes(cache) -> int:
+    n = int(cache.k.size + cache.v.size) * cache.k.dtype.itemsize
+    if cache.k_scale is not None:
+        n += 4 * int(cache.k_scale.size + cache.v_scale.size)
+    return n
+
+
+def _blank_rows(template_cache, last_t, lens_dtype, rows: int,
+                slot_len: int):
+    """Numerically-inert filler: one valid zero-K slot per row (the
+    softmax never reduces over an empty set), zero logits, length 1 —
+    the _Phase2Pool blank rule, at the ring's current slot length."""
+    L, _, _, G, D = template_cache.k.shape
+    kv = jnp.zeros((L, rows, slot_len, G, D), template_cache.k.dtype)
+    valid = jnp.zeros((rows, slot_len), bool).at[:, 0].set(True)
+    scale = (jnp.ones((L, rows, slot_len, G), jnp.float32)
+             if template_cache.k_scale is not None else None)
+    cache = dmod.KVCache(
+        k=kv, v=kv,
+        positions=jnp.zeros((rows, slot_len),
+                            template_cache.positions.dtype),
+        valid=valid, length=template_cache.length,
+        k_scale=scale, v_scale=scale,
+    )
+    last = jnp.zeros((rows, last_t.shape[1]), last_t.dtype)
+    lens = jnp.ones((rows,), lens_dtype)
+    return cache, last, lens
+
+
+class SlotRing:
+    """Fixed-capacity decode ring with retire-and-refill repack.
+
+    One ring per quantized cache length (its consumers key rings the way
+    the ``_Phase2Pool`` keys flushes).  Device state is a batched
+    :class:`~..models.decoder.KVCache` plus per-lane logits / effective
+    lengths / EOS flags; host state is one :class:`SlotRow` per occupied
+    lane.  The loop is::
+
+        feed(...) -> pending          pump() -> [repack | decode | retire]*
+
+    ``pump(drain=False)`` decodes only while refill work exists (live
+    rows freeze between cranks so lanes never spin empty waiting for
+    traffic); ``pump(drain=True)`` runs everything to retirement.
+
+    Callbacks (the consumer contract):
+
+    - ``retire(row) -> int``: inspect ``row.toks[:row.decoded]`` from
+      ``row.checked`` on; return the retirement step ``r*`` or -1.
+      Called between chunks only — a pure function of the row's own
+      tokens keeps results composition-independent.
+    - ``batch_review(rows, stacked) -> None``: optional vectorized hook
+      run before per-row ``retire`` with the live rows' stacked stats
+      (the binary leg's yes/no scan runs once per chunk here instead of
+      once per row).
+    - ``emit(rows)``: finished rows, in retirement order, batched per
+      pump.
+    - ``refill_hook(n_free) -> bool``: optional starvation escape — the
+      serve scheduler admits newly-queued compatible requests here,
+      mid-decode, returning True when it fed new work.
+    """
+
+    def __init__(self, engine, *, steps: int, eos_id, capacity: int,
+                 leg: str, workload: str,
+                 retire: Callable, emit: Callable,
+                 batch_review: Optional[Callable] = None,
+                 refill_hook: Optional[Callable] = None,
+                 refill: bool = True,
+                 with_scores: bool = True,
+                 min_check: int = 1,
+                 chunk: Optional[int] = None,
+                 compact_slack: Optional[int] = None,
+                 pad_slice: Optional[Callable] = None):
+        self.engine = engine
+        self.steps = int(steps)
+        self.eos_id = eos_id
+        self.capacity = max(1, int(capacity))
+        self.leg = leg
+        self.workload = workload
+        self.retire = retire
+        self.emit = emit
+        self.batch_review = batch_review
+        self.refill_hook = refill_hook
+        self.refill = bool(refill)
+        self.with_scores = bool(with_scores)
+        self.min_check = max(1, int(min_check))
+        scan = max(1, int(getattr(engine.ecfg, "scan_chunk", 5)))
+        # uniform chunks >= min_check: every row's first window covers the
+        # positions its minimum-read contract needs inside ONE chunk (the
+        # tail buffer's masked zeros make within-chunk positions exact, so
+        # e.g. the confidence stats at positions 0-2 stay bit-identical
+        # to the legacy 3-step opening chunk)
+        self.chunk = int(chunk) if chunk else max(scan, self.min_check)
+        self.chunk = min(self.chunk, self.steps)
+        self.compact_slack = (int(compact_slack) if compact_slack
+                              else self.steps + self.chunk)
+        self._pad_slice = pad_slice or (lambda n: n)
+        self.stats = OccupancyStats(capacity=self.capacity)
+        self._pending: List[_PendingGroup] = []
+        self._finished: List[SlotRow] = []
+        # device state (None until the first repack)
+        self._cache = None
+        self._prev = None
+        self._lens = None
+        self._done = None
+        self._tids = None
+        self._prev_h = None           # K-decode frontier hidden
+        self._slots: List[Optional[SlotRow]] = []
+        self._base_len: Optional[int] = None
+
+    # -- feeding ---------------------------------------------------------
+
+    def feed(self, cache, last, lens, row_ids, metas) -> None:
+        """Queue one gathered batch of real rows ([g] leading axes; no
+        padding rows — callers gather real rows before feeding)."""
+        if not len(metas):
+            return
+        base = int(cache.k.shape[2])
+        if self._base_len is None or base > self._base_len:
+            # mixed buckets share one ring on the slotted-serve and
+            # grown-pack paths: the compaction target tracks the widest
+            # PROMPT region fed so far (a row's valid slots never exceed
+            # base + steps)
+            self._base_len = base
+        self._pending.append(_PendingGroup(cache, last, lens, row_ids,
+                                           metas))
+        self.stats.rows += len(metas)
+        slot_counter("slot_rows", len(metas), self.leg, self.workload)
+
+    def pending_rows(self) -> int:
+        return sum(g.remaining() for g in self._pending)
+
+    def live_rows(self) -> int:
+        return sum(1 for s in self._slots
+                   if s is not None and s.retire_step < 0)
+
+    # -- pump ------------------------------------------------------------
+
+    def pump(self, drain: bool = False) -> None:
+        """Crank the ring: repack (drop retired lanes, refill from
+        pending), decode one chunk, run retirement.  Without ``drain``
+        the ring pauses as soon as no refill work remains — live rows
+        freeze in place until the next feed — so lanes only ever spin
+        when there is work to backfill them with."""
+        while True:
+            if self.refill_hook is not None and not self._pending:
+                self.refill_hook(self.capacity - self.live_rows())
+            live, pending = self.live_rows(), self.pending_rows()
+            if not live and not pending:
+                break
+            if not drain and not live and pending < self.capacity:
+                break      # accumulate to capacity before spinning up —
+                #            the pool-at-target cadence the flush had
+            if not drain and live and not pending and live < self.capacity:
+                self.stats.repack_stalls += 1
+                slot_counter("slot_repack_stalls", 1, self.leg,
+                             self.workload)
+                break
+            self._repack()
+            if not self.live_rows():
+                break
+            self._decode_chunk()
+            self._retirement_scan()
+            # emit PER CHUNK (not per pump): consumers that grow new work
+            # out of finished rows (the packed autoregressive-demo stages)
+            # feed the pending queue in time for the NEXT repack, which is
+            # what lets a later-stage pack refill a lane mid-decode
+            self._flush_finished()
+        self._flush_finished()
+        if self._cache is not None and not self.live_rows():
+            # every lane retired and nothing refilled: stream the whole
+            # ring's K/V back to the allocator instead of pinning it
+            # until the next crank
+            record_counter("completion_cache_bytes_freed",
+                           _cache_nbytes(self._cache))
+            self._slots = []
+            self._cache = self._prev = self._lens = None
+            self._done = self._tids = self._prev_h = None
+
+    def drain(self) -> None:
+        self.pump(drain=True)
+
+    def _flush_finished(self) -> None:
+        if self._finished:
+            rows, self._finished = self._finished, []
+            self.emit(rows)
+
+    # -- repack ----------------------------------------------------------
+
+    def _take_pending(self, n: int):
+        """Pop up to ``n`` rows off the pending groups (FIFO): returns
+        [(cache_sub, last_sub, lens_sub, ids, rows)] gathered per source
+        group at its OWN slot length — :meth:`_repack` pads every part
+        (live lanes and newcomers alike) to the common maximum."""
+        out = []
+        while n > 0 and self._pending:
+            g = self._pending[0]
+            take = min(n, g.remaining())
+            idx = np.arange(g.taken, g.taken + take, dtype=np.int32)
+            idx_dev = jnp.asarray(idx)
+            sub = _gather_ring_rows(g.cache, idx_dev)
+            rows = []
+            for j in idx:
+                rows.append(SlotRow(g.metas[j], g.row_ids[j], self.steps,
+                                    dmod.REDUCED_TOPK, self.with_scores))
+            out.append((sub, g.last[idx_dev], g.lens[idx_dev],
+                        jnp.asarray(g.row_ids[idx]), rows))
+            g.taken += take
+            n -= take
+            if not g.remaining():
+                self._pending.pop(0)
+        return out
+
+    def _repack(self) -> None:
+        """Drop retired lanes, refill from pending, re-blank the rest.
+
+        The concat-based rebuild IS the refill: live lanes gather across
+        (their decoded tails ride along), and every part — live lanes
+        and newcomers alike — pads with inert invalid slots up to the
+        WIDEST part's slot length before the concat.  When
+        the slot axis has outgrown ``base_len + compact_slack`` the live
+        rows' slots compact valid-first first (fragmentation — never
+        mere retirement — pays for the rebuild)."""
+        alive_idx = [i for i, s in enumerate(self._slots)
+                     if s is not None and s.retire_step < 0]
+        had_state = self._cache is not None
+        n_free = self.capacity - len(alive_idx)
+        retired_lanes = sum(1 for s in self._slots
+                            if s is not None and s.retire_step >= 0)
+        will_take = ((self.refill or not alive_idx) and n_free > 0
+                     and self.pending_rows() > 0)
+        if had_state and not retired_lanes and not will_take \
+                and not self._needs_compaction():
+            return                      # nothing changed: keep lanes
+        parts_cache, parts_last, parts_lens, parts_ids, rows = \
+            [], [], [], [], []
+        old_bytes = _cache_nbytes(self._cache) if had_state else 0
+        done_parts = []
+        if alive_idx:
+            idx_dev = jnp.asarray(np.asarray(alive_idx, np.int32))
+            sub = _gather_ring_rows(self._cache, idx_dev)
+            if self._needs_compaction():
+                out_len = self._base_len + self.steps
+                sub = _compact_cache_slots(sub, out_len)
+                self.stats.compactions += 1
+                slot_counter("slot_compactions", 1, self.leg, self.workload)
+            parts_cache.append(sub)
+            parts_last.append(self._prev[idx_dev])
+            parts_lens.append(self._lens[idx_dev])
+            parts_ids.append(self._tids[idx_dev])
+            done_parts.append(self._done[idx_dev])
+            rows.extend(self._slots[i] for i in alive_idx)
+        groups = self._take_pending(n_free) \
+            if (self.refill or not alive_idx) else []
+        n_new = sum(len(g[4]) for g in groups)
+        for sub, last, lens, tids, grows in groups:
+            parts_cache.append(sub)
+            parts_last.append(last)
+            parts_lens.append(lens)
+            parts_ids.append(tids)
+            done_parts.append(jnp.zeros((len(grows),), bool))
+            rows.extend(grows)
+        # common slot length = the WIDEST part: newcomers from a longer
+        # bucket pad the live lanes up, not only the other way around
+        # (one ring serves mixed buckets in the slotted-serve and
+        # grown-pack paths)
+        cur_len = max((int(c.k.shape[2]) for c in parts_cache),
+                      default=None)
+        parts_cache = [c if int(c.k.shape[2]) == cur_len
+                       else _pad_cache_to(c, cur_len)
+                       for c in parts_cache]
+        if not rows:
+            if had_state:
+                # the whole ring retired at once: every lane's K/V slice
+                # streams back to the allocator
+                record_counter("completion_cache_bytes_freed", old_bytes)
+            self._slots = []
+            self._cache = self._prev = self._lens = None
+            self._done = self._tids = self._prev_h = None
+            return
+        m = self._pad_slice(len(rows))
+        if m > len(rows):
+            template = parts_cache[0]
+            blank_c, blank_l, blank_n = _blank_rows(
+                template, parts_last[0], parts_lens[0].dtype,
+                m - len(rows), cur_len)
+            parts_cache.append(blank_c)
+            parts_last.append(blank_l)
+            parts_lens.append(blank_n)
+            parts_ids.append(jnp.zeros((m - len(rows), 2), jnp.int32))
+            done_parts.append(jnp.zeros((m - len(rows),), bool))
+        self._cache = _concat_caches(parts_cache)
+        self._prev = (parts_last[0] if len(parts_last) == 1
+                      else jnp.concatenate(parts_last, axis=0))
+        self._lens = (parts_lens[0] if len(parts_lens) == 1
+                      else jnp.concatenate(parts_lens, axis=0))
+        self._tids = (parts_ids[0] if len(parts_ids) == 1
+                      else jnp.concatenate(parts_ids, axis=0))
+        self._done = (done_parts[0] if len(done_parts) == 1
+                      else jnp.concatenate(done_parts, axis=0))
+        # the K-decode frontier hidden is per-lane state the gather
+        # cannot extend to newcomers: drop it and let the next chunk's
+        # bootstrap block re-establish it (verify-and-accept keeps any
+        # proposal source safe — a stale frontier costs passes, never
+        # bits)
+        self._prev_h = None
+        self._slots = rows + [None] * (m - len(rows))
+        if had_state:
+            freed = old_bytes - _cache_nbytes(self._cache)
+            if freed > 0:
+                record_counter("completion_cache_bytes_freed", freed)
+        self.stats.repacks += 1
+        slot_counter("slot_repacks", 1, self.leg, self.workload)
+        if n_new and had_state and alive_idx:
+            self.stats.refills += n_new
+            slot_counter("slot_refills", n_new, self.leg, self.workload)
+
+    def _needs_compaction(self) -> bool:
+        if self._cache is None or self._base_len is None:
+            return False
+        return (int(self._cache.k.shape[2])
+                > self._base_len + self.compact_slack)
+
+    # -- decode + retirement --------------------------------------------
+
+    def _real_mask(self) -> np.ndarray:
+        return np.asarray([s is not None and s.retire_step < 0
+                           for s in self._slots], bool)
+
+    def _decode_chunk(self) -> None:
+        eng = self.engine
+        n = self.chunk
+        real = self._real_mask()
+        ws = "reduced" if self.with_scores else False
+        if eng._k_active():
+            toks_c, sc_c, self._cache, self._prev, self._done, \
+                self._prev_h, _acc = eng._k_decode_chunk(
+                    self._cache, self._prev, self._lens, np.int32(0), n,
+                    self.eos_id, self._done, ws,
+                    self._tids if self.with_scores else None,
+                    self._prev_h, real, self.leg)
+        else:
+            toks_c, sc_c, self._cache, self._prev, self._done = \
+                dmod.decode_steps(
+                    eng.params, eng.cfg, self._cache, self._prev,
+                    self._lens, np.int32(0), n, self.eos_id, self._done,
+                    with_scores=ws,
+                    target_ids=self._tids if self.with_scores else None)
+        self._lens = self._lens + n
+        toks_np = np.asarray(toks_c)
+        sc_np = (tuple(np.asarray(f) for f in sc_c)
+                 if self.with_scores else None)
+        self.stats.capacity_steps += self.capacity * n
+        live_now = 0
+        for i, row in enumerate(self._slots):
+            if row is None or row.retire_step >= 0:
+                continue
+            take = min(n, self.steps - row.decoded)
+            if take > 0:
+                row.toks[row.decoded: row.decoded + take] = \
+                    toks_np[i, :take]
+                if sc_np is not None:
+                    vals, ids_k, logz, tgt = sc_np
+                    row.vals[row.decoded: row.decoded + take] = \
+                        vals[i, :take]
+                    row.ids_k[row.decoded: row.decoded + take] = \
+                        ids_k[i, :take]
+                    row.logz[row.decoded: row.decoded + take] = \
+                        logz[i, :take]
+                    row.tgt[row.decoded: row.decoded + take] = tgt[i, :take]
+                self.stats.live_steps += take
+                live_now += take
+                slot_counter("slot_live_steps", take, self.leg,
+                             self.workload)
+            row.decoded += take
+        # idle reconciles exactly with the occupancy block:
+        # capacity_steps - live_steps, per chunk
+        slot_counter("slot_idle_steps",
+                     max(0, self.capacity * n - live_now), self.leg,
+                     self.workload)
+
+    def _retirement_scan(self) -> None:
+        live = [s for s in self._slots
+                if s is not None and s.retire_step < 0]
+        if self.batch_review is not None and live:
+            self.batch_review(live)
+        for row in live:
+            r = self.retire(row)
+            if r is None:
+                r = -1
+            row.checked = row.decoded
+            row.natural = r >= 0
+            if r < 0 and row.decoded >= self.steps:
+                r = row.decoded            # budget exhausted: force-retire
+            if r >= 0:
+                row.retire_step = int(r)
+                self._finished.append(row)
+                self.stats.row_steps.append(row.decoded)
+                slot_counter("slot_retired", 1, self.leg, self.workload)

@@ -38,7 +38,30 @@ import numpy as np
 from .prompts import PACKED_SEPARATOR, format_packed_demo as format_demo
 
 __all__ = ["PACKED_SEPARATOR", "format_demo", "build_packs",
-           "encode_packs", "drift_report", "demos_from_relative_probs"]
+           "encode_packs", "drift_report", "demos_from_relative_probs",
+           "autoregressive_demos"]
+
+
+def autoregressive_demos(engine, prompts: Sequence[str], packing: int,
+                         max_demo_tokens: int = 8,
+                         repack: Optional[bool] = None):
+    """Auto-Demo's AUTOREGRESSIVE demonstrations (the PR-10 follow-up)
+    via decode-then-repack (runtime/slots.py): question k's demo is the
+    model's OWN greedy continuation decoded in the pack's packed context
+    so far — not an answer imported from a separate isolated pass
+    (:func:`demos_from_relative_probs`) — and each finished demo retires
+    its decode slot, which immediately refills with whatever pack stage
+    is ready.  Returns ``(packs, demos)`` with ``packs`` in
+    :func:`build_packs` layout, ready for ``engine.score_packed``.
+
+    Thin façade over
+    :meth:`~..runtime.engine.ScoringEngine.packed_autoregressive_demos`
+    so sweep code imports the packed toolbox from ONE module;
+    ``repack=False`` runs the identical stages whole-flush (the parity
+    comparator — demos are per-row pure, so both modes emit identical
+    texts)."""
+    return engine.packed_autoregressive_demos(
+        prompts, packing, max_demo_tokens=max_demo_tokens, repack=repack)
 
 
 def build_packs(prompts: Sequence, packing: int,

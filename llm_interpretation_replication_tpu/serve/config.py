@@ -45,6 +45,19 @@ class SchedulerConfig:
     #: wedged coalescer with a short queue as healthy — the head
     #: request's age cannot lie.  0 disables the check.
     health_max_queue_age_s: float = 30.0
+    #: Slot-level continuous batching (runtime/slots.py): eligible
+    #: micro-batches (plain binary scored requests on an engine without
+    #: completion decoding) launch through
+    #: ``ScoringEngine.score_prompts_slotted``, and newly-queued
+    #: COMPATIBLE requests are admitted into vacated decode slots
+    #: MID-DECODE (the ring's starvation hook polls the queue between
+    #: chunks) instead of waiting for the next coalescer boundary.
+    #: Default OFF: the slotted chunk schedule moves multi-chunk score
+    #: fields within the chunked-prefill fp32 class, and the replay
+    #: harness's default contract is BIT parity with offline
+    #: ``score_prompts`` — turn this on when occupancy beats the last
+    #: ulp (PARITY.md "Decode-then-repack").
+    slot_admission: bool = False
     #: Prometheus labels stamped onto this scheduler's ``serve_*``
     #: counters / sample rings / latency histograms IN ADDITION to the
     #: unlabeled family (which stays the fleet-wide aggregate) — the

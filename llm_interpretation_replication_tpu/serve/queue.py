@@ -98,6 +98,26 @@ class RequestQueue:
                 return None
             return now_fn() - min(t.enqueue_t for t in self._items)
 
+    def pop_compatible(self, key, max_n: int,
+                       now_fn=time.monotonic) -> List[Ticket]:
+        """NON-BLOCKING pop of up to ``max_n`` live tickets whose
+        coalescer key matches ``key`` — the slot-admission path
+        (scheduler._launch's mid-decode refill hook): a vacated decode
+        slot pulls freshly-queued compatible traffic without waiting for
+        the coalescer boundary.  Deadline-expired tickets are left in
+        place for ``pop_group``'s sweep (one rejection path, not two)."""
+        with self._cond:
+            now = now_fn()
+            out: List[Ticket] = []
+            for t in sorted(self._items, key=Ticket.sort_key):
+                if len(out) >= max(1, max_n):
+                    break
+                if t.key == key and not t.expired(now):
+                    out.append(t)
+            for t in out:
+                self._items.remove(t)
+            return out
+
     def pop_group(self, max_batch: int, max_wait_s: float,
                   now_fn=time.monotonic
                   ) -> Tuple[Optional[List[Ticket]], List[Ticket]]:

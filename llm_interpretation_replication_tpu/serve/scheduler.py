@@ -322,8 +322,54 @@ class Scheduler:
         pair_list = [tuple(t.request.targets) for t in group]
         targets = (list(first.targets) if len(set(pair_list)) == 1
                    else pair_list)
+        admitted: List[Ticket] = []
 
-        if first.prefix is not None:
+        if self._slotted_eligible(first):
+            # slot-level continuous batching (runtime/slots.py): the
+            # micro-batch decodes through the slot ring, and the ring's
+            # starvation hook pulls freshly-queued COMPATIBLE requests
+            # into vacated slots MID-DECODE — admission stops being a
+            # coalescer-boundary event.  Results come back in feed order
+            # (group first, admitted appended).
+            key = group[0].key
+            prompts = [t.encoded if t.encoded is not None
+                       else t.request.prompt for t in group]
+
+            def admit():
+                # bounded admission: at most one extra micro-batch worth
+                # of rows joins a launch — an unbounded window under
+                # sustained compatible load would keep this launch alive
+                # forever, starving every OTHER key's traffic (and the
+                # deadline sweep) behind the single loop thread
+                budget = self._max_batch() - len(admitted)
+                if budget <= 0:
+                    return None
+                extra = self.queue.pop_compatible(key, budget)
+                if not extra:
+                    return None
+                t_adm = time.monotonic()
+                for t in extra:
+                    t.queue_wait_s = max(0.0, t_adm - t.enqueue_t)
+                    t.coalesce_s = 0.0
+                self._counter("serve_slot_admitted", len(extra))
+                admitted.extend(extra)
+                return ([t.encoded if t.encoded is not None
+                         else t.request.prompt for t in extra],
+                        [tuple(t.request.targets) for t in extra])
+
+            def call():
+                if admitted:
+                    # transient RETRY: the re-invoked session feeds only
+                    # the original prompts, so a previous attempt's
+                    # admissions must re-enter the queue (original seq
+                    # kept — they sort ahead of newer traffic) or their
+                    # futures would be zipped against the wrong rows /
+                    # never resolved
+                    self.queue.requeue(list(admitted))
+                    admitted.clear()
+                return self.engine.score_prompts_slotted(
+                    prompts, targets=pair_list, admit_fn=admit)
+        elif first.prefix is not None:
             pairs = [
                 (t.encoded[0], (t.encoded[1],)) if t.encoded is not None
                 else (t.request.prefix, (t.request.suffix,))
@@ -355,6 +401,10 @@ class Scheduler:
                         call, self.config.retry_policy, label="serve")()
         # graftlint: disable=G05 serve fault boundary: the error IS classified (faults.is_oom routes to the split/re-queue ladder) and everything else lands typed on each request's future — nothing above the scheduler thread could observe a re-raise
         except Exception as err:
+            # slot-admitted tickets ride the SAME recovery as the group
+            # they joined: an OOM re-queues everyone down the ladder,
+            # anything else lands typed on every participating future
+            group = group + admitted
             if faults.is_oom(err) and self._split_requeue(group, err):
                 return
             self._counter("serve_failed", len(group))
@@ -363,6 +413,7 @@ class Scheduler:
             return
         done = time.monotonic()
         engine_s = done - now
+        group = group + admitted        # slotted results ride feed order
         for t, row in zip(group, rows):
             self._sample("serve_latency_ms", (done - t.enqueue_t) * 1000.0)
             if t.trace_id is not None:
@@ -396,6 +447,24 @@ class Scheduler:
             obs.add_span("respond", done, time.monotonic(),
                          phase="serve_respond", batch=len(group),
                          trace_id=group[0].trace_id)
+
+    def _slotted_eligible(self, first) -> bool:
+        """Slot-level admission engages only where its contract holds:
+        the pooled binary scored path (no prefix pair, no confidence
+        leg, engine without completion decoding, decoder-only engine)
+        and the knob on.  Everything else keeps the coalescer-boundary
+        launch — including every configuration whose replay contract
+        pins BIT parity with offline scoring."""
+        if not self.config.slot_admission:
+            return False
+        if first.prefix is not None or first.with_confidence:
+            return False
+        ecfg = getattr(self.engine, "ecfg", None)
+        if ecfg is None or ecfg.decode_completions:
+            return False
+        if getattr(self.engine, "is_encoder_decoder", False):
+            return False
+        return hasattr(self.engine, "score_prompts_slotted")
 
     def _split_requeue(self, group: List[Ticket], err) -> bool:
         """OOM recovery: split the micro-batch down the PR-1 ladder and

@@ -1,0 +1,695 @@
+"""Decode-then-repack slot-level continuous batching (ISSUE 14,
+``-m slots``, tier-1).
+
+Pins the slot allocator's contracts (runtime/slots.py, PARITY.md
+"Decode-then-repack"):
+
+- **repack-on == repack-off row parity on all three consumers** — the
+  ``_Phase2Pool`` legs (confidence + binary), the packed autoregressive
+  demo decode, and the serve scheduler's slot-admission path: tokens,
+  parses, verdicts and position-0 fields identical, multi-chunk score
+  fields within the chunked-prefill fp32 class; the legacy whole-flush
+  schedule stays reachable via ``slot_repack=False`` /
+  ``SchedulerConfig.slot_admission=False``.
+- **occupancy gain is measured, not asserted**: a synthetic
+  staggered-retirement run shows the ``occupancy`` block's slot-idle
+  fraction STRICTLY lower with repack than the whole-flush
+  counterfactual, with refills actually recorded.
+- **retirement is repack-invariant** (satellite): a row's
+  ``first_int_stable`` retirement step and parse are identical whether
+  it decodes in a fresh batch, a refilled slot, or the legacy flush.
+- Satellites: K-head persistence beside snapshots (load-or-redistill
+  key), ``slot_*`` telemetry in the PR-12 labeled convention +
+  Prometheus export, bench-diff ``occupancy`` alignment with slot-idle
+  as a lower-is-better row, refill-model plan pricing.
+"""
+
+import dataclasses
+import itertools
+import json
+import os
+
+import numpy as np
+import pytest
+
+from test_runtime import _tiny_engine
+
+from llm_interpretation_replication_tpu.runtime import engine as emod
+from llm_interpretation_replication_tpu.runtime import slots as slots_mod
+from llm_interpretation_replication_tpu.runtime.engine import ScoringEngine
+from llm_interpretation_replication_tpu.scoring.confidence import (
+    extract_first_int,
+)
+from llm_interpretation_replication_tpu.utils import telemetry
+
+pytestmark = pytest.mark.slots
+
+EXACT_FIELDS = ("first_token_yes_prob", "first_token_no_prob",
+                "first_token_relative_prob")
+PROB_FIELDS = ("yes_prob", "no_prob", "relative_prob")
+
+CONF_PROMPTS = [f"How confident are you about rule {i}, 0-100?"
+                for i in range(16)]
+BIN_PROMPTS = [f"Is item {i} a vehicle? Answer Yes or No."
+               for i in range(12)]
+
+
+def _clone(eng, tok, **kw):
+    return ScoringEngine(eng.family, eng.cfg, eng.params, tok,
+                         engine_config=dataclasses.replace(eng.ecfg, **kw))
+
+
+@pytest.fixture(scope="module")
+def tiny():
+    eng, _, tok = _tiny_engine(batch_size=8)
+    return eng, tok
+
+
+class TestPoolParity:
+    def test_confidence_leg_repack_matches_whole_flush(self, tiny):
+        """Acceptance: repack-on vs repack-off on the confidence pool —
+        weighted confidence, first-int parse, completion and position-0
+        fields identical; scan fields within the chunked class."""
+        eng, tok = tiny
+        telemetry.clear_counters()
+        rows_r = _clone(eng, tok).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        c = telemetry.counters()
+        assert c.get("slot_rows", 0) >= len(CONF_PROMPTS)
+        # satellite: labeled twin rides the PR-12 convention from day one
+        assert c.get("slot_rows|leg=confidence,workload=engine", 0) >= \
+            len(CONF_PROMPTS)
+        rows_f = _clone(eng, tok, slot_repack=False).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        for a, b in zip(rows_r, rows_f):
+            assert a["success"] and b["success"]
+            assert a["weighted_confidence"] == b["weighted_confidence"]
+            assert a["completion"] == b["completion"]
+            assert extract_first_int(a["completion"]) == \
+                extract_first_int(b["completion"])
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+            for f in PROB_FIELDS:
+                np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                           atol=1e-9, err_msg=f)
+
+    def test_binary_leg_repack_matches_whole_flush(self, tiny):
+        """The binary undecided-row pool through the ring: verdicts and
+        position-0 fields identical, scan probabilities within the
+        chunked class (the ring decodes 5+5 chunks with per-row early
+        exit; the legacy flush decodes one async 10-step chunk)."""
+        eng, tok = tiny
+        telemetry.clear_counters()
+        rows_r = _clone(eng, tok, decode_completions=False).score_prompts(
+            BIN_PROMPTS)
+        assert telemetry.counter(
+            "slot_rows|leg=binary,workload=engine") > 0
+        rows_f = _clone(eng, tok, decode_completions=False,
+                        slot_repack=False).score_prompts(BIN_PROMPTS)
+        for a, b in zip(rows_r, rows_f):
+            assert a["success"] and b["success"]
+            assert a["scan_found"] == b["scan_found"]
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+            for f in PROB_FIELDS + ("odds_ratio",):
+                np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                           atol=1e-9, err_msg=f)
+
+    def test_ring_composition_never_changes_a_row(self, tiny):
+        """Ring capacity (pool target) changes batch composition and
+        refill timing — emitted confidence rows must not move (the
+        pooled-confidence bit-reproducibility rule, re-pinned on the
+        ring)."""
+        eng, tok = tiny
+        small = _clone(eng, tok, phase2_pool_target=4).score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        big = _clone(eng, tok, batch_size=16).score_prompts(
+            CONF_PROMPTS[:9], with_confidence=True, max_new_tokens=10)
+        for a, b in zip(small, big):
+            assert a["weighted_confidence"] == b["weighted_confidence"]
+            assert a["completion"] == b["completion"]
+
+
+class TestStaggeredOccupancy:
+    def _staggered(self, eng, tok, repack: bool):
+        """Score with a deterministic staggered retirement cadence and
+        a 4-lane ring; returns (rows, occupancy block, counters)."""
+        counter = itertools.count()
+        orig = emod._Phase2Pool._conf_retired_at
+        emod._Phase2Pool._conf_retired_at = \
+            lambda self, toks, k: next(counter) % 4 == 0
+        telemetry.clear_counters()
+        try:
+            e = _clone(eng, tok, batch_size=16, phase2_pool_target=4,
+                       slot_repack=repack)
+            rows = e.score_prompts(CONF_PROMPTS, with_confidence=True,
+                                   max_new_tokens=10)
+            occ = e.occupancy_report()
+        finally:
+            emod._Phase2Pool._conf_retired_at = orig
+        return rows, occ, telemetry.counters()
+
+    def test_staggered_retirement_idle_fraction_strictly_lower(self, tiny):
+        """Acceptance: the synthetic staggered-retirement case — rows
+        retire at different steps, vacated lanes REFILL mid-decode, and
+        the occupancy block shows slot-idle fraction strictly lower
+        with repack than the whole-flush counterfactual."""
+        eng, tok = tiny
+        rows, occ, c = self._staggered(eng, tok, repack=True)
+        assert all(r["success"] for r in rows)
+        assert occ is not None and occ["rows"] == len(CONF_PROMPTS)
+        assert c.get("slot_refills", 0) > 0, "no lane ever refilled"
+        assert occ["refills"] > 0
+        assert occ["slot_idle_frac"] is not None
+        assert occ["slot_idle_frac_no_repack"] is not None
+        assert occ["slot_idle_frac"] < occ["slot_idle_frac_no_repack"]
+        # the legacy counters keep firing under repack (same semantics)
+        assert c.get("conf_steps_saved", 0) > 0
+        assert c.get("completion_cache_bytes_freed", 0) > 0
+        assert c.get("pooled_conf_retired_rows", 0) > 0
+
+    def test_legacy_path_reachable_and_ring_counters_silent(self, tiny):
+        """Acceptance: ``slot_repack=False`` keeps the whole-flush
+        schedule — no slot_* counters fire, no occupancy block."""
+        eng, tok = tiny
+        rows, occ, c = self._staggered(eng, tok, repack=False)
+        assert all(r["success"] for r in rows)
+        assert occ is None
+        assert c.get("slot_rows", 0) == 0
+        assert c.get("slot_refills", 0) == 0
+
+
+class TestRetirementUnderRepack:
+    """Satellite: a row's retirement step and parse are a pure function
+    of its own tokens — identical in a fresh batch, a refilled slot, and
+    the legacy flush path (append-proof style, test_pooled_conf.py)."""
+
+    def test_retire_step_identical_across_paths(self, tiny):
+        eng, tok = tiny
+        seen = {}
+        orig = emod._Phase2Pool._conf_retired_at
+
+        def spy(self, toks, k):
+            out = orig(self, toks, k)
+            if out:
+                key = tuple(int(t) for t in np.asarray(toks[:k]))
+                seen.setdefault(key, k)
+                assert seen[key] == k     # same prefix -> same r*
+            return out
+
+        emod._Phase2Pool._conf_retired_at = spy
+        try:
+            for cfg_kw in ({"slot_repack": True},
+                           {"slot_repack": True, "phase2_pool_target": 4},
+                           {"slot_repack": False}):
+                _clone(eng, tok, **cfg_kw).score_prompts(
+                    CONF_PROMPTS[:9], with_confidence=True,
+                    max_new_tokens=10)
+        finally:
+            emod._Phase2Pool._conf_retired_at = orig
+
+    def test_parse_identical_fresh_vs_refilled_vs_flush(self, tiny):
+        """End-to-end: per-row parses emitted by a fresh ring (capacity
+        >= rows, no refills), a refilled ring (capacity 4 — rows 5+ run
+        in refilled lanes), and the legacy flush are identical."""
+        eng, tok = tiny
+        fresh = _clone(eng, tok, batch_size=16).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        refilled = _clone(eng, tok, batch_size=16,
+                          phase2_pool_target=4).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        flush = _clone(eng, tok, batch_size=16,
+                       slot_repack=False).score_prompts(
+            CONF_PROMPTS, with_confidence=True, max_new_tokens=10)
+        for a, b, c in zip(fresh, refilled, flush):
+            pa = extract_first_int(a["completion"])
+            assert pa == extract_first_int(b["completion"])
+            assert pa == extract_first_int(c["completion"])
+            assert a["weighted_confidence"] == b["weighted_confidence"] \
+                == c["weighted_confidence"]
+            assert a["completion"] == b["completion"] == c["completion"]
+
+
+class TestPackedDemos:
+    def test_autoregressive_demos_repack_parity(self, tiny):
+        """Packed consumer: decode-then-repack autoregressive demos are
+        identical texts whether slots refill mid-decode or run
+        whole-flush; the last question of each pack stays demo-free."""
+        from llm_interpretation_replication_tpu.scoring import packed
+
+        eng, tok = tiny
+        qs = [f"Q{i}: is a tent a dwelling? Answer Yes or No."
+              for i in range(8)]
+        telemetry.clear_counters()
+        e_on = _clone(eng, tok, phase2_pool_target=2,
+                      buckets=(32, 64, 128, 256))
+        packs_on, demos_on = packed.autoregressive_demos(
+            e_on, qs, packing=4, max_demo_tokens=6)
+        c = telemetry.counters()
+        assert c.get("slot_rows|leg=packed,workload=packed", 0) > 0
+        packs_off, demos_off = packed.autoregressive_demos(
+            _clone(eng, tok, phase2_pool_target=2,
+                   buckets=(32, 64, 128, 256)), qs, packing=4,
+            max_demo_tokens=6, repack=False)
+        assert demos_on == demos_off
+        assert packs_on == packs_off
+        assert len(demos_on) == 8
+        assert demos_on[3] is None and demos_on[7] is None
+        assert all(d is not None for d in demos_on[:3])
+        # the packs feed score_packed directly (build_packs layout)
+        rows = e_on.score_packed(packs_on, targets=("Yes", "No"))
+        assert len(rows) == 8 and all(r["success"] for r in rows)
+
+    def test_demo_decode_occupancy_recorded(self, tiny):
+        eng, tok = tiny
+        e = _clone(eng, tok, phase2_pool_target=2,
+                   buckets=(32, 64, 128, 256))
+        e.packed_autoregressive_demos(
+            [f"Q{i}?" for i in range(6)], packing=3, max_demo_tokens=4)
+        occ = e.occupancy_report()
+        assert occ is not None and occ["rows"] >= 4
+
+
+class TestServeSlotAdmission:
+    def _scheduler(self, eng, tok, slot_admission, max_batch=2):
+        from llm_interpretation_replication_tpu.serve import (
+            Scheduler,
+            SchedulerConfig,
+        )
+
+        engine = _clone(eng, tok, decode_completions=False)
+        return engine, Scheduler(engine, SchedulerConfig(
+            max_batch=max_batch, max_wait_s=0.01,
+            slot_admission=slot_admission))
+
+    def test_mid_decode_admission_and_parity(self, tiny):
+        """Acceptance (serve consumer): requests queued beyond the first
+        micro-batch are admitted into vacated slots MID-DECODE
+        (serve_slot_admitted fires) and every answered row matches the
+        whole-flush scheduler's within the documented class."""
+        from llm_interpretation_replication_tpu.serve import ScoreRequest
+
+        eng, tok = tiny
+        telemetry.clear_counters()
+        engine, sched = self._scheduler(eng, tok, slot_admission=True)
+        futures = [sched.submit(ScoreRequest(prompt=p))
+                   for p in BIN_PROMPTS]     # queued BEFORE the loop runs
+        with sched:
+            rows = [f.result(timeout=300) for f in futures]
+        assert telemetry.counter("serve_slot_admitted") > 0
+        assert telemetry.counter(
+            "slot_admitted|leg=binary,workload=serve") > 0
+        _, sched_off = self._scheduler(eng, tok, slot_admission=False)
+        futures = [sched_off.submit(ScoreRequest(prompt=p))
+                   for p in BIN_PROMPTS]
+        with sched_off:
+            rows_off = [f.result(timeout=300) for f in futures]
+        for a, b in zip(rows, rows_off):
+            assert a["success"] and b["success"]
+            assert a["scan_found"] == b["scan_found"]
+            for f in PROB_FIELDS:
+                np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                           atol=1e-9, err_msg=f)
+
+    def test_slotted_matches_offline_scoring(self, tiny):
+        """Served slotted rows vs offline ``score_prompts`` on the same
+        engine configuration (the replay-harness comparison, at the
+        ring's documented tolerance class)."""
+        from llm_interpretation_replication_tpu.serve import ScoreRequest
+
+        eng, tok = tiny
+        engine, sched = self._scheduler(eng, tok, slot_admission=True,
+                                        max_batch=4)
+        futures = [sched.submit(ScoreRequest(prompt=p))
+                   for p in BIN_PROMPTS[:8]]
+        with sched:
+            rows = [f.result(timeout=300) for f in futures]
+        offline = _clone(eng, tok, decode_completions=False).score_prompts(
+            BIN_PROMPTS[:8])
+        for a, b in zip(rows, offline):
+            assert a["scan_found"] == b["scan_found"]
+            for f in PROB_FIELDS + EXACT_FIELDS:
+                np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
+                                           atol=1e-9, err_msg=f)
+
+    def test_confidence_requests_keep_coalescer_path(self, tiny):
+        """Eligibility guard: confidence requests never route slotted
+        (their replay contract is the pooled-confidence one), even with
+        the knob on."""
+        from llm_interpretation_replication_tpu.serve import ScoreRequest
+
+        eng, tok = tiny
+        telemetry.clear_counters()
+        engine, sched = self._scheduler(eng, tok, slot_admission=True)
+        with sched:
+            row = sched.submit(ScoreRequest(
+                prompt=CONF_PROMPTS[0], with_confidence=True,
+                max_new_tokens=10)).result(timeout=300)
+        assert row["success"] and "weighted_confidence" in row
+        assert telemetry.counter("serve_slot_admitted") == 0
+
+
+class TestKHeadPersistence:
+    """Satellite: distilled K-heads persist beside snapshots keyed on
+    (snapshot fingerprint, decode_k); load-or-redistill on construction."""
+
+    def _snapshot_dir(self, tmp_path, seed=b"weights-v1"):
+        d = tmp_path / "snap"
+        d.mkdir(exist_ok=True)
+        (d / "config.json").write_text(json.dumps({"model_type": "test"}))
+        (d / "model.safetensors").write_bytes(seed)
+        return str(d)
+
+    def test_round_trip_and_key_misses(self, tmp_path, tiny):
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+        from llm_interpretation_replication_tpu.runtime import loader
+
+        eng, _ = tiny
+        path = self._snapshot_dir(tmp_path)
+        head = dmod.init_k_head(eng.cfg, k=3, seed=7)
+        out = loader.save_k_head(path, head, decode_k=3)
+        assert os.path.basename(out) == loader.K_HEAD_FILENAME
+        loaded = loader.load_k_head(path, decode_k=3)
+        assert loaded is not None
+        np.testing.assert_allclose(np.asarray(loaded["w"], np.float32),
+                                   np.asarray(head["w"], np.float32),
+                                   rtol=1e-6)
+        # decode_k mismatch -> miss (re-distill)
+        assert loader.load_k_head(path, decode_k=4) is None
+        # weight change moves the fingerprint -> miss
+        with open(os.path.join(path, "model.safetensors"), "wb") as f:
+            f.write(b"weights-v2-longer")
+        assert loader.load_k_head(path, decode_k=3) is None
+
+    def test_attach_on_construction(self, tmp_path, tiny):
+        from llm_interpretation_replication_tpu.models import decoder as dmod
+        from llm_interpretation_replication_tpu.runtime import loader
+
+        eng, tok = tiny
+        path = self._snapshot_dir(tmp_path)
+        e = _clone(eng, tok, decode_k=3)
+        assert not loader.attach_k_head(e, path)      # nothing saved yet
+        assert e.k_head is None
+        loader.save_k_head(path, dmod.init_k_head(e.cfg, k=3), decode_k=3)
+        telemetry.clear_counters()
+        assert loader.attach_k_head(e, path)
+        assert e.k_head is not None
+        assert telemetry.counter("k_head_loaded") == 1
+        # decode_k=1 engines never touch the file
+        assert not loader.attach_k_head(_clone(eng, tok), path)
+
+    def test_torn_file_is_a_miss(self, tmp_path):
+        from llm_interpretation_replication_tpu.runtime import loader
+
+        path = self._snapshot_dir(tmp_path)
+        with open(os.path.join(path, loader.K_HEAD_FILENAME), "wb") as f:
+            f.write(b"not an npz")
+        assert loader.load_k_head(path, decode_k=3) is None
+
+
+class TestTelemetryAndExport:
+    def test_slot_counters_export_as_labeled_prometheus_series(self, tiny):
+        """Satellite: slot_* counters ride the ``name|k=v`` convention,
+        so the exporter emits ONE family with {leg, workload} label sets
+        — no second migration needed."""
+        from llm_interpretation_replication_tpu.obs import (
+            metrics as obs_metrics,
+        )
+
+        eng, tok = tiny
+        telemetry.clear_counters()
+        _clone(eng, tok).score_prompts(CONF_PROMPTS[:6],
+                                       with_confidence=True,
+                                       max_new_tokens=10)
+        obs_metrics.get_registry().sample()
+        text = obs_metrics.prometheus_text()
+        labeled = [l for l in text.splitlines()
+                   if l.startswith("llm_interp_slot_rows{")]
+        assert any('leg="confidence"' in l and 'workload="engine"' in l
+                   for l in labeled), text[:2000]
+
+
+class TestBenchDiffOccupancy:
+    def _rec(self, idle, before=0.5, refills=3, stalls=0):
+        return {"metric": "rows/sec x", "value": 10.0, "unit": "rows/sec",
+                "occupancy": {"capacity": 320, "rows": 100,
+                              "slot_steps": 1000, "live_steps": 800,
+                              "slot_idle_frac": idle,
+                              "slot_idle_frac_no_repack": before,
+                              "refills": refills, "repacks": 5,
+                              "compactions": 1, "repack_stalls": stalls}}
+
+    def test_occupancy_rows_flatten_and_regress(self):
+        """Satellite: the occupancy block aligns across records with
+        slot-idle fraction as a LOWER-is-better verdict row."""
+        from llm_interpretation_replication_tpu.obs import benchdiff
+
+        flat = benchdiff.flatten_metrics(self._rec(0.2))
+        assert flat["slot idle fraction [idle-frac]"]["value"] == 0.2
+        assert "slot idle fraction (no-repack counterfactual)" in flat
+        diff = benchdiff.diff_records(
+            [dict(self._rec(0.2), label="r1"),
+             dict(self._rec(0.4), label="r2")], threshold_pct=5.0)
+        row = next(r for r in diff["metrics"]
+                   if r["key"] == "slot idle fraction [idle-frac]")
+        assert row["verdict"] == "REGRESSION"       # idle GREW = worse
+        diff2 = benchdiff.diff_records(
+            [dict(self._rec(0.4), label="r1"),
+             dict(self._rec(0.2), label="r2")], threshold_pct=5.0)
+        row2 = next(r for r in diff2["metrics"]
+                    if r["key"] == "slot idle fraction [idle-frac]")
+        assert row2["verdict"] == "improved"
+
+    def test_nested_secondary_occupancy_flattens(self):
+        from llm_interpretation_replication_tpu.obs import benchdiff
+
+        rec = {"metric": "prompts/sec y", "value": 5.0,
+               "unit": "prompts/sec",
+               "secondary": [dict(self._rec(0.3),
+                                  metric="full-study rows/sec",
+                                  unit="rows/sec")]}
+        flat = benchdiff.flatten_metrics(rec)
+        assert flat["slot idle fraction [idle-frac]"]["value"] == 0.3
+
+
+class TestPlanRefillModel:
+    def test_refill_pricing_is_cheaper_and_opt_in(self):
+        """The refill model prices the confidence pool below the
+        all-or-nothing flush accumulation (capacity-shaped residency),
+        and the default keeps every legacy pin byte-identical."""
+        from llm_interpretation_replication_tpu.models.config import (
+            BENCH_GEOMETRIES,
+            DecoderConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime import plan
+
+        f7 = DecoderConfig(**BENCH_GEOMETRIES["falcon-7b"])
+        legacy = plan.pooled_confidence_extra_bytes(f7, 320, 256,
+                                                   kv_dtype="int8")
+        refill = plan.slot_refill_pool_bytes(f7, 320, 320, 256,
+                                             kv_dtype="int8")
+        assert refill < legacy
+        base = plan.full_study_need_terms(
+            f7, plan.weight_bytes(f7, "int8"), "xla", 320, 256,
+            kv_dtype="int8", prefill_chunk=128, pooled_confidence=True)
+        repack = plan.full_study_need_terms(
+            f7, plan.weight_bytes(f7, "int8"), "xla", 320, 256,
+            kv_dtype="int8", prefill_chunk=128, pooled_confidence=True,
+            slot_repack=True)
+        assert base["conf_pool"] == plan.pooled_confidence_extra_bytes(
+            f7, 320, 256, kv_dtype="int8")       # default untouched
+        assert repack["conf_pool"] == refill
+        assert sum(repack.values()) < sum(base.values())
+
+    def test_search_threads_slot_repack(self):
+        from llm_interpretation_replication_tpu.models.config import (
+            BENCH_GEOMETRIES,
+            DecoderConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime import plan_search
+
+        f7 = DecoderConfig(**BENCH_GEOMETRIES["falcon-7b"])
+        ranked_r = plan_search.search_plans(
+            f7, "int8", n_devices=1, workload="full", slot_repack=True)
+        ranked_l = plan_search.search_plans(
+            f7, "int8", n_devices=1, workload="full")
+        fits_r = sum(1 for c in ranked_r if c.fits)
+        fits_l = sum(1 for c in ranked_l if c.fits)
+        assert fits_r >= fits_l       # cheaper pool can only admit more
+
+
+class TestRingUnit:
+    def test_occupancy_counterfactual_math(self):
+        s = slots_mod.OccupancyStats(capacity=4)
+        s.capacity_steps, s.live_steps = 100, 80
+        s.row_steps = [10, 5, 5, 10, 3, 3, 3, 3]
+        assert s.idle_fraction() == pytest.approx(0.2)
+        # flushes: [10,5,5,10] dur 10 -> idle 10; [3,3,3,3] dur 3 -> 0
+        assert s.no_repack_idle_fraction() == pytest.approx(10 / 52)
+        merged = slots_mod.merge_occupancy(
+            [s, slots_mod.OccupancyStats(capacity=2)])
+        assert merged.rows == s.rows and merged.capacity == 4
+
+    def test_strict_mode_clean(self, tiny):
+        """Strict-mode transfer guard holds through the ring (every
+        chunk fetch happens inside the sanctioned consume scope)."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng, tok = tiny
+        e = _clone(eng, tok, kv_dtype="int8")
+        strict.activate()
+        try:
+            snap = telemetry.counters()
+            rows = e.score_prompts(CONF_PROMPTS[:6], with_confidence=True,
+                                   max_new_tokens=10)
+            delta = telemetry.counters_since(snap)
+            assert delta.get(strict.BLOCKED_COUNTER, 0) == 0
+            assert delta.get("slot_rows", 0) >= 6
+            assert all(r["success"] for r in rows)
+        finally:
+            strict.deactivate()
+
+
+class TestBenchIntegration:
+    def test_bench_sweep_full_occupancy_block_end_to_end(self, tmp_path):
+        """The whole bench wiring, executed: a tiny --mode sweep-full run
+        with a 4-lane pool lands the ``occupancy`` block (slot-idle
+        fraction + whole-flush counterfactual + refill/repack counts)
+        and the slot counters in the record's context."""
+        import argparse
+
+        import jax
+        import jax.numpy as jnp
+
+        import bench
+        from llm_interpretation_replication_tpu.models.config import (
+            DecoderConfig,
+        )
+        from test_kdecode import TINY
+
+        scenarios = [{
+            "original_main": "Is soup a beverage?",
+            "response_format": "Answer only 'Yes' or 'No'.",
+            "confidence_format": "How confident are you (0-100)?",
+            "target_tokens": ["Yes", "No"],
+            "rephrasings": [f"Is soup number {i} a beverage?"
+                            for i in range(6)],
+        }]
+        corpus = tmp_path / "perturbations.json"
+        corpus.write_text(json.dumps(scenarios))
+        cfg = DecoderConfig(**dict(
+            TINY, parallel_residual=True, qkv_bias=True, out_bias=True,
+            mlp_bias=True))
+        params = bench.init_params(cfg, jax.random.PRNGKey(0),
+                                   jnp.float32)
+        args = argparse.Namespace(
+            model="tiny", quant="none", sweep_batch=8, sweep_rows=0,
+            sweep_repeats=1, pool_target=4, pipeline_depth=2,
+            checkpoint_every=100, sweep_out=str(tmp_path / "out.xlsx"),
+            decided_frac=0.9, perturbations=str(corpus),
+            mode="sweep-full", warmup=False, fuse_prefix=True,
+            eos_mode="none", eos_brackets=False, decode_k=1)
+        rps, rate, _ = bench.run_sweep_full_mode(args, cfg, params)
+        assert rps > 0 and np.isfinite(rps)
+        record = bench._full_study_record(args, rps, rate)
+        occ = record["occupancy"]
+        assert occ["rows"] == 6 and occ["capacity"] == 4
+        assert occ["slot_idle_frac"] is not None
+        assert occ["slot_idle_frac_no_repack"] is not None
+        assert record["context"]["slot_repack"] is True
+        assert record["context"]["slot_rows"] == 6
+        json.dumps(record)      # record-serializable
+        # bench-diff aligns the executed record's occupancy rows
+        from llm_interpretation_replication_tpu.obs import benchdiff
+
+        flat = benchdiff.flatten_metrics(record)
+        assert "slot idle fraction [idle-frac]" in flat
+
+    def test_bench_source_wires_slot_repack(self):
+        """Source pins (the child-forwarding test style): the flag
+        exists, both sweep engines receive it, the full-study secondary
+        child inherits it, and plan search prices with the refill model
+        when it is on."""
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        src = open(os.path.join(repo_root, "bench.py"),
+                   encoding="utf-8").read()
+        assert '"--slot-repack"' in src
+        assert src.count('slot_repack=getattr(args, "slot_repack", True)'
+                         ) >= 4
+        assert 'child.slot_repack = getattr(args, "slot_repack", True)' \
+            in src
+        assert 'slot_repack=getattr(child, "slot_repack", True)' in src
+        cli_src = open(os.path.join(
+            repo_root, "llm_interpretation_replication_tpu",
+            "__main__.py"), encoding="utf-8").read()
+        assert '"--slot-repack"' in cli_src
+        assert 'slot_repack=getattr(args, "slot_repack", True)' in cli_src
+
+
+class TestMixedSlotLengths:
+    def test_longer_newcomer_pads_live_lanes_up(self, tiny):
+        """Review regression: a pending group whose cache slot axis is
+        WIDER than the ring's current slot length must pad the live
+        lanes up (not crash the concat) — the slotted-serve mixed-bucket
+        and grown-pack scenarios.  Tokens must match the same rows
+        decoded in unmixed rings (padding slots are inert)."""
+        import jax.numpy as jnp
+
+        from llm_interpretation_replication_tpu.runtime import (
+            batching as bmod,
+        )
+
+        eng, tok = tiny
+        e = _clone(eng, tok)
+        eos = getattr(tok, "eos_token_id", None)
+
+        def group(prompts, pad_to):
+            encoded = bmod.encode_prompts(tok, prompts)
+            batch = next(bmod.batches_for_prompts(
+                encoded, len(prompts), (32,),
+                pad_id=tok.pad_token_id or 0))
+            last, cache = e._prefill(jnp.asarray(batch.token_ids),
+                                     jnp.asarray(batch.attention_mask),
+                                     batch.bucket_len)
+            lens = jnp.sum(jnp.asarray(batch.attention_mask), axis=-1)
+            cache = slots_mod._pad_cache_to(cache, pad_to)
+            metas = [{"orig": int(i)} for i in batch.indices]
+            return cache, last, lens, np.zeros((len(prompts), 2),
+                                               np.int32), metas
+
+        def run(groups, steps=6):
+            got = {}
+
+            def emit(rows):
+                for r in rows:
+                    got[r.meta["orig"] + r.meta.get("base", 0)] = \
+                        r.toks[: r.decoded].copy()
+
+            ring = slots_mod.SlotRing(
+                e, steps=steps, eos_id=eos, capacity=3, leg="binary",
+                workload="test",
+                retire=lambda row: row.decoded
+                if row.decoded >= steps else -1,
+                emit=emit, with_scores=False,
+                pad_slice=lambda n: n)
+            for base, g in enumerate(groups):
+                cache, last, lens, ids, metas = g
+                for m in metas:
+                    m["base"] = base * 10
+                ring.feed(cache, last, lens, ids, metas)
+            ring.drain()
+            return got
+
+        narrow = group(["Is a kayak a boat?", "Is tea a soup?"], 40)
+        wide = group(["Is rain weather now?", "Is a shed a house?"], 56)
+        mixed = run([narrow, wide])
+        # the same rows through single-length rings (the reference)
+        solo_n = run([group(["Is a kayak a boat?", "Is tea a soup?"], 40)])
+        solo_w = run([group(["Is rain weather now?",
+                             "Is a shed a house?"], 56)])
+        assert len(mixed) == 4
+        for k in (0, 1):
+            np.testing.assert_array_equal(mixed[k], solo_n[k])
+        for k in (10, 11):
+            np.testing.assert_array_equal(mixed[k], solo_w[k - 10])
