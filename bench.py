@@ -1458,6 +1458,26 @@ def _full_study_record(a, rps: float, rate: float) -> dict:
     return record
 
 
+#: The child-namespace contract for the in-process sweep-full companion
+#: (cross-checked by ``lint contracts``): exactly these attributes may be
+#: re-pointed on the shallow-copied namespace inside
+#: ``_full_study_secondary`` — everything else INHERITS from the parent
+#: run (the ISSUE-10 bracket flags, --trace/--metrics instrumentation,
+#: corpus paths).  Adding a ``child.x = ...`` without declaring it here
+#: (or declaring one and dropping the assignment) fails the contracts
+#: gate, which is the machine-checked successor of the hand-written
+#: child-forwarding source pins.
+FULL_STUDY_CHILD_OVERRIDES = (
+    "mode", "sweep_repeats", "kv_dtype", "prefill_chunk", "attn",
+    "pooled_confidence", "slot_repack", "sweep_out", "plan_search_report",
+    "profile",
+    # plan-search / fixed-plan resolve outputs for the child's own
+    # full-workload operating point:
+    "sweep_batch", "pool_target", "fit_decision", "predicted_batch",
+    "decode_k", "predicted_k",
+)
+
+
 def _full_study_secondary(args, cfg, geometry, params) -> dict:
     """The sweep mode's full-study companion row, IN-PROCESS (ISSUE 12).
 
@@ -2376,7 +2396,7 @@ def main():
             raise
 
     from llm_interpretation_replication_tpu.models.decoder import (
-        KVCache,
+        cache_kv_map,
         decode_steps,
     )
     from llm_interpretation_replication_tpu.runtime.engine import (
@@ -2432,14 +2452,15 @@ def main():
             # Pool flush: concatenate accumulated slices up to ``sub`` rows
             # (modeled by tiling the latest slice — identical shapes/bytes
             # to the engine's cross-batch concat) and run ONE scored decode.
+            # Tiling routes through cache_kv_map so an int8 slice's scales
+            # would tile with the codes (G07 — the scale-awareness rule).
             reps = -(-sub // sel_m)
-            cache = KVCache(
-                k=jnp.concatenate([sub_cache.k] * reps, axis=1)[:, :sub],
-                v=jnp.concatenate([sub_cache.v] * reps, axis=1)[:, :sub],
+            cache = cache_kv_map(
+                sub_cache,
+                lambda x: jnp.concatenate([x] * reps, axis=1)[:, :sub],
                 positions=jnp.concatenate(
                     [sub_cache.positions] * reps, axis=0)[:sub],
                 valid=jnp.concatenate([sub_cache.valid] * reps, axis=0)[:sub],
-                length=sub_cache.length,
             )
             last = jnp.concatenate([last_s] * reps, axis=0)[:sub]
             lens = jnp.concatenate([len_s] * reps, axis=0)[:sub]

@@ -1531,11 +1531,15 @@ def main(argv=None):
 
     p = sub.add_parser("lint",
                        help="JAX-aware static analysis (graftlint rules "
-                            "G01-G05) gated by lint_baseline.json")
+                            "G01-G08, interprocedural) gated by "
+                            "lint_baseline.json; `lint contracts` runs "
+                            "the cross-artifact drift checker")
     p.add_argument("lint_args", nargs=argparse.REMAINDER,
-                   help="forwarded to the linter: paths, --format "
-                        "text|json, --baseline PATH, --no-baseline, "
-                        "--write-baseline, --explain RULE|all")
+                   help="forwarded to the linter: paths, --diff, "
+                        "--format text|json, --baseline PATH, "
+                        "--no-baseline, --write-baseline, --explain "
+                        "RULE|all, or the `contracts` subcommand "
+                        "(--root, --only KIND, --diff)")
     p.set_defaults(fn=cmd_lint)
 
     p = sub.add_parser("plan",

@@ -7,18 +7,24 @@ per batch).  PR 2 built the machinery that avoids them (prefix-KV reuse,
 plan-keyed compile caching, double-buffered host pipeline); this package
 makes reintroducing them a TEST FAILURE instead of a perf mystery.
 
-Layout:
+Layout (two analysis layers since PR 15):
 
-- :mod:`.visitor` — the AST pass: function stack, jit/device-region and
-  static-argname resolution, suppression comments.
+- :mod:`.visitor` — the AST passes: a module-level call graph that
+  propagates device-region membership interprocedurally (bounded depth,
+  import-alias aware), then the rule-dispatching function-stack walk.
 - :mod:`.rules` — rules G01 (host-sync), G02 (traced control flow),
   G03 (PRNG key reuse), G04 (jit-boundary hygiene), G05 (broad except
-  before fault classification).
+  before fault classification), G06 (telemetry naming discipline),
+  G07 (KV-cache scale awareness), G08 (tracer span hygiene).
+- :mod:`.contracts` — layer 2, ``lint contracts``: cross-artifact drift
+  checking (code vs README tables, pyproject marker registry, bench-diff
+  block classification, the sweep-full child-override contract).
 - :mod:`.report` — findings, fingerprints, formatting.
 - :mod:`.baseline` — the grandfathered-findings ratchet
-  (``lint_baseline.json``).
+  (``lint_baseline.json``), including the scope-independent rot check.
 - :mod:`.cli` — the ``python -m llm_interpretation_replication_tpu lint``
-  subcommand; ``tests/test_lint.py`` runs it inside tier-1.
+  subcommand (``--diff`` for changed-files CI runs);
+  ``tests/test_lint.py`` runs it inside tier-1.
 
 The runtime complement lives in :mod:`..runtime.strict`: an env-gated
 strict mode (``LLM_INTERP_STRICT=1``) that arms ``jax.transfer_guard``
@@ -26,8 +32,10 @@ around the scoring pipeline and counts recompiles, so the same contract
 the linter enforces statically is enforced (and telemetered) on device.
 """
 
-from .baseline import apply_baseline, load_baseline, save_baseline
-from .cli import default_paths, lint_paths, main
+from .baseline import (apply_baseline, load_baseline, rotten_entries,
+                       save_baseline)
+from .cli import changed_files, default_paths, lint_paths, main
+from .contracts import check_contracts
 from .report import Finding, format_report
 from .rules import RULES, default_rules
 from .visitor import lint_source
@@ -36,6 +44,8 @@ __all__ = [
     "Finding",
     "RULES",
     "apply_baseline",
+    "changed_files",
+    "check_contracts",
     "default_paths",
     "default_rules",
     "format_report",
@@ -43,5 +53,6 @@ __all__ = [
     "lint_source",
     "load_baseline",
     "main",
+    "rotten_entries",
     "save_baseline",
 ]

@@ -60,6 +60,32 @@ def save_baseline(findings: Sequence[Finding], path: str,
         f.write("\n")
 
 
+def rotten_entries(entries: Sequence[Dict], root: str) -> List[Dict]:
+    """Baseline entries whose fingerprint no longer matches ANY line of
+    the file they point at (or whose file is gone) — baseline rot.
+
+    ``apply_baseline`` only surfaces stale entries for files the current
+    run actually linted; a subset run (``--diff``, explicit paths) would
+    let an entry for a deleted/rewritten file linger forever, silently
+    re-shielding the next violation with the same fingerprint.  This
+    check is scope-independent: the entry's own file is re-read from
+    disk, so rot fails the gate on every run regardless of target set."""
+    rotten: List[Dict] = []
+    for e in entries:
+        rel = e.get("path", "")
+        code = normalize_code(e.get("code", ""))
+        try:
+            with open(os.path.join(root, rel), encoding="utf-8") as f:
+                lines = f.read().splitlines()
+        except OSError:
+            rotten.append(e)
+            continue
+        if not code or not any(normalize_code(line) == code
+                               for line in lines):
+            rotten.append(e)
+    return rotten
+
+
 def apply_baseline(findings: Sequence[Finding], entries: Sequence[Dict]
                    ) -> Tuple[List[Finding], List[Dict], int]:
     """Split ``findings`` against the baseline.

@@ -11,8 +11,10 @@ Usage::
     python -m llm_interpretation_replication_tpu lint
     python -m llm_interpretation_replication_tpu lint --format json
     python -m llm_interpretation_replication_tpu lint path/to/file.py
+    python -m llm_interpretation_replication_tpu lint --diff       # changed files only
     python -m llm_interpretation_replication_tpu lint --explain G02
     python -m llm_interpretation_replication_tpu lint --write-baseline  # refresh
+    python -m llm_interpretation_replication_tpu lint contracts    # cross-artifact layer
 """
 
 from __future__ import annotations
@@ -20,9 +22,10 @@ from __future__ import annotations
 import argparse
 import os
 import sys
-from typing import List, Optional, Sequence
+from typing import List, Optional, Sequence, Set
 
-from .baseline import apply_baseline, load_baseline, save_baseline
+from .baseline import (apply_baseline, load_baseline, rotten_entries,
+                       save_baseline)
 from .report import Finding, format_report, sort_findings
 from .rules import RULES, default_rules
 from .visitor import lint_source
@@ -50,6 +53,30 @@ def default_paths() -> List[str]:
 
 def default_baseline_path() -> str:
     return os.path.join(repo_root(), "lint_baseline.json")
+
+
+def changed_files(root: Optional[str] = None) -> Optional[List[str]]:
+    """Repo-relative posix paths changed vs git HEAD (staged, unstaged,
+    and untracked) — the ``--diff`` target set for cheap CI.  Returns
+    ``None`` when git is unavailable or ``root`` is not a work tree, so
+    callers can fall back to the full scan rather than silently passing
+    an empty diff."""
+    import subprocess
+
+    root = os.path.abspath(root or repo_root())
+    out: List[str] = []
+    for cmd in (["git", "diff", "--name-only", "HEAD"],
+                ["git", "ls-files", "--others", "--exclude-standard"]):
+        try:
+            proc = subprocess.run(cmd, cwd=root, capture_output=True,
+                                  text=True, timeout=30)
+        except (OSError, subprocess.TimeoutExpired):
+            return None
+        if proc.returncode != 0:
+            return None
+        out.extend(line.strip() for line in proc.stdout.splitlines()
+                   if line.strip())
+    return sorted(set(out))
 
 
 def iter_python_files(paths: Sequence[str]) -> List[str]:
@@ -90,13 +117,25 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None,
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
+    if argv and argv[0] == "contracts":
+        # layer 2: cross-artifact contract checking (`lint contracts`),
+        # routed before argparse like the parent `lint` routing itself
+        from .contracts import main as contracts_main
+
+        return contracts_main(list(argv[1:]))
     parser = argparse.ArgumentParser(
         prog="llm_interpretation_replication_tpu lint",
-        description="JAX-aware static analysis (rules G01-G05) with a "
-                    "grandfathered-findings baseline")
+        description="JAX-aware static analysis (rules G01-G08, "
+                    "interprocedural device regions) with a "
+                    "grandfathered-findings baseline; `lint contracts` "
+                    "runs the cross-artifact layer")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the package + "
                              "bench.py)")
+    parser.add_argument("--diff", action="store_true",
+                        help="lint only files changed vs git HEAD "
+                             "(staged+unstaged+untracked); the baseline "
+                             "rot check still covers the whole file")
     parser.add_argument("--baseline", default=None,
                         help="baseline JSON (default: lint_baseline.json "
                              "at the repo root; missing file = empty)")
@@ -125,7 +164,33 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         print(f"{rid} [{title}] {desc}")
         return 0
 
+    if args.diff and args.write_baseline:
+        # a baseline written from a changed-files subset would silently
+        # drop every grandfathered entry for untouched files
+        print("--write-baseline needs the full scan; drop --diff",
+              file=sys.stderr)
+        return 2
+
     paths = args.paths or default_paths()
+    linted_rel: Optional[Set[str]] = None
+    if args.diff:
+        root = repo_root()
+        changed = changed_files(root)
+        if changed is None:
+            print("# lint --diff: git unavailable; falling back to the "
+                  "full scan", file=sys.stderr)
+        else:
+            changed_abs = {os.path.abspath(os.path.join(root, c))
+                           for c in changed}
+            paths = [f for f in iter_python_files(paths)
+                     if os.path.abspath(f) in changed_abs]
+            # stale accounting below is restricted to the files actually
+            # linted — a --diff run must not flag every untouched file's
+            # baseline entry as stale; rot (scope-independent) still runs
+            linted_rel = {
+                os.path.relpath(os.path.abspath(f), root).replace(
+                    os.sep, "/")
+                for f in paths}
     findings = lint_paths(paths)
     baseline_path = args.baseline or default_baseline_path()
 
@@ -140,13 +205,21 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return 0
 
     entries = [] if args.no_baseline else load_baseline(baseline_path)
-    new, stale, matched = apply_baseline(findings, entries)
+    rot = rotten_entries(entries, repo_root())
+    scoped = (entries if linted_rel is None
+              else [e for e in entries if e.get("path") in linted_rel])
+    new, stale, matched = apply_baseline(findings, scoped)
+    # a rotten entry is usually also stale on a full run — report it once,
+    # under the more specific diagnosis
+    rot_ids = set(map(id, rot))
+    stale = [e for e in stale if id(e) not in rot_ids]
     print(format_report(new, stale=stale, baselined=matched,
-                        fmt=args.format))
-    # stale entries fail the gate too: the baseline is a ratchet, and a
-    # leftover entry for fixed code would silently re-shield the next
-    # violation with the same fingerprint — delete it (or --write-baseline)
-    return 1 if (new or stale) else 0
+                        fmt=args.format, rot=rot))
+    # stale/rotten entries fail the gate too: the baseline is a ratchet,
+    # and a leftover entry for fixed code would silently re-shield the
+    # next violation with the same fingerprint — delete it (or
+    # --write-baseline)
+    return 1 if (new or stale or rot) else 0
 
 
 if __name__ == "__main__":

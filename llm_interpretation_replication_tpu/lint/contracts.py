@@ -1,0 +1,656 @@
+"""``lint contracts``: cross-artifact drift checking (graftlint layer 2).
+
+Layer 1 (the G-rules) checks code against code-local conventions.  This
+layer parses the codebase AND the docs/config as ONE system and fails on
+drift between artifacts that describe each other — the conventions that,
+before this checker, were each guarded by a hand-written source-pin test
+that rotted one PR at a time:
+
+- **counter-table** — telemetry counters recorded in code
+  (``record_counter`` and its chokepoint wrappers) vs the README
+  "Telemetry counters" table.  A counter recorded but undocumented never
+  shows up in anyone's dashboard runbook; a documented counter nothing
+  records is a row readers will wait on forever (and, since the
+  Prometheus exporter enumerates the telemetry registry generically,
+  "documented but never recorded" is exactly "documented but never
+  exported").
+- **markers** — pytest markers used in ``tests/`` vs the
+  ``[tool.pytest.ini_options] markers`` registry in pyproject.toml, both
+  directions (an unregistered marker is a silent ``-m`` no-op under
+  ``--strict-markers``; a registered-but-unused one is dead config).
+- **record-blocks** — top-level blocks ``bench.py`` emits into its JSON
+  record vs :mod:`..obs.benchdiff`'s declared classification
+  (``ALIGNED_BLOCKS`` / ``CONTEXT_BLOCKS`` / ``INFORMATIONAL_BLOCKS``):
+  every emitted block must be consciously classified, and every block
+  benchdiff claims to align/contextualize must actually be read by it.
+- **child-flags** — ``bench.FULL_STUDY_CHILD_OVERRIDES`` vs the actual
+  ``child.x = ...`` assignments inside ``_full_study_secondary``: the
+  in-process sweep-full companion inherits the parent namespace, so the
+  set of re-pointed attributes IS the forwarding contract.
+- **phase-table** — :data:`..obs.tracer.KNOWN_PHASES` vs the README
+  "Span / phase names" table (G08 enforces code→table membership; this
+  check keeps the two tables themselves in lockstep).
+
+Everything here is static (regex + ``ast`` over sources): no package
+import, no JAX init — cheap enough to run before pytest in the tier-1
+gate.  ``--root`` points the checker at another tree (the teeth tests
+seed drift into temp copies).
+"""
+
+from __future__ import annotations
+
+import argparse
+import ast
+import json
+import os
+import re
+import sys
+from typing import Dict, List, Optional, Sequence, Set, Tuple
+
+from .cli import repo_root
+
+PKG_NAME = "llm_interpretation_replication_tpu"
+
+#: marks provided by pytest itself — never need registration.
+_BUILTIN_MARKS = {"parametrize", "skip", "skipif", "xfail", "usefixtures",
+                  "filterwarnings", "tryfirst", "trylast"}
+
+#: registered marks that are legitimately unused by any test TODAY:
+#: ``slow`` is the tier-1 gate's exclusion selector (``-m 'not slow'``
+#: in ROADMAP's verify command) — the registration documents the gate
+#: convention and must survive windows where nothing is marked slow.
+_SELECTOR_MARKS = {"slow"}
+
+# Only counter-kind names (record_counter + its chokepoint wrappers)
+# are checked against the README counter table — sample rings and
+# histograms are documented prose-side next to it.
+
+
+class Drift:
+    """One cross-artifact disagreement."""
+
+    def __init__(self, kind: str, message: str, artifact: str):
+        self.kind = kind          # check id, e.g. "counter-table"
+        self.message = message
+        self.artifact = artifact  # the artifact that needs the edit
+
+    def format(self) -> str:
+        return f"[{self.kind}] {self.message} (fix in: {self.artifact})"
+
+    def to_json(self) -> Dict:
+        return {"kind": self.kind, "message": self.message,
+                "artifact": self.artifact}
+
+
+# ---------------------------------------------------------------------------
+# shared parsing helpers
+# ---------------------------------------------------------------------------
+
+def _read(path: str) -> Optional[str]:
+    try:
+        with open(path, encoding="utf-8") as f:
+            return f.read()
+    except OSError:
+        return None
+
+
+def _iter_package_files(root: str) -> List[str]:
+    pkg = os.path.join(root, PKG_NAME)
+    out = []
+    for dirpath, dirnames, filenames in os.walk(pkg):
+        dirnames[:] = [d for d in dirnames
+                       if d not in ("__pycache__", ".git")]
+        for fname in sorted(filenames):
+            if fname.endswith(".py"):
+                out.append(os.path.join(dirpath, fname))
+    bench = os.path.join(root, "bench.py")
+    if os.path.exists(bench):
+        out.append(bench)
+    return out
+
+
+def _dotted(node: ast.AST) -> str:
+    parts: List[str] = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return ""
+
+
+def _table_rows(md: str, heading: str) -> List[str]:
+    """Backticked names from the FIRST column of the markdown table under
+    ``heading`` (rows until the next heading).  ``\\|`` inside backticks
+    (the labeled-twin spellings) is unescaped after the column split."""
+    lines = md.splitlines()
+    names: List[str] = []
+    in_section = False
+    for line in lines:
+        if line.startswith("#") and heading in line:
+            in_section = True
+            continue
+        if in_section and line.startswith("#"):
+            break
+        if not in_section or not line.startswith("|"):
+            continue
+        cell = line.replace("\\|", "\x00").split("|")[1]
+        for name in re.findall(r"`([^`]+)`", cell.replace("\x00", "\\|")):
+            names.append(name)
+    return names
+
+
+# ---------------------------------------------------------------------------
+# check 1: telemetry counters vs README counter table
+# ---------------------------------------------------------------------------
+
+def _first_arg_literal_base(arg: ast.expr) -> List[str]:
+    """Statically-resolvable base name(s) of a metric-name expression:
+    the literal (or literal f-string prefix / both IfExp arms), stripped
+    of the ``|k=v`` label suffix.  Forwarded params resolve at the
+    wrapper's call sites instead and return []."""
+    if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+        return [arg.value.partition("|")[0]]
+    if isinstance(arg, ast.JoinedStr):
+        first = arg.values[0] if arg.values else None
+        if isinstance(first, ast.Constant):
+            base = str(first.value).partition("|")[0]
+            # a fully-literal base ends before the first dynamic segment;
+            # `f"k_steps_saved|leg={leg}"` resolves, `f"slot_{kind}"` not
+            if "|" in str(first.value) or len(arg.values) == 1:
+                return [base]
+        return []
+    if isinstance(arg, ast.IfExp):
+        return (_first_arg_literal_base(arg.body)
+                + _first_arg_literal_base(arg.orelse))
+    if isinstance(arg, ast.BinOp):  # name + label_suffix: base is left
+        return _first_arg_literal_base(arg.left)
+    return []
+
+
+def _collect_code_counters(root: str) -> Set[str]:
+    """Counter names recorded anywhere in the package + bench.py,
+    resolved through chokepoint wrappers (a function whose body forwards
+    its own param to ``record_counter`` makes every literal at ITS call
+    sites a counter name)."""
+    files = _iter_package_files(root)
+    trees: List[Tuple[str, ast.Module]] = []
+    for path in files:
+        text = _read(path)
+        if text is None:
+            continue
+        try:
+            trees.append((path, ast.parse(text)))
+        except SyntaxError:
+            continue
+    counters: Set[str] = set()
+    wrappers: Set[str] = set()
+
+    def _base_param(arg: ast.expr, params: Set[str]) -> bool:
+        """True when the metric-name expression FORWARDS a param as its
+        base (the chokepoint idiom): a bare param, an f-string whose
+        base segment is one (``f"{name}|leg={leg}"``), or ``name + sfx``.
+        A param that only interpolates a LABEL VALUE
+        (``f"k_steps_saved|leg={leg}"``) is not forwarding — the literal
+        base resolves right here, and treating the function as a wrapper
+        would register its call-site argument strings as counter names."""
+        if isinstance(arg, ast.Name):
+            return arg.id in params
+        if isinstance(arg, ast.JoinedStr) and arg.values:
+            first = arg.values[0]
+            return (isinstance(first, ast.FormattedValue)
+                    and isinstance(first.value, ast.Name)
+                    and first.value.id in params)
+        if isinstance(arg, ast.BinOp):
+            return _base_param(arg.left, params)
+        return False
+
+    # pass 1: direct record_counter literals + wrapper discovery
+    for path, tree in trees:
+        for node in ast.walk(tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            params = {a.arg for a in (node.args.posonlyargs + node.args.args
+                                      + node.args.kwonlyargs)}
+            for sub in ast.walk(node):
+                if not isinstance(sub, ast.Call):
+                    continue
+                fn = _dotted(sub.func).rsplit(".", 1)[-1]
+                if fn != "record_counter" or not sub.args:
+                    continue
+                if _base_param(sub.args[0], params):
+                    wrappers.add(node.name)
+    for path, tree in trees:
+        consts = {t.id: n.value.value for n in ast.walk(tree)
+                  if isinstance(n, ast.Assign)
+                  and isinstance(n.value, ast.Constant)
+                  and isinstance(n.value.value, str)
+                  for t in n.targets if isinstance(t, ast.Name)}
+        for node in ast.walk(tree):
+            if not isinstance(node, ast.Call) or not node.args:
+                continue
+            fn = _dotted(node.func).rsplit(".", 1)[-1]
+            if fn == "record_counter" or fn in wrappers:
+                arg = node.args[0]
+                if (isinstance(arg, ast.Name) and arg.id in consts):
+                    counters.add(consts[arg.id].partition("|")[0])
+                else:
+                    counters.update(_first_arg_literal_base(arg))
+    return {c for c in counters if c}
+
+
+def check_counter_table(root: str) -> List[Drift]:
+    md = _read(os.path.join(root, "README.md"))
+    if md is None:
+        return [Drift("counter-table", "README.md missing", "README.md")]
+    doc_names: List[str] = []
+    for name in _table_rows(md, "Telemetry counters"):
+        base = name.partition("\\|")[0].partition("|")[0]
+        for part in base.split(" / "):
+            part = part.strip().strip("`")
+            if part:
+                doc_names.append(part)
+    code = _collect_code_counters(root)
+    drifts: List[Drift] = []
+
+    def documented(counter: str) -> bool:
+        for doc in doc_names:
+            if doc.endswith("*"):
+                if counter.startswith(doc[:-1]):
+                    return True
+            elif counter == doc:
+                return True
+        return False
+
+    for counter in sorted(code):
+        if not documented(counter):
+            drifts.append(Drift(
+                "counter-table",
+                f"counter '{counter}' is recorded in code but missing "
+                f"from the README 'Telemetry counters' table",
+                "README.md"))
+    for doc in doc_names:
+        if doc.endswith("*"):
+            hit = any(c.startswith(doc[:-1]) for c in code)
+        else:
+            hit = doc in code
+        if not hit:
+            drifts.append(Drift(
+                "counter-table",
+                f"README counter-table row '{doc}' matches no counter "
+                f"recorded anywhere in the code (never recorded means "
+                f"never exported)",
+                "README.md"))
+    return drifts
+
+
+# ---------------------------------------------------------------------------
+# check 2: pytest markers vs pyproject registry
+# ---------------------------------------------------------------------------
+
+def check_markers(root: str) -> List[Drift]:
+    pyproject = _read(os.path.join(root, "pyproject.toml"))
+    if pyproject is None:
+        return [Drift("markers", "pyproject.toml missing",
+                      "pyproject.toml")]
+    m = re.search(r"markers\s*=\s*\[(.*?)\]", pyproject, re.DOTALL)
+    registered: Set[str] = set()
+    if m:
+        for entry in re.findall(r'"([^":]+):', m.group(1)):
+            registered.add(entry.strip())
+    used: Set[str] = set()
+    tests_dir = os.path.join(root, "tests")
+    if os.path.isdir(tests_dir):
+        for fname in sorted(os.listdir(tests_dir)):
+            if not fname.endswith(".py"):
+                continue
+            text = _read(os.path.join(tests_dir, fname)) or ""
+            used.update(re.findall(r"pytest\.mark\.(\w+)", text))
+    used -= _BUILTIN_MARKS
+    drifts: List[Drift] = []
+    for name in sorted(used - registered):
+        drifts.append(Drift(
+            "markers",
+            f"pytest marker '{name}' is used in tests/ but not "
+            f"registered in pyproject [tool.pytest.ini_options] markers",
+            "pyproject.toml"))
+    for name in sorted(registered - used - _SELECTOR_MARKS):
+        drifts.append(Drift(
+            "markers",
+            f"pytest marker '{name}' is registered in pyproject but "
+            f"used by no test (dead registry entry)",
+            "pyproject.toml"))
+    return drifts
+
+
+# ---------------------------------------------------------------------------
+# check 3: bench record blocks vs benchdiff classification
+# ---------------------------------------------------------------------------
+
+def _bench_emitted_blocks(tree: ast.Module) -> Set[str]:
+    """Top-level record blocks bench emits: ``record["k"] = ...``
+    subscript assignments plus keys of dict literals returned by local
+    helpers applied via ``record.update(helper(...))``."""
+    helper_keys: Dict[str, Set[str]] = {}
+    for node in ast.walk(tree):
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            keys: Set[str] = set()
+            for sub in ast.walk(node):
+                if isinstance(sub, ast.Return) and isinstance(
+                        sub.value, ast.Dict):
+                    for k in sub.value.keys:
+                        if isinstance(k, ast.Constant) and isinstance(
+                                k.value, str):
+                            keys.add(k.value)
+            if keys:
+                helper_keys[node.name] = keys
+    emitted: Set[str] = set()
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Subscript)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "record"
+                        and isinstance(t.slice, ast.Constant)
+                        and isinstance(t.slice.value, str)):
+                    emitted.add(t.slice.value)
+        elif isinstance(node, ast.Call):
+            fn = _dotted(node.func)
+            if fn == "record.update" and node.args:
+                arg = node.args[0]
+                if isinstance(arg, ast.Call):
+                    name = _dotted(arg.func).rsplit(".", 1)[-1]
+                    emitted.update(helper_keys.get(name, ()))
+    return emitted
+
+
+def _module_str_tuples(tree: ast.Module, names: Sequence[str]
+                       ) -> Dict[str, Tuple[List[str], ast.Assign]]:
+    out: Dict[str, Tuple[List[str], ast.Assign]] = {}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and isinstance(
+                node.value, (ast.Tuple, ast.List)):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id in names:
+                    vals = [e.value for e in node.value.elts
+                            if isinstance(e, ast.Constant)
+                            and isinstance(e.value, str)]
+                    out[t.id] = (vals, node)
+    return out
+
+
+def check_record_blocks(root: str) -> List[Drift]:
+    bench_text = _read(os.path.join(root, "bench.py"))
+    diff_path = os.path.join(root, PKG_NAME, "obs", "benchdiff.py")
+    diff_text = _read(diff_path)
+    if bench_text is None or diff_text is None:
+        return [Drift("record-blocks", "bench.py or obs/benchdiff.py "
+                      "missing", "bench.py")]
+    try:
+        bench_tree = ast.parse(bench_text)
+        diff_tree = ast.parse(diff_text)
+    except SyntaxError as err:
+        return [Drift("record-blocks", f"unparseable source: {err}",
+                      "bench.py")]
+    emitted = _bench_emitted_blocks(bench_tree)
+    declared = _module_str_tuples(
+        diff_tree, ("ALIGNED_BLOCKS", "CONTEXT_BLOCKS",
+                    "INFORMATIONAL_BLOCKS"))
+    drifts: List[Drift] = []
+    missing_decls = [n for n in ("ALIGNED_BLOCKS", "CONTEXT_BLOCKS",
+                                 "INFORMATIONAL_BLOCKS")
+                     if n not in declared]
+    if missing_decls:
+        return [Drift("record-blocks",
+                      f"obs/benchdiff.py no longer declares "
+                      f"{', '.join(missing_decls)} — the block contract "
+                      f"has no benchdiff side to check against",
+                      "obs/benchdiff.py")]
+    classified: Set[str] = set()
+    decl_nodes = []
+    for vals, node in declared.values():
+        classified.update(vals)
+        decl_nodes.append(node)
+    for key in sorted(emitted - classified):
+        drifts.append(Drift(
+            "record-blocks",
+            f"bench.py emits record block '{key}' that obs/benchdiff.py "
+            f"classifies in none of ALIGNED_BLOCKS / CONTEXT_BLOCKS / "
+            f"INFORMATIONAL_BLOCKS — bench-diff would silently ignore "
+            f"it round over round",
+            "obs/benchdiff.py"))
+    # ALIGNED/CONTEXT entries must actually be READ by benchdiff: the
+    # string must occur outside the declaration tuples themselves AND
+    # outside docstrings (a docstring mentioning "secondary" is not code
+    # reading the block)
+    skip_ids = {id(e) for node in decl_nodes
+                for e in ast.walk(node)}
+    for node in ast.walk(diff_tree):
+        if isinstance(node, (ast.Module, ast.FunctionDef,
+                             ast.AsyncFunctionDef, ast.ClassDef)):
+            body = node.body
+            if (body and isinstance(body[0], ast.Expr)
+                    and isinstance(body[0].value, ast.Constant)
+                    and isinstance(body[0].value.value, str)):
+                skip_ids.add(id(body[0].value))
+    read_strings: Set[str] = set()
+    for node in ast.walk(diff_tree):
+        if (isinstance(node, ast.Constant) and isinstance(node.value, str)
+                and id(node) not in skip_ids):
+            read_strings.add(node.value)
+    for decl_name in ("ALIGNED_BLOCKS", "CONTEXT_BLOCKS"):
+        for key in declared[decl_name][0]:
+            if key not in read_strings:
+                drifts.append(Drift(
+                    "record-blocks",
+                    f"obs/benchdiff.py declares '{key}' in {decl_name} "
+                    f"but never reads it — the block stopped being "
+                    f"aligned/flattened",
+                    "obs/benchdiff.py"))
+    return drifts
+
+
+# ---------------------------------------------------------------------------
+# check 4: full-study child-override contract
+# ---------------------------------------------------------------------------
+
+def check_child_flags(root: str) -> List[Drift]:
+    bench_text = _read(os.path.join(root, "bench.py"))
+    if bench_text is None:
+        return [Drift("child-flags", "bench.py missing", "bench.py")]
+    try:
+        tree = ast.parse(bench_text)
+    except SyntaxError as err:
+        return [Drift("child-flags", f"unparseable bench.py: {err}",
+                      "bench.py")]
+    declared = _module_str_tuples(tree, ("FULL_STUDY_CHILD_OVERRIDES",))
+    if "FULL_STUDY_CHILD_OVERRIDES" not in declared:
+        return [Drift("child-flags",
+                      "bench.py no longer declares "
+                      "FULL_STUDY_CHILD_OVERRIDES — the child-namespace "
+                      "contract has no declared side",
+                      "bench.py")]
+    declared_names = set(declared["FULL_STUDY_CHILD_OVERRIDES"][0])
+    fn = next((n for n in tree.body
+               if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))
+               and n.name == "_full_study_secondary"), None)
+    if fn is None:
+        return [Drift("child-flags",
+                      "bench.py has no _full_study_secondary — update "
+                      "the contract checker alongside the refactor",
+                      "bench.py")]
+    assigned: Set[str] = set()
+    for node in ast.walk(fn):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if (isinstance(t, ast.Attribute)
+                        and isinstance(t.value, ast.Name)
+                        and t.value.id == "child"):
+                    assigned.add(t.attr)
+    drifts: List[Drift] = []
+    for name in sorted(assigned - declared_names):
+        drifts.append(Drift(
+            "child-flags",
+            f"_full_study_secondary re-points child.{name} without "
+            f"declaring it in FULL_STUDY_CHILD_OVERRIDES — undeclared "
+            f"overrides are how parent settings silently stop reaching "
+            f"the companion run",
+            "bench.py"))
+    for name in sorted(declared_names - assigned):
+        drifts.append(Drift(
+            "child-flags",
+            f"FULL_STUDY_CHILD_OVERRIDES declares '{name}' but "
+            f"_full_study_secondary never assigns child.{name} — the "
+            f"declared forwardable flag is dropped by the child block",
+            "bench.py"))
+    return drifts
+
+
+# ---------------------------------------------------------------------------
+# check 5: tracer phase table vs README phase table
+# ---------------------------------------------------------------------------
+
+def check_phase_table(root: str) -> List[Drift]:
+    tracer_text = _read(os.path.join(root, PKG_NAME, "obs", "tracer.py"))
+    md = _read(os.path.join(root, "README.md"))
+    if tracer_text is None or md is None:
+        return [Drift("phase-table", "obs/tracer.py or README.md missing",
+                      "obs/tracer.py")]
+    try:
+        tree = ast.parse(tracer_text)
+    except SyntaxError as err:
+        return [Drift("phase-table", f"unparseable tracer.py: {err}",
+                      "obs/tracer.py")]
+    known: Set[str] = set()
+    for node in tree.body:
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name) and t.id == "KNOWN_PHASES":
+                    for sub in ast.walk(node.value):
+                        if (isinstance(sub, ast.Constant)
+                                and isinstance(sub.value, str)):
+                            known.add(sub.value)
+    if not known:
+        return [Drift("phase-table",
+                      "obs/tracer.py no longer declares KNOWN_PHASES",
+                      "obs/tracer.py")]
+    doc: Set[str] = set()
+    for name in _table_rows(md, "Span / phase names"):
+        for part in name.split(" / "):
+            part = part.strip().strip("`")
+            if part:
+                doc.add(part)
+    drifts: List[Drift] = []
+    for name in sorted(known - doc):
+        drifts.append(Drift(
+            "phase-table",
+            f"phase '{name}' is in obs/tracer.KNOWN_PHASES but missing "
+            f"from the README 'Span / phase names' table",
+            "README.md"))
+    for name in sorted(doc - known):
+        drifts.append(Drift(
+            "phase-table",
+            f"README phase-table row '{name}' is not in "
+            f"obs/tracer.KNOWN_PHASES (G08 would reject a span using it)",
+            "obs/tracer.py"))
+    return drifts
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+CHECKS = (
+    ("counter-table", check_counter_table),
+    ("markers", check_markers),
+    ("record-blocks", check_record_blocks),
+    ("child-flags", check_child_flags),
+    ("phase-table", check_phase_table),
+)
+
+#: repo-relative path predicates per check — the ``--diff`` scope: a
+#: check runs when ANY file it reads changed.  Predicates take a
+#: repo-relative posix path.
+CHECK_TRIGGERS = {
+    "counter-table": lambda p: (p == "README.md" or p == "bench.py"
+                                or (p.startswith(PKG_NAME + "/")
+                                    and p.endswith(".py"))),
+    "markers": lambda p: (p == "pyproject.toml"
+                          or (p.startswith("tests/")
+                              and p.endswith(".py"))),
+    "record-blocks": lambda p: p in ("bench.py",
+                                     PKG_NAME + "/obs/benchdiff.py"),
+    "child-flags": lambda p: p == "bench.py",
+    "phase-table": lambda p: p in ("README.md",
+                                   PKG_NAME + "/obs/tracer.py"),
+}
+
+
+def check_contracts(root: Optional[str] = None,
+                    only: Optional[Sequence[str]] = None) -> List[Drift]:
+    root = os.path.abspath(root or repo_root())
+    drifts: List[Drift] = []
+    for kind, check in CHECKS:
+        if only is not None and kind not in only:
+            continue
+        drifts.extend(check(root))
+    return drifts
+
+
+def main(argv: Optional[Sequence[str]] = None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="llm_interpretation_replication_tpu lint contracts",
+        description="cross-artifact contract checking: code vs README "
+                    "tables, pyproject marker registry, bench-diff block "
+                    "classification, and the sweep-full child contract")
+    parser.add_argument("--root", default=None,
+                        help="tree to check (default: this repo)")
+    parser.add_argument("--format", choices=["text", "json"],
+                        default="text")
+    parser.add_argument("--only", default=None, metavar="KIND",
+                        help="run one check: " + ", ".join(
+                            k for k, _ in CHECKS))
+    parser.add_argument("--diff", action="store_true",
+                        help="run only the checks whose artifacts "
+                             "changed vs git HEAD (cheap CI mode; git "
+                             "unavailable falls back to all checks)")
+    args = parser.parse_args(argv)
+    root = os.path.abspath(args.root or repo_root())
+    if args.only:
+        table = dict(CHECKS)
+        if args.only not in table:
+            print(f"unknown check {args.only!r}; known: "
+                  f"{', '.join(k for k, _ in CHECKS)}")
+            return 2
+        drifts = table[args.only](root)
+    elif args.diff:
+        from .cli import changed_files
+
+        changed = changed_files(root)
+        if changed is None:
+            drifts = check_contracts(root)
+        else:
+            triggered = [kind for kind, _ in CHECKS
+                         if any(CHECK_TRIGGERS[kind](p) for p in changed)]
+            drifts = check_contracts(root, only=triggered)
+            if args.format == "text":
+                skipped = [k for k, _ in CHECKS if k not in triggered]
+                if skipped:
+                    print(f"# --diff: skipped {', '.join(skipped)} "
+                          f"(no relevant artifact changed)")
+    else:
+        drifts = check_contracts(root)
+    if args.format == "json":
+        print(json.dumps({"drift": [d.to_json() for d in drifts]},
+                         indent=2))
+    else:
+        for d in drifts:
+            print(d.format())
+        print(f"{len(drifts)} contract drift(s)" if drifts
+              else "contracts clean: code, docs, and config agree")
+    return 1 if drifts else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -88,14 +88,18 @@ def suppressed(finding: Finding, suppressions: Dict[int, List[str]]) -> bool:
 def format_report(findings: Sequence[Finding],
                   stale: Sequence[Dict] = (),
                   baselined: int = 0,
-                  fmt: str = "text") -> str:
+                  fmt: str = "text",
+                  rot: Sequence[Dict] = ()) -> str:
     """Render the lint result.  ``findings`` are the NEW (non-baselined)
     violations; ``stale`` are baseline entries that no longer match any
-    finding (fixed code whose grandfather clause should be deleted)."""
+    finding in this run's target set (fixed code whose grandfather clause
+    should be deleted); ``rot`` are entries whose fingerprint matches no
+    line of their own file on disk (scope-independent baseline rot)."""
     if fmt == "json":
         return json.dumps({
             "findings": [f.to_json() for f in findings],
             "stale_baseline": list(stale),
+            "rotten_baseline": list(rot),
             "baselined": baselined,
         }, indent=2)
     lines: List[str] = [f.format() for f in findings]
@@ -104,11 +108,19 @@ def format_report(findings: Sequence[Finding],
             f"# stale baseline entry ({entry.get('rule')} "
             f"{entry.get('path')}): no longer matches — delete it from the "
             f"baseline ({normalize_code(entry.get('code', ''))!r})")
+    for entry in rot:
+        lines.append(
+            f"# rotten baseline entry ({entry.get('rule')} "
+            f"{entry.get('path')}): fingerprint matches no line of that "
+            f"file on disk — the exempted code is gone; delete the entry "
+            f"({normalize_code(entry.get('code', ''))!r})")
     summary = (f"{len(findings)} new finding(s)"
                + (f", {baselined} baselined" if baselined else "")
                + (f", {len(stale)} stale baseline entr"
-                  f"{'y' if len(stale) == 1 else 'ies'}" if stale else ""))
-    lines.append(summary if (findings or stale or baselined)
+                  f"{'y' if len(stale) == 1 else 'ies'}" if stale else "")
+               + (f", {len(rot)} rotten baseline entr"
+                  f"{'y' if len(rot) == 1 else 'ies'}" if rot else ""))
+    lines.append(summary if (findings or stale or rot or baselined)
                  else "clean: no findings")
     return "\n".join(lines)
 

@@ -1,4 +1,4 @@
-"""graftlint rules G01-G05: the TPU-hostile patterns this repo bans.
+"""graftlint rules G01-G08: the TPU-hostile patterns this repo bans.
 
 Each rule is a small class plugging into :class:`..lint.visitor.LintVisitor`
 hooks.  The catalogue (also printed by ``lint --explain``):
@@ -36,14 +36,36 @@ hooks.  The catalogue (also printed by ``lint --explain``):
   operating point.  Handlers that re-raise (``raise`` / ``raise err``)
   pass; intentional keep-alive catches take an inline
   ``# graftlint: disable=G05 <reason>``.
+- **G06 telemetry-discipline** — metric names passed to
+  ``record_counter``/``record_sample``/``record_hist`` must be
+  statically enumerable: string literals (or module constants /
+  forwarded chokepoint-helper params), with labels spelled in the
+  ``name|k=v,k2=v2`` convention using LITERAL label keys.  A
+  dynamically concatenated name mints an unbounded metric family the
+  README counter table cannot document, bench-diff cannot align, and
+  the Prometheus exporter cannot re-split into one labeled family.
+- **G07 cache-scale-awareness** — ``reshape``/``gather``/``concat``
+  (and friends) applied directly to ``KVCache.k``/``.v`` outside the
+  ops helpers and ``models/decoder.cache_kv_map``: with int8 KV the
+  per-head ``k_scale``/``v_scale`` must ride every storage re-layout,
+  or dequantization silently reads misaligned scales — the exact bug
+  class the PR-5 int8 scale-plumbing audit chased by hand.
+- **G08 span-hygiene** — tracer spans must be context-managed (``with
+  obs.span(...)``; cross-thread timing uses ``add_span``) and every
+  ``phase=`` tag must be a literal from the canonical phase table
+  (``obs/tracer.KNOWN_PHASES``): a leaked span corrupts the per-thread
+  SELF-time stack, and a typo'd phase forks a row outside the
+  documented partition.
 """
 
 from __future__ import annotations
 
 import ast
+import re
 from typing import Dict, List, Optional, Set, Tuple
 
-from .visitor import FileContext, LintVisitor, dotted_name
+from ..obs.tracer import KNOWN_PHASES
+from .visitor import METADATA_ATTRS, FileContext, LintVisitor, dotted_name
 
 #: rule id -> (title, one-line summary) — the CLI's --explain table.
 RULES: Dict[str, Tuple[str, str]] = {
@@ -58,12 +80,38 @@ RULES: Dict[str, Tuple[str, str]] = {
                             "self/bound-method capture, unpinned shape params"),
     "G05": ("broad-except", "broad except swallows errors before "
                             "runtime/faults.py classification"),
+    "G06": ("telemetry-discipline", "metric names must be literal (or "
+                                    "forwarded params); labels ride the "
+                                    "name|k=v convention with literal keys"),
+    "G07": ("cache-scale-awareness", "reshape/gather/concat directly on "
+                                     "KVCache.k/.v outside ops helpers — "
+                                     "int8 scales must ride along "
+                                     "(cache_kv_map)"),
+    "G08": ("span-hygiene", "tracer spans must be context-managed and "
+                            "phase= tags must come from the known phase "
+                            "table"),
 }
 
 #: numpy-namespace fetch calls (host materialization of a device value)
 _FETCH_CALLS = {"np.asarray", "np.array", "numpy.asarray", "numpy.array",
                 "jax.device_get", "device_get"}
 _CAST_BUILTINS = {"float", "int", "bool"}
+
+def _names_outside_metadata(expr: ast.expr) -> Set[str]:
+    """Names in ``expr`` NOT reached through metadata attribute access
+    (``x.shape``/``.size``/... are Python-static under trace)."""
+    names: Set[str] = set()
+
+    def walk(n: ast.AST) -> None:
+        if isinstance(n, ast.Attribute) and n.attr in METADATA_ATTRS:
+            return  # the base only appears as metadata here
+        if isinstance(n, ast.Name):
+            names.add(n.id)
+        for child in ast.iter_child_nodes(n):
+            walk(child)
+
+    walk(expr)
+    return names
 
 
 class HostSyncRule:
@@ -97,7 +145,7 @@ class HostSyncRule:
         is_item = isinstance(node.func, ast.Attribute) and node.func.attr == "item"
         in_device = frame is not None and frame.in_device_region
         if is_item and (in_device or ctx.hot_module):
-            where = ("a jit region" if frame is not None and frame.in_jit
+            where = (frame.region_desc() if in_device
                      else "a hot-path module")
             v.report(self.rule, node,
                      f".item() forces a per-element device sync inside "
@@ -108,12 +156,13 @@ class HostSyncRule:
             return
         if fn in _FETCH_CALLS:
             v.report(self.rule, node,
-                     f"{fn}() materializes a device value inside a device "
-                     f"region (jit trace / launch closure); move the fetch "
+                     f"{fn}() materializes a device value inside "
+                     f"{frame.region_desc()}; move the fetch "
                      f"to the pipeline's consume callback")
         elif fn in _CAST_BUILTINS and node.args:
-            arg_names = {n.id for n in ast.walk(node.args[0])
-                         if isinstance(n, ast.Name)}
+            # metadata access is host-static: `int(cache.k.size + ...)`
+            # never touches the device even when `cache` is traced
+            arg_names = _names_outside_metadata(node.args[0])
             hits = sorted(arg_names & self._device_names(frame))
             if hits:
                 v.report(self.rule, node,
@@ -400,6 +449,340 @@ class BroadExceptRule:
                  f"deliberate")
 
 
+#: the telemetry recording API (utils/telemetry.py) whose first argument
+#: is a metric name — the G06 surface.
+_TELEMETRY_RECORDERS = {"record_counter", "record_sample", "record_hist"}
+
+#: label-section skeleton of the `name|k=v,k2=v2` convention after
+#: replacing dynamic values with {}: literal keys, comma-separated.
+_LABELS_SKELETON_RE = re.compile(
+    r"[A-Za-z_][A-Za-z0-9_]*=(\{\}|[A-Za-z0-9_.-]*)"
+    r"(,[A-Za-z_][A-Za-z0-9_]*=(\{\}|[A-Za-z0-9_.-]*))*$")
+
+
+class TelemetryDisciplineRule:
+    """G06 — see module docstring.
+
+    The telemetry layer keys on PLAIN STRINGS, and the Prometheus
+    exporter (obs/metrics.split_labeled_name) re-splits the
+    ``name|k=v,k2=v2`` convention into one labeled family.  That only
+    works when metric names are statically enumerable: a dynamically
+    concatenated name (``"slot_" + kind``) mints an unbounded family the
+    README counter table cannot document and bench-diff cannot align.
+    Allowed spellings for the name argument of
+    record_counter/record_sample/record_hist:
+
+    - a string literal (labels, if any, after ``|`` with literal keys);
+    - an f-string whose BASE (before ``|``) is literal and whose label
+      section has literal keys — values may interpolate
+      (``f"k_steps_saved|leg={leg}"``);
+    - a forwarded parameter of the enclosing function — the chokepoint-
+      helper idiom (scheduler._counter, slots._slot_counter); the
+      helper's callers are checked instead (and `lint contracts`
+      enumerates names through those chokepoints);
+    - a module-level string constant (runtime/strict.RECOMPILE_COUNTER);
+    - a forwarded parameter plus a precomputed label suffix
+      (``name + self._label_suffix``).
+    """
+
+    rule = "G06"
+
+    def __init__(self):
+        self._module_consts: Dict[str, str] = {}
+
+    def check_module(self, tree: ast.Module, ctx: FileContext,
+                     v: LintVisitor) -> None:
+        self._module_consts = {}
+        for node in tree.body:
+            if isinstance(node, ast.Assign) and isinstance(
+                    node.value, ast.Constant) and isinstance(
+                    node.value.value, str):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._module_consts[t.id] = node.value.value
+
+    # -- helpers -----------------------------------------------------------
+
+    @staticmethod
+    def _is_param(name: str, frame) -> bool:
+        f = frame
+        while f is not None:
+            if name in f.params:
+                return True
+            f = f.parent
+        return False
+
+    def _check_literal(self, text: str, node, v: LintVisitor) -> None:
+        if "|" not in text:
+            return
+        base, _, labels = text.partition("|")
+        if not base or not _LABELS_SKELETON_RE.match(labels or ""):
+            v.report(self.rule, node,
+                     f"malformed labeled metric name {text!r}: the "
+                     f"convention is 'name|k=v,k2=v2' with literal "
+                     f"identifier keys (obs/metrics.split_labeled_name "
+                     f"cannot re-split anything else into one Prometheus "
+                     f"family)")
+
+    def _check_fstring(self, node: ast.JoinedStr, frame,
+                       v: LintVisitor) -> None:
+        # build the skeleton: literal text stays, FormattedValue -> {}
+        parts: List[str] = []
+        dynamic_names: List[Optional[str]] = []
+        for seg in node.values:
+            if isinstance(seg, ast.Constant):
+                parts.append(str(seg.value))
+                dynamic_names.append(None)
+            else:  # FormattedValue
+                parts.append("{}")
+                inner = seg.value if isinstance(
+                    seg, ast.FormattedValue) else None
+                dynamic_names.append(
+                    inner.id if isinstance(inner, ast.Name) else "")
+        skeleton = "".join(parts)
+        base, sep, labels = skeleton.partition("|")
+        if "{}" in base:
+            # the one sanctioned dynamic base: a single forwarded param
+            # (the chokepoint-helper idiom, e.g. f"{name}|leg={leg}")
+            first_dyn = next((n for p, n in zip(parts, dynamic_names)
+                              if p == "{}"), "")
+            forwarding = (base == "{}" and first_dyn
+                          and self._is_param(first_dyn, frame))
+            if not forwarding:
+                v.report(self.rule, node,
+                         "dynamically-constructed metric name: the base "
+                         "before '|' must be a string literal (or a "
+                         "forwarded parameter of a chokepoint helper) — "
+                         "dynamic names mint unbounded metric families "
+                         "the counter table and exporter cannot track")
+                return
+        if sep and not _LABELS_SKELETON_RE.match(labels):
+            v.report(self.rule, node,
+                     "labeled metric name must spell labels as "
+                     "'|k=v,k2=v2' with LITERAL identifier keys — "
+                     "dynamic label keys break the one-family Prometheus "
+                     "re-split")
+
+    def _leftmost(self, node: ast.expr) -> ast.expr:
+        while isinstance(node, ast.BinOp):
+            node = node.left
+        return node
+
+    # -- the check ---------------------------------------------------------
+
+    def check_call(self, node: ast.Call, ctx: FileContext,
+                   v: LintVisitor) -> None:
+        fn = dotted_name(node.func)
+        if fn.rsplit(".", 1)[-1] not in _TELEMETRY_RECORDERS:
+            return
+        if not node.args:
+            return
+        self._check_name_expr(node.args[0], v.function, node, v)
+
+    def _check_name_expr(self, arg: ast.expr, frame, node: ast.Call,
+                         v: LintVisitor) -> None:
+        if isinstance(arg, ast.Constant) and isinstance(arg.value, str):
+            self._check_literal(arg.value, node, v)
+            return
+        if isinstance(arg, ast.JoinedStr):
+            self._check_fstring(arg, frame, v)
+            return
+        if isinstance(arg, ast.IfExp):
+            # `"hit" if ok else "miss"` — enumerable iff both arms are
+            self._check_name_expr(arg.body, frame, node, v)
+            self._check_name_expr(arg.orelse, frame, node, v)
+            return
+        if isinstance(arg, ast.Name):
+            if self._is_param(arg.id, frame):
+                return  # chokepoint forwarding: callers are the surface
+            if arg.id in self._module_consts:
+                self._check_literal(self._module_consts[arg.id], node, v)
+                return
+            v.report(self.rule, node,
+                     f"metric name '{arg.id}' is not statically "
+                     f"resolvable (not a literal, module constant, or "
+                     f"forwarded parameter) — telemetry names must be "
+                     f"enumerable for the counter table and the "
+                     f"Prometheus exporter")
+            return
+        if isinstance(arg, ast.BinOp):
+            left = self._leftmost(arg)
+            if isinstance(left, ast.Name) and self._is_param(
+                    left.id, frame):
+                return  # name + precomputed label suffix (scheduler idiom)
+            v.report(self.rule, node,
+                     "dynamically-concatenated metric name: concatenation "
+                     "mints metric families the README counter table and "
+                     "bench-diff cannot track; use a literal base with "
+                     "the 'name|k=v' labeled convention instead")
+            return
+        v.report(self.rule, node,
+                 "metric name is not statically resolvable; pass a "
+                 "string literal (labels via 'name|k=v' with literal "
+                 "keys) or forward a chokepoint helper's parameter")
+
+
+#: array-manipulation callables that re-layout cache storage — the exact
+#: operations that must keep k_scale/v_scale aligned with the int8 codes.
+_CACHE_MANIP_FNS = {
+    "reshape", "concatenate", "stack", "take", "take_along_axis",
+    "gather", "dynamic_slice", "dynamic_update_slice", "pad", "tile",
+    "repeat", "moveaxis", "swapaxes", "transpose", "broadcast_to",
+    "roll", "flip", "split", "where", "zeros_like", "empty_like",
+}
+
+#: modules allowed to touch KVCache.k/.v storage directly: the ops
+#: helpers (quant/attention readers) and the decoder that OWNS the cache
+#: layout (cache_kv_map and the append/fold sites live there).
+_CACHE_EXEMPT_PATHS = ("/ops/", "ops/", "models/decoder.py")
+
+
+class CacheScaleAwarenessRule:
+    """G07 — see module docstring.
+
+    The int8-KV audit (PR 5) chased exactly this bug class by hand: a
+    reshape/gather/concat applied to ``cache.k``/``cache.v`` codes
+    without the same transform on ``k_scale``/``v_scale`` silently
+    dequantizes with misaligned scales.  Every cache-reshaping site must
+    route through ``models/decoder.cache_kv_map`` (which maps codes AND
+    scales) or live in the exempt helper modules.  Metadata access
+    (``cache.k.shape``/``.size``/``.dtype``) is host-static and fine."""
+
+    rule = "G07"
+
+    @classmethod
+    def _cache_kv_operands(cls, node: ast.expr) -> List[ast.Attribute]:
+        """``.k``/``.v`` attribute accesses on cache-named bases in the
+        subtree, skipping metadata accesses (``cache.k.shape`` never
+        touches storage)."""
+        hits: List[ast.Attribute] = []
+
+        def walk(n: ast.AST) -> None:
+            if isinstance(n, ast.Attribute):
+                if n.attr in METADATA_ATTRS:
+                    return  # metadata: don't descend into its base
+                if n.attr in ("k", "v"):
+                    base = dotted_name(n.value)
+                    last = base.rsplit(".", 1)[-1].lower()
+                    if "cache" in last or last == "kv":
+                        hits.append(n)
+            for child in ast.iter_child_nodes(n):
+                walk(child)
+
+        walk(node)
+        return hits
+
+    def check_call(self, node: ast.Call, ctx: FileContext,
+                   v: LintVisitor) -> None:
+        if any(m in ctx.path for m in _CACHE_EXEMPT_PATHS):
+            return
+        fn = dotted_name(node.func)
+        head, _, tail = fn.partition(".")
+        name = fn.rsplit(".", 1)[-1]
+        if name not in _CACHE_MANIP_FNS:
+            return
+        if head not in ("jnp", "jax", "lax", "np", "numpy"):
+            return
+        hits = []
+        for arg in list(node.args) + [kw.value for kw in node.keywords]:
+            hits.extend(self._cache_kv_operands(arg))
+        if hits:
+            operand = dotted_name(hits[0].value) + "." + hits[0].attr
+            v.report(self.rule, node,
+                     f"{fn}() re-layouts {operand} storage directly — "
+                     f"with int8 KV the per-head scales must ride every "
+                     f"reshape/gather/concat; route through "
+                     f"models/decoder.cache_kv_map (or an ops/ helper) "
+                     f"so codes and k_scale/v_scale transform together")
+
+
+#: spans are context-managed (`with obs.span(...)`); the sanctioned
+#: exceptions are ExitStack.enter_context(...) and the tracer module's
+#: own plumbing.
+_SPAN_EXEMPT_PATHS = ("obs/tracer.py",)
+
+
+class SpanHygieneRule:
+    """G08 — see module docstring.
+
+    Two invariants keep the phases block a TRUE partition of wall-clock:
+    (a) spans close exactly once, on the thread that opened them — which
+    in Python means the ``with`` protocol (an un-entered or leaked span
+    corrupts the per-thread stack and every SELF-time total above it);
+    (b) phase tags come from the canonical table
+    (:data:`..obs.tracer.KNOWN_PHASES`) — a typo'd phase silently forks
+    a new row that ``obs report``, the bench ``phases`` block, and
+    bench-diff all treat as a different phase."""
+
+    rule = "G08"
+
+    def __init__(self):
+        self._managed_ids: set = set()
+
+    def check_module(self, tree: ast.Module, ctx: FileContext,
+                     v: LintVisitor) -> None:
+        """Pre-collect the span calls that ARE context-managed: withitem
+        context expressions and enter_context(...) arguments."""
+        self._managed_ids = set()
+        for node in ast.walk(tree):
+            if isinstance(node, (ast.With, ast.AsyncWith)):
+                for item in node.items:
+                    self._managed_ids.add(id(item.context_expr))
+            elif isinstance(node, ast.Call):
+                fn = dotted_name(node.func)
+                if fn.rsplit(".", 1)[-1] == "enter_context":
+                    for arg in node.args:
+                        self._managed_ids.add(id(arg))
+
+    @staticmethod
+    def _is_span_call(node: ast.Call) -> bool:
+        if isinstance(node.func, ast.Attribute):
+            return node.func.attr == "span"
+        return isinstance(node.func, ast.Name) and node.func.id == "span"
+
+    @staticmethod
+    def _is_add_span_call(node: ast.Call) -> bool:
+        fn = dotted_name(node.func)
+        return fn.rsplit(".", 1)[-1] == "add_span"
+
+    def check_call(self, node: ast.Call, ctx: FileContext,
+                   v: LintVisitor) -> None:
+        if any(m in ctx.path for m in _SPAN_EXEMPT_PATHS):
+            return
+        is_span = self._is_span_call(node)
+        is_add = not is_span and self._is_add_span_call(node)
+        if not (is_span or is_add):
+            return
+        if is_span and id(node) not in self._managed_ids:
+            v.report(self.rule, node,
+                     "tracer span must be context-managed ('with "
+                     "obs.span(...)' or stack.enter_context(...)): a "
+                     "span that never closes corrupts the per-thread "
+                     "span stack and every phase SELF-time above it "
+                     "(cross-thread timing belongs to add_span)")
+        for kw in node.keywords:
+            if kw.arg != "phase":
+                continue
+            val = kw.value
+            if isinstance(val, ast.Constant) and val.value is None:
+                continue
+            if not (isinstance(val, ast.Constant)
+                    and isinstance(val.value, str)):
+                v.report(self.rule, node,
+                         "span phase= must be a string literal from the "
+                         "known phase table (obs/tracer.KNOWN_PHASES): a "
+                         "computed phase name forks the phases block "
+                         "outside the documented partition")
+            elif val.value not in KNOWN_PHASES:
+                v.report(self.rule, node,
+                         f"unknown span phase {val.value!r}: phases come "
+                         f"from obs/tracer.KNOWN_PHASES (README 'Span / "
+                         f"phase names' table) — add it there first if "
+                         f"this is a new pipeline stage")
+
+
 def default_rules() -> List:
     return [HostSyncRule(), TracedControlFlowRule(), KeyReuseRule(),
-            JitBoundaryRule(), BroadExceptRule()]
+            JitBoundaryRule(), BroadExceptRule(),
+            TelemetryDisciplineRule(), CacheScaleAwarenessRule(),
+            SpanHygieneRule()]

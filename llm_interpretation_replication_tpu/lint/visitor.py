@@ -1,12 +1,20 @@
 """AST walking infrastructure for graftlint.
 
-The linter is a single :class:`ast.NodeVisitor` pass per file that keeps a
-stack of :class:`FunctionInfo` frames (so rules always know the enclosing
-function, whether it is jit-compiled, and which of its parameters are
-static) and dispatches each node to every rule that declares a matching
-``check_<nodetype>`` method.  Rules stay declarative — all the JAX-specific
-context resolution (what counts as a jit decorator, which arguments are
-static, what a "device region" is) lives here, once.
+The linter runs TWO passes per file.  Pass 1 (:class:`ModuleGraph`)
+builds a module-level call graph — every function/method under a dotted
+qualname, module import aliases resolved (``from x import y as z``),
+edges from call sites to same-module callees — and propagates
+device-region membership interprocedurally from the roots (jit-decorated
+functions, ``launch`` pipeline closures) down to bounded depth, seeding
+each reached helper with the parameters that actually receive
+traced-looking arguments at its device call sites.  Pass 2 is the
+original :class:`ast.NodeVisitor` walk that keeps a stack of
+:class:`FunctionInfo` frames (now graph-aware: a helper reachable from a
+jit region carries ``in_jit``/``in_device_region`` and the seeded traced
+params) and dispatches each node to every rule that declares a matching
+``check_<nodetype>`` method.  Rules stay declarative — all the
+JAX-specific context resolution (what counts as a jit decorator, which
+arguments are static, what a "device region" is) lives here, once.
 
 Terminology the rules share:
 
@@ -26,9 +34,17 @@ Terminology the rules share:
 from __future__ import annotations
 
 import ast
-from typing import List, Optional, Sequence, Set, Tuple
+import re
+from typing import Callable, Dict, List, Optional, Sequence, Set, Tuple
 
 from .report import Finding, parse_suppressions, suppressed
+
+#: interprocedural propagation bound: device-region membership flows at
+#: most this many call hops from a jit/launch root.  Deep enough for the
+#: real helper chains in this repo (engine → pool → gather helper is 2-3
+#: hops); a bound keeps pathological/recursive graphs terminating and the
+#: findings explainable (every message names its root and depth).
+INTERPROCEDURAL_DEPTH = 4
 
 #: Path fragments marking the per-batch hot path (see module docstring).
 HOT_PATH_MARKERS = (
@@ -69,15 +85,46 @@ def dotted_name(node: ast.AST) -> str:
     return ""
 
 
-def _jit_decorator_info(dec: ast.expr) -> Optional[Tuple[Set[str], Set[int]]]:
+#: jax callables that return HOST structure (lists of leaves, treedefs,
+#: shapes), not traced arrays — a local bound from them is host data.
+_HOST_STRUCTURAL_RE = re.compile(
+    r"jax\.(tree|tree_util|tree_structure|eval_shape)")
+
+#: array attributes that are Python-static under trace — THE shared
+#: definition (G01's cast scan, G02's host-static predicate, and G07's
+#: operand walk all key on it; keep one copy so they cannot drift).
+METADATA_ATTRS = ("shape", "size", "dtype", "ndim", "itemsize")
+
+
+def host_static_value(value: ast.expr) -> bool:
+    """True when ``value`` is Python-static under trace: metadata access
+    (``x.shape[0]``, ``x.dtype``, ``x.ndim``) or an identity comparison
+    (``x is None`` — tracers are never None, so the result is a host
+    bool; the int8 layout flag ``quantized = cache.k_scale is not None``
+    is the canonical case)."""
+    for sub in ast.walk(value):
+        if isinstance(sub, ast.Attribute) and sub.attr in METADATA_ATTRS:
+            return True
+    if isinstance(value, ast.Compare) and all(
+            isinstance(op, (ast.Is, ast.IsNot)) for op in value.ops):
+        return True
+    return False
+
+
+def _jit_decorator_info(dec: ast.expr,
+                        resolve: Optional[Callable[[str], str]] = None
+                        ) -> Optional[Tuple[Set[str], Set[int]]]:
     """(static_argnames, static_argnums) when ``dec`` is a jit decorator,
     else None.  Recognizes ``jax.jit``, ``jit``, ``pjit``, ``jax.pjit``,
-    and ``functools.partial(jax.jit, static_argnames=(...))``."""
+    and ``functools.partial(jax.jit, static_argnames=(...))``; with a
+    ``resolve`` callable (the module alias map), import aliases like
+    ``from jax import jit as fastjit`` resolve too."""
     target = dec
     names: Set[str] = set()
     nums: Set[int] = set()
+    resolve = resolve or (lambda n: n)
     if isinstance(dec, ast.Call):
-        fn = dotted_name(dec.func)
+        fn = resolve(dotted_name(dec.func))
         if fn.endswith("partial") and dec.args:
             target = dec.args[0]
             kws = dec.keywords
@@ -89,8 +136,9 @@ def _jit_decorator_info(dec: ast.expr) -> Optional[Tuple[Set[str], Set[int]]]:
                 names |= set(_const_strings(kw.value))
             elif kw.arg == "static_argnums":
                 nums |= set(_const_ints(kw.value))
-    name = dotted_name(target)
-    if name in ("jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit"):
+    name = resolve(dotted_name(target))
+    if name in ("jax.jit", "jit", "pjit", "jax.pjit", "pjit.pjit",
+                "jax.experimental.pjit.pjit"):
         return names, nums
     return None
 
@@ -113,11 +161,344 @@ def _const_ints(node: ast.expr) -> List[int]:
     return []
 
 
+class _FnNode:
+    """One function in the module call graph (pass 1)."""
+
+    __slots__ = ("node", "qualname", "name", "params", "static_params",
+                 "is_jit", "is_launch", "is_method", "calls",
+                 "traced_locals", "seeded", "reached_kind",
+                 "reached_depth", "reached_via", "children")
+
+    def __init__(self, node, qualname: str, is_method: bool):
+        self.node = node
+        self.qualname = qualname
+        self.name = node.name
+        args = node.args
+        self.params: List[str] = [
+            a.arg for a in (args.posonlyargs + args.args + args.kwonlyargs)]
+        self.static_params: Set[str] = set()
+        self.is_jit = False
+        self.is_launch = False
+        self.is_method = is_method
+        #: [(callee _FnNode, ast.Call)] same-module edges
+        self.calls: List[Tuple["_FnNode", ast.Call]] = []
+        #: locals bound from jnp./jax./lax. expressions (host approximation)
+        self.traced_locals: Set[str] = set()
+        #: params that receive traced-looking args at device call sites
+        self.seeded: Set[str] = set()
+        self.reached_kind: Optional[str] = None   # "jit" | "launch"
+        self.reached_depth: Optional[int] = None  # 0 for roots
+        self.reached_via: Optional[str] = None    # root qualname
+        #: directly-nested function name -> _FnNode (lexical resolution)
+        self.children: Dict[str, "_FnNode"] = {}
+
+    def effective_traced(self) -> Set[str]:
+        """Names plausibly traced inside this function, for seeding its
+        callees: a jit root contributes its non-static params, a reached
+        helper its seeded params, a launch closure only jax-derived
+        locals (its params are host batch metadata)."""
+        if self.is_jit:
+            return ((set(self.params) - self.static_params
+                     - {"self", "cls"}) | self.traced_locals)
+        if self.reached_kind == "jit":
+            return set(self.seeded) | self.traced_locals
+        return set(self.traced_locals)
+
+
+class ModuleGraph:
+    """Pass 1: module-level call graph + interprocedural device regions.
+
+    Scope is deliberately ONE module: the linter never imports code, and
+    the conventions it guards (engine helpers, decode reshapes, pipeline
+    closures) live next to their callers.  Aliases are resolved for
+    imports (``from jax import jit as fastjit``, ``import jax.numpy as
+    jnp``) and for module-level function rebinds (``score = _score``);
+    propagation is bounded by :data:`INTERPROCEDURAL_DEPTH` so recursive
+    or cyclic call chains terminate with an explainable depth."""
+
+    def __init__(self, tree: ast.Module, hot_module: bool,
+                 max_depth: int = INTERPROCEDURAL_DEPTH):
+        self.max_depth = max_depth
+        self.functions: Dict[str, _FnNode] = {}
+        self.aliases: Dict[str, str] = {}
+        self._methods: Dict[str, Dict[str, _FnNode]] = {}
+        self._module_fns: Dict[str, _FnNode] = {}
+        self._collect_aliases(tree)
+        self._collect_functions(tree, hot_module)
+        self._collect_edges()
+        self._propagate()
+
+    # -- alias handling ---------------------------------------------------
+
+    def _collect_aliases(self, tree: ast.Module) -> None:
+        for node in tree.body:
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    if a.asname:
+                        self.aliases[a.asname] = a.name
+            elif isinstance(node, ast.ImportFrom):
+                mod = node.module or ""
+                for a in node.names:
+                    if a.name == "*":
+                        continue
+                    full = f"{mod}.{a.name}" if mod else a.name
+                    self.aliases[a.asname or a.name] = full
+
+    def resolve(self, dotted: str) -> str:
+        """Resolve the leading segment of a dotted name through the
+        module's import aliases: ``jnp.where`` -> ``jax.numpy.where``."""
+        if not dotted:
+            return dotted
+        head, _, rest = dotted.partition(".")
+        full = self.aliases.get(head)
+        if full is None:
+            return dotted
+        return f"{full}.{rest}" if rest else full
+
+    # -- function + edge collection ---------------------------------------
+
+    def _collect_functions(self, tree: ast.Module, hot_module: bool) -> None:
+        graph = self
+
+        class Collector(ast.NodeVisitor):
+            def __init__(self):
+                self.qual: List[str] = []
+                self.fn_stack: List[_FnNode] = []
+                self.class_depth = 0
+
+            def _function(self, node):
+                qualname = ".".join(self.qual + [node.name])
+                is_method = (self.class_depth > 0
+                             and bool(self.qual)
+                             and not self.fn_stack)
+                fn = _FnNode(node, qualname, is_method)
+                for dec in node.decorator_list:
+                    info = _jit_decorator_info(dec, graph.resolve)
+                    if info is not None:
+                        fn.is_jit = True
+                        names, nums = info
+                        fn.static_params |= names
+                        for i in nums:
+                            if 0 <= i < len(fn.params):
+                                fn.static_params.add(fn.params[i])
+                fn.is_launch = hot_module and node.name == "launch"
+                graph.functions[qualname] = fn
+                if self.fn_stack:
+                    self.fn_stack[-1].children[node.name] = fn
+                elif self.class_depth == 0:
+                    graph._module_fns[node.name] = fn
+                if is_method:
+                    graph._methods.setdefault(
+                        self.qual[-1], {})[node.name] = fn
+                self.qual.append(node.name)
+                self.fn_stack.append(fn)
+                try:
+                    for child in node.body:
+                        self.visit(child)
+                finally:
+                    self.fn_stack.pop()
+                    self.qual.pop()
+
+            visit_FunctionDef = _function
+            visit_AsyncFunctionDef = _function
+
+            def visit_ClassDef(self, node):
+                self.qual.append(node.name)
+                self.class_depth += 1
+                try:
+                    for child in node.body:
+                        self.visit(child)
+                finally:
+                    self.class_depth -= 1
+                    self.qual.pop()
+
+        Collector().visit(tree)
+        # module-level function rebinds: `score = _score` aliases the graph
+        for node in tree.body:
+            if (isinstance(node, ast.Assign)
+                    and isinstance(node.value, ast.Name)
+                    and node.value.id in self._module_fns):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        self._module_fns.setdefault(
+                            t.id, self._module_fns[node.value.id])
+
+    def _owner_chain(self, fn: _FnNode) -> List[_FnNode]:
+        """Lexically-enclosing function nodes, innermost first."""
+        chain = []
+        parts = fn.qualname.split(".")
+        for i in range(len(parts) - 1, 0, -1):
+            parent = self.functions.get(".".join(parts[:i]))
+            if parent is not None:
+                chain.append(parent)
+        return chain
+
+    def _resolve_call(self, fn: _FnNode, call: ast.Call
+                      ) -> Optional[_FnNode]:
+        func = call.func
+        if isinstance(func, ast.Name):
+            name = func.id
+            # lexical scope chain: own nested defs, enclosing functions'
+            # nested defs, then module level
+            if name in fn.children:
+                return fn.children[name]
+            for parent in self._owner_chain(fn):
+                if name in parent.children:
+                    return parent.children[name]
+            target = self._module_fns.get(name)
+            if target is not None:
+                return target
+            # import alias of a same-module name never resolves (the
+            # linter is per-file); a foreign alias is simply not ours
+            return None
+        if isinstance(func, ast.Attribute):
+            dotted = dotted_name(func)
+            parts = dotted.split(".")
+            if len(parts) == 2 and parts[0] in ("self", "cls"):
+                # method call: the enclosing class is the first qualname
+                # segment that owns a method table
+                for seg in fn.qualname.split("."):
+                    table = self._methods.get(seg)
+                    if table and parts[1] in table:
+                        return table[parts[1]]
+        return None
+
+    def _collect_edges(self) -> None:
+        for fn in self.functions.values():
+            for stmt in fn.node.body:
+                for sub in self._iter_body_nodes(stmt):
+                    if isinstance(sub, ast.Call):
+                        callee = self._resolve_call(fn, sub)
+                        if callee is not None and callee is not fn:
+                            fn.calls.append((callee, sub))
+                    elif isinstance(sub, (ast.Assign, ast.AugAssign)):
+                        self._note_traced(fn, sub)
+
+    @staticmethod
+    def _iter_body_nodes(stmt):
+        """Walk a statement without descending into nested function /
+        class bodies (those belong to their own graph nodes)."""
+        stack = [stmt]
+        while stack:
+            node = stack.pop()
+            yield node
+            for child in ast.iter_child_nodes(node):
+                if isinstance(child, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef,
+                                      ast.Lambda, ast.ClassDef)):
+                    continue
+                stack.append(child)
+
+    def _note_traced(self, fn: _FnNode, stmt) -> None:
+        """Host-side approximation of the visitor's traced-locals rule:
+        a local assigned from a jnp./jax./lax. expression is traced,
+        unless the expression is metadata access (shape/dtype/...)."""
+        value = stmt.value
+        targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                   else [stmt.target])
+        if host_static_value(value):
+            return
+        traced = False
+        for sub in ast.walk(value):
+            if isinstance(sub, ast.Call):
+                callee = self.resolve(dotted_name(sub.func))
+                if (callee.split(".", 1)[0] in ("jnp", "jax", "lax")
+                        and not _HOST_STRUCTURAL_RE.match(callee)):
+                    traced = True
+                    break
+        if traced:
+            for t in targets:
+                for name_node in ast.walk(t):
+                    if isinstance(name_node, ast.Name):
+                        fn.traced_locals.add(name_node.id)
+
+    # -- propagation -------------------------------------------------------
+
+    def _arg_is_traced(self, caller: _FnNode, arg: ast.expr,
+                       caller_traced: Set[str]) -> bool:
+        if host_static_value(arg):
+            return False
+        for sub in ast.walk(arg):
+            if isinstance(sub, ast.Name) and sub.id in caller_traced:
+                return True
+            if isinstance(sub, ast.Call):
+                fn = self.resolve(dotted_name(sub.func))
+                if (fn.split(".", 1)[0] in ("jnp", "jax", "lax")
+                        and not _HOST_STRUCTURAL_RE.match(fn)):
+                    return True
+        return False
+
+    def _seed_callee(self, caller: _FnNode, callee: _FnNode,
+                     call: ast.Call) -> bool:
+        """Mark callee params receiving traced-looking args; True when
+        the seed set grew."""
+        caller_traced = caller.effective_traced()
+        params = callee.params
+        offset = 1 if params[:1] in (["self"], ["cls"]) else 0
+        grew = False
+        for i, arg in enumerate(call.args):
+            if isinstance(arg, ast.Starred):
+                continue
+            idx = i + offset
+            if idx < len(params) and self._arg_is_traced(
+                    caller, arg, caller_traced):
+                if params[idx] not in callee.seeded:
+                    callee.seeded.add(params[idx])
+                    grew = True
+        for kw in call.keywords:
+            if kw.arg and kw.arg in params and self._arg_is_traced(
+                    caller, kw.value, caller_traced):
+                if kw.arg not in callee.seeded:
+                    callee.seeded.add(kw.arg)
+                    grew = True
+        return grew
+
+    def _propagate(self) -> None:
+        for fn in self.functions.values():
+            if fn.is_jit or fn.is_launch:
+                fn.reached_kind = "jit" if fn.is_jit else "launch"
+                fn.reached_depth = 0
+                fn.reached_via = fn.qualname
+        # fixpoint over (reach, seeds): both only grow and are bounded,
+        # so this terminates; the depth bound caps the frontier
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.functions.values():
+                if fn.reached_kind is None:
+                    continue
+                if fn.reached_depth >= self.max_depth:
+                    continue
+                for callee, call in fn.calls:
+                    kind = fn.reached_kind
+                    depth = fn.reached_depth + 1
+                    via = fn.reached_via or fn.qualname
+                    upgrade = (
+                        callee.reached_kind is None
+                        or (kind == "jit"
+                            and callee.reached_kind == "launch")
+                        or (kind == callee.reached_kind
+                            and depth < (callee.reached_depth or 0)))
+                    if upgrade and not callee.is_jit:
+                        callee.reached_kind = kind
+                        callee.reached_depth = depth
+                        callee.reached_via = via
+                        changed = True
+                    if kind == "jit" and self._seed_callee(
+                            fn, callee, call):
+                        changed = True
+
+    def lookup(self, qualname: str) -> Optional[_FnNode]:
+        return self.functions.get(qualname)
+
+
 class FunctionInfo:
     """One frame of the visitor's function stack."""
 
     def __init__(self, node, parent: Optional["FunctionInfo"],
-                 hot_module: bool):
+                 hot_module: bool,
+                 graph_node: Optional[_FnNode] = None,
+                 resolve: Optional[Callable[[str], str]] = None):
         self.node = node
         self.parent = parent
         self.name = getattr(node, "name", "<lambda>")
@@ -127,7 +508,7 @@ class FunctionInfo:
         self.static_params: Set[str] = set()
         self.is_jit = False
         for dec in getattr(node, "decorator_list", ()):
-            info = _jit_decorator_info(dec)
+            info = _jit_decorator_info(dec, resolve)
             if info is not None:
                 self.is_jit = True
                 names, nums = info
@@ -142,6 +523,27 @@ class FunctionInfo:
         self.in_device_region = (
             self.is_jit or self.is_launch
             or (parent is not None and parent.in_device_region))
+        #: interprocedural reach: (kind, root qualname, depth) when the
+        #: module call graph proved this function is reachable from a
+        #: device region — the PR-15 upgrade over the per-function walk
+        self.device_path: Optional[Tuple[str, str, int]] = None
+        #: params that receive traced args at device call sites (only
+        #: meaningful when seeded_only)
+        self.seeded: Set[str] = set()
+        #: True for interprocedurally-reached helpers: traced_names()
+        #: then returns ONLY the seeded params + jax-derived locals, so
+        #: a helper with host-only params never floods G01/G02
+        self.seeded_only = False
+        if (graph_node is not None and graph_node.reached_kind is not None
+                and not self.is_jit and not self.is_launch):
+            self.device_path = (graph_node.reached_kind,
+                                graph_node.reached_via or "?",
+                                graph_node.reached_depth or 0)
+            self.in_device_region = True
+            if graph_node.reached_kind == "jit":
+                self.in_jit = True
+                self.seeded_only = True
+                self.seeded = set(graph_node.seeded)
         #: locals assigned from jnp./jax./lax. expressions — treated as
         #: traced values by G02's control-flow rule
         self.traced_locals: Set[str] = set()
@@ -149,8 +551,26 @@ class FunctionInfo:
 
     def traced_names(self) -> Set[str]:
         """Names holding (potentially) traced arrays in this jit frame."""
+        if self.seeded_only:
+            return set(self.seeded) | self.traced_locals
         return (set(self.params) - self.static_params
                 - {"self", "cls"}) | self.traced_locals
+
+    def region_desc(self) -> str:
+        """Human description of why this frame is a device region — the
+        interprocedural path when the call graph supplied one."""
+        if self.is_jit:
+            return "a jit region"
+        if self.is_launch:
+            return "a launch pipeline closure"
+        if self.device_path is not None:
+            kind, via, depth = self.device_path
+            root = "jit region" if kind == "jit" else "launch closure"
+            return (f"a helper reachable from {root} '{via}' "
+                    f"({depth} call hop{'s' if depth != 1 else ''})")
+        if self.parent is not None:
+            return self.parent.region_desc()
+        return "a device region"
 
 
 class FileContext:
@@ -180,11 +600,14 @@ class LintVisitor(ast.NodeVisitor):
     rule needs to know about them.
     """
 
-    def __init__(self, ctx: FileContext, rules: Sequence):
+    def __init__(self, ctx: FileContext, rules: Sequence,
+                 graph: Optional[ModuleGraph] = None):
         self.ctx = ctx
         self.rules = rules
+        self.graph = graph
         self.findings: List[Finding] = []
         self.stack: List[FunctionInfo] = []
+        self._qual: List[str] = []
 
     # -- rule-facing API --------------------------------------------------
 
@@ -211,8 +634,19 @@ class LintVisitor(ast.NodeVisitor):
                 fn(node, self.ctx, self)
 
     def _visit_function(self, node) -> None:
-        frame = FunctionInfo(node, self.function, self.ctx.hot_module)
+        name = getattr(node, "name", None)
+        graph_node = None
+        resolve = None
+        if self.graph is not None:
+            resolve = self.graph.resolve
+            if name is not None:
+                graph_node = self.graph.lookup(
+                    ".".join(self._qual + [name]))
+        frame = FunctionInfo(node, self.function, self.ctx.hot_module,
+                             graph_node=graph_node, resolve=resolve)
         self.stack.append(frame)
+        if name is not None:
+            self._qual.append(name)
         self._dispatch("check_functiondef", node)
         decorators = set(map(id, getattr(node, "decorator_list", ())))
         try:
@@ -222,10 +656,19 @@ class LintVisitor(ast.NodeVisitor):
                 self.visit(child)
         finally:
             self.stack.pop()
+            if name is not None:
+                self._qual.pop()
 
     visit_FunctionDef = _visit_function
     visit_AsyncFunctionDef = _visit_function
     visit_Lambda = _visit_function
+
+    def visit_ClassDef(self, node: ast.ClassDef) -> None:
+        self._qual.append(node.name)
+        try:
+            self.generic_visit(node)
+        finally:
+            self._qual.pop()
 
     def visit_Assign(self, node: ast.Assign) -> None:
         self._note_traced_assignment(node.targets, node.value)
@@ -242,18 +685,16 @@ class LintVisitor(ast.NodeVisitor):
         frame = self.function
         if frame is None or not (frame.in_jit or frame.in_device_region):
             return
-        # metadata access (`x.shape[0]`, `x.dtype`, `x.ndim`) is Python-
-        # static under trace — a local bound from it is a host int, not a
-        # traced value, even when `x` itself is traced
-        for sub in ast.walk(value):
-            if isinstance(sub, ast.Attribute) and sub.attr in (
-                    "shape", "ndim", "dtype", "size"):
-                return
+        if host_static_value(value):
+            return
         traced = False
         for sub in ast.walk(value):
             if isinstance(sub, ast.Call):
                 fn = dotted_name(sub.func)
-                if fn.split(".", 1)[0] in ("jnp", "jax", "lax"):
+                if self.graph is not None:
+                    fn = self.graph.resolve(fn)
+                if (fn.split(".", 1)[0] in ("jnp", "jax", "lax")
+                        and not _HOST_STRUCTURAL_RE.match(fn)):
                     traced = True
                     break
             elif isinstance(sub, ast.Name) and sub.id in frame.traced_names():
@@ -298,11 +739,17 @@ class LintVisitor(ast.NodeVisitor):
         self.generic_visit(node)
 
 
-def lint_source(path: str, text: str, rules: Sequence) -> List[Finding]:
+def lint_source(path: str, text: str, rules: Sequence,
+                interprocedural: bool = True) -> List[Finding]:
     """Run ``rules`` over one file's source; syntax errors become a single
     G00 finding instead of crashing the whole run (the linter gates a repo
     that must stay importable anyway — the test suite catches real syntax
-    rot; the G00 row just keeps the lint report honest)."""
+    rot; the G00 row just keeps the lint report honest).
+
+    ``interprocedural=False`` reverts to the PR-3 per-function engine
+    (no call graph, no device-region propagation) — kept so the fixture
+    tests can pin that the interprocedural layer catches what the old
+    engine provably missed."""
     ctx = FileContext(path, text)
     try:
         tree = ast.parse(text)
@@ -311,7 +758,8 @@ def lint_source(path: str, text: str, rules: Sequence) -> List[Finding]:
                         (err.offset or 0) + 1,
                         f"syntax error: {err.msg}",
                         ctx.source_line(err.lineno or 1))]
-    visitor = LintVisitor(ctx, rules)
+    graph = ModuleGraph(tree, ctx.hot_module) if interprocedural else None
+    visitor = LintVisitor(ctx, rules, graph=graph)
     for rule in rules:
         fn = getattr(rule, "check_module", None)
         if fn is not None:

@@ -49,6 +49,24 @@ _HIGHER_IS_BETTER_UNITS = ("prompts/sec", "rows/sec")
 #: (ISSUE 11): a p99 that grew past the threshold is the regression.
 _LOWER_IS_BETTER_UNITS = ("ms", "idle-frac")
 
+#: The bench-record block contract (cross-checked by ``lint contracts``):
+#: every top-level block ``bench.py`` emits must be classified in exactly
+#: one of these tuples, and every ALIGNED/CONTEXT entry must actually be
+#: read by this module — so a new bench block cannot land without
+#: teaching the diff what it means, and a block this module claims to
+#: align cannot silently stop being flattened.
+#:
+#: blocks :func:`flatten_metrics` aligns into verdict/informational rows:
+ALIGNED_BLOCKS = ("secondary", "brackets", "packed", "k_decode",
+                  "occupancy", "serve_load")
+#: blocks :func:`diff_records` reads as cross-round context tables:
+CONTEXT_BLOCKS = ("context", "phases")
+#: blocks deliberately NOT aligned (free-form diagnostics whose shape is
+#: owned by their producer; listed so the classification is a conscious
+#: decision, not an omission):
+INFORMATIONAL_BLOCKS = ("strict", "plan_search", "packed_drift", "serve",
+                        "serve_load_pool", "repeats")
+
 
 def load_bench_record(path: str) -> Dict:
     """One record, unwrapped from the driver shape when present, with a

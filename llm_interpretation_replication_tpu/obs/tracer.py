@@ -53,6 +53,18 @@ from typing import Any, Dict, Iterator, List, Optional, Tuple
 #: eviction — they accumulate at span close, not at export time.
 DEFAULT_SPAN_CAP = 200_000
 
+#: The canonical phase table.  Every ``phase=`` tag on a span must come
+#: from this set: the ``phases`` block partitions wall-clock across these
+#: names, ``obs report`` and bench-diff aggregate by them, and the README
+#: "Span / phase names" table documents them row for row (graftlint G08
+#: enforces the literal-membership rule statically; ``lint contracts``
+#: cross-checks this set against the README table).
+KNOWN_PHASES = frozenset({
+    "host_tokenize", "host_prep", "dispatch", "prefill", "extend_prefill",
+    "decode", "pooled_decode", "d2h_fetch", "host_rows", "host_write",
+    "serve_queue_wait", "serve_coalesce", "serve_engine", "serve_respond",
+})
+
 
 class _ThreadState(threading.local):
     def __init__(self):
