@@ -943,7 +943,220 @@ def _serve_load_pool_secondary(args, engine, prompts, targets,
         configurations.append(measure(pool, "multi-model"))
     finally:
         pool.close()
-    return {"replicas": n, "configurations": configurations}
+    out = {"replicas": n, "configurations": configurations}
+    if getattr(args, "serve_load_faults", ""):
+        # fleet self-healing under injected faults (ISSUE 16): a THIRD,
+        # supervised configuration — same harness, same parity
+        # reference, with replicas killed/wedged (and a vendor outage
+        # burst) on the --serve-load-faults schedule.  The resulting
+        # 'recovery' block is the round-over-round yardstick: detection
+        # and restart latency, requests failed-over, requests lost
+        # (structurally zero or the self-healing layer failed).
+        entry = _serve_load_recovery_leg(
+            args, engine, prompts, targets, offline_rows, rates,
+            sibling, sched_cfg)
+        configurations.append(entry)
+        out["recovery"] = entry["recovery"]
+    return out
+
+
+def _parse_fault_schedule(spec):
+    """``'kill@1.0,wedge@2.5,vendor@0'`` -> ``[(kind, offset_s), ...]``
+    sorted by offset.  Kinds: kill | wedge | vendor."""
+    out = []
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        kind, _, at = part.partition("@")
+        kind = kind.strip().lower()
+        if kind not in ("kill", "wedge", "vendor"):
+            raise ValueError(
+                f"unknown fault kind {kind!r} in --serve-load-faults "
+                f"(expected kill|wedge|vendor)")
+        out.append((kind, float(at or 0.0)))
+    return sorted(out, key=lambda f: f[1])
+
+
+def _serve_load_recovery_leg(args, engine, prompts, targets,
+                             offline_rows, rates, sibling,
+                             sched_cfg) -> dict:
+    """One open-loop run at the TOP swept rate over a SUPERVISED pool
+    (serve/supervisor.py) while the --serve-load-faults schedule kills /
+    wedges replicas mid-traffic; a ``vendor`` entry adds a flaky
+    RemoteBackend outage burst after the measured run.  Every local
+    replica is a :class:`BreakableEngine`-wrapped sibling of the sweep
+    snapshot, so failed-over rows stay bit-identical to the offline
+    reference — the recovery block proves the fleet healed without
+    changing WHAT was computed."""
+    import threading
+
+    from llm_interpretation_replication_tpu.serve import (
+        ScoreRequest,
+        SupervisorConfig,
+    )
+    from llm_interpretation_replication_tpu.serve import (
+        load as serve_load_mod,
+    )
+    from llm_interpretation_replication_tpu.serve.pool import (
+        EnginePool,
+        PoolConfig,
+        RemoteBackend,
+    )
+    from llm_interpretation_replication_tpu.utils.testing import (
+        BreakableEngine,
+        FlakyVendor,
+    )
+
+    faults = _parse_fault_schedule(args.serve_load_faults)
+    n = max(2, int(args.serve_load_replicas))
+    duration = args.serve_load_duration
+    breakables = []
+
+    def breakable():
+        b = BreakableEngine(sibling())
+        breakables.append(b)
+        return b
+
+    sup_cfg = SupervisorConfig(
+        wedge_timeout_s=max(1.5, 0.25 * duration),
+        rebuild_backoff_initial_s=0.1, rebuild_backoff_max_s=1.0,
+        breaker_failure_threshold=3, breaker_cooldown_s=1.0,
+        poll_s=0.02)
+    pool = EnginePool(PoolConfig(scheduler=sched_cfg,
+                                 supervision=sup_cfg))
+    vendor = None
+    vendor_model = f"{args.model}-vendor"
+    fired = []
+    try:
+        for _ in range(n):
+            pool.load(args.model, breakable(), owns_engine=False)
+        pool.supervisor.register_rebuild(args.model, breakable)
+        if any(k == "vendor" for k, _ in faults):
+            # the vendor leg gets its OWN model name plus one local
+            # sibling under that name: the breaker sheds outage traffic
+            # to the sibling without ever mixing vendor-shaped rows into
+            # the parity-checked measured run
+            vendor = FlakyVendor()
+            pool.load_remote(RemoteBackend(vendor_model, vendor),
+                             model=vendor_model)
+            pool.load(vendor_model, breakable(), owns_engine=False)
+            pool.supervisor.register_rebuild(vendor_model, breakable)
+
+        def pick_victim():
+            """A live, healthy local replica of the measured model —
+            only while a sibling survives to fail over to."""
+            live = [r for r in pool.replicas(args.model)
+                    if r.state == "live"
+                    and isinstance(r.engine, BreakableEngine)
+                    and r.engine.mode == "ok"]
+            return live[0].engine if len(live) >= 2 else None
+
+        stop = threading.Event()
+
+        def inject():
+            t0 = time.monotonic()
+            for kind, at in faults:
+                delay = t0 + at - time.monotonic()
+                if delay > 0 and stop.wait(delay):
+                    return
+                if kind == "vendor":
+                    continue        # the post-run burst leg below
+                victim = pick_victim()
+                if victim is None:
+                    fired.append({"kind": kind, "at_s": at,
+                                  "skipped": "no healthy sibling pair"})
+                    continue
+                (victim.kill if kind == "kill" else victim.wedge)()
+                fired.append({"kind": kind, "at_s": at})
+
+        injector = threading.Thread(target=inject, daemon=True,
+                                    name="bench-fault-injector")
+        injector.start()
+        report = serve_load_mod.run_load(
+            engine, prompts, targets=targets, rate=max(rates),
+            duration_s=duration, seed=args.serve_load_seed,
+            config=sched_cfg, offline_rows=offline_rows,
+            scheduler_factory=lambda cfg: pool.client(args.model))
+        stop.set()
+        injector.join(timeout=5.0)
+
+        vendor_block = None
+        if vendor is not None:
+            vendor.down = True
+            burst = [pool.submit(
+                ScoreRequest(prompt=prompts[i % len(prompts)],
+                             targets=("Yes", "No"), timeout_s=120.0),
+                model=vendor_model) for i in range(24)]
+            answered = 0
+            for f in burst:
+                try:
+                    f.result(timeout=120.0)
+                    answered += 1
+                except Exception as err:  # graftlint: disable=G05 outage burst audit: any per-request failure type counts against 'answered' below; the burst must drain fully to read the breaker verdict
+                    print(f"# recovery vendor burst: "
+                          f"{type(err).__name__}: {err}", file=sys.stderr)
+            opened = pool.supervisor.breaker_states()
+            vendor.down = False
+            deadline = time.monotonic() + 30.0
+            reclosed = False
+            while time.monotonic() < deadline:
+                states = pool.supervisor.breaker_states()
+                if all(s == "closed" for s in states.values()):
+                    reclosed = True
+                    break
+                # half-open probes need traffic to re-close the breaker
+                try:
+                    pool.submit(ScoreRequest(
+                        prompt=prompts[0], targets=("Yes", "No"),
+                        timeout_s=30.0),
+                        model=vendor_model).result(timeout=30.0)
+                except Exception:  # graftlint: disable=G05 probe traffic: a probe bounced by a still-open breaker is expected; the loop keeps probing until the cooldown admits one
+                    pass
+                time.sleep(0.1)
+            vendor_block = {
+                "requests": len(burst),
+                "answered": answered,
+                "breaker_opened": "open" in opened.values(),
+                "breaker_reclosed": reclosed,
+                "vendor_calls": vendor.calls,
+                "vendor_failures": vendor.failures,
+            }
+            fired.extend({"kind": kind, "at_s": at, "post_run": True}
+                         for kind, at in faults if kind == "vendor")
+
+        sup_report = pool.supervisor.report()
+    finally:
+        for b in breakables:
+            b.heal()            # unblock wedged coalescer threads
+        pool.close()
+
+    lost = int(report.get("errors_by_type", {}).get("TimeoutError", 0))
+    recovery = dict(sup_report)
+    recovery["requests_lost"] = lost
+    recovery["faults_injected"] = fired
+    recovery["load"] = {
+        k: report.get(k) for k in (
+            "offered_rate", "requests", "completed", "errors",
+            "errors_by_type", "shed", "parity")}
+    if vendor_block is not None:
+        recovery["vendor_outage"] = vendor_block
+    det = recovery.get("detection_ms") or {}
+    rst = recovery.get("restart_ms") or {}
+    print(f"# serve load pool [self-healing]: "
+          f"{recovery['incidents']} incident(s) "
+          f"({recovery['crashes']} crash, {recovery['wedges']} wedge), "
+          f"{recovery['restarts']} restart(s), "
+          f"{recovery['requests_failed_over']} failed over, "
+          f"{lost} lost; detection mean "
+          f"{det.get('mean', 'n/a')} ms, restart mean "
+          f"{rst.get('mean', 'n/a')} ms", file=sys.stderr)
+    if lost:
+        print("# serve load pool [self-healing]: REQUESTS LOST — the "
+              "always-answered contract broke under injected faults",
+              file=sys.stderr)
+    return {"name": "self-healing", "faults": fired,
+            "serve_load_point": report, "recovery": recovery}
 
 
 def _packed_secondary(args, engine, prompts, targets, isolated_rows) -> dict:
@@ -2153,6 +2366,25 @@ def main():
                              "block with one serve_load block per "
                              "configuration (0/1 = skip the pool "
                              "companion)")
+    parser.add_argument("--serve-load-faults", metavar="K@T[,K@T...]",
+                        default="",
+                        help="--serve-load pool companion: fault-"
+                             "injection schedule for a third, SUPERVISED "
+                             "pool configuration (serve/supervisor.py "
+                             "self-healing) — comma list of kind@offset_s "
+                             "entries fired against the fleet during one "
+                             "open-loop run at the top swept rate.  "
+                             "Kinds: 'kill' (replica engine crashes: "
+                             "quarantine + rebuild + in-flight failover), "
+                             "'wedge' (replica hangs: watchdog detection "
+                             "+ reclaim), 'vendor' (a flaky RemoteBackend "
+                             "outage burst: circuit breaker opens, "
+                             "traffic sheds to a local sibling, half-"
+                             "open probe re-closes).  The record gains a "
+                             "'recovery' block: detection/restart "
+                             "latency, requests failed-over vs lost "
+                             "(lost must be 0).  Example: "
+                             "'kill@1.0,wedge@2.5,vendor@0'")
     parser.add_argument("--strict", action="store_true",
                         help="arm strict mode (runtime/strict.py, same as "
                              "LLM_INTERP_STRICT=1): transfer-guard the "
@@ -2794,6 +3026,12 @@ def main():
             # (single-model-xN replicas + the multi-model roster), with
             # per-replica health/plan notes
             record["serve_load_pool"] = args.serve_load_pool_report
+            if args.serve_load_pool_report.get("recovery"):
+                # fleet self-healing under --serve-load-faults (ISSUE
+                # 16): detection/restart latency + failed-over vs lost —
+                # top-level so bench-diff aligns it round over round
+                record["recovery"] = (
+                    args.serve_load_pool_report["recovery"])
         if getattr(args, "packed_report", None):
             # the packed-mode companion record (ISSUE 10): questions/s at
             # the packed operating point + the measured drift block

@@ -1527,6 +1527,16 @@ def main(argv=None):
                         "and --load-rate drives the pool through the "
                         "same open-loop harness (0/1 = single-engine "
                         "scheduler, today's path)")
+    p.add_argument("--supervise", action="store_true",
+                   help="arm fleet self-healing on the --pool-replicas "
+                        "fleet (serve/supervisor.py): per-replica "
+                        "watchdogs classify crash vs wedge, dead "
+                        "replicas are quarantined and rebuilt from the "
+                        "shared snapshot with exponential backoff, "
+                        "in-flight requests fail over to a sibling "
+                        "at-most-once, and repeat-killer requests are "
+                        "rejected as poisonous instead of taking a "
+                        "third replica down")
     p.set_defaults(fn=cmd_serve)
 
     p = sub.add_parser("lint",

@@ -185,10 +185,13 @@ def _collect_code_counters(root: str) -> Set[str]:
         except SyntaxError:
             continue
     counters: Set[str] = set()
-    wrappers: Set[str] = set()
+    # wrapper name -> (call-site positional index of the forwarded name
+    # param with any bound self/cls dropped, or -1 for keyword-only;
+    # keyword name).  record_counter itself is the (0, "name") root.
+    wrappers: Dict[str, Tuple[int, str]] = {"record_counter": (0, "name")}
 
-    def _base_param(arg: ast.expr, params: Set[str]) -> bool:
-        """True when the metric-name expression FORWARDS a param as its
+    def _base_param_name(arg: ast.expr, params: Set[str]) -> Optional[str]:
+        """Name of the param the metric-name expression FORWARDS as its
         base (the chokepoint idiom): a bare param, an f-string whose
         base segment is one (``f"{name}|leg={leg}"``), or ``name + sfx``.
         A param that only interpolates a LABEL VALUE
@@ -196,31 +199,70 @@ def _collect_code_counters(root: str) -> Set[str]:
         base resolves right here, and treating the function as a wrapper
         would register its call-site argument strings as counter names."""
         if isinstance(arg, ast.Name):
-            return arg.id in params
+            return arg.id if arg.id in params else None
         if isinstance(arg, ast.JoinedStr) and arg.values:
             first = arg.values[0]
-            return (isinstance(first, ast.FormattedValue)
+            if (isinstance(first, ast.FormattedValue)
                     and isinstance(first.value, ast.Name)
-                    and first.value.id in params)
+                    and first.value.id in params):
+                return first.value.id
+            return None
         if isinstance(arg, ast.BinOp):
-            return _base_param(arg.left, params)
-        return False
+            return _base_param_name(arg.left, params)
+        return None
 
-    # pass 1: direct record_counter literals + wrapper discovery
-    for path, tree in trees:
-        for node in ast.walk(tree):
-            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
-                continue
-            params = {a.arg for a in (node.args.posonlyargs + node.args.args
-                                      + node.args.kwonlyargs)}
-            for sub in ast.walk(node):
-                if not isinstance(sub, ast.Call):
+    def _name_arg(call: ast.Call, fn: str) -> Optional[ast.expr]:
+        """The metric-name argument at a recorder/wrapper call site:
+        the registered keyword if present, else the positional slot."""
+        idx, kw = wrappers[fn]
+        for k in call.keywords:
+            if k.arg == kw:
+                return k.value
+        if 0 <= idx < len(call.args):
+            return call.args[idx]
+        return None
+
+    # pass 1 (fixpoint): wrapper discovery — a function that forwards
+    # its own param as the NAME argument of record_counter or of an
+    # already-known wrapper is itself a wrapper.  The fixpoint makes the
+    # idiom transitive: `_reject(..., counter=...)` forwarding to
+    # `self._counter(counter)` forwarding to `record_counter(name)`
+    # registers `_reject` call-site literals too.
+    changed = True
+    while changed:
+        changed = False
+        for path, tree in trees:
+            for node in ast.walk(tree):
+                if not isinstance(node,
+                                  (ast.FunctionDef, ast.AsyncFunctionDef)):
                     continue
-                fn = _dotted(sub.func).rsplit(".", 1)[-1]
-                if fn != "record_counter" or not sub.args:
+                if node.name in wrappers:
                     continue
-                if _base_param(sub.args[0], params):
-                    wrappers.add(node.name)
+                pos_params = node.args.posonlyargs + node.args.args
+                params = {a.arg for a in (pos_params
+                                          + node.args.kwonlyargs)}
+                for sub in ast.walk(node):
+                    if not isinstance(sub, ast.Call):
+                        continue
+                    fn = _dotted(sub.func).rsplit(".", 1)[-1]
+                    if fn not in wrappers:
+                        continue
+                    arg = _name_arg(sub, fn)
+                    if arg is None:
+                        continue
+                    pname = _base_param_name(arg, params)
+                    if pname is None:
+                        continue
+                    ordered = [a.arg for a in pos_params]
+                    if ordered and ordered[0] in ("self", "cls"):
+                        ordered = ordered[1:]   # bound at call sites
+                    idx = (ordered.index(pname) if pname in ordered
+                           else -1)            # kwonly: keyword-only
+                    wrappers[node.name] = (idx, pname)
+                    changed = True
+                    break
+    # pass 2: collect literal (or module-const) names at every recorder
+    # and wrapper call site
     for path, tree in trees:
         consts = {t.id: n.value.value for n in ast.walk(tree)
                   if isinstance(n, ast.Assign)
@@ -228,15 +270,18 @@ def _collect_code_counters(root: str) -> Set[str]:
                   and isinstance(n.value.value, str)
                   for t in n.targets if isinstance(t, ast.Name)}
         for node in ast.walk(tree):
-            if not isinstance(node, ast.Call) or not node.args:
+            if not isinstance(node, ast.Call):
                 continue
             fn = _dotted(node.func).rsplit(".", 1)[-1]
-            if fn == "record_counter" or fn in wrappers:
-                arg = node.args[0]
-                if (isinstance(arg, ast.Name) and arg.id in consts):
-                    counters.add(consts[arg.id].partition("|")[0])
-                else:
-                    counters.update(_first_arg_literal_base(arg))
+            if fn not in wrappers:
+                continue
+            arg = _name_arg(node, fn)
+            if arg is None:
+                continue
+            if (isinstance(arg, ast.Name) and arg.id in consts):
+                counters.add(consts[arg.id].partition("|")[0])
+            else:
+                counters.update(_first_arg_literal_base(arg))
     return {c for c in counters if c}
 
 

@@ -44,6 +44,10 @@ hooks.  The catalogue (also printed by ``lint --explain``):
   dynamically concatenated name mints an unbounded metric family the
   README counter table cannot document, bench-diff cannot align, and
   the Prometheus exporter cannot re-split into one labeled family.
+  Fault *kinds* are held to the same discipline at the registry level:
+  a literal first argument to ``record_fault`` must be a member of
+  ``utils.telemetry.FAULT_KINDS`` — a typo'd kind forks an event
+  stream no flight-recorder trigger or listener ever matches.
 - **G07 cache-scale-awareness** — ``reshape``/``gather``/``concat``
   (and friends) applied directly to ``KVCache.k``/``.v`` outside the
   ops helpers and ``models/decoder.cache_kv_map``: with int8 KV the
@@ -65,6 +69,7 @@ import re
 from typing import Dict, List, Optional, Set, Tuple
 
 from ..obs.tracer import KNOWN_PHASES
+from ..utils.telemetry import FAULT_KINDS
 from .visitor import METADATA_ATTRS, FileContext, LintVisitor, dotted_name
 
 #: rule id -> (title, one-line summary) — the CLI's --explain table.
@@ -82,7 +87,9 @@ RULES: Dict[str, Tuple[str, str]] = {
                             "runtime/faults.py classification"),
     "G06": ("telemetry-discipline", "metric names must be literal (or "
                                     "forwarded params); labels ride the "
-                                    "name|k=v convention with literal keys"),
+                                    "name|k=v convention with literal "
+                                    "keys; record_fault literals must be "
+                                    "registered FAULT_KINDS"),
     "G07": ("cache-scale-awareness", "reshape/gather/concat directly on "
                                      "KVCache.k/.v outside ops helpers — "
                                      "int8 scales must ride along "
@@ -483,6 +490,14 @@ class TelemetryDisciplineRule:
     - a module-level string constant (runtime/strict.RECOMPILE_COUNTER);
     - a forwarded parameter plus a precomputed label suffix
       (``name + self._label_suffix``).
+
+    ``record_fault`` is the registry-side twin: a LITERAL kind (either
+    IfExp arm counts) must be a member of
+    :data:`..utils.telemetry.FAULT_KINDS` — the flight recorder's
+    trigger set and every fault listener match on exact kinds, so a
+    typo'd literal forks an event stream nothing ever reads.  Dynamic
+    kinds (forwarded params, computed names) are the chokepoint idiom
+    and stay out of scope here.
     """
 
     rule = "G06"
@@ -573,11 +588,34 @@ class TelemetryDisciplineRule:
     def check_call(self, node: ast.Call, ctx: FileContext,
                    v: LintVisitor) -> None:
         fn = dotted_name(node.func)
-        if fn.rsplit(".", 1)[-1] not in _TELEMETRY_RECORDERS:
+        tail = fn.rsplit(".", 1)[-1]
+        if tail == "record_fault":
+            if node.args:
+                self._check_fault_kind(node.args[0], node, v)
+            return
+        if tail not in _TELEMETRY_RECORDERS:
             return
         if not node.args:
             return
         self._check_name_expr(node.args[0], v.function, node, v)
+
+    def _check_fault_kind(self, arg: ast.expr, node: ast.Call,
+                          v: LintVisitor) -> None:
+        if isinstance(arg, ast.IfExp):
+            self._check_fault_kind(arg.body, node, v)
+            self._check_fault_kind(arg.orelse, node, v)
+            return
+        if not (isinstance(arg, ast.Constant)
+                and isinstance(arg.value, str)):
+            return  # dynamic kind: chokepoint territory, out of scope
+        if arg.value not in FAULT_KINDS:
+            v.report(self.rule, node,
+                     f"unregistered fault kind {arg.value!r}: "
+                     f"record_fault literals must be members of "
+                     f"utils/telemetry.FAULT_KINDS — the flight "
+                     f"recorder's triggers and fault listeners match on "
+                     f"exact kinds, so a typo forks an event stream "
+                     f"nothing ever reads")
 
     def _check_name_expr(self, arg: ast.expr, frame, node: ast.Call,
                          v: LintVisitor) -> None:

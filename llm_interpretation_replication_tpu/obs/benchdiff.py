@@ -49,6 +49,12 @@ _HIGHER_IS_BETTER_UNITS = ("prompts/sec", "rows/sec")
 #: (ISSUE 11): a p99 that grew past the threshold is the regression.
 _LOWER_IS_BETTER_UNITS = ("ms", "idle-frac")
 
+#: units where ANY non-zero value is a regression, no percentage
+#: threshold: the self-healing recovery block's ``requests_lost`` — one
+#: lost request means the always-answered contract broke, and "only 3%
+#: worse than last round's zero" is not a sentence that parses.
+_HARD_ZERO_UNITS = ("lost-requests",)
+
 #: The bench-record block contract (cross-checked by ``lint contracts``):
 #: every top-level block ``bench.py`` emits must be classified in exactly
 #: one of these tuples, and every ALIGNED/CONTEXT entry must actually be
@@ -58,7 +64,7 @@ _LOWER_IS_BETTER_UNITS = ("ms", "idle-frac")
 #:
 #: blocks :func:`flatten_metrics` aligns into verdict/informational rows:
 ALIGNED_BLOCKS = ("secondary", "brackets", "packed", "k_decode",
-                  "occupancy", "serve_load")
+                  "occupancy", "serve_load", "recovery")
 #: blocks :func:`diff_records` reads as cross-round context tables:
 CONTEXT_BLOCKS = ("context", "phases")
 #: blocks deliberately NOT aligned (free-form diagnostics whose shape is
@@ -189,6 +195,7 @@ def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
         for key, row in _occupancy_rows(holder).items():
             out.setdefault(key, row)
     out.update(_serve_load_rows(rec))
+    out.update(_recovery_rows(rec))
     return out
 
 
@@ -292,6 +299,48 @@ def _serve_load_rows(rec: Dict) -> Dict[str, Dict]:
     return out
 
 
+def _recovery_rows(rec: Dict) -> Dict[str, Dict]:
+    """Aligned rows from a record's ``recovery`` block (ISSUE 16): the
+    self-healing drill that ``--serve-load-faults`` runs.  Detection and
+    restart latency are lower-is-better ``ms`` rows; ``requests_lost``
+    carries the zero-tolerance ``lost-requests`` unit — the contract is
+    that every request is ANSWERED (a result or a typed rejection), so a
+    single lost request is a hard regression regardless of percentage.
+    Incident, failover and restart counts ride along informationally:
+    their absolute values track the injected fault schedule, not code
+    quality, so no verdict is attached to them."""
+    block = rec.get("recovery")
+    if not isinstance(block, dict):
+        return {}
+    out: Dict[str, Dict] = {}
+    det = block.get("detection_ms") or {}
+    if det.get("mean") is not None:
+        out["recovery detection mean [ms]"] = {
+            "value": det["mean"], "unit": "ms",
+            "metric": "mean fault-to-quarantine detection latency over "
+                      f"{det.get('n')} incident(s)"}
+    rst = block.get("restart_ms") or {}
+    if rst.get("mean") is not None:
+        out["recovery restart mean [ms]"] = {
+            "value": rst["mean"], "unit": "ms",
+            "metric": "mean quarantine-to-live replica rebuild latency "
+                      f"over {rst.get('n')} rebuild(s)"}
+    if block.get("requests_lost") is not None:
+        out["recovery lost [lost-requests]"] = {
+            "value": block["requests_lost"], "unit": "lost-requests",
+            "metric": "requests neither answered nor rejected under "
+                      "injected faults (must stay 0)"}
+    for key, label in (("requests_failed_over", "failed-over"),
+                       ("incidents", "incidents"),
+                       ("restarts", "restarts")):
+        if block.get(key) is not None:
+            out[f"recovery {label}"] = {
+                "value": block[key], "unit": "",
+                "metric": f"self-healing {label.replace('-', ' ')} count "
+                          "under the injected fault schedule"}
+    return out
+
+
 def _pct(old: Optional[float], new: Optional[float]) -> Optional[float]:
     if old is None or new is None or not old:
         return None
@@ -320,7 +369,18 @@ def diff_records(records: Sequence[Dict],
         first = next((v for v in values if v is not None), None)
         last = next((v for v in reversed(values) if v is not None), None)
         delta = _pct(first, last)
-        if values[0] is None:
+        if unit in _HARD_ZERO_UNITS:
+            # zero-tolerance rows: any non-zero value in the newest
+            # round is a regression outright — no threshold, and "new"
+            # is no excuse (the first round the row shows up non-zero
+            # is exactly when it must scream)
+            if last:
+                verdict = "REGRESSION"
+            elif values[-1] is None:
+                verdict, delta = "gone", None
+            else:
+                verdict, delta = "ok", None
+        elif values[0] is None:
             verdict, delta = "new", None
         elif values[-1] is None:
             verdict, delta = "gone", None

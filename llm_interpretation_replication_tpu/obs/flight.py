@@ -53,6 +53,10 @@ TRIGGER_KINDS = frozenset({
     "engine_oom_backoff", "sweep_oom_backoff", "sweep_oom_skip",
     "serve_oom_split", "transient_exhausted", "preempted",
     "watchdog_stall",
+    # fleet self-healing: every replica kill / wedge / poison reject and
+    # every breaker trip leaves a post-mortem artifact when armed.
+    "pool_replica_crash", "pool_replica_wedged",
+    "pool_replica_quarantined", "pool_poison_request", "breaker_open",
 })
 
 #: frames retained in the activity ring.

@@ -56,6 +56,8 @@ __all__ = [
     "Preempted",
     "PreemptionGuard",
     "TransientError",
+    "fleet_backoff_delay",
+    "fleet_backoff_policy",
     "is_oom",
     "is_transient",
     "next_batch_down",
@@ -262,6 +264,30 @@ def default_transient_policy() -> RetryPolicy:
     API ladder is for rate limits; a local RPC hiccup clears in seconds)."""
     return RetryPolicy(max_retries=3, initial_delay=2.0, max_delay=30.0,
                        retry_predicate=is_transient)
+
+
+def fleet_backoff_policy(initial_delay_s: float = 1.0,
+                         max_delay_s: float = 60.0,
+                         max_retries: int = 5) -> RetryPolicy:
+    """Fleet-event backoff: FULL jitter (delay uniform in [0, clamped
+    base]) instead of the multiplicative [0.8, 1.2] band.  When a replica
+    dies, every failing-over request and every rebuild attempt starts its
+    clock at the same instant — multiplicative jitter keeps them within
+    +-20% of lockstep and the whole herd lands on the rebuilt replica at
+    once.  Full jitter spreads them across the entire window (the AWS
+    exponential-backoff result the serving literature leans on)."""
+    return RetryPolicy(max_retries=max_retries,
+                       initial_delay=initial_delay_s,
+                       max_delay=max_delay_s, full_jitter=True)
+
+
+def fleet_backoff_delay(attempt: int,
+                        policy: Optional[RetryPolicy] = None) -> float:
+    """The full-jittered delay before rebuild/failover ``attempt``
+    (0-based) under ``policy`` (default :func:`fleet_backoff_policy`).
+    A function, not an inlined formula, so the supervisor and any future
+    fleet actor share ONE jitter discipline."""
+    return (policy or fleet_backoff_policy()).delay_for_attempt(attempt)
 
 
 def retry_transient(fn: Callable, policy: Optional[RetryPolicy] = None,

@@ -13,7 +13,10 @@ the stdlib JSONL driver (:mod:`.cli`) is the
 of N engine replicas (and ``api_backends/`` vendors as
 :class:`RemoteBackend` replicas) behind one router with per-model
 queues, hot load/unload over the engine's verified teardown, and
-cost/latency-aware backend selection.
+cost/latency-aware backend selection.  :mod:`.supervisor` makes the
+fleet self-healing: per-replica watchdogs classify crash vs wedge,
+quarantine-and-rebuild with backoff, fail requests over to siblings
+at-most-once, and trip circuit breakers on flaky remote vendors.
 """
 
 from .config import SchedulerConfig
@@ -32,6 +35,7 @@ from .queue import RequestQueue, Ticket
 from .replay import replay, rows_equal
 from .request import (
     DeadlineExceeded,
+    PoisonousRequest,
     QueueFull,
     SchedulerClosed,
     ScoreFuture,
@@ -39,18 +43,22 @@ from .request import (
     ServeError,
 )
 from .scheduler import Scheduler, labeled_metric
+from .supervisor import CircuitBreaker, ReplicaSupervisor, SupervisorConfig
 
 __all__ = [
+    "CircuitBreaker",
     "DeadlineExceeded",
     "EnginePool",
     "LocalReplica",
     "ParamShareGroup",
+    "PoisonousRequest",
     "PoolClient",
     "PoolClosed",
     "PoolConfig",
     "QueueFull",
     "RemoteBackend",
     "RemoteReplica",
+    "ReplicaSupervisor",
     "RequestQueue",
     "SchedulerClosed",
     "Scheduler",
@@ -58,6 +66,7 @@ __all__ = [
     "ScoreFuture",
     "ScoreRequest",
     "ServeError",
+    "SupervisorConfig",
     "Ticket",
     "UnknownModel",
     "labeled_metric",

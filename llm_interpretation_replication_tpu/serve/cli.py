@@ -93,8 +93,29 @@ def _metrics_endpoint(sched, port: int, healthz_fn=None):
     return server
 
 
+def shared_sibling_factory(engine):
+    """A rebuild factory over ONE loaded snapshot: each call constructs
+    a fresh :class:`~..runtime.engine.ScoringEngine` sibling around the
+    same param buffers / tokenizer / mesh / operating point.  This is
+    what the supervisor runs to resurrect a quarantined replica — the
+    shared arrays are still alive (the dead sibling's share-group slot
+    transfers to its successor), so a rebuild costs a scheduler + warm
+    compiled-shape reuse, never a second weight load."""
+    from ..runtime.engine import ScoringEngine
+
+    def factory():
+        sibling = ScoringEngine(
+            engine.family, engine.cfg, engine.params, engine.tokenizer,
+            mesh=engine.mesh, engine_config=engine.ecfg)
+        sibling.plan_decision = getattr(engine, "plan_decision", None)
+        return sibling
+
+    return factory
+
+
 def build_shared_pool(engine, model: str, replicas: int,
-                      config: Optional[SchedulerConfig] = None):
+                      config: Optional[SchedulerConfig] = None,
+                      supervise=None):
     """An :class:`~.pool.EnginePool` of ``replicas`` local replicas of
     ONE loaded snapshot: siblings share the param tree (no extra weight
     HBM on the same devices — the arrays are the same buffers), each
@@ -103,13 +124,24 @@ def build_shared_pool(engine, model: str, replicas: int,
     (:class:`~.pool.ParamShareGroup`): only the last sibling to unload
     releases them, whatever order the operator hot-unloads in.  When the
     CLI's --plan-search factory chose the snapshot's operating point,
-    every sibling inherits it through the primary's engine config."""
+    every sibling inherits it through the primary's engine config.
+
+    ``supervise`` arms fleet self-healing (serve/supervisor.py): pass
+    ``True`` for the default :class:`~.supervisor.SupervisorConfig` or a
+    config instance; the shared-snapshot sibling constructor doubles as
+    the rebuild factory, so a crashed or wedged replica comes back
+    without reloading weights."""
     from ..runtime.engine import ScoringEngine
     from .pool import EnginePool, ParamShareGroup, PoolConfig
+    from .supervisor import SupervisorConfig
 
     n = max(1, replicas)
     group = ParamShareGroup(n)
-    pool = EnginePool(PoolConfig(scheduler=config))
+    sup_cfg = None
+    if supervise:
+        sup_cfg = (supervise if isinstance(supervise, SupervisorConfig)
+                   else SupervisorConfig())
+    pool = EnginePool(PoolConfig(scheduler=config, supervision=sup_cfg))
     pool.load(model, engine, share_group=group,
               plan_note=getattr(engine, "plan_decision", None))
     for _ in range(1, n):
@@ -119,6 +151,8 @@ def build_shared_pool(engine, model: str, replicas: int,
         sibling.plan_decision = engine.plan_decision
         pool.load(model, sibling, share_group=group,
                   plan_note=engine.plan_decision)
+    if pool.supervisor is not None:
+        pool.supervisor.register_rebuild(model, shared_sibling_factory(engine))
     return pool
 
 
@@ -302,10 +336,12 @@ def main(engine, args) -> int:
     # the --replay corpus) serves through the pool when asked
     if replicas > 1 and (getattr(args, "load_rate", None)
                          or not args.replay):
+        supervise = bool(getattr(args, "supervise", False))
         pool = build_shared_pool(engine, getattr(args, "model", "model"),
-                                 replicas, config)
+                                 replicas, config, supervise=supervise)
         print(f"# serve: EnginePool with {replicas} replicas of "
-              f"{getattr(args, 'model', 'model')} (shared snapshot)",
+              f"{getattr(args, 'model', 'model')} (shared snapshot"
+              f"{', supervised' if supervise else ''})",
               file=sys.stderr)
     try:
         if getattr(args, "load_rate", None):

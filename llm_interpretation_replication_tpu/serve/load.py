@@ -309,6 +309,7 @@ def run_load(engine, prompts: Sequence, targets=("Yes", "No"),
             records.sort(key=lambda r: r["i"])
 
         completed, errors = 0, 0
+        errors_by_type: Dict[str, int] = {}
         mismatched: List[int] = []
         # ONE shared budget for the whole collection phase, not one per
         # future: a wedged scheduler must cost result_timeout_s once,
@@ -327,6 +328,8 @@ def run_load(engine, prompts: Sequence, targets=("Yes", "No"),
                 except Exception as err:  # graftlint: disable=G05 harness result relay: the scheduler already classified the error (OOM split/typed rejection) before it landed on the future; the report counts it instead of sinking the other requests' anatomy
                     errors += 1
                     rec["error_type"] = type(err).__name__
+                    errors_by_type[rec["error_type"]] = (
+                        errors_by_type.get(rec["error_type"], 0) + 1)
                 else:
                     completed += 1
                     rec["ok"] = True
@@ -367,6 +370,11 @@ def run_load(engine, prompts: Sequence, targets=("Yes", "No"),
         "requests": len(records),
         "completed": completed,
         "errors": errors,
+        # typed-vs-lost split: a typed rejection (DeadlineExceeded,
+        # PoisonousRequest, ...) is an ANSWERED request; a TimeoutError
+        # here means the future never resolved inside the shared budget
+        # — the "lost" signal the self-healing recovery block audits
+        "errors_by_type": errors_by_type,
         "shed": shed,
         "achieved_rows_per_s": (round(completed / makespan_s, 2)
                                 if makespan_s > 0 else None),

@@ -73,6 +73,7 @@ from .request import (
     ServeError,
 )
 from .scheduler import Scheduler
+from .supervisor import ReplicaSupervisor, SupervisorConfig
 
 #: router reap tick while work is IN FLIGHT: replica futures resolve on
 #: replica threads that cannot signal the pool's condition, so completion
@@ -129,6 +130,13 @@ class PoolConfig:
     #: ``degraded`` in :meth:`EnginePool.health` (0 disables; falls back
     #: to the scheduler template's ``health_max_queue_age_s``).
     health_max_queue_age_s: float = 0.0
+    #: fleet self-healing (serve/supervisor.py): None (default) keeps
+    #: the pool report-only — replica failures propagate to callers
+    #: exactly as before this layer existed.  A
+    #: :class:`~.supervisor.SupervisorConfig` arms crash/wedge
+    #: detection, quarantine + rebuild, in-flight failover, hedging,
+    #: and vendor circuit breakers.
+    supervision: Optional[SupervisorConfig] = None
 
 
 @dataclasses.dataclass
@@ -144,6 +152,14 @@ class _PoolTicket:
     replica_future: Optional[ScoreFuture] = None
     replica: Optional["_BaseReplica"] = None
     dispatch_t: Optional[float] = None
+    #: supervision bookkeeping (serve/supervisor.py): failed-over hops,
+    #: replicas this request's leg took down (the poison-row ceiling),
+    #: and the optional tail-latency hedge leg.  Rides the TICKET, never
+    #: the request or the row — replay bit-parity never sees it.
+    failovers: int = 0
+    kills: int = 0
+    hedge_future: Optional[ScoreFuture] = None
+    hedge_replica: Optional["_BaseReplica"] = None
 
     def sort_key(self):
         return (-self.request.priority, self.seq)
@@ -163,6 +179,12 @@ class ParamShareGroup:
     def __init__(self, count: int):
         self._count = max(1, int(count))
         self._lock = threading.Lock()
+
+    def acquire_one(self) -> None:
+        """Add one reference (a rebuilt sibling joining the group after
+        a quarantine, serve/supervisor.py)."""
+        with self._lock:
+            self._count += 1
 
     def release_one(self) -> bool:
         """True exactly once: on the release that drops the last ref."""
@@ -506,9 +528,27 @@ class EnginePool:
         self._lock = threading.Lock()
         self._wake = threading.Condition(self._lock)
         self._closed = False
+        self.supervisor: Optional[ReplicaSupervisor] = None
+        if self.config.supervision is not None:
+            self.supervisor = ReplicaSupervisor(
+                self, self.config.supervision)
         self._router = threading.Thread(
             target=self._route_loop, name="pool-router", daemon=True)
         self._router.start()
+
+    def supervise(self, config: Optional[SupervisorConfig] = None
+                  ) -> ReplicaSupervisor:
+        """Arm fleet self-healing on a running pool (idempotent): every
+        current and future replica gains crash/wedge supervision, and
+        the returned :class:`ReplicaSupervisor` takes rebuild-factory
+        registrations (:meth:`ReplicaSupervisor.register_rebuild`)."""
+        with self._wake:
+            if self.supervisor is None:
+                self.supervisor = ReplicaSupervisor(
+                    self, config or self.config.supervision)
+                for replica in self._replicas.values():
+                    self.supervisor.track(replica)
+        return self.supervisor
 
     # -- replica lifecycle ----------------------------------------------
 
@@ -543,6 +583,8 @@ class EnginePool:
             self._replicas[rid] = replica
             self._known_models.add(model)
             self._queues.setdefault(model, collections.deque())
+            if self.supervisor is not None:
+                self.supervisor.track(replica)
             record_counter("pool_replicas_loaded")
             self._wake.notify_all()
         return replica
@@ -563,6 +605,8 @@ class EnginePool:
             self._replicas[rid] = replica
             self._known_models.add(replica.model)
             self._queues.setdefault(replica.model, collections.deque())
+            if self.supervisor is not None:
+                self.supervisor.track(replica)
             record_counter("pool_replicas_loaded")
             self._wake.notify_all()
         return replica
@@ -587,6 +631,8 @@ class EnginePool:
         replica.shutdown(drain=drain, release_params=release_params)
         with self._wake:
             self._replicas.pop(replica_id, None)
+            if self.supervisor is not None:
+                self.supervisor.untrack(replica_id)
             record_counter("pool_replicas_unloaded")
             self._wake.notify_all()
 
@@ -664,6 +710,9 @@ class EnginePool:
         for replica in self._replicas.values():
             if replica.model != model or replica.state != "live":
                 continue
+            if (self.supervisor is not None
+                    and not self.supervisor.allows(replica)):
+                continue        # vendor breaker open: shed to siblings
             score = (cfg.latency_weight * replica.predicted_wait_s()
                      + cfg.cost_weight * replica.cost_estimate_usd(request)
                      * cfg.cost_scale_s_per_usd)
@@ -737,6 +786,8 @@ class EnginePool:
                 ticket.replica = replica
                 ticket.dispatch_t = time.monotonic()
                 replica.outstanding += 1
+                if self.supervisor is not None:
+                    self.supervisor.on_dispatch(replica)
                 self._inflight.append(ticket)
                 n += 1
         return n
@@ -745,40 +796,115 @@ class EnginePool:
         """Relay resolved replica futures onto pool futures (lock held).
         A ``SchedulerClosed`` bounce from a replica that shut down under
         the request re-queues the ticket — the unload path's
-        always-answered guarantee."""
+        always-answered guarantee.  Under supervision
+        (serve/supervisor.py) this is also where failover happens:
+        crashed legs re-queue, legs stranded on a torn-down quarantined
+        replica are reclaimed, and hedge legs race first-wins."""
         n = 0
         still: List[_PoolTicket] = []
         for ticket in self._inflight:
-            rf = ticket.replica_future
-            if rf is None or not rf.done():
+            if self._reap_one(ticket):
+                n += 1
+            else:
                 still.append(ticket)
-                continue
-            n += 1
-            replica = ticket.replica
-            replica.outstanding = max(0, replica.outstanding - 1)
-            err = rf.exception(timeout=0)
-            if isinstance(err, SchedulerClosed):
-                record_counter("pool_redispatched")
-                ticket.replica_future = None
-                ticket.replica = None
-                self._queues[ticket.model].appendleft(ticket)
-                continue
-            if err is not None:
-                replica.failed += 1
-                record_counter("pool_failed")
-                ticket.future._set_exception(err)
-                continue
-            replica.completed += 1
-            timing = rf.timing
-            if timing and "e2e_ms" in timing:
-                replica.note_latency(timing["e2e_ms"] / 1000.0)
-            elif ticket.dispatch_t is not None:
-                replica.note_latency(time.monotonic() - ticket.dispatch_t)
-            ticket.future.timing = timing
-            record_counter("pool_completed")
-            ticket.future._set_result(rf.result(timeout=0))
         self._inflight = still
         return n
+
+    def _reap_one(self, ticket: _PoolTicket) -> bool:
+        """True when the ticket left the in-flight set (resolved,
+        requeued, or typed-rejected); False = still waiting."""
+        sup = self.supervisor
+        # hedge leg first: a successful hedge answers the request
+        # (first-wins on the pool future); a failed one drops silently —
+        # the primary leg is still racing
+        hf = ticket.hedge_future
+        if hf is not None and hf.done():
+            hedge_replica = ticket.hedge_replica
+            hedge_replica.outstanding = max(
+                0, hedge_replica.outstanding - 1)
+            herr = hf.exception(timeout=0)
+            ticket.hedge_future = None
+            ticket.hedge_replica = None
+            if herr is None:
+                if sup is not None:
+                    if ticket.replica is not None:
+                        # the slow primary leg is orphaned: its replica's
+                        # outstanding drops when it eventually resolves
+                        sup.orphan_leg(ticket.replica,
+                                       ticket.replica_future)
+                        ticket.replica = None
+                        ticket.replica_future = None
+                    sup.note_hedge_won(ticket)
+                self._resolve_success(ticket, hf, hedge_replica,
+                                      hedged=True)
+                return True
+            if (sup is not None
+                    and not isinstance(herr, SchedulerClosed)):
+                sup.handle_hedge_failure(hedge_replica, herr)
+        rf = ticket.replica_future
+        replica = ticket.replica
+        if rf is None or not rf.done():
+            # supervised: a leg still unresolved AFTER a quarantined
+            # replica's teardown completed (state reached "closed" and
+            # the scheduler bounce already re-queued everything it
+            # could) is the wedged batch itself — fail it over instead
+            # of waiting on a corpse
+            if (sup is not None and replica is not None
+                    and getattr(replica, "quarantined", False)
+                    and replica.state == "closed"):
+                replica.outstanding = max(0, replica.outstanding - 1)
+                if ticket.hedge_future is not None:
+                    # promote the live hedge leg to primary
+                    ticket.replica_future = ticket.hedge_future
+                    ticket.replica = ticket.hedge_replica
+                    ticket.hedge_future = None
+                    ticket.hedge_replica = None
+                    return False
+                sup.reclaim_locked(ticket)
+                return True
+            return False
+        replica.outstanding = max(0, replica.outstanding - 1)
+        err = rf.exception(timeout=0)
+        if isinstance(err, SchedulerClosed):
+            record_counter("pool_redispatched")
+            ticket.replica_future = None
+            ticket.replica = None
+            self._queues[ticket.model].appendleft(ticket)
+            return True
+        if err is not None:
+            if sup is not None and sup.handle_failure(ticket, replica,
+                                                      err):
+                return True
+            replica.failed += 1
+            record_counter("pool_failed")
+            ticket.future._set_exception(err)
+            return True
+        self._resolve_success(ticket, rf, replica, hedged=False)
+        return True
+
+    def _resolve_success(self, ticket: _PoolTicket, rf: ScoreFuture,
+                         replica, hedged: bool) -> None:
+        replica.completed += 1
+        timing = rf.timing
+        e2e_s = None
+        if timing and "e2e_ms" in timing:
+            e2e_s = timing["e2e_ms"] / 1000.0
+        elif ticket.dispatch_t is not None:
+            e2e_s = time.monotonic() - ticket.dispatch_t
+        if e2e_s is not None:
+            replica.note_latency(e2e_s)
+        if self.supervisor is not None:
+            self.supervisor.on_success(replica, e2e_s)
+            if ticket.failovers or hedged:
+                # failover/hedge provenance rides the TIMING (future-
+                # side), never the row: replay bit-parity (PARITY.md)
+                timing = dict(timing or {})
+                timing["failovers"] = ticket.failovers
+                if hedged:
+                    timing["hedged"] = True
+        ticket.future.timing = timing
+        record_counter("pool_completed")
+        ticket.future._set_result(rf.result(timeout=0))
 
     # -- lifecycle / health ---------------------------------------------
 
@@ -809,6 +935,10 @@ class EnginePool:
             "replicas": replicas,
             "queued_by_model": queued,
         }
+        if self.supervisor is not None:
+            breakers = self.supervisor.breaker_states()
+            if breakers:
+                doc["breakers"] = breakers
         degraded = [r["replica"] for r in replicas
                     if r.get("status") == "degraded"]
         if orphaned:
@@ -849,6 +979,8 @@ class EnginePool:
             if idle:
                 break
             time.sleep(DISPATCH_TICK_S)
+        if self.supervisor is not None:
+            self.supervisor.stop()
         for replica in list(self._replicas.values()):
             replica.shutdown(drain=drain)
         with self._wake:

@@ -88,6 +88,16 @@ class RequestQueue:
             self._closed = True
             self._cond.notify_all()
 
+    def drain(self) -> List[Ticket]:
+        """Remove and return EVERY queued ticket without closing the
+        queue.  The supervisor's failover path (serve/supervisor.py)
+        reclaims a quarantined replica's queued-but-unlaunched tickets
+        this way so they re-enter the pool's per-model queue instead of
+        dying with the replica."""
+        with self._cond:
+            items, self._items = self._items, []
+            return items
+
     def oldest_wait_s(self, now_fn=time.monotonic) -> Optional[float]:
         """Age of the OLDEST queued ticket in seconds (None when empty).
         The /healthz degraded condition reads this: queue depth alone

@@ -36,6 +36,14 @@ class SchedulerClosed(ServeError):
     """The scheduler shut down before (or while) the request could run."""
 
 
+class PoisonousRequest(ServeError):
+    """The same request took down multiple replicas (the supervisor's
+    poison-row ceiling, serve/supervisor.py): after ``poison_kill_limit``
+    replica crashes attributable to one request, it is rejected with this
+    typed error instead of being failed over to — and killing — a third
+    replica.  The caller learns the request itself is the hazard."""
+
+
 @dataclasses.dataclass
 class ScoreRequest:
     """One scoring request.
@@ -92,25 +100,42 @@ class ScoreFuture:
     has returned: ``{"e2e_ms", "queue_wait_ms", "coalesce_ms",
     "serve_engine_ms", "respond_ms"}`` (serve/load.py semantics; the
     four phases sum to e2e).  It rides the FUTURE, not the result row,
-    so the replay bit-parity contract never sees it."""
+    so the replay bit-parity contract never sees it.
 
-    __slots__ = ("_event", "_row", "_err", "timing")
+    Resolution is AT-MOST-ONCE: the first ``_set_result`` /
+    ``_set_exception`` wins and every later attempt is a silent no-op.
+    Under the pool's failover and hedging paths (serve/supervisor.py) two
+    legs of the same request can race to answer — first-wins is what makes
+    "requests re-route to a sibling" safe without a cancellation protocol
+    for the loser."""
+
+    __slots__ = ("_event", "_lock", "_row", "_err", "timing")
 
     def __init__(self):
         self._event = threading.Event()
+        self._lock = threading.Lock()
         self._row: Optional[Dict] = None
         self._err: Optional[BaseException] = None
         self.timing: Optional[Dict] = None
 
     # -- scheduler side --------------------------------------------------
 
-    def _set_result(self, row: Dict) -> None:
-        self._row = row
-        self._event.set()
+    def _set_result(self, row: Dict) -> bool:
+        """First resolution wins; returns False when already resolved."""
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._row = row
+            self._event.set()
+            return True
 
-    def _set_exception(self, err: BaseException) -> None:
-        self._err = err
-        self._event.set()
+    def _set_exception(self, err: BaseException) -> bool:
+        with self._lock:
+            if self._event.is_set():
+                return False
+            self._err = err
+            self._event.set()
+            return True
 
     # -- caller side -----------------------------------------------------
 

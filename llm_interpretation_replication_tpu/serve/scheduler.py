@@ -171,15 +171,15 @@ class Scheduler:
             leftover, expired = self.queue.pop_group(max_batch=1 << 30,
                                                      max_wait_s=0)
             for t in expired:
-                self._counter("serve_rejected_deadline")
                 self._reject(t, DeadlineExceeded(
-                    "deadline passed before the scheduler shut down"))
+                    "deadline passed before the scheduler shut down"),
+                    counter="serve_rejected_deadline")
             if not leftover:
                 break
             for t in leftover:
-                self._counter("serve_rejected_closed")
                 self._reject(t, SchedulerClosed(
-                    "scheduler shut down before the request launched"))
+                    "scheduler shut down before the request launched"),
+                    counter="serve_rejected_closed")
         # the prefix pool's close() is idempotent (safe double-close): the
         # engine already closed it per call; closing again here only sweeps
         # leak accounting from a launch that died mid-flight
@@ -258,10 +258,10 @@ class Scheduler:
                                  phase="serve_coalesce", batch=len(group),
                                  trace_id=group[0].trace_id)
             for t in expired:
-                self._counter("serve_rejected_deadline")
                 self._reject(t, DeadlineExceeded(
                     f"deadline passed {time.monotonic() - t.deadline:.3f}s "
-                    f"before the micro-batch launched"))
+                    f"before the micro-batch launched"),
+                    counter="serve_rejected_deadline")
             if group is None:
                 return          # closed and drained
             if group:
@@ -273,9 +273,15 @@ class Scheduler:
         ecfg = getattr(self.engine, "ecfg", None)
         return ecfg.batch_size if ecfg is not None else 32
 
-    @staticmethod
-    def _reject(ticket: Ticket, err: Exception) -> None:
-        ticket.future._set_exception(err)
+    def _reject(self, ticket: Ticket, err: Exception,
+                counter: Optional[str] = None) -> None:
+        """Resolve a ticket's future with a typed error, counting
+        ``counter`` only when this resolution actually WON the future's
+        first-wins guard — a future already answered elsewhere (the
+        pool's failover/hedging orphan legs land here) must not inflate
+        the serve_rejected_*/serve_failed split."""
+        if ticket.future._set_exception(err) and counter:
+            self._counter(counter)
 
     def _engine_overrides(self, group: List[Ticket]):
         """Per-launch EngineConfig overrides: the serve path owns OOM

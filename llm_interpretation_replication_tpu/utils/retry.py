@@ -31,11 +31,21 @@ class RetryPolicy:
     retry_predicate: Optional[Callable[[BaseException], bool]] = None
     sleep: Callable[[float], None] = field(default=time.sleep)
     rng: random.Random = field(default_factory=random.Random)
+    # Full jitter (AWS-style): the delay is uniform in [0, clamped base]
+    # instead of base * uniform(jitter).  Decorrelates N callers that
+    # failed at the same instant — the failing-over-fleet case where
+    # multiplicative jitter still produces a thundering herd on the
+    # rebuilt replica (all N delays land within +-20% of each other).
+    full_jitter: bool = False
 
     def delay_for_attempt(self, attempt: int) -> float:
         base = min(self.initial_delay * self.exponential_base**attempt, self.max_delay)
+        if self.full_jitter:
+            return self.rng.uniform(0.0, base)
         lo, hi = self.jitter
-        return base * self.rng.uniform(lo, hi)
+        # clamp AFTER jitter: with hi > 1 the old order let the delay
+        # exceed max_delay on every capped attempt
+        return min(base * self.rng.uniform(lo, hi), self.max_delay)
 
 
 def retry_with_exponential_backoff(policy: RetryPolicy | None = None, **overrides):

@@ -33,6 +33,25 @@ _FAULT_EVENTS_CAP = 1000
 _FAULT_LISTENERS: List = []   # called with each event as it is recorded —
                               # the obs/ flight recorder's trigger path
 
+#: The CLOSED registry of fault-event kinds.  Every ``record_fault``
+#: literal in the codebase must name a member (graftlint G06 enforces
+#: this statically), and fault LISTENERS — the flight recorder's
+#: TRIGGER_KINDS, dashboards keyed on kind strings — can enumerate it
+#: instead of grepping: a typo'd kind would otherwise fork a new event
+#: stream no listener ever matches.  Grouped by the layer that records.
+FAULT_KINDS = frozenset({
+    # runtime/faults.py + runtime/engine.py (PR 1 fault layer)
+    "engine_oom_backoff", "sweep_oom_skip", "sweep_oom_backoff",
+    "transient_retry", "transient_exhausted", "preempted",
+    # runtime/strict.py + scoring (measurement-integrity events)
+    "blocked_transfer", "nan_logits", "packed_error_rows",
+    # serve/ scheduler + obs/flight.py watchdog
+    "serve_oom_split", "watchdog_stall",
+    # serve/supervisor.py fleet self-healing (ISSUE 16)
+    "pool_replica_crash", "pool_replica_wedged",
+    "pool_replica_quarantined", "pool_poison_request", "breaker_open",
+})
+
 
 def add_fault_listener(fn) -> None:
     """Register ``fn(event_dict)`` to run on every :func:`record_fault`

@@ -19,6 +19,7 @@ import contextlib
 import dataclasses
 import os
 import signal
+import threading
 import time
 from typing import List, Optional, Sequence
 
@@ -266,3 +267,155 @@ class FaultyEngine:
             # SIG_DFL/SIG_IGN would kill (or ignore in) the test runner;
             # simulate the preemption exit instead
             raise Preempted(signal.SIGTERM)
+
+
+# ---------------------------------------------------------------------------
+# Pool-level fault harness (fleet self-healing, serve/supervisor.py)
+# ---------------------------------------------------------------------------
+
+class BreakableEngine:
+    """Duck-typed engine wrapper a test can KILL or WEDGE at will.
+
+    :class:`FaultyEngine` injects faults the ENGINE-level ladder recovers
+    from (OOM back-off, transient retry); this wrapper injects the faults
+    that kill a whole POOL REPLICA so the supervisor's quarantine /
+    rebuild / failover paths can be pinned:
+
+    - :meth:`kill` — every subsequent scoring call raises a non-request
+      ``RuntimeError`` (the supervisor classifies it as a replica CRASH);
+    - :meth:`wedge` — every subsequent scoring call BLOCKS (a hung
+      device: no beats while busy → the supervisor's wedge watchdog);
+    - :meth:`heal` — back to delegation; unblocks a wedged call so a
+      quarantined replica's bounded teardown can complete in tests;
+    - ``poison_marker`` — any batch whose prompt contains this substring
+      crashes the call wherever it lands: the SAME request killing
+      replica after replica, which is exactly the poison-row ceiling's
+      trigger (``SupervisorConfig.poison_kill_limit``).
+
+    ``crashes`` counts injected kills for assertions.  Factories built
+    from this wrapper (one per replica) drive the strict failover matrix
+    in tests/test_pool.py and the ``bench --serve-load-replicas`` fault
+    schedule."""
+
+    def __init__(self, engine, poison_marker: Optional[str] = None):
+        self.engine = engine
+        self.poison_marker = poison_marker
+        self.mode = "ok"               # ok | dead | wedged
+        self.crashes = 0
+        self._unwedge = threading.Event()
+        self._unwedge.set()
+        if hasattr(engine, "score_prefixed"):
+            self.score_prefixed = self._score_prefixed
+        # real engines expose the slot-admission entry the serve
+        # scheduler PREFERS over score_prompts; a plain __getattr__
+        # delegation would bypass the fault gate entirely
+        if hasattr(engine, "score_prompts_slotted"):
+            self.score_prompts_slotted = self._score_prompts_slotted
+
+    # -- fault controls --------------------------------------------------
+
+    def kill(self) -> None:
+        self.mode = "dead"
+
+    def wedge(self) -> None:
+        self._unwedge.clear()
+        self.mode = "wedged"
+
+    def heal(self) -> None:
+        self.mode = "ok"
+        self._unwedge.set()
+
+    # -- injection gate --------------------------------------------------
+
+    def _crash(self, why: str) -> "RuntimeError":
+        self.crashes += 1
+        return RuntimeError(
+            f"replica engine crashed: {why} (injected by BreakableEngine)")
+
+    def _text(self, prompt) -> str:
+        # the pool coalescer pre-tokenizes on the submit thread whenever
+        # the engine has a tokenizer, so by the time a real engine is
+        # called the "prompt" is a token-id list — decode it back or the
+        # poison marker is invisible on exactly the engines that matter
+        if isinstance(prompt, str):
+            return prompt
+        if isinstance(prompt, (tuple, list)) and all(
+                isinstance(x, str) for x in prompt):
+            return "".join(prompt)       # un-encoded suffix tuple
+        tok = getattr(self.engine, "tokenizer", None)
+        if tok is None:
+            return ""
+        try:
+            return tok.decode(list(prompt))
+        except Exception:
+            return ""
+
+    def _gate(self, prompts: Sequence) -> None:
+        if self.mode == "dead":
+            raise self._crash("killed")
+        if self.mode == "wedged":
+            # block like a hung device until heal(); the scheduler's
+            # coalescer thread sits here, so the replica makes no
+            # progress beats while busy — the wedge watchdog's signature
+            self._unwedge.wait()
+            if self.mode == "dead":
+                raise self._crash("killed while wedged")
+        if self.poison_marker and any(
+                self.poison_marker in self._text(p) for p in prompts):
+            raise self._crash(f"poison marker {self.poison_marker!r}")
+
+    # -- delegation ------------------------------------------------------
+
+    def __getattr__(self, name):
+        return getattr(self.engine, name)
+
+    def score_prompts(self, prompts, **kw):
+        self._gate(prompts)
+        return self.engine.score_prompts(prompts, **kw)
+
+    def _score_prefixed(self, pairs, **kw):
+        self._gate([f"{self._text(p)}{self._text(s)}" for p, s in pairs])
+        return self.engine.score_prefixed(pairs, **kw)
+
+    def _score_prompts_slotted(self, prompts, **kw):
+        self._gate(prompts)
+        return self.engine.score_prompts_slotted(prompts, **kw)
+
+    def first_token_relative_prob(self, prompts, **kw):
+        self._gate(prompts)
+        return self.engine.first_token_relative_prob(prompts, **kw)
+
+
+class FlakyVendor:
+    """A togglable-outage ``evaluate`` callable for
+    :class:`~..serve.pool.RemoteBackend` — the vendor-side twin of
+    :class:`BreakableEngine` that drives the circuit-breaker tests.
+
+    Usable directly as ``RemoteBackend("vendor-model", FlakyVendor())``.
+    Set ``down = True`` for a hard outage (every call raises a transport
+    ``RuntimeError``) or ``fail_next = N`` for a bounded burst; calls and
+    failures are counted for breaker-threshold assertions."""
+
+    def __init__(self, yes_prob: float = 0.9, no_prob: float = 0.1,
+                 latency_s: float = 0.0):
+        self.yes_prob = yes_prob
+        self.no_prob = no_prob
+        self.latency_s = latency_s
+        self.down = False
+        self.fail_next = 0
+        self.calls = 0
+        self.failures = 0
+
+    def __call__(self, prompt, targets, with_confidence, max_new_tokens):
+        self.calls += 1
+        if self.down or self.fail_next > 0:
+            if self.fail_next > 0:
+                self.fail_next -= 1
+            self.failures += 1
+            raise RuntimeError(
+                "vendor unavailable: injected 503 (FlakyVendor)")
+        if self.latency_s:
+            time.sleep(self.latency_s)
+        return {"yes_prob": self.yes_prob, "no_prob": self.no_prob,
+                "response": "Yes" if self.yes_prob >= self.no_prob
+                else "No"}

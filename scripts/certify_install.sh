@@ -1,0 +1,52 @@
+#!/usr/bin/env bash
+# certify_install.sh — prove the package installs and serves from a FRESH
+# virtualenv with NO network access, then smoke the tier-1 gate.
+#
+# What this certifies (the failure modes it exists to catch):
+#   * packaging drift — a module missing from the wheel/editable install
+#     that the in-repo test run never notices because the repo root is on
+#     sys.path anyway;
+#   * hidden network dependencies — `--no-index` makes any build-time or
+#     install-time fetch a hard failure (the image bakes in the runtime
+#     deps; an install that needs PyPI is broken here by definition);
+#   * console entry-point rot (`llm-interp-tpu` must resolve and answer
+#     `--help` from the venv, not from the checkout).
+#
+# Usage:
+#   scripts/certify_install.sh                 # fast smoke (-m faults)
+#   CERTIFY_SMOKE_MARKER='not slow' \
+#       scripts/certify_install.sh             # the full tier-1 gate
+#   CERTIFY_VENV=/tmp/certify-venv \
+#       scripts/certify_install.sh             # reuse/inspect the venv
+set -euo pipefail
+
+REPO="$(cd "$(dirname "${BASH_SOURCE[0]}")/.." && pwd)"
+VENV="${CERTIFY_VENV:-"$(mktemp -d)/certify-venv"}"
+# a fast in-gate marker by default; 'not slow' runs the whole tier-1 gate
+SMOKE_MARKER="${CERTIFY_SMOKE_MARKER:-faults}"
+
+echo "== certify_install: fresh venv at $VENV"
+# --system-site-packages: the runtime deps (jax, numpy, ...) resolve from
+# the image, OFFLINE — the venv only isolates the package install itself
+python3 -m venv --system-site-packages "$VENV"
+# shellcheck source=/dev/null
+. "$VENV/bin/activate"
+
+echo "== certify_install: offline editable install (--no-index)"
+pip install --quiet --no-index --no-build-isolation --no-deps -e "$REPO"
+
+echo "== certify_install: import + console entry point"
+python - <<'PYEOF'
+import llm_interpretation_replication_tpu as pkg
+from llm_interpretation_replication_tpu.serve import EnginePool  # noqa: F401
+print(f"import ok: {pkg.__name__}")
+PYEOF
+llm-interp-tpu --help >/dev/null
+echo "console entry point ok"
+
+echo "== certify_install: tier-1 smoke (-m '$SMOKE_MARKER')"
+cd "$REPO/tests"
+JAX_PLATFORMS=cpu python -m pytest -q -m "$SMOKE_MARKER" \
+    -p no:cacheprovider
+
+echo "== certify_install: PASS (venv: $VENV)"

@@ -772,6 +772,47 @@ class TestG06TelemetryDiscipline:
         """)
         assert rules_of(findings) == ["G06"]
 
+    def test_unregistered_fault_kind_flagged(self):
+        """A literal record_fault kind outside FAULT_KINDS forks an
+        event stream no flight trigger or listener matches."""
+        findings = run("serve/m.py", """
+            from ..utils.telemetry import record_fault
+
+            def f(rid):
+                record_fault("pool_replica_crashd", replica=rid)
+        """)
+        assert rules_of(findings) == ["G06"]
+        assert "FAULT_KINDS" in findings[0].message
+
+    def test_registered_fault_kind_ok(self):
+        assert run("serve/m.py", """
+            from ..utils.telemetry import record_fault
+
+            def f(rid, wedged):
+                record_fault("pool_replica_wedged" if wedged
+                             else "pool_replica_crash", replica=rid)
+        """) == []
+
+    def test_dynamic_fault_kind_out_of_scope(self):
+        """Forwarded/dynamic kinds are the chokepoint idiom — the
+        registry check only bites on literals."""
+        assert run("serve/m.py", """
+            from ..utils.telemetry import record_fault
+
+            def f(kind, rid):
+                record_fault(kind, replica=rid)
+        """) == []
+
+    def test_fault_listener_kind_sets_stay_registered(self):
+        """Listeners match on event['kind'] (add_fault_listener takes no
+        kind filter), so the consumer-side literal sets must be subsets
+        of the same registry G06 holds producers to — a trigger kind
+        outside FAULT_KINDS could never fire."""
+        from llm_interpretation_replication_tpu.obs import flight
+        from llm_interpretation_replication_tpu.utils import telemetry
+
+        assert set(flight.TRIGGER_KINDS) <= telemetry.FAULT_KINDS
+
     def test_ifexp_of_literals_ok(self):
         assert run("utils/m.py", """
             from .telemetry import record_counter

@@ -31,13 +31,16 @@ from llm_interpretation_replication_tpu.runtime import (
     EngineClosed,
     live_buffer_count,
 )
+from llm_interpretation_replication_tpu.obs import flight
 from llm_interpretation_replication_tpu.serve import (
     EnginePool,
+    PoisonousRequest,
     PoolClosed,
     PoolConfig,
     RemoteBackend,
     SchedulerConfig,
     ScoreRequest,
+    SupervisorConfig,
     UnknownModel,
     rows_equal,
 )
@@ -47,6 +50,10 @@ from llm_interpretation_replication_tpu.serve.pool import (
     RemoteReplica,
 )
 from llm_interpretation_replication_tpu.utils import telemetry
+from llm_interpretation_replication_tpu.utils.testing import (
+    BreakableEngine,
+    FlakyVendor,
+)
 
 pytestmark = pytest.mark.enginepool
 
@@ -434,6 +441,208 @@ class TestPoolUnderLoad:
         assert report["parity"]["mismatched_rows"] == 0
         assert report["blocked_transfers"] == 0
         eng_ref.close()
+
+
+# ---------------------------------------------------------------------------
+# Fleet self-healing (ISSUE 16): supervised failover, poison ceiling,
+# wedge watchdog, hedging, vendor circuit breakers
+# ---------------------------------------------------------------------------
+
+def _wait_for(cond, timeout_s=8.0):
+    deadline = time.monotonic() + timeout_s
+    while time.monotonic() < deadline:
+        if cond():
+            return True
+        time.sleep(0.02)
+    return cond()
+
+
+class TestSupervision:
+    def _sup_pool(self, **kw):
+        sup = SupervisorConfig(rebuild_backoff_initial_s=0.05,
+                               rebuild_backoff_max_s=0.2, poll_s=0.01, **kw)
+        return EnginePool(PoolConfig(scheduler=FAST, supervision=sup))
+
+    def test_failover_matrix_strict_bit_identical(self, tmp_path):
+        """The strict failover matrix: a replica killed under the
+        --serve-load open-loop harness.  Every request is answered, the
+        answered rows are bit-identical to the no-fault offline run
+        (failover re-enters the queue — provenance rides on ``timing``,
+        never the row), the strict transfer guard stays at
+        ``blocked_transfers == 0``, the crashed lineage rebuilds, and
+        the injected kill leaves a flight-recorder dump."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng_ref, _, _ = _tiny_engine(batch_size=4)
+        victim = BreakableEngine(_tiny_engine(batch_size=4)[0])
+        sibling = BreakableEngine(_tiny_engine(batch_size=4)[0])
+        prompts = [f"Is thing {i} a stuff?" for i in range(6)]
+        offline = eng_ref.score_prompts(prompts)   # warm + parity reference
+        flight.enable(str(tmp_path))
+        pool = self._sup_pool()
+        try:
+            pool.load("tiny", victim)
+            pool.load("tiny", sibling)
+            pool.supervisor.register_rebuild(
+                "tiny",
+                lambda: BreakableEngine(_tiny_engine(batch_size=4)[0]))
+            pool.submit(ScoreRequest(prompt=prompts[0]),
+                        model="tiny").result(timeout=300)  # warm replicas
+            # dead, but still "live" to the router: the next request
+            # dispatched to it crashes mid-traffic and must fail over
+            victim.kill()
+            strict.activate(sentry=False)
+            try:
+                report = load_mod.run_load(
+                    eng_ref, prompts, rate=30.0, duration_s=0.5,
+                    offline_rows=offline,
+                    scheduler_factory=lambda cfg: pool.client("tiny"))
+            finally:
+                strict.deactivate()
+            rep = pool.supervisor.report()
+            assert report["errors"] == 0                       # all answered
+            assert report["errors_by_type"].get("TimeoutError", 0) == 0
+            assert report["parity"]["mismatched_rows"] == 0    # bit-identical
+            assert report["blocked_transfers"] == 0
+            assert rep["incidents"] >= 1 and rep["crashes"] >= 1
+            assert rep["requests_failed_over"] >= 1
+            assert rep["requests_lost"] == 0
+            assert _wait_for(
+                lambda: pool.supervisor.report()["restarts"] >= 1)
+            flight.get_recorder().wait()
+            assert sorted(tmp_path.glob(
+                "flightrec-pool_replica_crash-*.json"))
+        finally:
+            victim.heal()
+            sibling.heal()
+            pool.close()
+            flight.get_recorder().wait()
+            flight.disable()
+            eng_ref.close()
+
+    def test_poison_row_ceiling_typed_rejection(self):
+        """The same request killing ``poison_kill_limit`` replicas is
+        poisoned: the caller gets a typed :class:`PoisonousRequest`, a
+        third replica never sees the row, and clean traffic keeps
+        flowing through the survivors."""
+        engines = [BreakableEngine(FakeEngine("tiny"),
+                                   poison_marker="POISONROW")
+                   for _ in range(3)]
+        pool = self._sup_pool()
+        try:
+            for eng in engines:
+                pool.load("tiny", eng)
+            fut = pool.submit(
+                ScoreRequest(prompt="this one is a POISONROW record"),
+                model="tiny")
+            with pytest.raises(PoisonousRequest):
+                fut.result(timeout=60)
+            rep = pool.supervisor.report()
+            assert rep["poison_rejects"] == 1
+            # the ceiling held: exactly two replicas crashed on the row
+            assert sum(1 for eng in engines if eng.crashes > 0) == 2
+            row = pool.submit(ScoreRequest(prompt="a clean row"),
+                              model="tiny").result(timeout=60)
+            assert row is not None
+        finally:
+            pool.close()
+
+    def test_wedge_detection_reclaims_and_rebuilds(self):
+        """A wedged replica (hung device: busy, no progress beats) is
+        detected by the supervisor's watchdog within the wedge timeout,
+        its in-flight legs are reclaimed and answered by the sibling,
+        and the lineage rebuilds."""
+        wedged = BreakableEngine(SlowEngine("tiny", delay_s=0.01))
+        healthy = BreakableEngine(SlowEngine("tiny", delay_s=0.01))
+        pool = self._sup_pool(wedge_timeout_s=0.3)
+        try:
+            pool.load("tiny", wedged)
+            pool.load("tiny", healthy)
+            pool.supervisor.register_rebuild(
+                "tiny",
+                lambda: BreakableEngine(SlowEngine("tiny", delay_s=0.01)))
+            wedged.wedge()
+            futs = [pool.submit(ScoreRequest(prompt=f"q{i}"), model="tiny")
+                    for i in range(8)]
+            assert _wait_for(
+                lambda: pool.supervisor.report()["wedges"] >= 1)
+            # unblock the hung coalescer so the quarantined corpse's
+            # bounded teardown (and leg reclaim) can complete
+            wedged.heal()
+            rows = [f.result(timeout=120) for f in futs]
+            assert all(r is not None for r in rows)
+            rep = pool.supervisor.report()
+            assert rep["wedges"] == 1          # one incident, many legs
+            assert rep["detection_ms"] is not None
+            assert rep["requests_lost"] == 0
+            assert _wait_for(
+                lambda: pool.supervisor.report()["restarts"] >= 1)
+        finally:
+            wedged.heal()
+            healthy.heal()
+            pool.close()
+
+    def test_hedge_rescues_silent_straggler(self):
+        """Opt-in hedging: with wedge detection OFF, a silently-stuck
+        replica's requests exceed hedge_k x p99 and a second leg
+        launches on the sibling — every request answered, hedges won
+        counted, nothing lost."""
+        straggler = BreakableEngine(FakeEngine("tiny"))
+        rescuer = BreakableEngine(FakeEngine("tiny"))
+        pool = self._sup_pool(hedge=True, hedge_k=2.0, hedge_min_samples=4)
+        try:
+            pool.load("tiny", straggler)
+            pool.load("tiny", rescuer)
+            # establish the per-model p99 the hedge threshold needs
+            warm = [pool.submit(ScoreRequest(prompt=f"w{i}"), model="tiny")
+                    for i in range(8)]
+            for f in warm:
+                f.result(timeout=60)
+            straggler.wedge()
+            futs = [pool.submit(ScoreRequest(prompt=f"q{i}"), model="tiny")
+                    for i in range(6)]
+            rows = [f.result(timeout=120) for f in futs]
+            assert all(r is not None for r in rows)
+            rep = pool.supervisor.report()
+            assert rep["hedges_launched"] >= 1
+            assert rep["hedges_won"] >= 1
+            assert rep["requests_lost"] == 0
+        finally:
+            straggler.heal()
+            rescuer.heal()
+            pool.close()
+
+    def test_vendor_breaker_open_shed_halfopen_reclose(self):
+        """A hard vendor outage opens the circuit breaker after the
+        failure threshold; traffic sheds to the local replica with
+        every request still answered; after the cooldown a half-open
+        probe against the healed vendor re-closes the breaker."""
+        vendor = FlakyVendor()
+        local = BreakableEngine(FakeEngine("m"))
+        pool = self._sup_pool(breaker_failure_threshold=3,
+                              breaker_cooldown_s=0.2)
+        try:
+            pool.load("m", local)
+            pool.load_remote(RemoteBackend("m", vendor), model="m")
+            vendor.down = True
+            futs = [pool.submit(ScoreRequest(prompt=f"q{i}"), model="m")
+                    for i in range(20)]
+            rows = [f.result(timeout=120) for f in futs]
+            assert all(r is not None for r in rows)   # shed, not lost
+            assert _wait_for(
+                lambda: "open" in pool.supervisor.breaker_states().values())
+            assert vendor.failures >= 3
+            # heal the vendor; keep trickling requests until a half-open
+            # probe succeeds and the breaker re-closes
+            vendor.down = False
+            assert _wait_for(lambda: (
+                pool.submit(ScoreRequest(prompt="probe"),
+                            model="m").result(timeout=60) is not None
+                and all(s == "closed"
+                        for s in pool.supervisor.breaker_states().values())
+            ), timeout_s=15.0)
+        finally:
+            pool.close()
 
 
 # ---------------------------------------------------------------------------
@@ -841,3 +1050,45 @@ class TestBenchPoolServeLoad:
         # multi-model configuration really hosts two models
         multi = block["configurations"][1]
         assert len({r["model"] for r in multi["replicas"]}) == 2
+
+    def test_bench_fault_schedule_emits_recovery_block(self, tmp_path):
+        """Acceptance (ISSUE 16): an injected replica kill plus a
+        vendor outage under the SAME bench harness — zero lost
+        requests, a populated `recovery` block (detection latency,
+        requests failed-over vs lost), and the vendor breaker opening
+        then re-closing after the outage heals."""
+        import bench
+        import jax as _jax
+        import jax.numpy as jnp
+        from test_bench import TINY, _args
+        from llm_interpretation_replication_tpu.models.decoder import (
+            DecoderConfig,
+        )
+
+        cfg = DecoderConfig(**TINY)
+        params = bench.init_params(cfg, _jax.random.PRNGKey(0),
+                                   jnp.float32)
+        args = _args(tmp_path, batch=8)
+        args.sweep_repeats = 1
+        args.serve_load = True
+        args.serve_load_rates = "auto"
+        args.serve_load_duration = 0.4
+        args.serve_load_seed = 0
+        args.serve_load_replicas = 2
+        args.serve_load_faults = "kill@0.05,vendor@0"
+        bench.run_sweep_mode(args, cfg, params)
+        block = args.serve_load_pool_report
+        names = [c["name"] for c in block["configurations"]]
+        assert names[-1] == "self-healing"
+        rec = block["recovery"]
+        assert rec["requests_lost"] == 0          # the contract
+        assert rec["incidents"] >= 1 and rec["crashes"] >= 1
+        assert rec["detection_ms"] is not None
+        assert rec["load"]["errors_by_type"].get("TimeoutError", 0) == 0
+        kinds = [f["kind"] for f in rec["faults_injected"]]
+        assert "kill" in kinds and "vendor" in kinds
+        vend = rec["vendor_outage"]
+        assert vend["answered"] == vend["requests"]   # shed, not lost
+        assert vend["breaker_opened"] is True
+        assert vend["breaker_reclosed"] is True
+        assert vend["vendor_failures"] >= 1
