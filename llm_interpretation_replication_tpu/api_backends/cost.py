@@ -7,6 +7,7 @@ tokens), with full-sweep cost extrapolation from a processed subset.
 
 from __future__ import annotations
 
+import threading
 from typing import Dict, Optional
 
 from ..config import api_models
@@ -16,12 +17,18 @@ class CostTracker:
     def __init__(self, pricing: Optional[Dict] = None):
         self.pricing = pricing if pricing is not None else api_models().get("pricing", {})
         self.usage: Dict[str, Dict[str, int]] = {}
+        # one tracker is shared by every RemoteReplica worker thread and
+        # the sweep's per-model threads at once; the tally increments
+        # below are read-modify-write (G09 api_backends/cost.py
+        # 'CostTracker.usage' — lost updates undercount spend)
+        self._lock = threading.Lock()
 
     def record(self, model: str, input_tokens: int, output_tokens: int) -> None:
-        u = self.usage.setdefault(model, {"input_tokens": 0, "output_tokens": 0, "requests": 0})
-        u["input_tokens"] += int(input_tokens)
-        u["output_tokens"] += int(output_tokens)
-        u["requests"] += 1
+        with self._lock:
+            u = self.usage.setdefault(model, {"input_tokens": 0, "output_tokens": 0, "requests": 0})
+            u["input_tokens"] += int(input_tokens)
+            u["output_tokens"] += int(output_tokens)
+            u["requests"] += 1
 
     def record_response(self, model: str, response: Dict) -> None:
         """Pull usage out of an OpenAI-style response object."""

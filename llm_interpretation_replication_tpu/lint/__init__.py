@@ -7,7 +7,7 @@ per batch).  PR 2 built the machinery that avoids them (prefix-KV reuse,
 plan-keyed compile caching, double-buffered host pipeline); this package
 makes reintroducing them a TEST FAILURE instead of a perf mystery.
 
-Layout (two analysis layers since PR 15):
+Layout (three analysis layers since PR 18):
 
 - :mod:`.visitor` — the AST passes: a module-level call graph that
   propagates device-region membership interprocedurally (bounded depth,
@@ -18,7 +18,16 @@ Layout (two analysis layers since PR 15):
   G07 (KV-cache scale awareness), G08 (tracer span hygiene).
 - :mod:`.contracts` — layer 2, ``lint contracts``: cross-artifact drift
   checking (code vs README tables, pyproject marker registry, bench-diff
-  block classification, the sweep-full child-override contract).
+  block classification, the sweep-full child-override contract, the
+  calibration-provenance citation gate on runtime/plan* coefficients).
+- :mod:`.threads` — layer 3, the whole-tree concurrency analysis: infers
+  the fleet's thread model (spawn sites, daemon loops, HTTP handlers,
+  executor submissions, the implicit ``<api>`` caller) and propagates
+  thread-root membership through the call graph, then checks G09
+  (guarded-by: shared state mutated outside its consistent lock), G10
+  (lock-order: cycles in the global acquisition-ordering graph), and
+  G11 (blocking calls under a contended lock).  Findings ride the same
+  fingerprint/suppression/baseline machinery as layers 1-2.
 - :mod:`.report` — findings, fingerprints, formatting.
 - :mod:`.baseline` — the grandfathered-findings ratchet
   (``lint_baseline.json``), including the scope-independent rot check.
@@ -38,11 +47,17 @@ from .cli import changed_files, default_paths, lint_paths, main
 from .contracts import check_contracts
 from .report import Finding, format_report
 from .rules import RULES, default_rules
+from .threads import (ThreadModel, build_model, collect_thread_findings,
+                      model_from_paths)
 from .visitor import lint_source
 
 __all__ = [
     "Finding",
     "RULES",
+    "ThreadModel",
+    "build_model",
+    "collect_thread_findings",
+    "model_from_paths",
     "apply_baseline",
     "changed_files",
     "check_contracts",

@@ -98,12 +98,22 @@ def iter_python_files(paths: Sequence[str]) -> List[str]:
 
 
 def lint_paths(paths: Sequence[str], root: Optional[str] = None,
-               rules=None) -> List[Finding]:
+               rules=None, threads: bool = True) -> List[Finding]:
     """Lint files/directories; paths in findings are relative to ``root``
-    (default: the repo root) so baselines are machine-independent."""
+    (default: the repo root) so baselines are machine-independent.
+
+    Runs two layers: the per-file rules (G01-G08) and, unless
+    ``threads=False``, the whole-tree concurrency layer (G09-G11) over
+    exactly the files scanned.  A partial scan both misses cross-module
+    findings AND can invent ones the full tree refutes (a caller that
+    holds the lock may live in an unscanned file), which is why
+    ``main()``'s ``--diff`` mode passes ``threads=False`` and instead
+    runs :func:`thread_findings` over the full target set, filtering
+    the report to the changed files."""
     root = os.path.abspath(root or repo_root())
     rules = rules if rules is not None else default_rules()
     findings: List[Finding] = []
+    texts = {}
     for fname in iter_python_files(paths):
         try:
             with open(fname, encoding="utf-8") as f:
@@ -112,8 +122,37 @@ def lint_paths(paths: Sequence[str], root: Optional[str] = None,
             print(f"# lint: cannot read {fname}: {err}", file=sys.stderr)
             continue
         rel = os.path.relpath(os.path.abspath(fname), root)
-        findings.extend(lint_source(rel.replace(os.sep, "/"), text, rules))
+        rel_posix = rel.replace(os.sep, "/")
+        texts[rel_posix] = text
+        findings.extend(lint_source(rel_posix, text, rules))
+    if threads and texts:
+        from .threads import collect_thread_findings
+
+        findings.extend(collect_thread_findings(texts))
     return sort_findings(findings)
+
+
+def thread_findings(paths: Optional[Sequence[str]] = None,
+                    root: Optional[str] = None) -> List[Finding]:
+    """Concurrency findings (G09-G11) over the FULL target set (default:
+    the repo gate's), independent of any ``--diff`` restriction — the
+    thread model needs every module at once to resolve cross-module
+    locks, thread roots, and entry-held callers."""
+    root = os.path.abspath(root or repo_root())
+    texts = {}
+    for fname in iter_python_files(paths or default_paths()):
+        try:
+            with open(fname, encoding="utf-8") as f:
+                text = f.read()
+        except OSError:
+            continue
+        rel = os.path.relpath(os.path.abspath(fname), root)
+        texts[rel.replace(os.sep, "/")] = text
+    if not texts:
+        return []
+    from .threads import collect_thread_findings
+
+    return sort_findings(collect_thread_findings(texts))
 
 
 def main(argv: Optional[Sequence[str]] = None) -> int:
@@ -125,10 +164,12 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
         return contracts_main(list(argv[1:]))
     parser = argparse.ArgumentParser(
         prog="llm_interpretation_replication_tpu lint",
-        description="JAX-aware static analysis (rules G01-G08, "
-                    "interprocedural device regions) with a "
-                    "grandfathered-findings baseline; `lint contracts` "
-                    "runs the cross-artifact layer")
+        description="JAX-aware static analysis (per-file rules G01-G08 "
+                    "with interprocedural device regions, plus the "
+                    "whole-tree concurrency layer G09-G11: thread-model "
+                    "inference, guarded-by checking, lock-order deadlock "
+                    "detection) with a grandfathered-findings baseline; "
+                    "`lint contracts` runs the cross-artifact layer")
     parser.add_argument("paths", nargs="*",
                         help="files/dirs to lint (default: the package + "
                              "bench.py)")
@@ -191,7 +232,16 @@ def main(argv: Optional[Sequence[str]] = None) -> int:
                 os.path.relpath(os.path.abspath(f), root).replace(
                     os.sep, "/")
                 for f in paths}
-    findings = lint_paths(paths)
+    if linted_rel is None:
+        findings = lint_paths(paths)
+    else:
+        # --diff: per-file rules over the changed files only, but the
+        # thread model over the FULL target set (a subset scan would
+        # both miss cross-module findings and invent ones the missing
+        # callers refute) — reported for the changed files
+        findings = sort_findings(
+            lint_paths(paths, threads=False)
+            + [f for f in thread_findings() if f.path in linted_rel])
     baseline_path = args.baseline or default_baseline_path()
 
     if args.write_baseline:

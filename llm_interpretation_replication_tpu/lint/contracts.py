@@ -30,6 +30,13 @@ that rotted one PR at a time:
 - **phase-table** — :data:`..obs.tracer.KNOWN_PHASES` vs the README
   "Span / phase names" table (G08 enforces code→table membership; this
   check keeps the two tables themselves in lockstep).
+- **calibration** — every pinned cost-model coefficient in
+  ``runtime/plan.py`` / ``runtime/plan_search.py`` must cite its
+  provenance: ``# anchor: BENCH_rNN`` (solved from that checked-in
+  bench record — the refit input of ROADMAP item 4's ``plan
+  calibrate`` loop) or ``# prior: <rationale>`` (a documented guess
+  and its recalibration story).  A new uncited literal fails the gate;
+  an uncited number is one nobody can ever refit.
 
 Everything here is static (regex + ``ast`` over sources): no package
 import, no JAX init — cheap enough to run before pytest in the tier-1
@@ -603,6 +610,90 @@ def check_phase_table(root: str) -> List[Drift]:
 
 
 # ---------------------------------------------------------------------------
+# check 6: calibration-coefficient provenance (ROADMAP item 4)
+# ---------------------------------------------------------------------------
+
+#: the files holding the plan search's pinned cost-model literals.
+CALIBRATED_FILES = ("runtime/plan.py", "runtime/plan_search.py")
+
+#: a provenance citation: ``# anchor: BENCH_rNN`` ties the literal to a
+#: checked-in bench record the `plan calibrate` loop (ROADMAP item 4)
+#: can refit it from; ``# prior: <rationale>`` documents an unmeasured
+#: guess AND its recalibration story.  ``#:`` (sphinx-style) counts too.
+_CITE_RE = re.compile(r"#:?\s*(anchor:\s*BENCH_r\d+\b|prior:\s*\S)")
+
+
+def _is_numeric_literal(node: ast.expr) -> bool:
+    """A scalar numeric expression made only of constants: ``169.5``,
+    ``6_921_420_800``, ``1 << 28``, ``-0.5``.  Tuples/menus (enumerated
+    search axes, not calibrated coefficients) don't count."""
+    if isinstance(node, ast.Constant):
+        return (isinstance(node.value, (int, float))
+                and not isinstance(node.value, bool))
+    if isinstance(node, ast.UnaryOp):
+        return _is_numeric_literal(node.operand)
+    if isinstance(node, ast.BinOp):
+        return (_is_numeric_literal(node.left)
+                and _is_numeric_literal(node.right))
+    return False
+
+
+def check_calibration(root: str) -> List[Drift]:
+    """Every pinned cost-model literal must carry its provenance.
+
+    Plan search ranks candidate plans with module-level numeric
+    coefficients; a literal without a citation is a number nobody can
+    recalibrate — the `plan calibrate` loop needs to know which bench
+    record each one was solved from (``anchor:``) or that it is a
+    documented guess awaiting its first measurement (``prior:``).  The
+    citation rides the assignment line or the comment block directly
+    above it."""
+    drifts: List[Drift] = []
+    for rel in CALIBRATED_FILES:
+        path = os.path.join(root, PKG_NAME, rel.replace("/", os.sep))
+        text = _read(path)
+        if text is None:
+            drifts.append(Drift("calibration", f"{rel} missing",
+                                PKG_NAME + "/" + rel))
+            continue
+        try:
+            tree = ast.parse(text)
+        except SyntaxError as err:
+            drifts.append(Drift("calibration",
+                                f"unparseable {rel}: {err}",
+                                PKG_NAME + "/" + rel))
+            continue
+        lines = text.splitlines()
+        for node in tree.body:
+            if not (isinstance(node, ast.Assign)
+                    and len(node.targets) == 1
+                    and isinstance(node.targets[0], ast.Name)):
+                continue
+            name = node.targets[0].id
+            if not re.fullmatch(r"[A-Z][A-Z0-9_]*", name):
+                continue
+            if not _is_numeric_literal(node.value):
+                continue
+            # trailing comment on the assignment line itself ...
+            cited = bool(_CITE_RE.search(lines[node.lineno - 1]))
+            # ... or anywhere in the contiguous comment block above it
+            i = node.lineno - 2
+            while not cited and i >= 0 and lines[i].lstrip().startswith("#"):
+                cited = bool(_CITE_RE.search(lines[i]))
+                i -= 1
+            if not cited:
+                drifts.append(Drift(
+                    "calibration",
+                    f"pinned coefficient {name} ({rel}:{node.lineno}) "
+                    f"carries no provenance citation — add "
+                    f"'# anchor: BENCH_rNN' (solved from that record) or "
+                    f"'# prior: <rationale>' (documented guess + its "
+                    f"recalibration story)",
+                    PKG_NAME + "/" + rel))
+    return drifts
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -612,6 +703,7 @@ CHECKS = (
     ("record-blocks", check_record_blocks),
     ("child-flags", check_child_flags),
     ("phase-table", check_phase_table),
+    ("calibration", check_calibration),
 )
 
 #: repo-relative path predicates per check — the ``--diff`` scope: a
@@ -629,6 +721,8 @@ CHECK_TRIGGERS = {
     "child-flags": lambda p: p == "bench.py",
     "phase-table": lambda p: p in ("README.md",
                                    PKG_NAME + "/obs/tracer.py"),
+    "calibration": lambda p: p in tuple(PKG_NAME + "/" + rel
+                                        for rel in CALIBRATED_FILES),
 }
 
 

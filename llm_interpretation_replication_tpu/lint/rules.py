@@ -60,6 +60,13 @@ hooks.  The catalogue (also printed by ``lint --explain``):
   (``obs/tracer.KNOWN_PHASES``): a leaked span corrupts the per-thread
   SELF-time stack, and a typo'd phase forks a row outside the
   documented partition.
+
+Rules G09-G11 (guarded-by, lock-order, blocking-under-lock) are NOT in
+this module: they need the whole tree at once — thread roots in serve/
+reach state in utils/ — so they live in :mod:`.threads` as a third
+analysis layer over the same Finding/suppression/baseline machinery.
+Their catalogue rows are in :data:`RULES` below so ``lint --explain``
+covers them.
 """
 
 from __future__ import annotations
@@ -97,6 +104,17 @@ RULES: Dict[str, Tuple[str, str]] = {
     "G08": ("span-hygiene", "tracer spans must be context-managed and "
                             "phase= tags must come from the known phase "
                             "table"),
+    # G09-G11 live in lint/threads.py (the whole-tree concurrency layer),
+    # not in default_rules(): they need every module at once, not one file
+    "G09": ("guarded-by", "shared attribute reached from >=2 thread roots "
+                          "mutated outside its consistently-held lock "
+                          "(incl. non-atomic read-modify-write)"),
+    "G10": ("lock-order", "cycle in the global lock-acquisition ordering "
+                          "graph (or non-reentrant self-reacquisition) — "
+                          "a static deadlock"),
+    "G11": ("blocking-under-lock", "blocking call (sleep, result/join "
+                                   "without timeout=0, network) while "
+                                   "holding a contended lock"),
 }
 
 #: numpy-namespace fetch calls (host materialization of a device value)

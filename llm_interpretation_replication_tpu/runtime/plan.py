@@ -30,14 +30,14 @@ from __future__ import annotations
 import dataclasses
 from typing import Optional, Tuple
 
-HBM_BYTES_V5E = 16 << 30
+HBM_BYTES_V5E = 16 << 30  # prior: v5e device spec (16 GiB HBM)
 #: Head-room XLA/runtime needs beside our tensors (compiled program
 #: buffers, fragmentation, transfer staging).  0.75 GiB separates the
 #: measured-fitting configs from the measured-OOM ones.
-RESERVE_BYTES = 3 << 28
+RESERVE_BYTES = 3 << 28  # anchor: BENCH_r05
 #: Extra head-room the FULL-STUDY (completions) path needs beyond the
 #: reserve before allocator thrash sets in — see resolve_full_sweep_plan.
-THRASH_HEADROOM_BYTES = 1 << 28
+THRASH_HEADROOM_BYTES = 1 << 28  # anchor: BENCH_r05
 
 
 def param_count(cfg) -> int:

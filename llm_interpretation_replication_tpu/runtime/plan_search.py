@@ -68,34 +68,34 @@ from .plan import (
 #: batch at half ceiling.  Solved from the measured BENCH_r05 pair —
 #: 120.15 p/s at batch 320 and 112.0 at batch 256 on identical code:
 #: 169.5 * 320/(320+131.4) = 120.2, * 256/(256+131.4) = 112.0.
-ROWS_CEILING = 169.5
-BATCH_HALF_SAT = 131.4
+ROWS_CEILING = 169.5  # anchor: BENCH_r05
+BATCH_HALF_SAT = 131.4  # anchor: BENCH_r05
 #: Binary-leg equivalents per full-study row, solved against the same
 #: curve from the measured 31.64 rows/s at batch 224:
 #: 169.5 * 224/(224+131.4) / 3.38 = 31.6.  (ROADMAP's ~3.8 figure divides
 #: the BATCH-320 binary rate by the batch-224 full rate and so mixes two
 #: batch efficiencies; the work factor here is batch-controlled.)
-FULL_STUDY_WORK = 3.38
+FULL_STUDY_WORK = 3.38  # anchor: BENCH_r05
 #: Collective overhead per extra tensor-parallel degree (all-reduce per
 #: projection riding ICI — the arxiv 2204.06514 overhead regime; the
 #: MULTICHIP legs are parity runs on virtual CPU devices, so this is a
 #: playbook prior, not a measured v5e number: revisit at the first real
 #: multi-chip bench).
-TP_COMM_PENALTY = 0.07
+TP_COMM_PENALTY = 0.07  # prior: pjit-playbook guess, no multi-chip bench yet
 #: int8 KV dequant-at-the-readers cost (PARITY.md: the quantize/dequant
 #: epilogues are VPU work overlapping the weight streams; small).
-INT8_KV_PENALTY = 0.02
+INT8_KV_PENALTY = 0.02  # prior: PARITY.md overlap argument, unmeasured
 #: Chunked-prefill replay overhead PER EXTRA CHUNK (PR-5: chunked prefill
 #: re-enters the suffix-extension program once per chunk beyond the
 #: first; near-noise at chunk 128 / the 256-token bucket, i.e. one
 #: replay).  Scaling by replay count — not a flat nonzero-chunk tax —
 #: keeps chunk 64 (3 replays at seq 256) from tying chunk 128 (1 replay)
 #: and winning on an arbitrary tie-break.
-CHUNK_PENALTY = 0.01
+CHUNK_PENALTY = 0.01  # prior: replay-count model, unmeasured
 #: Parameter count of the falcon-7b bench geometry the coefficients were
 #: calibrated on; other geometries scale the rate by params ratio (per-row
 #: FLOPs are ~proportional to parameter count in this regime).
-CALIBRATION_PARAMS = 6_921_420_800
+CALIBRATION_PARAMS = 6_921_420_800  # anchor: BENCH_r05
 
 # -- joint next-K-token decode (ISSUE 13 — models/decoder.k_verify_block) ---
 #: Per-position proposal-accept prior for the K-head on this system's
@@ -104,13 +104,13 @@ CALIBRATION_PARAMS = 6_921_420_800
 #: first-int parse; EOS-terminated completions), the K-Forcing regime
 #: (arxiv 2606.10820).  Recalibrate from the first driver bench record's
 #: ``k_decode.accepted_k_hist`` (the block exists for exactly this).
-K_ACCEPT_PRIOR = 0.9
+K_ACCEPT_PRIOR = 0.9  # prior: K-Forcing regime, await accepted_k_hist
 #: Fraction of the full-study per-row work spent in the two decode legs —
 #: what K-decode can touch (Amdahl).  Derived from the phases-block
 #: shape of the r05-era decomposition (decode launches dominate per-row
 #: time after the prefill-side wins); a prior until a K>1 bench record
 #: exists, like the accept prior above.
-K_DECODE_SHARE = 0.55
+K_DECODE_SHARE = 0.55  # prior: r05 phases-block shape, await K>1 record
 #: decode_k values the full-study search enumerates (1 = the sequential
 #: baseline row in the runner-up table).
 DEFAULT_DECODE_KS = (1, 2, 4, 8)
@@ -147,20 +147,20 @@ def k_decode_speedup(decode_k: int, accept: float = K_ACCEPT_PRIOR) -> float:
 #: stderr line: "token lengths mean 104" on the 10k rephrasings at the
 #: sweep tokenizer; the sweep secondary measures its steady state at the
 #: same 104-token point).
-PACKED_QUESTION_TOKENS = 104.0
+PACKED_QUESTION_TOKENS = 104.0  # prior: corpus tokenizer mean, no packed record
 #: Per-ROW shared scaffold tokens an isolated prompt pays once (the format
 #: suffix — the " Answer only 'Yes' or 'No'." texts tokenize to ~16 via
 #: the sweep tokenizer); a packed row pays it once per Q questions.
-PACKED_SHARED_TOKENS = 16.0
+PACKED_SHARED_TOKENS = 16.0  # prior: suffix tokenization count, no packed record
 #: Demonstration-continuation tokens per packed question (scoring/packed.
 #: format_demo: " {answer}.\n\n" plus the answer token — ~12 through the
 #: sweep tokenizer) — the overhead packing pays that isolated rows don't.
-PACKED_DEMO_TOKENS = 12.0
+PACKED_DEMO_TOKENS = 12.0  # prior: format_demo tokenization, no packed record
 #: Throughput the packed path recovers by having NO decode path at all:
 #: the r01-r04 steady-state anchors put the single forward at 38.15 p/s
 #: against the two-phase parity mode's 36.9 — the pooled phase-2 decode
 #: overhead packed rows never pay.  38.15 / 36.9 = 1.034.
-PACKED_NO_DECODE_GAIN = 1.034
+PACKED_NO_DECODE_GAIN = 1.034  # anchor: BENCH_r01
 #: Packing factors the search enumerates (1 shows the demo-overhead
 #: tradeoff in the runner-up table; the attention transient's quadratic
 #: growth in the packed row length prices out large Q on its own).
@@ -171,7 +171,7 @@ DEFAULT_PACKINGS = (1, 2, 4, 8)
 #: covers it (no measured OOM boundary exists yet for this workload;
 #: recalibrate from the first real packed bench the way
 #: BINARY_SWEEP_HEADROOM_BYTES was).
-PACKED_SWEEP_HEADROOM_BYTES = 1 << 28
+PACKED_SWEEP_HEADROOM_BYTES = 1 << 28  # prior: no measured packed OOM boundary
 
 
 def packed_seq_tokens(packing: int,
@@ -192,7 +192,7 @@ def packed_seq_tokens(packing: int,
 #: inside the naive weights+scores+activations sum.  1.75 GiB is
 #: calibrated so the model reproduces that exact boundary (fits 320,
 #: rejects 352); anchor-pinned in tests like every other coefficient.
-BINARY_SWEEP_HEADROOM_BYTES = 7 << 28
+BINARY_SWEEP_HEADROOM_BYTES = 7 << 28  # anchor: BENCH_r05
 
 # ---------------------------------------------------------------------------
 # Candidate space defaults

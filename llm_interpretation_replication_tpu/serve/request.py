@@ -155,6 +155,7 @@ class ScoreFuture:
 
     def exception(self, timeout: Optional[float] = None
                   ) -> Optional[BaseException]:
+        # graftlint: disable=G11 the in-tree callers (pool reap / supervisor orphan sweep) enter with the router lock held but only ever on done() futures and with timeout=0 — the event wait returns without blocking
         if not self._event.wait(timeout):
             raise TimeoutError("score request still pending")
         return self._err

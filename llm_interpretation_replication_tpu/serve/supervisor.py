@@ -430,7 +430,12 @@ class ReplicaSupervisor:
             factory = self._factories.get(model)
             self.untrack(replica.rid)
         if factory is None or births >= self.config.max_rebuilds:
-            self.permanent_quarantines += 1
+            with self.pool._wake:
+                # supervisor counters ride the POOL's lock (class
+                # docstring): this worker thread races stats() readers
+                # and sibling rebuild workers on the same field
+                # (G09 serve/supervisor.py 'self.permanent_quarantines += 1')
+                self.permanent_quarantines += 1
             record_fault(
                 "pool_replica_quarantined", replica=replica.rid,
                 model=model, rebuilds=births, permanent=True,
@@ -462,16 +467,19 @@ class ReplicaSupervisor:
         except Exception as err:  # graftlint: disable=G05 rebuild must never crash the supervisor: a failed factory (pool closed, OOM on reload) downgrades to permanent quarantine, recorded below
             if replica.share_group is not None:
                 replica.share_group.release_one()
-            self.permanent_quarantines += 1
+            with self.pool._wake:
+                self.permanent_quarantines += 1
             record_fault("pool_replica_quarantined", replica=replica.rid,
                          model=model, rebuilds=births, permanent=True,
                          reason=f"rebuild failed: {str(err)[:120]}")
             return
         with self.pool._wake:
             self._lineage[new.rid] = births + 1
-        self.restarts += 1
-        incident["restart_ms"] = round(
-            (self._clock() - t0) * 1000.0, 3)
+            # restarts and the incident row are pool-lock-guarded state
+            # too: stats() snapshots both while this worker finishes
+            self.restarts += 1
+            incident["restart_ms"] = round(
+                (self._clock() - t0) * 1000.0, 3)
         _labeled_counter("pool_replica_restarts",
                          {"replica": new.rid, "model": model})
 
@@ -619,5 +627,9 @@ class ReplicaSupervisor:
     def stop(self, timeout: float = 5.0) -> None:
         self._stop.set()
         self._thread.join(timeout=timeout)
-        for worker in self._workers:
+        with self.pool._wake:
+            # snapshot under the pool lock: _quarantine_locked appends
+            # rebuild workers to this list from the router thread
+            workers = list(self._workers)
+        for worker in workers:
             worker.join(timeout=timeout)

@@ -40,9 +40,13 @@ class SessionLogger:
     __call__ = log
 
     def close(self) -> None:
-        if self._file:
-            self._file.close()
-            self._file = None
+        # same guard as log(): the scheduler thread may be mid-write when
+        # the owning harness closes the session (G09 utils/logging.py
+        # 'self._file = None' — close raced the guarded writer)
+        with self._lock:
+            if self._file:
+                self._file.close()
+                self._file = None
 
 
 class Progress:

@@ -32,6 +32,15 @@ _FAULT_EVENTS: List[Dict] = []
 _FAULT_EVENTS_CAP = 1000
 _FAULT_LISTENERS: List = []   # called with each event as it is recorded —
                               # the obs/ flight recorder's trigger path
+# Faults are recorded from the scheduler loop, supervisor workers,
+# watchdogs, AND API callers at once (graftlint G09 fingerprints
+# 'G09/utils/telemetry.py/_FAULT_EVENTS.append(event)' and the
+# listener check-then-append): the append+trim pair and the listener
+# list need one lock.  Listeners are invoked OUTSIDE it — a listener
+# that blocks (flight-recorder dump) must never stall every other
+# fault-recording thread, and holding a telemetry lock into listener
+# code would mint a telemetry->flight lock-order edge (G10).
+_FAULTS_LOCK = threading.Lock()
 
 #: The CLOSED registry of fault-event kinds.  Every ``record_fault``
 #: literal in the codebase must name a member (graftlint G06 enforces
@@ -58,24 +67,30 @@ def add_fault_listener(fn) -> None:
     (idempotent per callable).  Listeners must be fast and must not
     raise; a raising listener is swallowed so the fault path — which is
     already handling an error — can never be broken by its observer."""
-    if fn not in _FAULT_LISTENERS:
-        _FAULT_LISTENERS.append(fn)
+    with _FAULTS_LOCK:
+        # check-then-append must be one atomic step, or two threads
+        # registering the same listener double-deliver every event
+        if fn not in _FAULT_LISTENERS:
+            _FAULT_LISTENERS.append(fn)
 
 
 def remove_fault_listener(fn) -> None:
-    try:
-        _FAULT_LISTENERS.remove(fn)
-    except ValueError:
-        pass
+    with _FAULTS_LOCK:
+        try:
+            _FAULT_LISTENERS.remove(fn)
+        except ValueError:
+            pass
 
 
 def record_fault(kind: str, **info) -> Dict:
     """Append one fault-recovery event ({kind, time, **info}); returns it."""
     event = {"kind": str(kind), "time": time.time(), **info}
-    _FAULT_EVENTS.append(event)
-    if len(_FAULT_EVENTS) > _FAULT_EVENTS_CAP:
-        del _FAULT_EVENTS[: len(_FAULT_EVENTS) - _FAULT_EVENTS_CAP]
-    for fn in list(_FAULT_LISTENERS):
+    with _FAULTS_LOCK:
+        _FAULT_EVENTS.append(event)
+        if len(_FAULT_EVENTS) > _FAULT_EVENTS_CAP:
+            del _FAULT_EVENTS[: len(_FAULT_EVENTS) - _FAULT_EVENTS_CAP]
+        listeners = list(_FAULT_LISTENERS)
+    for fn in listeners:    # outside the lock: see _FAULTS_LOCK comment
         try:
             fn(event)
         except Exception:  # a listener can never break the fault path
@@ -85,13 +100,15 @@ def record_fault(kind: str, **info) -> Dict:
 
 def fault_events(kind: Optional[str] = None) -> List[Dict]:
     """Recorded fault events, newest last (optionally filtered by kind)."""
-    if kind is None:
-        return list(_FAULT_EVENTS)
-    return [e for e in _FAULT_EVENTS if e["kind"] == kind]
+    with _FAULTS_LOCK:
+        if kind is None:
+            return list(_FAULT_EVENTS)
+        return [e for e in _FAULT_EVENTS if e["kind"] == kind]
 
 
 def clear_fault_events() -> None:
-    _FAULT_EVENTS.clear()
+    with _FAULTS_LOCK:
+        _FAULT_EVENTS.clear()
 
 
 # ---------------------------------------------------------------------------

@@ -44,6 +44,17 @@ PYEOF
 llm-interp-tpu --help >/dev/null
 echo "console entry point ok"
 
+echo "== certify_install: static-analysis gates from the venv"
+# the three lint entry points a CI hook runs, executed from the fresh
+# install: the repo gate (G01-G11 incl. the whole-tree thread model),
+# the cross-artifact contracts layer, and the cheap changed-files mode
+# (must exit 0 on a clean tree even when the diff is empty)
+cd "$REPO"
+python -m llm_interpretation_replication_tpu lint
+python -m llm_interpretation_replication_tpu lint contracts
+python -m llm_interpretation_replication_tpu lint --diff
+python -m llm_interpretation_replication_tpu lint contracts --diff
+
 echo "== certify_install: tier-1 smoke (-m '$SMOKE_MARKER')"
 cd "$REPO/tests"
 JAX_PLATFORMS=cpu python -m pytest -q -m "$SMOKE_MARKER" \
