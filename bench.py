@@ -761,7 +761,9 @@ def run_sweep_mode(args, cfg, params):
         rep_report = replay(
             engine, all_prompts, targets=all_targets,
             config=SchedulerConfig(max_batch=args.sweep_batch,
-                                   queue_capacity=max(4096, n_total)),
+                                   queue_capacity=max(4096, n_total),
+                                   slot_admission=not getattr(
+                                       args, "no_slot_admission", False)),
             # compare scoring against scoring: the serve pass has no
             # row-building/xlsx tail, so the offline side is the best
             # repeat's SCORING time, not its e2e wall clock
@@ -803,7 +805,9 @@ def run_sweep_mode(args, cfg, params):
             config=SchedulerConfig(
                 max_batch=args.sweep_batch,
                 queue_capacity=max(
-                    4096, int(max(rates) * args.serve_load_duration * 2))),
+                    4096, int(max(rates) * args.serve_load_duration * 2)),
+                slot_admission=not getattr(
+                    args, "no_slot_admission", False)),
             offline_rows=last_rows, closed_comparator=True)
         args.serve_load_report = load_block
         print(serve_load_mod.format_rate_table(load_block),
@@ -890,7 +894,8 @@ def _serve_load_pool_secondary(args, engine, prompts, targets,
     sched_cfg = SchedulerConfig(
         max_batch=args.sweep_batch,
         queue_capacity=max(4096,
-                           int(max(rates) * args.serve_load_duration * 2)))
+                           int(max(rates) * args.serve_load_duration * 2)),
+        slot_admission=not getattr(args, "no_slot_admission", False))
     try:
         plan = replica_plan(engine.cfg, args.quant, 1, workload="binary",
                             batches=(args.sweep_batch,),
@@ -943,6 +948,47 @@ def _serve_load_pool_secondary(args, engine, prompts, targets,
         configurations.append(measure(pool, "multi-model"))
     finally:
         pool.close()
+    roles_spec = getattr(args, "serve_load_roles", "") or ""
+    if roles_spec:
+        # Disaggregated roster (ISSUE 20): prefill:N,decode:M specialist
+        # replicas over REAL mesh slices (parallel/mesh.carve_slices —
+        # degenerate shared placement on the CPU harness, and the
+        # replica health docs say which), measured through the SAME rate
+        # sweep so its knee lands next to the symmetric roster at equal
+        # replica count.  Offline rows stay the parity reference: the
+        # cross-replica KV handoff moves WHERE decode runs, never WHAT.
+        from llm_interpretation_replication_tpu.parallel import (
+            mesh as mesh_mod,
+        )
+
+        roster = _parse_roles_spec(roles_spec)
+        total = sum(roster.values())
+        slices = mesh_mod.carve_slices(total)
+        pool = EnginePool(PoolConfig(scheduler=sched_cfg))
+        try:
+            idx = 0
+            for role, count in roster.items():
+                for _ in range(count):
+                    try:
+                        rplan = replica_plan(
+                            engine.cfg, args.quant, len(slices[idx]),
+                            workload="binary",
+                            batches=(args.sweep_batch,),
+                            attention_impl=getattr(args, "attn", "xla"),
+                            role=role)
+                        note = rplan.reason if rplan is not None else None
+                    except (ValueError, AttributeError, TypeError):
+                        note = None
+                    pool.load(args.model, sibling(), owns_engine=False,
+                              plan_note=note, role=role,
+                              devices=slices[idx])
+                    idx += 1
+            tag = ",".join(f"{r}:{c}" for r, c in roster.items())
+            entry = measure(pool, f"roles-{tag}")
+            entry["roles"] = dict(roster)
+            configurations.append(entry)
+        finally:
+            pool.close()
     out = {"replicas": n, "configurations": configurations}
     if getattr(args, "serve_load_faults", ""):
         # fleet self-healing under injected faults (ISSUE 16): a THIRD,
@@ -957,6 +1003,29 @@ def _serve_load_pool_secondary(args, engine, prompts, targets,
             sibling, sched_cfg)
         configurations.append(entry)
         out["recovery"] = entry["recovery"]
+    return out
+
+
+def _parse_roles_spec(spec):
+    """``'prefill:2,decode:2'`` -> ``{"prefill": 2, "decode": 2}``; both
+    roles required with counts >= 1 (a fleet missing either role is not
+    disaggregated — the symmetric roster already measures that)."""
+    out = {}
+    for part in str(spec).split(","):
+        part = part.strip()
+        if not part:
+            continue
+        role, _, count = part.partition(":")
+        role = role.strip().lower()
+        if role not in ("prefill", "decode"):
+            raise ValueError(
+                f"unknown role {role!r} in --serve-load-roles "
+                f"(expected prefill|decode)")
+        out[role] = int(count or 0)
+    if out.get("prefill", 0) < 1 or out.get("decode", 0) < 1:
+        raise ValueError(
+            "--serve-load-roles needs both roles with counts >= 1, "
+            "e.g. 'prefill:1,decode:1'")
     return out
 
 
@@ -2385,6 +2454,27 @@ def main():
                              "latency, requests failed-over vs lost "
                              "(lost must be 0).  Example: "
                              "'kill@1.0,wedge@2.5,vendor@0'")
+    parser.add_argument("--serve-load-roles", metavar="prefill:N,decode:M",
+                        default="",
+                        help="--serve-load pool companion: also measure a "
+                             "DISAGGREGATED roster — N prefill-specialist "
+                             "replicas (chunked prefill + position-0 "
+                             "scan, finished KV slabs handed off) and M "
+                             "decode-specialist replicas (slot rings fed "
+                             "by imported slabs) of the sweep snapshot, "
+                             "through the SAME rate sweep, as an extra "
+                             "'serve_load_pool' configuration tagged with "
+                             "its role composition.  Compare its knee "
+                             "against the symmetric single-model-x(N+M) "
+                             "roster at equal replica count (obs "
+                             "bench-diff aligns rosters by role tag).  "
+                             "Empty = symmetric rosters only")
+    parser.add_argument("--no-slot-admission", action="store_true",
+                        help="serve legs: disable slot-level mid-decode "
+                             "admission (SchedulerConfig.slot_admission, "
+                             "default ON since replay bit-parity was "
+                             "pinned) and launch only at coalescer "
+                             "boundaries — the A/B escape hatch")
     parser.add_argument("--strict", action="store_true",
                         help="arm strict mode (runtime/strict.py, same as "
                              "LLM_INTERP_STRICT=1): transfer-guard the "
@@ -2501,6 +2591,18 @@ def main():
         if len(rates) < 3:
             parser.error("--serve-load-rates needs >= 3 offered rates "
                          "to bracket a knee (or 'auto')")
+    if getattr(args, "serve_load_roles", ""):
+        if not args.serve_load:
+            parser.error("--serve-load-roles is a --serve-load pool "
+                         "configuration; add --serve-load")
+        if getattr(args, "serve_load_replicas", 0) <= 1:
+            parser.error("--serve-load-roles rides the pool companion; "
+                         "--serve-load-replicas must be >= 2 so the "
+                         "symmetric roster exists to compare against")
+        try:
+            _parse_roles_spec(args.serve_load_roles)  # fail fast, not
+        except ValueError as err:                     # after the sweep
+            parser.error(str(err))
 
     import jax
     import jax.numpy as jnp

@@ -1484,6 +1484,13 @@ def main(argv=None):
                    help="default per-request deadline (expired requests "
                         "are rejected with a typed DeadlineExceeded, "
                         "never silently dropped)")
+    p.add_argument("--no-slot-admission", action="store_true",
+                   help="disable slot-level mid-decode admission "
+                        "(SchedulerConfig.slot_admission, default ON "
+                        "since replay bit-parity was pinned): eligible "
+                        "requests launch only at coalescer boundaries "
+                        "instead of refilling vacated decode slots — "
+                        "the A/B escape hatch")
     p.add_argument("--metrics-port", type=int, default=0, metavar="PORT",
                    help="host /metrics (Prometheus text exposition over "
                         "the telemetry counters + serve sample-ring "

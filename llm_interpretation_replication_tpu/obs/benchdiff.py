@@ -64,14 +64,15 @@ _HARD_ZERO_UNITS = ("lost-requests",)
 #:
 #: blocks :func:`flatten_metrics` aligns into verdict/informational rows:
 ALIGNED_BLOCKS = ("secondary", "brackets", "packed", "k_decode",
-                  "occupancy", "serve_load", "recovery")
+                  "occupancy", "serve_load", "serve_load_pool",
+                  "recovery")
 #: blocks :func:`diff_records` reads as cross-round context tables:
 CONTEXT_BLOCKS = ("context", "phases")
 #: blocks deliberately NOT aligned (free-form diagnostics whose shape is
 #: owned by their producer; listed so the classification is a conscious
 #: decision, not an omission):
 INFORMATIONAL_BLOCKS = ("strict", "plan_search", "packed_drift", "serve",
-                        "serve_load_pool", "repeats")
+                        "repeats")
 
 
 def load_bench_record(path: str) -> Dict:
@@ -195,6 +196,7 @@ def flatten_metrics(rec: Dict) -> Dict[str, Dict]:
         for key, row in _occupancy_rows(holder).items():
             out.setdefault(key, row)
     out.update(_serve_load_rows(rec))
+    out.update(_serve_pool_rows(rec))
     out.update(_recovery_rows(rec))
     return out
 
@@ -296,6 +298,68 @@ def _serve_load_rows(rec: Dict) -> Dict[str, Dict]:
         out["serve-load saturation [rows/sec]"] = {
             "value": block["saturation_rows_per_s"], "unit": "rows/sec",
             "metric": "serve load saturation throughput"}
+    return out
+
+
+def _pool_roster_tag(entry: Dict) -> str:
+    """Cross-round identity of one ``serve_load_pool`` configuration.
+
+    Keyed by ROLE COMPOSITION, not the free-text name: a disaggregated
+    roster tags itself ``prefill:N,decode:M`` (sorted so spelling order
+    in the flag never splits the series) and compares only with rosters
+    of the same composition; symmetric rosters key as ``symmetric-xN``
+    by replica count — so the ISSUE-20 knee-vs-knee comparison
+    (disaggregated vs symmetric at equal chips) lands as two adjacent
+    verdict rows instead of one mis-aligned one."""
+    roles = entry.get("roles")
+    if isinstance(roles, dict) and roles:
+        return ",".join(f"{r}:{roles[r]}" for r in sorted(roles,
+                                                          reverse=True))
+    name = str(entry.get("name", ""))
+    n = len(entry.get("replicas", ()) or ())
+    if name.startswith("single-model"):
+        return f"symmetric-x{n}" if n else name
+    return name or f"symmetric-x{n}"
+
+
+def _serve_pool_rows(rec: Dict) -> Dict[str, Dict]:
+    """Aligned rows from a record's ``serve_load_pool`` block (ISSUE 12
+    fleet, ISSUE 20 roles): per roster configuration — keyed by
+    :func:`_pool_roster_tag` — the saturation throughput
+    (higher-better ``rows/sec``: the roster's knee) and the p99 e2e
+    latency at the TOP swept rate (lower-better ``ms``), with the
+    replica count riding along informationally so a knee move is
+    explainable by a fleet-size change in place."""
+    block = rec.get("serve_load_pool")
+    if not isinstance(block, dict):
+        return {}
+    out: Dict[str, Dict] = {}
+    for entry in block.get("configurations", ()) or ():
+        if not isinstance(entry, dict):
+            continue
+        tag = _pool_roster_tag(entry)
+        sl = entry.get("serve_load")
+        if not isinstance(sl, dict):
+            continue
+        if sl.get("saturation_rows_per_s") is not None:
+            out[f"pool[{tag}] saturation [rows/sec]"] = {
+                "value": sl["saturation_rows_per_s"], "unit": "rows/sec",
+                "metric": f"pool roster {tag} saturation throughput "
+                          f"(knee of the rate sweep)"}
+        points = sl.get("rates", ()) or ()
+        if points:
+            p99 = (points[-1].get("latency_ms") or {}).get("p99")
+            if p99 is not None:
+                out[f"pool[{tag}] p99@top [ms]"] = {
+                    "value": p99, "unit": "ms",
+                    "metric": f"pool roster {tag} p99 e2e latency at "
+                              f"the top swept rate"}
+        n = len(entry.get("replicas", ()) or ())
+        if n:
+            out[f"pool[{tag}] replicas"] = {
+                "value": n, "unit": "",
+                "metric": f"pool roster {tag} replica count "
+                          f"(informational)"}
     return out
 
 

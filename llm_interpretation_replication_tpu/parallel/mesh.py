@@ -79,6 +79,63 @@ def enumerate_mesh_shapes(
     return tuple(sorted(set(shapes), key=lambda s: (-s[0], s[1], s[2])))
 
 
+def carve_slices(
+    n_slices: Optional[int] = None,
+    devices: Optional[Sequence] = None,
+    counts: Optional[Sequence[int]] = None,
+) -> Tuple[Tuple, ...]:
+    """Partition the pod's device list into per-replica slices.
+
+    The EnginePool (serve/pool.py) calls this once per roster so each
+    replica binds a :func:`make_mesh` over ITS slice instead of the whole
+    device view — contiguous runs, because ICI neighbors stay neighbors
+    inside a contiguous block and a replica's collectives should never
+    straddle another replica's chips.  Two spellings:
+
+    - ``counts=(4, 2, 2)`` — explicit per-slice chip counts for a
+      heterogeneous roster (the disaggregated prefill/decode fleet gives
+      prefill replicas wider slices than decode replicas); must sum to
+      the device count.
+    - ``n_slices=N`` — N equal slices; the device count must divide.
+
+    When there are FEWER devices than slices (the CPU harness: one host
+    device, many replicas) every slice degenerates to the full device
+    list — shared placement, exactly the pre-slice behavior.  This keeps
+    the two-role pool runnable on the CPU harness; the record's
+    ``placement`` field says ``shared`` so nobody mistakes it for real
+    disaggregation.
+    """
+    devices = tuple(devices if devices is not None else jax.devices())
+    n = len(devices)
+    if counts is not None:
+        counts = tuple(int(c) for c in counts)
+        if n_slices is not None and len(counts) != n_slices:
+            raise ValueError(
+                f"counts has {len(counts)} entries for n_slices={n_slices}")
+        if any(c < 1 for c in counts):
+            raise ValueError(f"every slice needs >= 1 device, got {counts}")
+        if n < len(counts):
+            return tuple(devices for _ in counts)
+        if sum(counts) != n:
+            raise ValueError(
+                f"counts {counts} sum to {sum(counts)}, not {n} devices")
+        out, at = [], 0
+        for c in counts:
+            out.append(devices[at:at + c])
+            at += c
+        return tuple(out)
+    if n_slices is None or n_slices < 1:
+        raise ValueError(f"n_slices must be >= 1, got {n_slices}")
+    if n < n_slices:
+        return tuple(devices for _ in range(n_slices))
+    if n % n_slices:
+        raise ValueError(
+            f"{n} devices not divisible into {n_slices} equal slices; "
+            f"pass counts= for a heterogeneous split")
+    per = n // n_slices
+    return tuple(devices[i * per:(i + 1) * per] for i in range(n_slices))
+
+
 def mesh_shape_for(n_devices: int, want_model: int = 1, want_seq: int = 1) -> Tuple[int, int, int]:
     """Largest data axis given desired model/seq parallelism, shrinking model
     then seq until they divide the device count."""

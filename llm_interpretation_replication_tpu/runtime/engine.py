@@ -522,6 +522,44 @@ class ScoringEngine:
             jnp.asarray(arr), NamedSharding(self.mesh, P(DATA_AXIS, *([None] * (arr.ndim - 1))))
         )
 
+    def _put_replicated(self, arr):
+        """Place an array replicated on this engine's mesh slice (plain
+        ``jnp.asarray`` off-mesh) — the KV-slab import placement: slab
+        rows arrive in whatever row count the exporter batched, which
+        need not divide the slice's data axis, so batch-sharding is not
+        an option and the cache rides replicated like the params."""
+        if self.mesh is None:
+            return jnp.asarray(arr)
+        from ..parallel import mesh as mesh_mod
+
+        return jax.device_put(jnp.asarray(arr), mesh_mod.replicated(self.mesh))
+
+    def bind_mesh(self, mesh) -> "ScoringEngine":
+        """Bind this engine to a device-mesh SLICE (the per-replica
+        placement of the disaggregated fleet — serve/pool.py carves the
+        pod via :func:`..parallel.mesh.carve_slices` and hands each
+        replica its own mesh).  Re-places the param tree (and K-head, if
+        loaded) replicated over the slice and clears the generation-plan
+        cache so every later launch compiles against the new placement.
+
+        Placement is a COPY when the slice differs from the params'
+        current devices: a ``ParamShareGroup`` sibling bound to a
+        different slice stops sharing HBM with its donor — that is the
+        point (each replica owns its chips), but rosters that want
+        zero-copy sharing must keep siblings on one slice.  Returns
+        ``self`` so ``pool.load(model, engine.bind_mesh(m))`` reads
+        naturally."""
+        from ..parallel import mesh as mesh_mod
+
+        self.mesh = mesh
+        sharding = mesh_mod.replicated(mesh)
+        self.params = jax.device_put(self.params, sharding)
+        if getattr(self, "k_head", None) is not None:
+            self.k_head = jax.device_put(self.k_head, sharding)
+        self._plan_cache.clear()
+        record_counter("replica_mesh_bound")
+        return self
+
     def _run_pipelined(self, batches: Iterable, launch: Callable,
                        consume: Callable, rebatch: Optional[Callable] = None):
         """Launch device programs up to ``pipeline_depth`` ahead of host-side
@@ -2227,12 +2265,177 @@ class ScoringEngine:
                 (f3[0:1], f3[1:2], f3[2:3]), 0)
             results[int(r.meta["orig"])] = row
 
+    def export_kv_slab(
+        self,
+        prompts: Sequence,
+        targets: Sequence = ("Yes", "No"),
+    ):
+        """Prefill-specialist half of the cross-replica KV handoff
+        (ROADMAP item 1b).  Runs the same prefill + position-0 scan as
+        :meth:`score_prompts_slotted`, but instead of decoding the
+        undecided rows HERE, it gathers them and materializes one host
+        :class:`~.slots.KVSlab` per prefill batch for a decode-specialist
+        replica to import (:meth:`decode_kv_slabs`).
+
+        Returns ``(rows, slabs)``: ``rows`` is per-prompt results with
+        the position-0-decided rows already resolved and ``None`` at
+        every index that shipped out in a slab; each slab's metas carry
+        ``{"orig": prompt index, "first3": ...}`` so the caller can map
+        decode-side rows back.  The union of resolved rows and slab-
+        decoded rows is bit-identical to a single-replica
+        ``score_prompts_slotted`` call over the same prompts (PARITY.md
+        "Cross-replica KV handoff") — same prefill program, same
+        position-0 resolution, and the slab round-trip moves bytes, not
+        values."""
+        self._check_open()
+        if self.is_encoder_decoder:
+            raise ValueError("KV slab export is decoder-only (T5 has no "
+                             "decoder-side prompt cache to hand off)")
+        ecfg = self.ecfg
+        results: List[Optional[Dict]] = [None] * len(prompts)
+        slabs: List[slots_mod.KVSlab] = []
+        ids_all = self._target_id_rows(prompts, targets)
+        with obs.span("encode_prompts", phase="host_tokenize",
+                      prompts=len(prompts)):
+            encoded = batching.encode_prompts(self.tokenizer, list(prompts))
+        with strict.scoring_guard(type(self).__name__):
+            with strict.sanctioned_fetch():
+                for batch in batching.batches_for_prompts(
+                        encoded, ecfg.batch_size, ecfg.buckets,
+                        pad_id=self.tokenizer.pad_token_id or 0,
+                        length_sorted=ecfg.length_sorted_batches):
+                    out = _prefill_select(
+                        self.params, self.cfg, self._put(batch.token_ids),
+                        self._put(batch.attention_mask),
+                        jnp.asarray(batch.indices >= 0),
+                        self._batch_target_rows(ids_all, batch)[:, 0],
+                        self._batch_target_rows(ids_all, batch)[:, 1],
+                        cache_len=batch.bucket_len,
+                        slice_m=int(batch.token_ids.shape[0]),
+                        top_k=ecfg.top_k,
+                        top_filter=ecfg.first_token_top_filter,
+                        out_len=_pool_len(batch.bucket_len),
+                    )
+                    scan0, first3, sel, sub_cache, last_s, len_s = out
+                    yes0, no0, rel0, odds0, hit0 = (np.asarray(a)
+                                                    for a in scan0)
+                    first3 = tuple(np.asarray(a) for a in first3)
+                    row_ids = self._batch_target_rows(ids_all, batch)
+                    valid = batch.indices >= 0
+                    undecided = np.flatnonzero(~hit0 & valid)
+                    sel_np = np.asarray(sel)
+                    for r, orig in enumerate(batch.indices):
+                        if orig >= 0 and hit0[r]:
+                            results[int(orig)] = _attach_first_token(
+                                _result_row(yes0[r], no0[r], rel0[r],
+                                            odds0[r], True, ""), first3, r)
+                    if undecided.size:
+                        count = undecided.size
+                        idx = jnp.asarray(np.arange(count, dtype=np.int32))
+                        sub = slots_mod._gather_ring_rows(sub_cache, idx)
+                        mapped = sel_np[:count]
+                        metas = [
+                            {"orig": int(batch.indices[m]),
+                             "first3": np.asarray([first3[0][m],
+                                                   first3[1][m],
+                                                   first3[2][m]])}
+                            for m in mapped]
+                        slabs.append(slots_mod.slab_from_device(
+                            sub, last_s[idx], len_s[idx],
+                            row_ids[mapped], metas))
+        if slabs:
+            slots_mod.slot_counter(
+                "slot_slab_export_rows", sum(s.rows() for s in slabs),
+                "binary", "serve")
+            record_counter("slab_export_bytes",
+                           sum(s.nbytes() for s in slabs))
+        return results, slabs
+
+    def decode_kv_slabs(
+        self,
+        slabs: Sequence,
+        admit_fn: Optional[Callable] = None,
+    ) -> List[Dict]:
+        """Decode-specialist half of the cross-replica KV handoff: import
+        host :class:`~.slots.KVSlab`\\ s straight into a slot ring's
+        pending queue and run the scored decode to retirement — no
+        prompt text, no prefill, just near-full decode lanes (ROADMAP
+        item 1b's occupancy goal).
+
+        Returns one result row per slab row in FLAT FEED ORDER (slabs in
+        the given order, rows in each slab's meta order) — the caller
+        maps back to its requests via the slab metas' ``orig`` indices.
+        ``admit_fn()`` may return MORE slabs between decode chunks (the
+        mid-decode admission hook, same shape as
+        :meth:`score_prompts_slotted`'s), so a decode replica's lanes
+        refill from the fleet's handoff queue without draining first.
+        Rows are bit-identical to the exporting replica decoding its own
+        cache (PARITY.md "Cross-replica KV handoff")."""
+        self._check_open()
+        if self.is_encoder_decoder:
+            raise ValueError("KV slab decode is decoder-only")
+        ecfg = self.ecfg
+        eos_id = getattr(self.tokenizer, "eos_token_id", None)
+        steps, _ = self._gen_plan(None, False)
+        results: List[Optional[Dict]] = []
+
+        def emit(rows):
+            self._emit_scored_slot_rows(rows, steps, eos_id, results)
+
+        ring = slots_mod.SlotRing(
+            self, steps=steps, eos_id=eos_id,
+            capacity=ecfg.phase2_pool_target or ecfg.batch_size,
+            leg="binary", workload="serve",
+            retire=_binary_retire, emit=emit,
+            batch_review=self._binary_batch_review(steps, eos_id),
+            pad_slice=lambda n: _pad_slice(n, max(n, 1)),
+        )
+
+        def feed_slab(slab):
+            base = len(results)
+            results.extend([None] * slab.rows())
+            cache, last, lens, row_ids, metas = slots_mod.slab_to_device(
+                slab, self._put_replicated)
+            # re-key to LOCAL result indices; the exporter's orig stays
+            # on the slab for the caller's request mapping
+            local = [{"orig": base + i, "first3": m["first3"]}
+                     for i, m in enumerate(metas)]
+            ring.feed(cache, last, lens, row_ids, local)
+            slots_mod.slot_counter("slot_slab_import_rows", slab.rows(),
+                                   "binary", "serve")
+
+        def refill_hook(n_free):
+            if admit_fn is None:
+                return False
+            more = admit_fn()
+            if not more:
+                return False
+            for slab in more:
+                feed_slab(slab)
+            return True
+
+        ring.refill_hook = refill_hook
+        with strict.scoring_guard(type(self).__name__):
+            with strict.sanctioned_fetch():
+                for slab in slabs:
+                    feed_slab(slab)
+                ring.drain()
+                # post-drain admission window, same contract as the
+                # slotted path: slabs that arrived during the last chunk
+                # are not orphaned
+                while admit_fn is not None and refill_hook(0):
+                    ring.drain()
+        self.record_occupancy(ring.stats)
+        return [r if r is not None else _error_row("missing")
+                for r in results]
+
     def packed_autoregressive_demos(
         self,
         prompts: Sequence[str],
         packing: int,
         max_demo_tokens: int = 8,
         repack: Optional[bool] = None,
+        extend_stages: bool = True,
     ):
         """Auto-Demo's AUTOREGRESSIVE demonstrations (the PR-10 follow-up)
         through decode-then-repack: each pack builds stage by stage —
@@ -2253,7 +2456,21 @@ class ScoringEngine:
         ``repack=False`` runs the same stages whole-flush (slots only
         fill when the ring is empty) — the legacy comparator the parity
         suite pins; demos are per-row pure either way, so the two modes
-        emit identical texts."""
+        emit identical texts.
+
+        ``extend_stages`` (default ON — the PR-10/14 follow-up): a grown
+        pack EXTENDS its previous stage's pristine prefill cache by just
+        the (formatted demo + next question) suffix via
+        :func:`models.decoder.extend_prefill`, instead of re-prefilling
+        the whole grown pack — stage k's prefill cost drops from
+        O(pack-so-far) to O(suffix).  The ring's decoded-token K/V is
+        NOT reusable (the grown pack appends the re-tokenized FORMATTED
+        demo, different tokens than the raw decode), so each stage pins
+        its prefill-only cache until its demo emits — the HBM-for-FLOPs
+        trade this flag names.  ``extend_stages=False`` is the legacy
+        re-prefill comparator; both spellings compute the same positions
+        over the same real tokens, so packs and demos are pinned
+        identical across them."""
         from ..scoring import packed as packed_mod
 
         self._check_open()
@@ -2283,10 +2500,15 @@ class ScoringEngine:
                          for k, e in zip(keys, enc)}
         demos: List[List[Optional[str]]] = [
             [None] * len(g) for g in groups]
-        # stage items: (pack_idx, question_idx, ids_so_far) — question_idx
-        # is the question whose demo the slot decodes next
+        use_extend = bool(extend_stages)
+        # stage items: (pack_idx, question_idx, ids_so_far, src, suffix) —
+        # question_idx is the question whose demo the slot decodes next;
+        # src is None (fresh full prefill of ids_so_far) or the previous
+        # stage's pristine (cache, row, prefix_len), in which case suffix
+        # is the token-id tail (formatted demo + next question) to extend
+        # that cache with
         stage_ready: List = [
-            (gi, 0, [int(t) for t in first_ids[gi]])
+            (gi, 0, [int(t) for t in first_ids[gi]], None, None)
             for gi, g in enumerate(groups) if len(g) > 1]
         steps = max(1, int(max_demo_tokens))
 
@@ -2306,15 +2528,20 @@ class ScoringEngine:
                 # the grown pack carries the FORMATTED demo (the same
                 # spelling encode_packs tokenizes), so the autoregressive
                 # context matches the pack score_packed will prefill
-                demo_ids = (self.tokenizer(
+                demo_ids = [int(t) for t in (self.tokenizer(
                     packed_mod.format_demo(text),
                     add_special_tokens=False)["input_ids"]
-                    if text else [])
-                grown = r.meta["ids"] + [int(t) for t in demo_ids]
+                    if text else [])]
+                grown = r.meta["ids"] + demo_ids
                 if qi + 1 < len(groups[gi]) - 1:
                     # the NEXT question needs a demo too: re-enter pending
+                    suffix = demo_ids + list(later[(gi, qi + 1)])
+                    src = r.meta.get("src") if use_extend else None
+                    if src is None or not suffix:
+                        src = suffix = None
                     stage_ready.append(
-                        (gi, qi + 1, grown + list(later[(gi, qi + 1)])))
+                        (gi, qi + 1, grown + list(later[(gi, qi + 1)]),
+                         src, suffix))
 
         ring = slots_mod.SlotRing(
             self, steps=steps, eos_id=eos_id,
@@ -2325,18 +2552,81 @@ class ScoringEngine:
             pad_slice=lambda n: _pad_slice(n, max(n, 1)),
         )
 
+        def feed_extended(chunk):
+            """Extend each item's pristine stage cache by its suffix
+            (formatted demo + next question) via
+            :func:`models.decoder.extend_prefill` and feed the ring —
+            the extend-stages half: stage k's prefill touches only the
+            suffix tokens, the pack-so-far rides the retained cache."""
+            # gather pristine rows source-cache by source-cache (items in
+            # one chunk may descend from different stage batches), then
+            # pad to a common slot width and concatenate in gather order
+            by_src: Dict[int, List[int]] = {}
+            caches: Dict[int, object] = {}
+            for n, (_, _, _, src, _) in enumerate(chunk):
+                caches[id(src[0])] = src[0]
+                by_src.setdefault(id(src[0]), []).append(n)
+            parts, order = [], []
+            width = 0
+            for key, members in by_src.items():
+                idx = jnp.asarray(np.asarray(
+                    [chunk[n][3][1] for n in members], np.int32))
+                part = slots_mod._gather_ring_rows(caches[key], idx)
+                width = max(width, int(part.k.shape[2]))
+                parts.append(part)
+                order.extend(members)
+            parts = [p if int(p.k.shape[2]) == width
+                     else _pad_cache_slots(p, width) for p in parts]
+            cache = slots_mod._concat_caches(parts)
+            # suffix block right-padded to a multiple of 8 so stage
+            # shapes bucket coarsely — every new (T, S) pair is one
+            # extend_prefill compile
+            s_pad = max(8, -(-max(len(chunk[n][4]) for n in order) // 8) * 8)
+            suf = np.zeros((len(order), s_pad), np.int32)
+            mask = np.zeros((len(order), s_pad), np.int32)
+            prefix_lens = np.asarray(
+                [chunk[n][3][2] for n in order], np.int32)
+            for row, n in enumerate(order):
+                sfx = chunk[n][4]
+                suf[row, : len(sfx)] = sfx
+                mask[row, : len(sfx)] = 1
+            with obs.span("extend_prefill", phase="extend_prefill",
+                          batch=len(order), bucket=int(s_pad)):
+                last, ext, total = dmod.extend_prefill(
+                    self.params, self.cfg, cache,
+                    self._put_replicated(suf), self._put_replicated(mask),
+                    jnp.asarray(prefix_lens))
+            plen = _pool_len(int(ext.k.shape[2]))
+            if plen > int(ext.k.shape[2]):
+                ext = _pad_cache_slots(ext, plen)
+            metas = []
+            for row, n in enumerate(order):
+                gi, qi, ids, _, sfx = chunk[n]
+                metas.append(
+                    {"pack": gi, "question": qi, "ids": ids,
+                     "src": (ext, row, int(prefix_lens[row]) + len(sfx))})
+            ring.feed(ext, last, total,
+                      np.zeros((len(order), 2), np.int32), metas)
+            slots_mod.slot_counter("slot_stage_extends", len(order),
+                                   "packed", "packed")
+
         def prefill_stage():
-            """Prefill every ready stage item as one batch and feed the
-            ring (the decode-then-REPACK half: a grown pack's prefill
-            lands its cache row into whatever lane is free)."""
+            """Prefill every ready stage item and feed the ring (the
+            decode-then-REPACK half: a grown pack's prefill lands its
+            cache row into whatever lane is free).  Fresh items (stage
+            0, or extend_stages off) batch through the full prefill;
+            extension items ride :func:`feed_extended`."""
             if not stage_ready:
                 return False
             items, stage_ready[:] = list(stage_ready), []
+            fresh = [it for it in items if it[3] is None]
+            extends = [it for it in items if it[3] is not None]
             pad_id = self.tokenizer.pad_token_id or 0
-            for batch in batching.batches_for_prompts(
-                    [ids for _, _, ids in items], ecfg.batch_size,
+            for batch in (batching.batches_for_prompts(
+                    [ids for _, _, ids, _, _ in fresh], ecfg.batch_size,
                     ecfg.buckets, pad_id=pad_id,
-                    length_sorted=ecfg.length_sorted_batches):
+                    length_sorted=ecfg.length_sorted_batches)
+                    if fresh else ()):
                 last, cache = self._prefill(
                     self._put(batch.token_ids),
                     self._put(batch.attention_mask), batch.bucket_len)
@@ -2352,11 +2642,18 @@ class ScoringEngine:
                 if plen > int(sub.k.shape[2]):
                     sub = _pad_cache_slots(sub, plen)
                 metas = []
-                for m in np.flatnonzero(valid):
-                    gi, qi, ids = items[int(batch.indices[m])]
-                    metas.append({"pack": gi, "question": qi, "ids": ids})
+                for j, m in enumerate(np.flatnonzero(valid)):
+                    gi, qi, ids, _, _ = fresh[int(batch.indices[m])]
+                    meta = {"pack": gi, "question": qi, "ids": ids}
+                    if use_extend:
+                        meta["src"] = (
+                            sub, j, int(batch.attention_mask[m].sum()))
+                    metas.append(meta)
                 ring.feed(sub, last_u, len_u,
                           np.zeros((count, 2), np.int32), metas)
+            step = max(1, int(ecfg.batch_size))
+            for at in range(0, len(extends), step):
+                feed_extended(extends[at: at + step])
             return True
 
         # starvation hook: a freed lane pulls the next READY pack stage

@@ -142,6 +142,60 @@ def k_decode_speedup(decode_k: int, accept: float = K_ACCEPT_PRIOR) -> float:
     p_blk = accept ** (k - 1)
     return k / (p_blk + (1.0 - p_blk) * (1.0 + k))
 
+# -- disaggregated prefill/decode roles (ISSUE 20 — serve/pool.py) ----------
+#: Fraction of a symmetric binary-scoring row's wall time spent in
+#: prefill + the position-0 scan (the remainder is the pooled phase-2
+#: decode leg a ``role="prefill"`` replica never runs).  Shaped from the
+#: r05 phases-block decomposition — the monolithic prefill launch
+#: dominates per-row time once the pool amortizes decode — and a PRIOR
+#: until a roles bench record (``serve_load_pool`` with a
+#: ``prefill:N,decode:M`` roster) measures the split directly.
+PREFILL_PHASE_SHARE = 0.72  # prior: r05 phases-block shape, await roles record
+#: Slot-ring residency gain a ``role="decode"`` specialist sees from
+#: imported KV slabs: its ring refills from the cross-replica handoff
+#: queue instead of stalling on its own prefill, so pool-target
+#: candidates run nearer capacity (the occupancy block's mean-occupancy
+#: tail is the recalibration input).
+DECODE_REFILL_GAIN = 1.08  # prior: occupancy-block tail model, await roles record
+
+
+def role_rate_factor(role: Optional[str], *, prefill_chunk: int = 0,
+                     seq: int = 256, pool_target: int = 0,
+                     decode_k: int = 1) -> float:
+    """Multiplier taking a SYMMETRIC binary-workload rate estimate to a
+    role-specialist estimate (serve/pool.py disaggregation).
+
+    ``"prefill"``: the replica runs only the prefill share of each row,
+    so per-chip row throughput rises by ~1/PREFILL_PHASE_SHARE — but
+    chunk replays now charge against the prefill-only row instead of
+    being diluted by decode time, so chunked candidates separate harder
+    than under symmetric pricing (the ISSUE's "prefill replicas weight
+    chunked-prefill terms").  ``"decode"``: only the decode share, with
+    the slot-refill residency gain on pooled candidates and the full
+    (un-Amdahled) K-decode speedup — a specialist's whole row IS the
+    decode leg.  ``None`` returns 1.0."""
+    if role is None:
+        return 1.0
+    if role == "prefill":
+        replays = 0
+        if prefill_chunk and prefill_chunk < seq:
+            replays = -(-seq // prefill_chunk) - 1
+        # un-apply the symmetric chunk discount, then charge the replay
+        # cost absolutely against the prefill-only row
+        sym = max(0.05, 1.0 - CHUNK_PENALTY * replays)
+        return 1.0 / (sym * (PREFILL_PHASE_SHARE
+                             + CHUNK_PENALTY * replays))
+    if role == "decode":
+        factor = 1.0 / (1.0 - PREFILL_PHASE_SHARE)
+        if pool_target:
+            factor *= DECODE_REFILL_GAIN
+        if decode_k > 1:
+            factor *= k_decode_speedup(decode_k)
+        return factor
+    raise ValueError(
+        f"role must be None, 'prefill', or 'decode': {role!r}")
+
+
 # -- packed batch prompting (ISSUE 10 — scoring/packed.py) ------------------
 #: Mean question tokens of the real perturbation corpus (the bench's own
 #: stderr line: "token lengths mean 104" on the 10k rephrasings at the
@@ -508,6 +562,7 @@ def chosen_plan(ranked: Sequence[PlanCandidate]) -> Optional[PlanCandidate]:
 
 def replica_plan(cfg, quant: str, n_devices: int, workload: str = "binary",
                  seq: int = 256, attention_impl: str = "xla",
+                 role: Optional[str] = None,
                  **kw) -> Optional[PlanCandidate]:
     """Per-REPLICA operating point for the EnginePool (serve/pool.py):
     search this replica's own mesh slice (``n_devices`` = the devices
@@ -515,11 +570,37 @@ def replica_plan(cfg, quant: str, n_devices: int, workload: str = "binary",
     candidate — batch / kv-dtype / prefill-chunk / pool-target priced
     for the slice instead of inherited from fleet-wide flags.  None
     when nothing fits the slice's budget (the caller keeps its
-    hand-configured EngineConfig and says so)."""
+    hand-configured EngineConfig and says so).
+
+    ``role`` re-ranks the fitting candidates by the role-specialist
+    rate (:func:`role_rate_factor`): a ``"prefill"`` replica's plan
+    weights chunked-prefill terms harder, a ``"decode"`` replica's
+    weights slot-refill and K-decode terms — the returned candidate
+    carries the adjusted prediction and a ``[role=...]`` reason tag so
+    the health doc's plan note says what was priced."""
     ranked = search_plans(cfg, quant, n_devices, seq=seq,
                           workload=workload,
                           attention_impl=attention_impl, **kw)
-    return chosen_plan(ranked)
+    if role is None:
+        return chosen_plan(ranked)
+    fit = [c for c in ranked if c.fits]
+    if not fit:
+        return None
+
+    def adjusted(c: PlanCandidate) -> float:
+        return c.predicted_rows_per_s * role_rate_factor(
+            role, prefill_chunk=c.prefill_chunk, seq=seq,
+            pool_target=c.pool_target, decode_k=c.decode_k)
+
+    fit.sort(key=lambda c: (
+        -adjusted(c), c.model, c.pipe, c.pool_target,
+        c.kv_dtype != "bf16", c.prefill_chunk, c.packing, c.decode_k,
+        -c.batch, c.reason))
+    best = fit[0]
+    return dataclasses.replace(
+        best, predicted_rows_per_s=adjusted(best),
+        reason=f"{best.reason} [role={role} "
+               f"x{role_rate_factor(role, prefill_chunk=best.prefill_chunk, seq=seq, pool_target=best.pool_target, decode_k=best.decode_k):.2f}]")
 
 
 def plan_search_record(ranked: Sequence[PlanCandidate], top: int = 8,

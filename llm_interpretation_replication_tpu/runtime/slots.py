@@ -57,10 +57,12 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from . import strict
 from ..models import decoder as dmod
 from ..utils.telemetry import record_counter
 
-__all__ = ["OccupancyStats", "SlotRing", "SlotRow", "slot_counter",
+__all__ = ["KVSlab", "OccupancyStats", "SlotRing", "SlotRow",
+           "slab_from_device", "slab_to_device", "slot_counter",
            "merge_occupancy", "occupancy_block"]
 
 
@@ -209,6 +211,94 @@ class _PendingGroup:
 
     def remaining(self) -> int:
         return len(self.metas) - self.taken
+
+
+@dataclasses.dataclass
+class KVSlab:
+    """Host-side snapshot of prefilled-but-undecided cache rows — the
+    cross-replica handoff unit of the disaggregated fleet.
+
+    A prefill-specialist replica finishes chunked prefill, resolves the
+    position-0 rows, and exports the survivors as one slab per prefill
+    batch; a decode-specialist replica imports the slab straight into its
+    ring's pending queue (:meth:`SlotRing.feed` takes exactly these
+    parts).  Everything is host ``np`` arrays: the slab crosses replica
+    (and eventually host) boundaries, so it must not pin the exporter's
+    devices.  bf16 K/V round-trip bit-exactly through ``ml_dtypes``
+    numpy; int8 slabs carry codes AND per-head scales (the
+    ``cache_kv_map`` layout), so the import decodes to the identical
+    values — the PARITY.md "Cross-replica KV handoff" class.
+
+    ``metas``/``row_ids``/``last``/``lens`` ride along so the importer
+    can feed the ring without re-touching the prompt text; ``length`` is
+    the cache's scalar slots-filled-so-far."""
+
+    k: np.ndarray                    # [L, m, T, Nkv, D]
+    v: np.ndarray
+    positions: np.ndarray            # [m, T] int32
+    valid: np.ndarray                # [m, T] bool
+    length: int                      # scalar slots filled (KVCache.length)
+    last: np.ndarray                 # [m, ...] last-position logits/reduced
+    lens: np.ndarray                 # [m] int32 real lengths
+    row_ids: np.ndarray              # [m, 2] int32 yes/no target ids
+    metas: List[Dict]                # per-row ring metadata
+    k_scale: Optional[np.ndarray] = None   # [L, m, T, Nkv] fp32 (int8 only)
+    v_scale: Optional[np.ndarray] = None
+
+    def rows(self) -> int:
+        return len(self.metas)
+
+    def nbytes(self) -> int:
+        out = 0
+        for a in (self.k, self.v, self.positions, self.valid, self.last,
+                  self.lens, self.row_ids, self.k_scale, self.v_scale):
+            if a is not None:
+                out += int(np.asarray(a).nbytes)
+        return out
+
+
+def slab_from_device(cache, last, lens, row_ids, metas) -> KVSlab:
+    """Materialize gathered ring rows into a host :class:`KVSlab`.
+
+    The fetch is SANCTIONED (runtime/strict.py): export is an explicit
+    transfer point of the handoff protocol, not an accidental sync, so
+    strict mode's ``blocked_transfers == 0`` contract holds across a
+    disaggregated run."""
+    with strict.sanctioned_fetch():
+        fetched = jax.device_get(
+            (cache.k, cache.v, cache.positions, cache.valid, cache.length,
+             cache.k_scale, cache.v_scale, last, lens))
+    k, v, positions, valid, length, ks, vs, last_h, lens_h = fetched
+    return KVSlab(
+        k=np.asarray(k), v=np.asarray(v),
+        positions=np.asarray(positions, np.int32),
+        valid=np.asarray(valid, bool),
+        length=int(length),
+        last=np.asarray(last_h),
+        lens=np.asarray(lens_h, np.int32),
+        row_ids=np.asarray(row_ids, np.int32),
+        metas=list(metas),
+        k_scale=None if ks is None else np.asarray(ks),
+        v_scale=None if vs is None else np.asarray(vs),
+    )
+
+
+def slab_to_device(slab: KVSlab, put=jnp.asarray):
+    """Rebuild ``(cache, last, lens, row_ids, metas)`` — the
+    :meth:`SlotRing.feed` argument tuple — from a host slab.  ``put``
+    is the importing engine's placement function (``ScoringEngine._put``
+    -less sharding: the decode replica passes a closure that lands
+    arrays on ITS mesh slice; the default is plain ``jnp.asarray``)."""
+    cache = dmod.KVCache(
+        k=put(slab.k), v=put(slab.v),
+        positions=put(np.asarray(slab.positions, np.int32)),
+        valid=put(np.asarray(slab.valid, bool)),
+        length=jnp.asarray(slab.length, jnp.int32),
+        k_scale=None if slab.k_scale is None else put(slab.k_scale),
+        v_scale=None if slab.v_scale is None else put(slab.v_scale),
+    )
+    return (cache, put(slab.last), put(np.asarray(slab.lens, np.int32)),
+            np.asarray(slab.row_ids, np.int32), list(slab.metas))
 
 
 @functools.partial(jax.jit, static_argnames=("out_len",))

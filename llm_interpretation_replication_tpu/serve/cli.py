@@ -328,6 +328,7 @@ def main(engine, args) -> int:
         max_wait_s=args.max_wait_ms / 1000.0,
         queue_capacity=args.queue_capacity,
         default_timeout_s=args.timeout_s,
+        slot_admission=not getattr(args, "no_slot_admission", False),
     )
     replicas = getattr(args, "pool_replicas", 0) or 0
     pool = None

@@ -52,12 +52,13 @@ class SchedulerConfig:
     #: COMPATIBLE requests are admitted into vacated decode slots
     #: MID-DECODE (the ring's starvation hook polls the queue between
     #: chunks) instead of waiting for the next coalescer boundary.
-    #: Default OFF: the slotted chunk schedule moves multi-chunk score
-    #: fields within the chunked-prefill fp32 class, and the replay
-    #: harness's default contract is BIT parity with offline
-    #: ``score_prompts`` — turn this on when occupancy beats the last
-    #: ulp (PARITY.md "Decode-then-repack").
-    slot_admission: bool = False
+    #: Default ON since the replay harness pinned slotted-vs-offline
+    #: BIT parity (tests/test_slots.py; PARITY.md "Decode-then-repack")
+    #: — occupancy is free once parity holds, and the disaggregated
+    #: fleet's decode replicas NEED near-full rings to earn their role.
+    #: ``--no-slot-admission`` (bench/serve CLI) is the escape hatch
+    #: back to coalescer-boundary launches for A/B comparison.
+    slot_admission: bool = True
     #: Prometheus labels stamped onto this scheduler's ``serve_*``
     #: counters / sample rings / latency histograms IN ADDITION to the
     #: unlabeled family (which stays the fleet-wide aggregate) — the

@@ -46,6 +46,16 @@ re-enters its model queue and is re-dispatched — the pool's
 always-answered contract: every admitted request resolves with a row or
 a typed error, never silently dropped.
 
+Disaggregation (ROADMAP item 1): replicas loaded with
+``role="prefill"``/``role="decode"`` split the phases across the fleet
+— prefill specialists run chunked prefill + the position-0 scan and
+ship undecided rows' int8/bf16 KV slabs (:class:`~..runtime.slots.KVSlab`)
+to decode specialists, whose slot rings import them mid-flight and stay
+near-full.  The router's role affinity keeps fresh prompts off decode
+chips unless nothing else is live, and every replica may own a REAL
+mesh slice (``devices=`` from :func:`~..parallel.mesh.carve_slices`)
+instead of time-slicing one default mesh.
+
 Measurement-only routing (PARITY.md): the pool changes WHERE and WHEN a
 row is computed, never WHAT — local replica rows are bit-identical to
 the same engine's offline ``score_prompts`` (tests/test_pool.py pins
@@ -199,9 +209,17 @@ class _BaseReplica:
 
     kind = "local"
 
-    def __init__(self, rid: str, model: str):
+    def __init__(self, rid: str, model: str,
+                 role: Optional[str] = None):
         self.rid = rid
         self.model = model
+        #: disaggregation role (ROADMAP item 1b): None = general (serves
+        #: everything), "prefill" = runs prefill + position-0 scan and
+        #: hands undecided KV slabs off, "decode" = imports slabs into
+        #: its slot ring; fresh prompts route to it only when no
+        #: prefill/general sibling is live (always-answered beats role
+        #: purity).
+        self.role = role
         self.state = "live"            # live | draining | closed
         self.outstanding = 0           # dispatched, not yet resolved
         self.completed = 0
@@ -242,6 +260,8 @@ class _BaseReplica:
             "failed": self.failed,
             "latency_ewma_ms": round(self.latency_ewma_s * 1000.0, 3),
         }
+        if self.role is not None:
+            doc["role"] = self.role
         age = self.oldest_wait_s()
         if age is not None:
             doc["oldest_wait_s"] = round(age, 3)
@@ -258,17 +278,33 @@ class LocalReplica(_BaseReplica):
     :class:`Scheduler`.  ``owns_engine`` controls whether unload calls
     ``engine.close(release_params=True)``: replicas sharing one param
     tree (bench fleets over a single snapshot) release buffers only when
-    the LAST sibling unloads."""
+    the LAST sibling unloads.
+
+    ``devices`` binds the replica's engine to a REAL mesh slice (a
+    contiguous run from :func:`~..parallel.mesh.carve_slices`): the
+    engine's params are ``device_put`` onto the slice before the
+    scheduler starts, so the replica owns its chips instead of N
+    replicas time-slicing one default mesh.  On the CPU harness the
+    carver degenerates to shared placement (every slice = all devices)
+    and the health doc says so."""
 
     def __init__(self, rid: str, model: str, engine,
                  config: SchedulerConfig, owns_engine: bool = True,
                  plan_note: Optional[str] = None,
-                 share_group: Optional[ParamShareGroup] = None):
-        super().__init__(rid, model)
+                 share_group: Optional[ParamShareGroup] = None,
+                 role: Optional[str] = None,
+                 devices=None):
+        super().__init__(rid, model, role=role)
         self.engine = engine
         self.owns_engine = owns_engine
         self.share_group = share_group
         self.plan_note = plan_note
+        self.devices = None if devices is None else tuple(devices)
+        if self.devices:
+            from ..parallel import mesh as mesh_mod
+
+            engine.bind_mesh(mesh_mod.make_mesh(
+                data=len(self.devices), devices=list(self.devices)))
         cfg = dataclasses.replace(
             config, metric_labels={**(config.metric_labels or {}),
                                    "replica": rid, "model": model})
@@ -306,6 +342,15 @@ class LocalReplica(_BaseReplica):
         doc = super().health(max_age_s)
         if self.plan_note:
             doc["plan"] = self.plan_note
+        if self.devices is not None:
+            doc["devices"] = len(self.devices)
+            # the CPU-harness carver hands every slice the full device
+            # list; flag it so a health reader never mistakes the
+            # degenerate placement for a real slice
+            import jax
+
+            doc["placement"] = ("shared" if len(self.devices)
+                                >= len(jax.devices()) else "sliced")
         return doc
 
 
@@ -556,7 +601,8 @@ class EnginePool:
              owns_engine: bool = True,
              plan_note: Optional[str] = None,
              share_group: Optional[ParamShareGroup] = None,
-             plan=None) -> LocalReplica:
+             plan=None, role: Optional[str] = None,
+             devices=None) -> LocalReplica:
         """Hot-add a local replica — traffic already queued for
         ``model`` starts draining onto it on the next router tick; no
         other replica pauses.  ``share_group`` refcounts a param tree
@@ -565,7 +611,20 @@ class EnginePool:
         :func:`~..runtime.plan_search.replica_plan` candidate) applies
         the searched operating point to THIS replica's engine config
         (:func:`replica_engine_config`) and doubles as its health-doc
-        plan note."""
+        plan note.
+
+        ``role`` splits the fleet into prefill/decode specialists
+        (``None`` = general): a ``"prefill"`` replica's scheduler gets a
+        handoff hook that ships finished int8/bf16 KV slabs to the
+        least-loaded live ``"decode"`` sibling of the same model, whose
+        slot ring imports them mid-flight; when no decode sibling is
+        live the prefill replica decodes locally (always-answered beats
+        role purity).  ``devices`` pins the replica to a mesh slice
+        (:func:`~..parallel.mesh.carve_slices`) before its scheduler
+        starts."""
+        if role not in (None, "prefill", "decode"):
+            raise ValueError(
+                f"role must be None, 'prefill', or 'decode': {role!r}")
         if plan is not None:
             engine.ecfg = replica_engine_config(engine.ecfg, plan)
             plan_note = plan_note or plan.reason
@@ -579,7 +638,10 @@ class EnginePool:
                                    self._sched_template,
                                    owns_engine=owns_engine,
                                    plan_note=plan_note,
-                                   share_group=share_group)
+                                   share_group=share_group,
+                                   role=role, devices=devices)
+            if role == "prefill":
+                replica.scheduler.handoff = self._make_handoff(replica)
             self._replicas[rid] = replica
             self._known_models.add(model)
             self._queues.setdefault(model, collections.deque())
@@ -704,9 +766,15 @@ class EnginePool:
         latency_weight x predicted wait (observed-latency EWMA x (1 +
         outstanding + queued)) + cost_weight x estimated USD x the
         configured exchange rate.  Local replicas cost $0, so the cost
-        term is pure vendor-spill pressure."""
+        term is pure vendor-spill pressure.
+
+        Role affinity rides on top: fresh prompts prefer prefill/general
+        replicas — a ``"decode"`` specialist's chips are reserved for
+        handed-off slabs and selected only when no other sibling is live
+        (always-answered fallback, counted as ``pool_decode_fallback``)."""
         cfg = self.config
         best, best_score = None, None
+        decode_best, decode_best_score = None, None
         for replica in self._replicas.values():
             if replica.model != model or replica.state != "live":
                 continue
@@ -716,9 +784,49 @@ class EnginePool:
             score = (cfg.latency_weight * replica.predicted_wait_s()
                      + cfg.cost_weight * replica.cost_estimate_usd(request)
                      * cfg.cost_scale_s_per_usd)
+            if getattr(replica, "role", None) == "decode":
+                if decode_best_score is None or score < decode_best_score:
+                    decode_best, decode_best_score = replica, score
+                continue
             if best_score is None or score < best_score:
                 best, best_score = replica, score
+        if best is None and decode_best is not None:
+            record_counter("pool_decode_fallback")
+            return decode_best
         return best
+
+    def _make_handoff(self, source: LocalReplica):
+        """Build the prefill→decode slab-shipping hook installed on a
+        ``"prefill"`` replica's scheduler (``scheduler.handoff``).
+
+        Called on the PREFILL replica's scheduler loop thread with
+        ``(slab, tickets, launch_t)``; picks the least-loaded live
+        ``"decode"`` sibling of the same model under the pool lock, then
+        submits OUTSIDE it (``submit_slab`` only touches the target's
+        own locks, so no lock cycle with the router).  Returns False —
+        prefill decodes locally — when no decode sibling accepts; a
+        ``SchedulerClosed`` bounce tries the next candidate, mirroring
+        the router's always-answered re-dispatch."""
+
+        def handoff(slab, tickets, launch_t) -> bool:
+            with self._lock:
+                cands = sorted(
+                    (r for r in self._replicas.values()
+                     if r is not source and r.model == source.model
+                     and r.state == "live"
+                     and getattr(r, "role", None) == "decode"
+                     and isinstance(r, LocalReplica)),
+                    key=lambda r: r.predicted_wait_s())
+            for target in cands:
+                try:
+                    target.scheduler.submit_slab(slab, tickets, launch_t)
+                except SchedulerClosed:
+                    continue
+                record_counter("pool_slab_handoffs")
+                return True
+            return False
+
+        return handoff
 
     def _route_loop(self) -> None:
         while True:

@@ -88,6 +88,14 @@ class RequestQueue:
             self._closed = True
             self._cond.notify_all()
 
+    def wake(self) -> None:
+        """Nudge a blocked ``pop_group`` so it re-checks its caller's
+        ``ready_fn`` — how the decode-role scheduler learns a KV slab
+        landed while its loop sat in the empty-queue wait (slab work must
+        run ON the loop thread; the engine is single-threaded)."""
+        with self._cond:
+            self._cond.notify_all()
+
     def drain(self) -> List[Ticket]:
         """Remove and return EVERY queued ticket without closing the
         queue.  The supervisor's failover path (serve/supervisor.py)
@@ -129,11 +137,18 @@ class RequestQueue:
             return out
 
     def pop_group(self, max_batch: int, max_wait_s: float,
-                  now_fn=time.monotonic
+                  now_fn=time.monotonic, ready_fn=None
                   ) -> Tuple[Optional[List[Ticket]], List[Ticket]]:
         """``(group, expired)``: the next launchable micro-batch plus the
         tickets whose deadline passed while queued.  ``group`` is ``None``
-        exactly when the queue is closed and drained."""
+        exactly when the queue is closed and drained.
+
+        ``ready_fn`` is the out-of-band work probe (paired with
+        :meth:`wake`): when it returns true the pop yields ``([],
+        expired)`` immediately so the loop thread can service that work —
+        an EMPTY group, distinct from the closed ``None`` — and the held
+        head ticket keeps its enqueue-time-based max-wait accounting on
+        the next call."""
         expired: List[Ticket] = []
         with self._cond:
             while True:
@@ -142,6 +157,8 @@ class RequestQueue:
                 for t in self._items:
                     (expired if t.expired(now) else live).append(t)
                 self._items = live
+                if ready_fn is not None and ready_fn():
+                    return [], expired
                 if not live:
                     if self._closed:
                         return None, expired

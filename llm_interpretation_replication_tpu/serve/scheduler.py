@@ -98,6 +98,16 @@ def labeled_metric(name: str, labels) -> str:
         f"{k}={v}" for k, v in sorted(labels.items()))
 
 
+def _entry_k(entry):
+    """decode_k class of one slab-intake entry ``(slab, tickets,
+    launch_t)`` — slabs are single-K by construction (the prefill side's
+    coalescer key includes decode_k), so the first ticket speaks for
+    all."""
+    tickets = entry[1]
+    return (getattr(tickets[0].request, "decode_k", None)
+            if tickets else None)
+
+
 class Scheduler:
     """Continuous-batching front door for one resident :class:`ScoringEngine`.
 
@@ -124,6 +134,20 @@ class Scheduler:
         # build it once, not per event in the per-request hot path
         self._label_suffix = (
             labeled_metric("", self._labels) if self._labels else "")
+        #: prefill→decode slab transfer hook, installed by the EnginePool
+        #: on PREFILL-role replicas (serve/pool.py): called as
+        #: ``handoff(slab, tickets, launch_t)`` and returns True when a
+        #: decode-role sibling accepted the slab.  None (the default)
+        #: keeps every launch fully local — single-engine schedulers and
+        #: symmetric pools never take the handoff branch.
+        self.handoff = None
+        # decode-role intake: slabs handed off BY prefill siblings, each
+        # entry ``(slab, tickets, launch_t)``.  Appended from the
+        # prefill replica's loop thread, drained on THIS loop thread
+        # (the engine's single-thread contract), with queue.wake()
+        # nudging pop_group's ready_fn probe in between.
+        self._slabs: List = []
+        self._slab_lock = threading.Lock()
 
     # -- telemetry (labeled twin per metric when metric_labels is set) ---
 
@@ -180,6 +204,15 @@ class Scheduler:
                 self._reject(t, SchedulerClosed(
                     "scheduler shut down before the request launched"),
                     counter="serve_rejected_closed")
+        # slabs that landed after the loop exited get a typed rejection,
+        # same contract as the queued leftovers above
+        with self._slab_lock:
+            leftovers, self._slabs = self._slabs, []
+        for _slab, tickets, _t in leftovers:
+            for t in tickets:
+                self._reject(t, SchedulerClosed(
+                    "decode replica shut down before its handed-off slab "
+                    "decoded"), counter="serve_rejected_closed")
         # the prefix pool's close() is idempotent (safe double-close): the
         # engine already closed it per call; closing again here only sweeps
         # leak accounting from a launch that died mid-flight
@@ -237,13 +270,34 @@ class Scheduler:
     def submit_many(self, requests) -> List[ScoreFuture]:
         return [self.submit(r) for r in requests]
 
+    def submit_slab(self, slab, tickets, launch_t=None) -> None:
+        """Accept a handed-off KV slab (decode-role side of the
+        disaggregated fleet): a PREFILL sibling's scheduler calls this —
+        via the pool's handoff closure — with the slab, the tickets whose
+        rows it carries (slab-meta order), and the prefill launch start
+        for latency attribution.  The slab decodes on THIS scheduler's
+        loop thread (the engine's single-thread contract); this call just
+        enqueues and wakes the loop.  Raises :class:`SchedulerClosed`
+        after shutdown so the caller can pick another sibling or decode
+        locally — never silently drops."""
+        with self._slab_lock:
+            if self._closed:
+                raise SchedulerClosed("scheduler is shut down")
+            self._slabs.append((slab, tickets, launch_t))
+        self._counter("serve_slab_received")
+        self.queue.wake()
+
     # -- scheduler loop --------------------------------------------------
+
+    def _slabs_ready(self) -> bool:
+        return bool(self._slabs)
 
     def _loop(self) -> None:
         while True:
             t_pop = time.monotonic()
             group, expired = self.queue.pop_group(
-                self._max_batch(), self.config.max_wait_s)
+                self._max_batch(), self.config.max_wait_s,
+                ready_fn=self._slabs_ready)
             hold_start = None
             if group:
                 # the admission window: how long the loop held the head
@@ -262,6 +316,11 @@ class Scheduler:
                     f"deadline passed {time.monotonic() - t.deadline:.3f}s "
                     f"before the micro-batch launched"),
                     counter="serve_rejected_deadline")
+            # handed-off slabs decode BEFORE the next launch: their rows
+            # are mid-request (prefill already paid elsewhere), so they
+            # are the closest-to-done work this thread holds
+            if self._slabs_ready():
+                self._drain_slabs()
             if group is None:
                 return          # closed and drained
             if group:
@@ -301,6 +360,164 @@ class Scheduler:
         ctx = getattr(self.engine, "config_overrides", None)
         return ctx(**ov) if ctx is not None else contextlib.nullcontext()
 
+    def _finish_ticket(self, t: Ticket, row, launch_t: float,
+                       done: float) -> None:
+        """Resolve one ticket with its row plus the four-phase timing
+        anatomy (the handoff paths' twin of ``_launch``'s inline fan-out:
+        ``serve_engine`` spans the prefill launch on the EXPORTING
+        replica through decode completion here — the handoff transfer is
+        engine time, not respond time)."""
+        if t.trace_id is not None:
+            row = dict(row)
+            row["trace_id"] = t.trace_id
+        t_set = time.monotonic()
+        timing = {
+            "e2e_ms": (t_set - t.enqueue_t) * 1000.0,
+            "queue_wait_ms": (t.queue_wait_s or 0.0) * 1000.0,
+            "coalesce_ms": (t.coalesce_s or 0.0) * 1000.0,
+            "serve_engine_ms": (done - launch_t) * 1000.0,
+            "respond_ms": (t_set - done) * 1000.0,
+        }
+        self._hist(HIST_E2E, timing["e2e_ms"])
+        self._hist(HIST_PHASES["queue_wait"], timing["queue_wait_ms"])
+        self._hist(HIST_PHASES["coalesce"], timing["coalesce_ms"])
+        self._hist(HIST_PHASES["serve_engine"], timing["serve_engine_ms"])
+        self._hist(HIST_PHASES["respond"], timing["respond_ms"])
+        t.future.timing = timing
+        t.future._set_result(row)
+
+    def _launch_handoff(self, group: List[Ticket],
+                        launch_t: float) -> None:
+        """Prefill-role launch (disaggregated fleet): run prefill + the
+        position-0 scan HERE, resolve the decided rows, and hand each
+        undecided slab to a decode-role sibling via the pool-installed
+        ``handoff`` closure.  A refused handoff (no decode sibling live,
+        or it closed mid-transfer) decodes the slab locally — the pool's
+        always-answered contract does not depend on roster composition.
+
+        Load accounting caveat (documented, accepted): the pool
+        attributes the full e2e to THIS replica's in-flight leg — the
+        decode sibling's share shows up in its own ``serve_slab_*``
+        counters, not in the router's EWMA."""
+        pair_list = [tuple(t.request.targets) for t in group]
+        prompts = [t.encoded if t.encoded is not None
+                   else t.request.prompt for t in group]
+        try:
+            with self._engine_overrides(group):
+                with obs.span("serve_engine", phase="serve_engine",
+                              batch=len(group),
+                              trace_id=group[0].trace_id):
+                    rows0, slabs = faults.retry_transient(
+                        lambda: self.engine.export_kv_slab(
+                            prompts, targets=pair_list),
+                        self.config.retry_policy, label="serve")()
+        # graftlint: disable=G05 same serve fault boundary as _launch: OOM routes to the split/re-queue ladder, everything else lands typed on the futures
+        except Exception as err:
+            if faults.is_oom(err) and self._split_requeue(group, err):
+                return
+            self._counter("serve_failed", len(group))
+            for t in group:
+                self._reject(t, err)
+            return
+        done = time.monotonic()
+        resolved = 0
+        for t, row in zip(group, rows0):
+            if row is None:
+                continue        # rides out in a slab
+            self._sample("serve_latency_ms",
+                         (done - t.enqueue_t) * 1000.0)
+            self._finish_ticket(t, row, launch_t, done)
+            resolved += 1
+        if resolved:
+            self._counter("serve_completed", resolved)
+        for slab in slabs:
+            tickets = [group[m["orig"]] for m in slab.metas]
+            if self.handoff(slab, tickets, launch_t):
+                self._counter("serve_handoff_rows", len(tickets))
+            else:
+                self._counter("serve_handoff_local", len(tickets))
+                self._decode_slabs([(slab, tickets, launch_t)])
+
+    def _drain_slabs(self) -> None:
+        """Decode every slab the intake holds, one launch per decode_k
+        class (a micro-batch must never mix K values — the same rule the
+        coalescer key enforces on the prefill side)."""
+        while True:
+            with self._slab_lock:
+                batch, self._slabs = self._slabs, []
+            if not batch:
+                return
+            by_k = {}
+            for entry in batch:
+                by_k.setdefault(_entry_k(entry), []).append(entry)
+            for entries in by_k.values():
+                self._decode_slabs(entries)
+
+    def _decode_slabs(self, entries) -> None:
+        """Decode handed-off slabs on the loop thread (decode-role side).
+        The engine's ``admit_fn`` hook pulls same-K slabs that land
+        MID-DECODE straight into vacated ring lanes, so a decode
+        replica's lanes refill from the fleet's handoff stream without
+        draining first."""
+        now = time.monotonic()
+        k_val = _entry_k(entries[0])
+        flat: List = []
+
+        def note(batch):
+            out = []
+            for slab, tickets, launch_t in batch:
+                out.append(slab)
+                lt = launch_t if launch_t is not None else now
+                flat.extend((t, lt) for t in tickets)
+            return out
+
+        slabs = note(entries)
+        base_n = len(flat)
+        admitted_entries: List = []
+
+        def admit():
+            with self._slab_lock:
+                more = [e for e in self._slabs if _entry_k(e) == k_val]
+                for e in more:
+                    self._slabs.remove(e)
+            if not more:
+                return None
+            admitted_entries.extend(more)
+            self._counter("serve_slab_admitted", len(more))
+            return note(more)
+
+        def call():
+            if admitted_entries:
+                # transient RETRY: the re-invoked decode feeds only the
+                # original slabs, so a previous attempt's admissions go
+                # back to the intake (same reasoning as the slotted
+                # launch's requeue)
+                with self._slab_lock:
+                    self._slabs[:0] = admitted_entries
+                admitted_entries.clear()
+                del flat[base_n:]
+            return self.engine.decode_kv_slabs(slabs, admit_fn=admit)
+
+        try:
+            with self._engine_overrides([t for t, _ in flat]):
+                with obs.span("serve_engine", phase="serve_engine",
+                              batch=len(flat),
+                              trace_id=flat[0][0].trace_id):
+                    rows = faults.retry_transient(
+                        call, self.config.retry_policy, label="serve")()
+        # graftlint: disable=G05 same serve fault boundary as _launch: the slab rows' errors land typed on each request's future, nothing re-raises above the loop thread
+        except Exception as err:
+            self._counter("serve_failed", len(flat))
+            for t, _ in flat:
+                self._reject(t, err)
+            return
+        done = time.monotonic()
+        for (t, lt), row in zip(flat, rows):
+            self._sample("serve_latency_ms",
+                         (done - t.enqueue_t) * 1000.0)
+            self._finish_ticket(t, row, lt, done)
+        self._counter("serve_completed", len(flat))
+
     def _launch(self, group: List[Ticket],
                 hold_start: Optional[float] = None) -> None:
         now = time.monotonic()
@@ -329,6 +546,14 @@ class Scheduler:
         targets = (list(first.targets) if len(set(pair_list)) == 1
                    else pair_list)
         admitted: List[Ticket] = []
+
+        if self.handoff is not None and self._slotted_eligible(first):
+            # prefill-role replica of a disaggregated roster: the slotted
+            # contract holding is exactly what makes the rows
+            # slab-exportable (scored binary decode, no prefix pair, no
+            # confidence leg)
+            self._launch_handoff(group, now)
+            return
 
         if self._slotted_eligible(first):
             # slot-level continuous batching (runtime/slots.py): the

@@ -358,6 +358,9 @@ class ReplicaSupervisor:
     # -- failure plumbing (pool lock held) -------------------------------
 
     def _has_sibling(self, replica) -> bool:
+        # any live sibling counts — a decode specialist CAN answer a
+        # fresh prompt in a pinch (always-answered beats role purity);
+        # _pick_sibling still prefers prefill/general capacity
         return any(r is not replica and r.model == replica.model
                    and r.state == "live"
                    for r in self.pool._replicas.values())
@@ -463,7 +466,10 @@ class ReplicaSupervisor:
             new = self.pool.load(model, engine,
                                  owns_engine=replica.owns_engine,
                                  plan_note=replica.plan_note,
-                                 share_group=replica.share_group)
+                                 share_group=replica.share_group,
+                                 role=getattr(replica, "role", None),
+                                 devices=getattr(replica, "devices",
+                                                 None))
         except Exception as err:  # graftlint: disable=G05 rebuild must never crash the supervisor: a failed factory (pool closed, OOM on reload) downgrades to permanent quarantine, recorded below
             if replica.share_group is not None:
                 replica.share_group.release_one()
@@ -570,8 +576,12 @@ class ReplicaSupervisor:
                 self.hedges_launched += 1
 
     def _pick_sibling(self, ticket):
+        """Least-loaded live sibling for a hedge/failover leg, with the
+        router's role affinity: a fresh-prompt leg lands on a decode
+        specialist only when no prefill/general sibling is available."""
         cfg = self.pool.config
         best, best_score = None, None
+        decode_best, decode_best_score = None, None
         for replica in self.pool._replicas.values():
             if (replica is ticket.replica
                     or replica.model != ticket.model
@@ -582,9 +592,13 @@ class ReplicaSupervisor:
                      + cfg.cost_weight
                      * replica.cost_estimate_usd(ticket.request)
                      * cfg.cost_scale_s_per_usd)
+            if getattr(replica, "role", None) == "decode":
+                if decode_best_score is None or score < decode_best_score:
+                    decode_best, decode_best_score = replica, score
+                continue
             if best_score is None or score < best_score:
                 best, best_score = replica, score
-        return best
+        return best if best is not None else decode_best
 
     # -- reporting / lifecycle -------------------------------------------
 

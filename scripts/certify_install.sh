@@ -55,6 +55,15 @@ python -m llm_interpretation_replication_tpu lint contracts
 python -m llm_interpretation_replication_tpu lint --diff
 python -m llm_interpretation_replication_tpu lint contracts --diff
 
+echo "== certify_install: sharded sweep-shell dryrun"
+# ROADMAP item 5 remainder: a tiny run_model_perturbation_sweep on a
+# dp×tp virtual mesh with a resume-skip assertion — must print the
+# 'dryrun sweep OK' line (fresh process: the dryrun pins the platform
+# and virtual device count before any JAX backend initializes)
+cd "$REPO"
+python __graft_entry__.py dryrun-sweep 4 | tee /dev/stderr \
+    | grep -q "dryrun sweep OK"
+
 echo "== certify_install: tier-1 smoke (-m '$SMOKE_MARKER')"
 cd "$REPO/tests"
 JAX_PLATFORMS=cpu python -m pytest -q -m "$SMOKE_MARKER" \

@@ -246,3 +246,20 @@ def test_non_oom_errors_propagate(tmp_path, monkeypatch):
     monkeypatch.setattr(ScoringEngine, "score_prompts", boom)
     with pytest.raises(ValueError, match="unrelated"):
         bench.run_sweep_mode(args, cfg, params)
+
+
+class TestServeLoadRolesSpec:
+    """--serve-load-roles parsing (ISSUE 20): both roles required, fail
+    fast on anything a roster can't mean."""
+
+    def test_parse_roles_spec(self):
+        assert bench._parse_roles_spec("prefill:2,decode:1") == {
+            "prefill": 2, "decode": 1}
+        assert bench._parse_roles_spec(" decode:1 , prefill:1 ") == {
+            "decode": 1, "prefill": 1}
+
+    def test_rejects_incomplete_or_unknown_rosters(self):
+        for bad in ("prefill:2", "decode:3", "draft:1,decode:1",
+                    "prefill:0,decode:1", "prefill:1,decode:0", ""):
+            with pytest.raises(ValueError):
+                bench._parse_roles_spec(bad)

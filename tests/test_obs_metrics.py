@@ -628,3 +628,56 @@ class TestBenchDiff:
             main(["obs", "bench-diff", self.R04, self.R05])
         assert exc.value.code == 0
         assert "120.15" in capsys.readouterr().out
+
+
+class TestBenchDiffPoolRoster:
+    """serve_load_pool is an ALIGNED block (ISSUE 20): rosters key by
+    role composition, so the disaggregated-vs-symmetric knee comparison
+    lands as adjacent verdict rows across rounds."""
+
+    def _rec(self, name, roles=None, sat=20.0, p99=50.0, n=2):
+        entry = {"name": name, "replicas": [{} for _ in range(n)],
+                 "serve_load": {"saturation_rows_per_s": sat,
+                                "rates": [{"latency_ms": {"p99": p99}}]}}
+        if roles:
+            entry["roles"] = roles
+        return {"metric": "rows/sec x", "value": 1.0, "unit": "rows/sec",
+                "serve_load_pool": {"replicas": n,
+                                    "configurations": [entry]}}
+
+    def test_block_is_aligned_not_informational(self):
+        assert "serve_load_pool" in benchdiff.ALIGNED_BLOCKS
+        assert "serve_load_pool" not in benchdiff.INFORMATIONAL_BLOCKS
+
+    def test_roles_roster_tags_by_composition_not_spelling(self):
+        """The tag sorts roles (prefill first), so flag spelling order
+        never splits a series across rounds."""
+        flat = benchdiff.flatten_metrics(self._rec(
+            "roles-decode:1,prefill:1",
+            roles={"decode": 1, "prefill": 1}))
+        key = "pool[prefill:1,decode:1] saturation [rows/sec]"
+        assert flat[key]["value"] == 20.0
+        assert flat["pool[prefill:1,decode:1] p99@top [ms]"][
+            "value"] == 50.0
+        assert flat["pool[prefill:1,decode:1] replicas"]["value"] == 2
+
+    def test_symmetric_roster_tags_by_replica_count(self):
+        flat = benchdiff.flatten_metrics(self._rec("single-model-x2"))
+        assert "pool[symmetric-x2] saturation [rows/sec]" in flat
+        flat3 = benchdiff.flatten_metrics(self._rec("single-model-x3",
+                                                    n=3))
+        assert "pool[symmetric-x3] saturation [rows/sec]" in flat3
+
+    def test_knee_drop_is_a_regression_row(self):
+        roles = {"prefill": 1, "decode": 1}
+        diff = benchdiff.diff_records(
+            [dict(self._rec("roles-a", roles=roles, sat=20.0, p99=50.0),
+                  label="r1"),
+             dict(self._rec("roles-a", roles=roles, sat=10.0, p99=30.0),
+                  label="r2")], threshold_pct=5.0)
+        row = next(r for r in diff["metrics"] if r["key"] ==
+                   "pool[prefill:1,decode:1] saturation [rows/sec]")
+        assert row["verdict"] == "REGRESSION"      # knee fell = worse
+        row99 = next(r for r in diff["metrics"] if r["key"] ==
+                     "pool[prefill:1,decode:1] p99@top [ms]")
+        assert row99["verdict"] == "improved"      # latency fell = better

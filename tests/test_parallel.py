@@ -485,3 +485,66 @@ class TestDistributedBootstrap:
         for i, (rc, out) in enumerate(outs):
             assert rc == 0, f"worker {i} failed:\n{out[-2000:]}"
             assert f"WORKER{i} OK 22.0" in out
+
+
+class TestCarveSlices:
+    """parallel.mesh.carve_slices — the per-replica pod partition of the
+    disaggregated fleet (ISSUE 20)."""
+
+    def test_equal_slices_partition_contiguously(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.parallel.mesh import (
+            carve_slices,
+        )
+
+        slices = carve_slices(2)
+        assert [len(s) for s in slices] == [4, 4]
+        flat = [d for s in slices for d in s]
+        assert flat == list(eight_cpu_devices)     # contiguous, disjoint
+
+    def test_heterogeneous_counts(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.parallel.mesh import (
+            carve_slices,
+        )
+
+        slices = carve_slices(counts=(4, 2, 2))
+        assert [len(s) for s in slices] == [4, 2, 2]
+        assert [d for s in slices for d in s] == list(eight_cpu_devices)
+        with pytest.raises(ValueError):
+            carve_slices(counts=(4, 2))            # doesn't sum to 8
+        with pytest.raises(ValueError):
+            carve_slices(counts=(4, 0, 4))         # empty slice
+
+    def test_indivisible_needs_counts(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.parallel.mesh import (
+            carve_slices,
+        )
+
+        with pytest.raises(ValueError):
+            carve_slices(3)
+        with pytest.raises(ValueError):
+            carve_slices(0)
+
+    def test_fewer_devices_than_slices_degenerates_to_shared(
+            self, eight_cpu_devices):
+        """The CPU-harness shape: more replicas than devices — every
+        slice is the FULL device list (shared placement; replica health
+        reports it so nobody mistakes it for real disaggregation)."""
+        from llm_interpretation_replication_tpu.parallel.mesh import (
+            carve_slices,
+        )
+
+        slices = carve_slices(16)
+        assert len(slices) == 16
+        assert all(s == tuple(eight_cpu_devices) for s in slices)
+        one = eight_cpu_devices[:1]
+        assert carve_slices(2, devices=one) == (tuple(one), tuple(one))
+
+    def test_explicit_device_subset(self, eight_cpu_devices):
+        from llm_interpretation_replication_tpu.parallel.mesh import (
+            carve_slices,
+        )
+
+        slices = carve_slices(2, devices=eight_cpu_devices[:4])
+        assert [len(s) for s in slices] == [2, 2]
+        assert [d for s in slices for d in s] == list(
+            eight_cpu_devices[:4])

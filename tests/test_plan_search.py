@@ -694,3 +694,71 @@ class TestConsoleEntryPoint:
         proc = self._run("plan", "search", "--dryrun", "--no-exec")
         assert proc.returncode == 0, proc.stderr
         assert "plan search dryrun OK" in proc.stderr
+
+
+class TestRolePricing:
+    """Role-specialist operating points for the disaggregated fleet
+    (ISSUE 20): replica_plan(role=...) re-ranks the slice's fitting
+    candidates by the role_rate_factor-adjusted rate."""
+
+    def test_role_coefficients_are_pinned(self):
+        """Provenance pins: both priors carry their anchor comments in
+        source (the lint contracts convention) and these exact values —
+        recalibrate them only against a roles bench record."""
+        from llm_interpretation_replication_tpu.runtime import plan_search
+
+        assert plan_search.PREFILL_PHASE_SHARE == 0.72
+        assert plan_search.DECODE_REFILL_GAIN == 1.08
+
+    def test_role_rate_factor_shapes(self):
+        from llm_interpretation_replication_tpu.runtime.plan_search import (
+            DECODE_REFILL_GAIN,
+            PREFILL_PHASE_SHARE,
+            k_decode_speedup,
+            role_rate_factor,
+        )
+
+        assert role_rate_factor(None) == 1.0
+        # prefill specialist: the symmetric rate divided by the prefill
+        # phase share (no chunking: no replays to charge)
+        assert role_rate_factor("prefill") == pytest.approx(
+            1.0 / PREFILL_PHASE_SHARE)
+        # chunk replays charge ABSOLUTELY against the prefill-only row:
+        # chunked candidates separate harder than under symmetric pricing
+        chunked = role_rate_factor("prefill", prefill_chunk=64, seq=256)
+        assert chunked < role_rate_factor("prefill")
+        # decode specialist: only the decode share, slot-refill gain on
+        # pooled candidates, full K-decode speedup
+        base = 1.0 / (1.0 - PREFILL_PHASE_SHARE)
+        assert role_rate_factor("decode") == pytest.approx(base)
+        assert role_rate_factor("decode", pool_target=320) == \
+            pytest.approx(base * DECODE_REFILL_GAIN)
+        assert role_rate_factor("decode", pool_target=320, decode_k=2) \
+            == pytest.approx(base * DECODE_REFILL_GAIN
+                             * k_decode_speedup(2))
+        with pytest.raises(ValueError):
+            role_rate_factor("draft")
+
+    def test_replica_plan_prices_roles_with_reason_tag(self):
+        from llm_interpretation_replication_tpu.models.config import (
+            BENCH_GEOMETRIES,
+            DecoderConfig,
+        )
+        from llm_interpretation_replication_tpu.runtime.plan_search import (
+            replica_plan,
+        )
+
+        cfg = DecoderConfig(**BENCH_GEOMETRIES["falcon-7b"])
+        sym = replica_plan(cfg, "int8", 1, workload="binary")
+        pre = replica_plan(cfg, "int8", 1, workload="binary",
+                           role="prefill")
+        dec = replica_plan(cfg, "int8", 1, workload="binary",
+                           role="decode")
+        assert sym is not None and pre is not None and dec is not None
+        assert "[role=" not in sym.reason
+        assert "[role=prefill x" in pre.reason
+        assert "[role=decode x" in dec.reason
+        # specialists price ABOVE the symmetric estimate (each runs only
+        # its share of the row)
+        assert pre.predicted_rows_per_s > sym.predicted_rows_per_s
+        assert dec.predicted_rows_per_s > sym.predicted_rows_per_s

@@ -1092,3 +1092,208 @@ class TestBenchPoolServeLoad:
         assert vend["breaker_opened"] is True
         assert vend["breaker_reclosed"] is True
         assert vend["vendor_failures"] >= 1
+
+
+# ---------------------------------------------------------------------------
+# Disaggregated prefill/decode fleet (ISSUE 20)
+# ---------------------------------------------------------------------------
+
+def _role_clone(eng, tok, **kw):
+    """Sibling ScoringEngine over the fixture's param tree, slotted-
+    eligible (decode_completions=False, the serve slot-admission
+    contract)."""
+    import dataclasses
+
+    from llm_interpretation_replication_tpu.runtime.engine import (
+        ScoringEngine,
+    )
+
+    return ScoringEngine(eng.family, eng.cfg, eng.params, tok,
+                         engine_config=dataclasses.replace(
+                             eng.ecfg, decode_completions=False, **kw))
+
+
+class TestDisaggregatedFleet:
+    """Role-split replicas over one pool: prefill specialists export KV
+    slabs through the scheduler handoff hook, decode specialists import
+    them into near-full slot rings; the router learns role affinity on
+    top of least-loaded scoring (ISSUE 20 tentpole)."""
+
+    PROMPTS = [f"Is item {i} a vehicle? Answer Yes or No."
+               for i in range(10)]
+
+    @pytest.fixture(scope="class")
+    def tiny(self):
+        eng, _, tok = _tiny_engine(batch_size=8)
+        return eng, tok
+
+    def test_two_role_pool_handoff_flows_and_rows_bit_identical(
+            self, tiny):
+        """Acceptance: a prefill + decode roster answers every request;
+        the undecided rows' caches really cross replicas (handoff
+        counters balance, requests never route to the decode replica)
+        and every row is BIT-identical to offline score_prompts
+        (PARITY.md "Cross-replica KV handoff")."""
+        eng, tok = tiny
+        telemetry.clear_counters()
+        pool = EnginePool(PoolConfig(scheduler=SchedulerConfig(
+            max_batch=4, max_wait_s=0.02, slot_admission=True)))
+        try:
+            pool.load("tiny", _role_clone(eng, tok), owns_engine=False,
+                      role="prefill")
+            pool.load("tiny", _role_clone(eng, tok), owns_engine=False,
+                      role="decode")
+            futs = [pool.submit(ScoreRequest(prompt=p), model="tiny")
+                    for p in self.PROMPTS]
+            rows = [f.result(timeout=300) for f in futs]
+            docs = {d["role"]: d for d in
+                    (r.health(0) for r in pool.replicas())}
+        finally:
+            pool.close()
+        assert all(r["success"] for r in rows)
+        c = telemetry.counters()
+        assert c.get("pool_slab_handoffs", 0) >= 1
+        assert c.get("serve_handoff_rows", 0) >= 1
+        assert c.get("slot_slab_import_rows", 0) == \
+            c.get("serve_handoff_rows")
+        assert c.get("slot_slab_export_rows", 0) == \
+            c.get("serve_handoff_rows")
+        # role affinity: every request ARRIVED at the prefill replica
+        # (e2e latency attributes to the leg the client submitted to)
+        assert docs["prefill"]["completed"] == len(self.PROMPTS)
+        assert docs["decode"]["completed"] == 0
+        offline = _role_clone(eng, tok).score_prompts(self.PROMPTS)
+        for a, b in zip(rows, offline):
+            assert a["scan_found"] == b["scan_found"]
+            for f in ("yes_prob", "no_prob", "relative_prob",
+                      "first_token_relative_prob"):
+                assert a[f] == b[f], f
+
+    def test_decode_only_pool_still_answers_with_fallback(self):
+        """Always-answered beats role purity: with only decode replicas
+        live, fresh prompts fall back to them and the
+        ``pool_decode_fallback`` counter says the roster is degenerate."""
+        telemetry.clear_counters()
+        with fast_pool() as pool:
+            pool.load("alpha", FakeEngine("fake/alpha-7b"), role="decode")
+            row = pool.submit(ScoreRequest(prompt="Is a kayak a boat?"),
+                              model="alpha").result(timeout=60)
+        assert row["success"]
+        assert telemetry.counter("pool_decode_fallback") >= 1
+
+    def test_router_prefers_non_decode_replicas(self):
+        """Fresh prompts land on the prefill/unroled replica whenever one
+        is live — the decode specialist's queue stays for handoffs."""
+        telemetry.clear_counters()
+        with fast_pool() as pool:
+            pool.load("alpha", FakeEngine("fake/alpha-7b"), role="prefill")
+            pool.load("alpha", FakeEngine("fake/alpha-7b"), role="decode")
+            futs = [pool.submit(ScoreRequest(prompt=f"q{i}"),
+                                model="alpha") for i in range(6)]
+            rows = [f.result(timeout=60) for f in futs]
+            docs = {d["role"]: d for d in
+                    (r.health(0) for r in pool.replicas())}
+        assert all(r["success"] for r in rows)
+        assert docs["prefill"]["completed"] == 6
+        assert docs["decode"]["completed"] == 0
+        assert telemetry.counter("pool_decode_fallback") == 0
+
+    def test_load_rejects_unknown_role(self):
+        with fast_pool() as pool:
+            with pytest.raises(ValueError):
+                pool.load("alpha", FakeEngine("fake/alpha-7b"),
+                          role="draft")
+
+    def test_mesh_slice_binding_and_placement_health(self, tiny,
+                                                     eight_cpu_devices):
+        """Real mesh-slice placement: a replica loaded with a 4-device
+        slice of the 8-device harness binds its engine to THAT mesh
+        (``replica_mesh_bound`` fires), scores through it, and the
+        health doc says ``sliced`` — vs ``shared`` for a full-pod
+        slice (the CPU degenerate placement)."""
+        from llm_interpretation_replication_tpu.parallel import (
+            mesh as mesh_mod,
+        )
+
+        eng, tok = tiny
+        slices = mesh_mod.carve_slices(2, devices=eight_cpu_devices)
+        assert [len(s) for s in slices] == [4, 4]
+        telemetry.clear_counters()
+        with fast_pool() as pool:
+            rep = pool.load("tiny", _role_clone(eng, tok),
+                            owns_engine=False, role="prefill",
+                            devices=slices[0])
+            assert telemetry.counter("replica_mesh_bound") == 1
+            doc = rep.health(0)
+            assert doc["role"] == "prefill"
+            assert doc["devices"] == 4
+            assert doc["placement"] == "sliced"
+            futs = [pool.submit(ScoreRequest(prompt=p), model="tiny")
+                    for p in ["Is a kayak a boat?", "Is tea a soup?"]]
+            rows = [f.result(timeout=300) for f in futs]
+        assert all(r["success"] for r in rows)
+        shared = mesh_mod.carve_slices(1, devices=eight_cpu_devices)
+        with fast_pool() as pool:
+            rep = pool.load("tiny", _role_clone(eng, tok),
+                            owns_engine=False, devices=shared[0])
+            assert rep.health(0)["placement"] == "shared"
+
+    def test_supervisor_threads_role_and_slice_through_rebuild(self):
+        """Source pins (the child-forwarding style): a supervised
+        rebuild reloads the replica with ITS role and device slice, and
+        failover prefers non-decode siblings with decode as the
+        always-answered fallback."""
+        import os
+
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        src = open(os.path.join(
+            repo_root, "llm_interpretation_replication_tpu", "serve",
+            "supervisor.py"), encoding="utf-8").read()
+        assert 'role=getattr(replica, "role", None)' in src
+        assert 'devices=getattr(replica, "devices"' in src
+        assert src.count('getattr(replica, "role", None) == "decode"') \
+            >= 1
+
+    def test_bench_roles_leg_emits_roster_block(self, tmp_path):
+        """Acceptance: ``bench --serve-load --serve-load-roles
+        prefill:1,decode:1`` measures the disaggregated roster through
+        the SAME rate sweep as the symmetric roster — one
+        ``serve_load_pool`` configuration tagged by role composition,
+        replicas carrying role/placement health docs, parity intact."""
+        import bench
+        import jax as _jax
+        import jax.numpy as jnp
+        from test_bench import TINY, _args
+        from llm_interpretation_replication_tpu.models.decoder import (
+            DecoderConfig,
+        )
+
+        cfg = DecoderConfig(**TINY)
+        params = bench.init_params(cfg, _jax.random.PRNGKey(0),
+                                   jnp.float32)
+        args = _args(tmp_path, batch=8)
+        args.sweep_repeats = 1
+        args.serve_load = True
+        args.serve_load_rates = "auto"
+        args.serve_load_duration = 0.4
+        args.serve_load_seed = 0
+        args.serve_load_replicas = 2
+        args.serve_load_roles = "prefill:1,decode:1"
+        bench.run_sweep_mode(args, cfg, params)
+        block = args.serve_load_pool_report
+        names = [c["name"] for c in block["configurations"]]
+        assert "roles-prefill:1,decode:1" in names
+        entry = next(c for c in block["configurations"]
+                     if c.get("roles"))
+        assert entry["roles"] == {"prefill": 1, "decode": 1}
+        roles = sorted(r.get("role") for r in entry["replicas"])
+        assert roles == ["decode", "prefill"]
+        for r in entry["replicas"]:
+            assert r.get("placement") in ("shared", "sliced")
+        sl = entry["serve_load"]
+        assert len(sl["rates"]) >= 3
+        assert sl["parity_ok"] is True
+        # knee-vs-knee: the symmetric roster at the same replica count
+        # is in the same report for bench-diff to align against
+        assert f"single-model-x{len(entry['replicas'])}" in names

@@ -332,6 +332,28 @@ class TestServeSlotAdmission:
                 np.testing.assert_allclose(a[f], b[f], rtol=2e-5,
                                            atol=1e-9, err_msg=f)
 
+    def test_slot_admission_default_on_with_escape_hatch(self):
+        """Satellite (ISSUE 20): slot admission is the serve DEFAULT now
+        that the replay harness pinned its parity; ``--no-slot-admission``
+        is the escape hatch on every serving entry point."""
+        from llm_interpretation_replication_tpu.serve import (
+            SchedulerConfig,
+        )
+
+        assert SchedulerConfig().slot_admission is True
+        repo_root = os.path.dirname(os.path.dirname(
+            os.path.abspath(__file__)))
+        for rel in ("bench.py",
+                    os.path.join("llm_interpretation_replication_tpu",
+                                 "__main__.py")):
+            src = open(os.path.join(repo_root, rel),
+                       encoding="utf-8").read()
+            assert '"--no-slot-admission"' in src, rel
+        cli = open(os.path.join(
+            repo_root, "llm_interpretation_replication_tpu", "serve",
+            "cli.py"), encoding="utf-8").read()
+        assert 'getattr(args, "no_slot_admission", False)' in cli
+
     def test_confidence_requests_keep_coalescer_path(self, tiny):
         """Eligibility guard: confidence requests never route slotted
         (their replay contract is the pooled-confidence one), even with
@@ -625,6 +647,134 @@ class TestBenchIntegration:
             "__main__.py"), encoding="utf-8").read()
         assert '"--slot-repack"' in cli_src
         assert 'slot_repack=getattr(args, "slot_repack", True)' in cli_src
+
+
+class TestKVSlabHandoff:
+    """Cross-replica KV handoff (ISSUE 20, PARITY.md "Cross-replica KV
+    handoff"): a prefill-specialist engine exports its undecided rows'
+    prompt caches as host KVSlabs; a DIFFERENT engine imports them into
+    its slot ring and decodes to retirement.  The round trip moves
+    bytes, not values — decode-leg rows are bit-identical to the
+    exporter decoding its own cache (bf16), within the int8 class when
+    the slab carries quantized codes + scales."""
+
+    def _merge(self, results, slabs, decoded):
+        """Map decode-side rows (flat feed order) back onto the
+        exporter's prompt indices via the slab metas."""
+        merged = list(results)
+        i = 0
+        for slab in slabs:
+            for m in slab.metas:
+                merged[m["orig"]] = decoded[i]
+                i += 1
+        return merged
+
+    def test_bf16_round_trip_bit_identical_under_strict(self, tiny):
+        """Acceptance: export -> host slab -> import on a FRESH engine,
+        with strict mode active end to end (``blocked_transfers == 0``)
+        — the merged rows are BIT-identical to offline score_prompts,
+        and the export/import telemetry balances."""
+        from llm_interpretation_replication_tpu.runtime import strict
+
+        eng, tok = tiny
+        exporter = _clone(eng, tok, decode_completions=False)
+        importer = _clone(eng, tok, decode_completions=False)
+        telemetry.clear_counters()
+        strict.activate()
+        try:
+            results, slabs = exporter.export_kv_slab(BIN_PROMPTS)
+            assert slabs, "no undecided rows ever shipped"
+            assert all(s.rows() > 0 and s.nbytes() > 0 for s in slabs)
+            assert all(s.k_scale is None and s.v_scale is None
+                       for s in slabs)          # bf16: no scale planes
+            decoded = importer.decode_kv_slabs(slabs)
+        finally:
+            strict.deactivate()
+        c = telemetry.counters()
+        assert c.get(strict.BLOCKED_COUNTER, 0) == 0
+        n = sum(s.rows() for s in slabs)
+        assert len(decoded) == n
+        assert c.get("slot_slab_export_rows", 0) == n
+        assert c.get("slot_slab_import_rows", 0) == n
+        assert c.get("slab_export_bytes", 0) > 0
+        merged = self._merge(results, slabs, decoded)
+        ref = _clone(eng, tok, decode_completions=False).score_prompts(
+            BIN_PROMPTS)
+        for a, b in zip(merged, ref):
+            assert a is not None and a["success"] and b["success"]
+            assert a["scan_found"] == b["scan_found"]
+            for f in EXACT_FIELDS + PROB_FIELDS + ("odds_ratio",):
+                assert a[f] == b[f], f
+
+    def test_int8_slab_carries_scales_within_class(self, tiny):
+        """int8 KV: the slab ships quantized codes AND the per-row scale
+        planes; imported decode stays within the documented int8 class
+        (|delta relative_prob| <= 0.05) of offline int8 scoring."""
+        eng, tok = tiny
+        exporter = _clone(eng, tok, decode_completions=False,
+                          kv_dtype="int8")
+        results, slabs = exporter.export_kv_slab(BIN_PROMPTS)
+        assert slabs
+        assert all(s.k_scale is not None and s.v_scale is not None
+                   for s in slabs)
+        importer = _clone(eng, tok, decode_completions=False,
+                          kv_dtype="int8")
+        decoded = importer.decode_kv_slabs(slabs)
+        merged = self._merge(results, slabs, decoded)
+        ref = _clone(eng, tok, decode_completions=False,
+                     kv_dtype="int8").score_prompts(BIN_PROMPTS)
+        for a, b in zip(merged, ref):
+            assert a is not None and a["success"] and b["success"]
+            assert abs(a["relative_prob"] - b["relative_prob"]) <= 0.05
+
+    def test_admit_fn_feeds_slabs_mid_decode(self, tiny):
+        """The decode replica's mid-decode admission hook: slabs that
+        arrive while earlier slabs are still decoding refill the ring
+        via ``admit_fn`` (not a fresh drain), and no row is orphaned —
+        the fleet handoff-queue shape of serve/scheduler.submit_slab."""
+        eng, tok = tiny
+        exporter = _clone(eng, tok, decode_completions=False)
+        results, slabs = exporter.export_kv_slab(BIN_PROMPTS)
+        assert len(slabs) >= 2, "need >= 2 prefill batches for the hook"
+        rest = list(slabs[1:])
+
+        def admit():
+            return [rest.pop(0)] if rest else []
+
+        importer = _clone(eng, tok, decode_completions=False)
+        decoded = importer.decode_kv_slabs(slabs[:1], admit_fn=admit)
+        assert not rest                    # every queued slab admitted
+        assert len(decoded) == sum(s.rows() for s in slabs)
+        merged = self._merge(results, slabs, decoded)
+        ref = _clone(eng, tok, decode_completions=False).score_prompts(
+            BIN_PROMPTS)
+        for a, b in zip(merged, ref):
+            assert a is not None
+            for f in EXACT_FIELDS:
+                assert a[f] == b[f], f
+
+
+class TestPackedStageExtend:
+    def test_extend_stages_bit_parity_vs_reprefill(self, tiny):
+        """Satellite (ISSUE 20): packed autoregressive demo stages grow
+        the pack by EXTENDING the previous stage's cache
+        (``extend_prefill``) instead of re-prefilling from scratch —
+        demos and packs bit-identical to the re-prefill path, with the
+        ``slot_stage_extends`` counter proving the reuse actually ran."""
+        eng, tok = tiny
+        qs = [f"Is item {i} a vehicle?" for i in range(6)]
+        telemetry.clear_counters()
+        e_ext = _clone(eng, tok, phase2_pool_target=2,
+                       buckets=(32, 64, 128, 256))
+        packs_ext, demos_ext = e_ext.packed_autoregressive_demos(
+            qs, packing=3, max_demo_tokens=4)
+        assert telemetry.counter("slot_stage_extends") > 0
+        e_leg = _clone(eng, tok, phase2_pool_target=2,
+                       buckets=(32, 64, 128, 256))
+        packs_leg, demos_leg = e_leg.packed_autoregressive_demos(
+            qs, packing=3, max_demo_tokens=4, extend_stages=False)
+        assert demos_ext == demos_leg
+        assert packs_ext == packs_leg
 
 
 class TestMixedSlotLengths:
